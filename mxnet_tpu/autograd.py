@@ -1,0 +1,197 @@
+"""Imperative autograd — the early AutogradRuntime, TPU-natively.
+
+The reference records imperative FCompute calls into an NNVM graph and binds
+a GraphExecutor over the tape (src/ndarray/autograd.h:51-115,
+AutogradRuntime::ComputeGradient). Here the tape replays as a pure JAX
+function of the marked variables and gradients come from one whole-tape
+``jax.vjp`` — XLA sees a single differentiable program instead of per-op
+backward kernels.
+
+API mirrors python/mxnet/contrib/autograd.py: set_is_training,
+train_section/test_section, mark_variables, backward / compute_gradient, and
+a convenience ``grad_and_loss``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as onp
+
+__all__ = ["set_is_training", "is_training", "is_recording", "train_section",
+           "test_section", "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "record_op"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "training"):
+        _state.training = False
+        _state.tape = []          # list of _Node
+        _state.node_of = {}       # id(chunk) -> (node, out_idx)
+        _state.marked = {}        # id(chunk) -> (ndarray, grad_ndarray, req)
+    return _state
+
+
+class _Node:
+    __slots__ = ("op", "attrs", "in_refs", "in_vals", "n_out", "octx")
+
+    def __init__(self, op, attrs, in_refs, in_vals, n_out, octx):
+        self.op = op
+        self.attrs = attrs
+        self.in_refs = in_refs      # list of chunk ids
+        self.in_vals = in_vals      # captured values (for constant leaves)
+        self.n_out = n_out
+        self.octx = octx
+
+
+def set_is_training(train_mode):
+    """Toggle training/recording mode; returns previous value."""
+    st = _st()
+    prev = st.training
+    st.training = bool(train_mode)
+    if not train_mode:
+        st.tape = []
+        st.node_of = {}
+    return prev
+
+
+def is_training():
+    return _st().training
+
+
+def is_recording():
+    return _st().training
+
+
+@contextlib.contextmanager
+def train_section():
+    prev = set_is_training(True)
+    try:
+        yield
+    finally:
+        _st().training = prev
+
+
+record = train_section
+
+
+@contextlib.contextmanager
+def test_section():
+    prev = set_is_training(False)
+    try:
+        yield
+    finally:
+        _st().training = prev
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Mark NDArrays as requiring gradient, paired with gradient buffers
+    (MXAutogradMarkVariables)."""
+    st = _st()
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        st.marked[id(var._chunk)] = (var, grad, req)
+
+
+def record_op(op, attrs, inputs, outputs, octx=None):
+    """Called by ndarray.invoke for every imperative op while recording."""
+    st = _st()
+    node = _Node(op, dict(attrs), [id(x._chunk) for x in inputs],
+                 [x._read() for x in inputs], len(outputs), octx)
+    st.tape.append(node)
+    for i, o in enumerate(outputs):
+        st.node_of[id(o._chunk)] = (node, i)
+
+
+def compute_gradient(outputs, out_grads=None, retain_graph=False):
+    """Compute gradients of ``outputs`` w.r.t. every marked variable and
+    write them into the paired gradient buffers (MXAutogradComputeGradient).
+    """
+    import jax
+    import jax.numpy as jnp
+    from .registry import OpContext
+
+    st = _st()
+    marked_ids = list(st.marked.keys())
+    if not marked_ids:
+        raise ValueError("no variables marked for gradient")
+    var_vals = [st.marked[cid][0]._read() for cid in marked_ids]
+    idx_of = {cid: i for i, cid in enumerate(marked_ids)}
+
+    def replay(vars_):
+        memo = {}
+
+        def value_of(cid, fallback):
+            if cid in idx_of:
+                return vars_[idx_of[cid]]
+            if cid in memo:
+                return memo[cid]
+            ent = st.node_of.get(cid)
+            if ent is None:
+                return fallback
+            node, oi = ent
+            ins = [value_of(c, v) for c, v in zip(node.in_refs, node.in_vals)]
+            octx = node.octx or OpContext(is_train=True)
+            res = node.op.fcompute(node.attrs, ins, octx)
+            for k in range(node.n_out):
+                # cache all outputs of this node under their chunk ids
+                for ocid, (n2, oi2) in st.node_of.items():
+                    if n2 is node:
+                        memo[ocid] = res[oi2]
+            return res[oi]
+
+        outs = []
+        for o in outputs:
+            cid = id(o._chunk)
+            outs.append(value_of(cid, o._read()))
+        return outs
+
+    outs, vjp_fn = jax.vjp(lambda v: replay(v), var_vals)
+    if out_grads is None:
+        head = [jnp.ones_like(o) for o in outs]
+    else:
+        head = [g._read() if hasattr(g, "_read") else jnp.asarray(g)
+                for g in out_grads]
+    (grads,) = vjp_fn(tuple(head))
+    for cid, g in zip(marked_ids, grads):
+        _, gbuf, req = st.marked[cid]
+        if req == "null" or gbuf is None:
+            continue
+        if req == "add":
+            gbuf._write(gbuf._read() + g)
+        else:
+            gbuf._write(g)
+    if not retain_graph:
+        st.tape = []
+        st.node_of = {}
+
+
+backward = compute_gradient
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss of ``func``
+    (mirrors contrib.autograd.grad_and_loss)."""
+    import jax
+
+    def wrapped(*args):
+        from .ndarray import NDArray, array
+
+        vals = [a._read() for a in args]
+        argnums = argnum if argnum is not None else tuple(range(len(args)))
+
+        def f(*vs):
+            nds = [NDArray(v, ctx=a.context) for v, a in zip(vs, args)]
+            out = func(*nds)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return sum(o._read().sum() for o in outs)
+
+        g = jax.grad(f, argnums=argnums)(*vals)
+        loss = f(*vals)
+        ctx = args[0].context
+        return [NDArray(x, ctx=ctx) for x in g], NDArray(loss, ctx=ctx)
+
+    return wrapped

@@ -1,0 +1,37 @@
+"""Base types and errors for mxnet_tpu.
+
+TPU-native re-design of the reference's ctypes base layer
+(``python/mxnet/base.py``). There is no C ABI boundary here: the "backend"
+is JAX/XLA, so this module only carries the error type, version, and small
+shared helpers.
+"""
+from __future__ import annotations
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "mx_uint", "mx_float",
+           "__version__"]
+
+# Reference is MXNet 0.9.5 (include/mxnet/base.h:87-93); we version the
+# TPU-native rebuild as 0.9.5+tpu.
+__version__ = "0.9.5+tpu.1"
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu (mirrors mxnet.base.MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int)
+
+# ctypes-era aliases kept so user code doing ``from mxnet.base import mx_uint``
+# keeps importing; they are plain python ints here.
+mx_uint = int
+mx_float = float
+
+
+def check_call(ret):
+    """No-op compatibility shim (there is no C call to check)."""
+    return ret
+
+
+def c_array(ctype, values):  # pragma: no cover - compat shim
+    return list(values)

@@ -1,0 +1,124 @@
+"""Device context model.
+
+TPU-native equivalent of the reference Context (include/mxnet/base.h:117-208):
+``Context{kCPU,kGPU,kCPUPinned} + dev_id``. Here ``gpu``/``tpu`` are the same
+accelerator device type (so reference scripts using ``--gpus`` run unchanged
+with TPU chips), and every Context maps onto a concrete ``jax.Device``.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_devices"]
+
+
+class Context:
+    """Device context. ``Context('tpu', 0)`` / ``mx.tpu(0)`` / ``mx.gpu(0)``.
+
+    Mirrors mxnet.context.Context (python/mxnet/context.py) including use as a
+    ``with`` scope for default-context selection.
+    """
+
+    # dev-type codes follow the reference enum (base.h:121-125); tpu aliases gpu.
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 2}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, "value", None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    # -- JAX mapping -------------------------------------------------------
+    def jax_device(self):
+        """Resolve this Context to a concrete jax.Device.
+
+        cpu -> a jax CPU-backend device; gpu/tpu -> the default accelerator
+        backend's device ``device_id``. When JAX runs CPU-only (tests use an
+        8-device virtual CPU mesh), accelerator contexts map onto CPU devices
+        so multi-device semantics stay testable, matching the reference's
+        trick of testing "multi-device" on multiple cpu contexts
+        (tests/python/unittest/test_model_parallel.py:12-30).
+        """
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned"):
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                devs = jax.devices()
+            return devs[min(self.device_id, len(devs) - 1)]
+        devs = jax.devices()  # default backend: TPU when present, else CPU
+        if self.device_id >= len(devs):
+            raise ValueError(
+                "Context %s out of range: only %d device(s) visible to JAX"
+                % (self, len(devs)))
+        return devs[self.device_id]
+
+
+def cpu(device_id=0):
+    """Return a CPU context (mirrors mx.cpu)."""
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    """Pinned-host context; identical to cpu under XLA (no hipHostMalloc)."""
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator context; on this build an alias for tpu(device_id)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """Return a TPU context."""
+    return Context("tpu", device_id)
+
+
+def num_devices(device_type="tpu"):
+    """Number of visible devices of a type."""
+    import jax
+
+    if device_type in ("cpu", "cpu_pinned"):
+        try:
+            return len(jax.devices("cpu"))
+        except RuntimeError:
+            return 0
+    return len(jax.devices())
+
+
+def current_context():
+    """The thread-local default context (mx.current_context)."""
+    cur = getattr(Context._default_ctx, "value", None)
+    return cur if cur is not None else Context("cpu", 0)

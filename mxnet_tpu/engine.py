@@ -1,0 +1,86 @@
+"""Engine shim — async semantics over the XLA runtime.
+
+The reference's 2,001-LoC dependency engine (src/engine/, ThreadedEnginePer-
+Device) exists because HIP ops are eager and hazard-prone; it toposorts ops by
+NDArray Var read/write dependencies and runs them on per-device thread pools.
+On TPU, JAX's dispatch is already asynchronous (every eager op / jitted call
+returns immediately with a future-backed Array and XLA orders execution by
+data flow), so the engine survives only as this thin layer providing:
+
+* ``waitall`` / per-array ``wait_to_read`` sync points
+  (Engine::WaitForAll/WaitForVar, include/mxnet/engine.h:172-180);
+* a host-side bulk/async push for IO + callbacks (PushAsync's kAsync path);
+* engine-type selection compat (``MXNET_ENGINE_TYPE``): "NaiveEngine" makes
+  every op synchronous, the reference's standard race-bisection tool
+  (src/engine/naive_engine.cc); we honour it by blocking after each op.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+__all__ = ["Engine", "get", "waitall", "is_naive"]
+
+_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def is_naive():
+    return _NAIVE
+
+
+class Engine:
+    """Host-side async executor (bounded worker, FIFO per push order)."""
+
+    _inst = None
+
+    def __init__(self, num_workers=1):
+        self._q = queue.Queue()
+        self._threads = []
+        for _ in range(num_workers):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self):
+        while True:
+            fn, done = self._q.get()
+            try:
+                fn()
+            finally:
+                done.set()
+                self._q.task_done()
+
+    def push_async(self, fn):
+        """Run ``fn`` on a host worker; returns an Event (the Var handle)."""
+        done = threading.Event()
+        if _NAIVE:
+            fn()
+            done.set()
+        else:
+            self._q.put((fn, done))
+        return done
+
+    def wait_for_all(self):
+        self._q.join()
+        import jax
+        try:
+            jax.effects_barrier()
+        except Exception:  # pragma: no cover
+            pass
+        # Block on any outstanding device computation.
+        try:
+            jax.device_put(0).block_until_ready()
+        except Exception:  # pragma: no cover
+            pass
+
+
+def get():
+    if Engine._inst is None:
+        Engine._inst = Engine()
+    return Engine._inst
+
+
+def waitall():
+    """mx.nd.waitall — block until all pending host+device work is done."""
+    get().wait_for_all()

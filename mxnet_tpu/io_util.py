@@ -1,0 +1,38 @@
+"""Image decode helper backing mx.nd.imdecode (src/io/image_io.cc:304)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from .ndarray import NDArray, array
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode an encoded image byte string to NDArray (HWC, BGR like the
+    reference's OpenCV path). Uses cv2 when present, else PIL, else raises.
+    """
+    buf = onp.frombuffer(bytes(str_img), dtype=onp.uint8)
+    img = None
+    try:
+        import cv2
+        flag = 1 if channels == 3 else 0
+        img = cv2.imdecode(buf, flag)
+    except ImportError:
+        try:
+            from PIL import Image
+            import io as _io
+            pil = Image.open(_io.BytesIO(bytes(str_img)))
+            img = onp.asarray(pil)
+            if channels == 3 and img.ndim == 3:
+                img = img[:, :, ::-1]  # RGB -> BGR to match OpenCV
+        except ImportError:
+            raise ImportError("imdecode requires cv2 or PIL")
+    if img is None:
+        raise ValueError("cannot decode image")
+    if mean is not None:
+        img = img.astype(onp.float32) - mean
+    res = array(img.astype(onp.float32))
+    if out is not None:
+        res.copyto(out)
+        return out
+    return res

@@ -1,0 +1,173 @@
+"""KVStore — parameter aggregation API over XLA collectives.
+
+TPU-native replacement for src/kvstore/ (1,139 LoC) + ps-lite. The reference
+builds reduce/broadcast trees over GPU P2P (comm.h CommCPU/CommDevice) and a
+ZMQ parameter server for multi-host (kvstore_dist.h); here
+
+* ``local``/``device``: per-device gradients are summed with jnp adds (XLA
+  emits the all-reduce; on one chip it's a fused sum) and broadcast back by
+  device_put — no staging buffers, no P2P management;
+* ``dist_sync``/``dist_device_sync``/``dist_async``: multi-process sums ride
+  ``parallel.dist`` (jax.distributed + psum over ICI/DCN); on a single
+  process they degrade to ``local`` with rank 0 / size 1 — exactly how the
+  reference's tests exercise dist semantics locally (SURVEY.md §4);
+* the server processes, heartbeats and barrier of ps-lite disappear; the
+  KVStore *API* (init/push/pull/set_optimizer/rank/num_workers/barrier)
+  stays for compatibility (include/mxnet/kvstore.h:26-303).
+
+Reduction order is fixed (ascending device index) so summed results are
+bitwise deterministic, matching the dist_sync test contract
+(tests/nightly/dist_sync_kvstore.py:36-46).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .base import MXNetError
+from . import optimizer as opt
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    return key if isinstance(key, (list, tuple)) else [key]
+
+
+def _val_list(key, value):
+    if isinstance(key, (list, tuple)):
+        assert isinstance(value, (list, tuple)) and len(key) == len(value)
+        return list(value)
+    return [value]
+
+
+class KVStore(object):
+    """Key-value store for data synchronization over devices/hosts."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._barrier_before_exit = True
+        self._compress = "none"
+        if kind.startswith("dist"):
+            from .parallel import dist as _dist
+            self._dist = _dist.get_runtime()
+        else:
+            self._dist = None
+
+    # ------------------------------------------------------------- basics
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return self._dist.rank if self._dist else 0
+
+    @property
+    def num_workers(self):
+        return self._dist.size if self._dist else 1
+
+    def init(self, key, value):
+        """Initialize key(s) with value(s); later push/pull use these keys."""
+        for k, v in zip(_key_list(key), _val_list(key, value)):
+            if k in self._store:
+                raise MXNetError("duplicate init of key %s" % str(k))
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate value(s) into the store (KVStore::Push).
+
+        ``value`` may be a list of per-device NDArrays — they are summed in
+        fixed device order. With an updater set (update_on_kvstore), the
+        updater merges the aggregated gradient into the stored weight;
+        otherwise the aggregate replaces the stored value for ``pull``.
+        """
+        for k, v in zip(_key_list(key), _val_list(key, value)):
+            if isinstance(v, (list, tuple)):
+                merged = v[0].copy()
+                for other in v[1:]:
+                    merged += other.as_in_context(merged.context)
+            else:
+                merged = v.copy()
+            if self._dist is not None:
+                merged = self._dist.allreduce(merged)
+            if k not in self._store:
+                raise MXNetError("please init key %s first" % str(k))
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        """Broadcast stored value(s) to out array(s) (KVStore::Pull)."""
+        assert out is not None
+        for k, o in zip(_key_list(key), _val_list(key, out)):
+            src = self._store.get(k)
+            if src is None:
+                raise MXNetError("please init key %s first" % str(k))
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                src.copyto(t)
+
+    # ---------------------------------------------------------- optimizer
+    def set_optimizer(self, optimizer):
+        """Register an optimizer; in dist mode the reference pickles it to
+        the servers (kvstore.py:set_optimizer) — here every process applies
+        the same deterministic update locally, so we just install it."""
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _send_command_to_servers(self, head, body):  # compat no-op
+        pass
+
+    # -------------------------------------------------------- dist compat
+    def barrier(self):
+        if self._dist is not None:
+            self._dist.barrier()
+
+    def _barrier(self):
+        self.barrier()
+
+    def set_barrier_before_exit(self, barrier_before_exit):
+        self._barrier_before_exit = barrier_before_exit
+
+    @property
+    def num_dead_node(self):
+        return 0
+
+    def get_num_dead_node(self, node_id, timeout=60):
+        """Failure detection (kvstore.h:242): with the PS gone, liveness is
+        the JAX distributed runtime's concern; report via parallel.dist."""
+        if self._dist is not None:
+            return self._dist.num_dead_nodes(timeout)
+        return 0
+
+    def save_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def create(name="local"):
+    """Create a KVStore: local | device | dist_sync | dist_device_sync |
+    dist_async (KVStore::Create, src/kvstore/kvstore.cc:17-45)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "local_allreduce_device",
+             "local_allreduce_cpu", "dist_sync", "dist_device_sync",
+             "dist_async", "dist")
+    if name not in valid:
+        raise MXNetError("unknown KVStore type %s" % name)
+    return KVStore(name)

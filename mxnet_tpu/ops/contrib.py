@@ -1,0 +1,104 @@
+"""Contrib operators (src/operator/contrib/): fft/ifft, count_sketch,
+MultiBox* detection ops, Proposal. Registered under the ``_contrib_`` prefix
+like the reference.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _fft_infer(attrs, in_shapes, aux):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, None, aux
+    return in_shapes, [tuple(d[:-1]) + (d[-1] * 2,)], aux
+
+
+@register("_contrib_fft", attr_types={"compute_size": int},
+          infer_shape=_fft_infer, alias=("fft",))
+def _fft(attrs, ins, octx):
+    """FFT over the last dim; complex output interleaved [re, im] pairs
+    (src/operator/contrib/fft-inl.h) — lax.fft under the hood."""
+    jnp = _jnp()
+    x = ins[0]
+    c = jnp.fft.fft(x.astype("float32"), axis=-1)
+    out = jnp.stack([c.real, c.imag], axis=-1)
+    return [out.reshape(x.shape[:-1] + (x.shape[-1] * 2,)).astype(x.dtype)]
+
+
+def _ifft_infer(attrs, in_shapes, aux):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, None, aux
+    return in_shapes, [tuple(d[:-1]) + (d[-1] // 2,)], aux
+
+
+@register("_contrib_ifft", attr_types={"compute_size": int},
+          infer_shape=_ifft_infer, alias=("ifft",))
+def _ifft(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    pairs = x.reshape(x.shape[:-1] + (x.shape[-1] // 2, 2))
+    c = pairs[..., 0] + 1j * pairs[..., 1]
+    # reference ifft does NOT normalize by N (cuFFT inverse is unscaled)
+    out = jnp.fft.ifft(c, axis=-1) * (x.shape[-1] // 2)
+    return [out.real.astype(x.dtype)]
+
+
+@register("_contrib_count_sketch", arg_names=("data", "h", "s"),
+          attr_types={"out_dim": int, "processing_batch_size": int})
+def _count_sketch(attrs, ins, octx):
+    """Count-sketch projection (src/operator/contrib/count_sketch-inl.h)."""
+    jnp = _jnp()
+    data, h, s = ins
+    out_dim = int(attrs["out_dim"])
+    hh = h.reshape(-1).astype("int32")
+    ss = s.reshape(-1)
+    vals = data * ss[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return [out.at[:, hh].add(vals)]
+
+
+@register("_contrib_MultiBoxPrior", arg_names=("data",),
+          attr_types={"sizes": tuple, "ratios": tuple, "clip": bool,
+                      "steps": tuple, "offsets": tuple})
+def _multibox_prior(attrs, ins, octx):
+    """Anchor-box generation (src/operator/contrib/multibox_prior-inl.h).
+    Output (1, h*w*num_anchors, 4) in normalized corner coords."""
+    jnp = _jnp()
+    x = ins[0]
+    h, w = x.shape[2], x.shape[3]
+    sizes = attrs.get("sizes", (1.0,))
+    ratios = attrs.get("ratios", (1.0,))
+    if isinstance(sizes, float):
+        sizes = (sizes,)
+    if isinstance(ratios, float):
+        ratios = (ratios,)
+    steps = attrs.get("steps", (-1.0, -1.0))
+    offsets = attrs.get("offsets", (0.5, 0.5))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (onp.arange(h) + offsets[0]) * step_y
+    cx = (onp.arange(w) + offsets[1]) * step_x
+    boxes = []
+    # reference enumerates (size_i, ratio_0) then (size_0, ratio_j>0)
+    combos = [(s, ratios[0]) for s in sizes] + \
+             [(sizes[0], r) for r in ratios[1:]]
+    for yy in cy:
+        for xx in cx:
+            for s, r in combos:
+                sr = onp.sqrt(r)
+                bw = s * sr / 2
+                bh = s / sr / 2
+                boxes.append([xx - bw, yy - bh, xx + bw, yy + bh])
+    out = onp.asarray(boxes, dtype=onp.float32)
+    if attrs.get("clip", False):
+        out = onp.clip(out, 0.0, 1.0)
+    return [jnp.asarray(out[None])]

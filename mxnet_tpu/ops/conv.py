@@ -1,0 +1,453 @@
+"""Convolution / pooling / spatial operators — XLA conv path.
+
+The reference's cuDNN(MIOpen) convolution stack (src/operator/convolution-inl.h,
+cudnn_convolution-inl.h, im2col.h/.cuh) collapses into
+``lax.conv_general_dilated``: XLA tiles these onto the MXU directly, replacing
+algo selection + im2col. Layout is NCHW to match the reference's default.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+    return lax
+
+
+def _tup(v, n):
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t
+
+
+def _conv_args(attrs):
+    return ("data", "weight") if attrs.get("no_bias", False) else \
+        ("data", "weight", "bias")
+
+
+def _conv_out_dim(i, k, p, s, d):
+    return (i + 2 * p - d * (k - 1) - 1) // s + 1
+
+
+def _conv_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    nd = len(data) - 2
+    kernel = _tup(attrs["kernel"], nd)
+    stride = _tup(attrs.get("stride", 1), nd)
+    pad = _tup(attrs.get("pad", 0), nd)
+    dilate = _tup(attrs.get("dilate", 1), nd)
+    nf = int(attrs["num_filter"])
+    ng = int(attrs.get("num_group", 1))
+    in_shapes[1] = (nf, data[1] // ng) + kernel
+    if not attrs.get("no_bias", False) and len(in_shapes) > 2:
+        in_shapes[2] = (nf,)
+    out_sp = tuple(_conv_out_dim(data[2 + i], kernel[i], pad[i], stride[i],
+                                 dilate[i]) for i in range(nd))
+    return in_shapes, [(data[0], nf) + out_sp], aux
+
+
+@register("Convolution", arg_names=_conv_args,
+          attr_types={"kernel": tuple, "stride": tuple, "dilate": tuple,
+                      "pad": tuple, "num_filter": int, "num_group": int,
+                      "workspace": int, "no_bias": bool, "cudnn_tune": str,
+                      "cudnn_off": bool, "layout": str},
+          infer_shape=_conv_infer, alias=("Convolution_v1",))
+def _convolution(attrs, ins, octx):
+    lax = _lax()
+    x, w = ins[0], ins[1]
+    nd = x.ndim - 2
+    stride = _tup(attrs.get("stride", 1), nd)
+    pad = _tup(attrs.get("pad", 0), nd)
+    dilate = _tup(attrs.get("dilate", 1), nd)
+    ng = int(attrs.get("num_group", 1))
+    spec = "NCHW"[:2 + nd] if nd <= 2 else "NCDHW"
+    if nd == 1:
+        spec_in, spec_k, spec_out = "NCH", "OIH", "NCH"
+    elif nd == 2:
+        spec_in, spec_k, spec_out = "NCHW", "OIHW", "NCHW"
+    else:
+        spec_in, spec_k, spec_out = "NCDHW", "OIDHW", "NCDHW"
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    (spec_in, spec_k, spec_out))
+    y = lax.conv_general_dilated(
+        x, w, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=ng)
+    if not attrs.get("no_bias", False):
+        b = ins[2]
+        y = y + b.reshape((1, -1) + (1,) * nd)
+    return [y]
+
+
+def _deconv_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    nd = len(data) - 2
+    kernel = _tup(attrs["kernel"], nd)
+    stride = _tup(attrs.get("stride", 1), nd)
+    pad = _tup(attrs.get("pad", 0), nd)
+    adj = _tup(attrs.get("adj", 0), nd)
+    nf = int(attrs["num_filter"])
+    ng = int(attrs.get("num_group", 1))
+    in_shapes[1] = (data[1], nf // ng) + kernel
+    if not attrs.get("no_bias", True) and len(in_shapes) > 2:
+        in_shapes[2] = (nf,)
+    out_sp = tuple((data[2 + i] - 1) * stride[i] - 2 * pad[i] + kernel[i]
+                   + adj[i] for i in range(nd))
+    return in_shapes, [(data[0], nf) + out_sp], aux
+
+
+@register("Deconvolution", arg_names=_conv_args,
+          attr_types={"kernel": tuple, "stride": tuple, "pad": tuple,
+                      "adj": tuple, "target_shape": tuple, "num_filter": int,
+                      "num_group": int, "workspace": int, "no_bias": bool},
+          infer_shape=_deconv_infer)
+def _deconvolution(attrs, ins, octx):
+    """Transposed convolution = conv with lhs dilation
+    (src/operator/deconvolution-inl.h). Weight layout (C_in, C_out/g, k...)."""
+    lax = _lax()
+    jnp = _jnp()
+    x, w = ins[0], ins[1]
+    nd = x.ndim - 2
+    stride = _tup(attrs.get("stride", 1), nd)
+    pad = _tup(attrs.get("pad", 0), nd)
+    adj = _tup(attrs.get("adj", 0), nd)
+    kernel = _tup(attrs["kernel"], nd)
+    ng = int(attrs.get("num_group", 1))
+    # flip spatial dims and swap I/O to express deconv as dilated conv
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    if ng == 1:
+        w_t = jnp.swapaxes(w_flip, 0, 1)  # (C_out, C_in, k...)
+    else:
+        ci, cog = w.shape[0], w.shape[1]
+        w_g = w_flip.reshape((ng, ci // ng, cog) + w.shape[2:])
+        w_t = jnp.swapaxes(w_g, 1, 2).reshape((ng * cog, ci // ng) + w.shape[2:])
+    if nd == 1:
+        specs = ("NCH", "OIH", "NCH")
+    elif nd == 2:
+        specs = ("NCHW", "OIHW", "NCHW")
+    else:
+        specs = ("NCDHW", "OIDHW", "NCDHW")
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, specs)
+    y = lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * nd,
+        padding=[(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
+                 for i in range(nd)],
+        lhs_dilation=stride, dimension_numbers=dn, feature_group_count=ng)
+    if not attrs.get("no_bias", True) and len(ins) > 2:
+        y = y + ins[2].reshape((1, -1) + (1,) * nd)
+    return [y]
+
+
+def _pool_out_dim(i, k, p, s, convention):
+    if convention == "full":
+        return int(onp.ceil(float(i + 2 * p - k) / s)) + 1
+    return (i + 2 * p - k) // s + 1
+
+
+def _pool_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    if attrs.get("global_pool", False):
+        return in_shapes, [tuple(data[:2]) + (1,) * (len(data) - 2)], aux
+    nd = len(data) - 2
+    kernel = _tup(attrs["kernel"], nd)
+    stride = _tup(attrs.get("stride", 1), nd)
+    pad = _tup(attrs.get("pad", 0), nd)
+    conv = attrs.get("pooling_convention", "valid")
+    out_sp = tuple(_pool_out_dim(data[2 + i], kernel[i], pad[i], stride[i],
+                                 conv) for i in range(nd))
+    return in_shapes, [tuple(data[:2]) + out_sp], aux
+
+
+@register("Pooling",
+          attr_types={"kernel": tuple, "stride": tuple, "pad": tuple,
+                      "pool_type": str, "global_pool": bool,
+                      "pooling_convention": str, "cudnn_off": bool},
+          infer_shape=_pool_infer, alias=("Pooling_v1",))
+def _pooling(attrs, ins, octx):
+    """max/avg/sum pooling via lax.reduce_window (src/operator/pooling-inl.h,
+    src/operator/nn/pool.h). avg divides by the full window size including
+    padding, matching mshadow's pool<red::sum>/k behaviour."""
+    lax = _lax()
+    jnp = _jnp()
+    x = ins[0]
+    nd = x.ndim - 2
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        kernel = tuple(x.shape[2:])
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = _tup(attrs["kernel"], nd)
+        stride = _tup(attrs.get("stride", 1), nd)
+        pad = _tup(attrs.get("pad", 0), nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    conv = attrs.get("pooling_convention", "valid")
+    pads = [(0, 0), (0, 0)]
+    for i in range(nd):
+        lo = pad[i]
+        hi = pad[i]
+        if conv == "full":
+            out = _pool_out_dim(x.shape[2 + i], kernel[i], pad[i], stride[i],
+                                "full")
+            need = (out - 1) * stride[i] + kernel[i] - x.shape[2 + i] - lo
+            hi = max(need, 0)
+        pads.append((lo, hi))
+    if ptype == "max":
+        init = -onp.inf if onp.issubdtype(onp.dtype(x.dtype), onp.floating) \
+            else onp.iinfo(onp.dtype(x.dtype)).min
+        y = lax.reduce_window(x, onp.asarray(init, x.dtype), lax.max, window,
+                              strides, pads)
+    else:
+        y = lax.reduce_window(x, onp.asarray(0, x.dtype), lax.add, window,
+                              strides, pads)
+        if ptype == "avg":
+            ksize = 1
+            for k in kernel:
+                ksize *= k
+            y = y / onp.asarray(ksize, x.dtype)
+    return [y]
+
+
+@register("UpSampling", variable_args="num_args",
+          attr_types={"scale": int, "sample_type": str, "num_filter": int,
+                      "multi_input_mode": str, "num_args": int})
+def _upsampling(attrs, ins, octx):
+    """Nearest/bilinear upsampling (src/operator/upsampling-inl.h)."""
+    jnp = _jnp()
+    scale = int(attrs.get("scale", 2))
+    stype = attrs.get("sample_type", "nearest")
+    outs = []
+    for x in ins:
+        if stype == "nearest":
+            y = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        else:
+            import jax
+            y = jax.image.resize(
+                x, x.shape[:2] + (x.shape[2] * scale, x.shape[3] * scale),
+                method="bilinear")
+        outs.append(y)
+    if len(outs) == 1:
+        return outs
+    mode = attrs.get("multi_input_mode", "concat")
+    if mode == "sum":
+        t = outs[0]
+        for o in outs[1:]:
+            t = t + o
+        return [t]
+    return [jnp.concatenate(outs, axis=1)]
+
+
+@register("Pad", attr_types={"mode": str, "pad_width": tuple,
+                             "constant_value": float},
+          alias=("pad",))
+def _pad(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    pw = attrs["pad_width"]
+    pairs = [(int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(x.ndim)]
+    mode = attrs.get("mode", "constant")
+    if mode == "constant":
+        return [jnp.pad(x, pairs, mode="constant",
+                        constant_values=float(attrs.get("constant_value", 0)))]
+    if mode == "edge":
+        return [jnp.pad(x, pairs, mode="edge")]
+    if mode == "reflect":
+        return [jnp.pad(x, pairs, mode="reflect")]
+    raise ValueError("unknown pad mode " + mode)
+
+
+def _crop_args(attrs):
+    return ("data", "crop_like") if int(attrs.get("num_args", 1)) == 2 \
+        else ("data",)
+
+
+def _crop_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    if int(attrs.get("num_args", 1)) == 2 and in_shapes[1] is not None:
+        hw = in_shapes[1][2:]
+    else:
+        hw = _tup(attrs.get("h_w", (0, 0)), 2)
+    return in_shapes, [tuple(data[:2]) + tuple(hw)], aux
+
+
+@register("Crop", arg_names=_crop_args,
+          attr_types={"offset": tuple, "h_w": tuple, "center_crop": bool,
+                      "num_args": int},
+          infer_shape=_crop_infer)
+def _crop_op(attrs, ins, octx):
+    """Spatial crop (src/operator/crop-inl.h)."""
+    x = ins[0]
+    if int(attrs.get("num_args", 1)) == 2:
+        th, tw = ins[1].shape[2], ins[1].shape[3]
+    else:
+        th, tw = _tup(attrs["h_w"], 2)
+    if attrs.get("center_crop", False):
+        oy = (x.shape[2] - th) // 2
+        ox = (x.shape[3] - tw) // 2
+    else:
+        oy, ox = _tup(attrs.get("offset", (0, 0)), 2)
+    return [x[:, :, oy:oy + th, ox:ox + tw]]
+
+
+@register("ROIPooling", arg_names=("data", "rois"),
+          attr_types={"pooled_size": tuple, "spatial_scale": float})
+def _roi_pooling(attrs, ins, octx):
+    """ROI max pooling (src/operator/roi_pooling-inl.h). Computed with a
+    mask-reduction over the feature map per output bin — static shapes for
+    XLA; a Pallas kernel is the planned fast path."""
+    import jax
+    jnp = _jnp()
+    data, rois = ins
+    ph, pw = _tup(attrs["pooled_size"], 2)
+    scale = float(attrs["spatial_scale"])
+    N, C, H, W = data.shape
+
+    ys = jnp.arange(H, dtype=data.dtype)
+    xs = jnp.arange(W, dtype=data.dtype)
+
+    def one_roi(roi):
+        batch = roi[0].astype("int32")
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        fmap = data[batch]  # (C, H, W)
+
+        def one_bin(iy, ix):
+            hstart = jnp.floor(y1 + iy * bin_h)
+            hend = jnp.ceil(y1 + (iy + 1) * bin_h)
+            wstart = jnp.floor(x1 + ix * bin_w)
+            wend = jnp.ceil(x1 + (ix + 1) * bin_w)
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            neg = onp.asarray(-1e30, data.dtype)
+            vals = jnp.where(mask[None], fmap, neg)
+            m = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.any(mask), m, onp.asarray(0, data.dtype))
+
+        iys = jnp.arange(ph)
+        ixs = jnp.arange(pw)
+        bins = jax.vmap(lambda iy: jax.vmap(lambda ix: one_bin(iy, ix))(ixs))(iys)
+        return jnp.transpose(bins, (2, 0, 1))  # (C, ph, pw)
+
+    out = jax.vmap(one_roi)(rois)
+    return [out]
+
+
+@register("GridGenerator", attr_types={"transform_type": str,
+                                       "target_shape": tuple})
+def _grid_generator(attrs, ins, octx):
+    """Affine/warp grid generation (src/operator/grid_generator-inl.h).
+    Output grid in [-1,1] coords, shape (n, 2, h, w)."""
+    jnp = _jnp()
+    ttype = attrs.get("transform_type", "affine")
+    if ttype == "affine":
+        h, w = _tup(attrs["target_shape"], 2)
+        theta = ins[0].reshape((-1, 2, 3))
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.reshape(-1), gy.reshape(-1),
+                            ones.reshape(-1)], axis=0)  # (3, h*w)
+        out = jnp.einsum("nij,jk->nik", theta, coords)  # (n, 2, h*w)
+        return [out.reshape((-1, 2, h, w))]
+    # warp: input is flow (n, 2, h, w) added to identity grid
+    flow = ins[0]
+    n, _, h, w = flow.shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    base = jnp.stack([gx, gy], axis=0)[None]
+    norm = jnp.asarray([(w - 1) / 2.0, (h - 1) / 2.0],
+                       flow.dtype).reshape((1, 2, 1, 1))
+    return [base + flow / norm]
+
+
+def _bilinear_sample(jnp, data, grid):
+    """Sample data (n,c,h,w) at grid (n,2,gh,gw) in [-1,1]; zero padding."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0  # (n, gh, gw)
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        valid = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w))
+        yc = jnp.clip(yy, 0, h - 1).astype("int32")
+        xc = jnp.clip(xx, 0, w - 1).astype("int32")
+        # (n, gh, gw) indices into (n, c, h, w) -> (n, c, gh, gw)
+        bidx = jnp.arange(n).reshape((n, 1, 1))
+        vals = data[bidx, :, yc, xc]  # (n, gh, gw, c)
+        vals = jnp.where(valid[..., None], vals, 0.0)
+        return jnp.transpose(vals, (0, 3, 1, 2))
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    top = v00 * (1 - wx_) + v01 * wx_
+    bot = v10 * (1 - wx_) + v11 * wx_
+    return top * (1 - wy_) + bot * wy_
+
+
+@register("BilinearSampler", arg_names=("data", "grid"))
+def _bilinear_sampler(attrs, ins, octx):
+    """(src/operator/bilinear_sampler-inl.h) — gather-based bilinear warp."""
+    jnp = _jnp()
+    return [_bilinear_sample(jnp, ins[0], ins[1])]
+
+
+def _st_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    in_shapes[1] = (data[0], 6)
+    h, w = _tup(attrs["target_shape"], 2)
+    return in_shapes, [(data[0], data[1], h, w)], aux
+
+
+@register("SpatialTransformer", arg_names=("data", "loc"),
+          attr_types={"target_shape": tuple, "transform_type": str,
+                      "sampler_type": str},
+          infer_shape=_st_infer)
+def _spatial_transformer(attrs, ins, octx):
+    """Affine spatial transformer (src/operator/spatial_transformer-inl.h)."""
+    jnp = _jnp()
+    data, loc = ins
+    h, w = _tup(attrs["target_shape"], 2)
+    theta = loc.reshape((-1, 2, 3))
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    coords = jnp.stack([gx.reshape(-1), gy.reshape(-1),
+                        jnp.ones_like(gx).reshape(-1)], axis=0)
+    grid = jnp.einsum("nij,jk->nik", theta, coords).reshape((-1, 2, h, w))
+    return [_bilinear_sample(jnp, data, grid)]

@@ -1,0 +1,193 @@
+"""Elementwise unary/binary/scalar operators.
+
+Covers the reference's macro-registered elementwise families
+(src/operator/tensor/elemwise_binary_op.cc, elemwise_binary_scalar_op.cc,
+elemwise_unary_op.cc; scalar functors src/operator/mshadow_op.h). Each op is
+one jnp expression — XLA fuses chains of these into single kernels, replacing
+mshadow expression templates.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _unary(name, fn, alias=()):
+    @register(name, alias=alias)
+    def _f(attrs, ins, octx, _fn=fn):
+        return [_fn(_jnp(), ins[0])]
+    _f.__doc__ = "Elementwise %s." % name
+    return _f
+
+
+_UNARY_TABLE = {
+    "abs": lambda jnp, x: jnp.abs(x),
+    "sign": lambda jnp, x: jnp.sign(x),
+    "round": lambda jnp, x: jnp.round(x),
+    "rint": lambda jnp, x: jnp.rint(x),
+    "ceil": lambda jnp, x: jnp.ceil(x),
+    "floor": lambda jnp, x: jnp.floor(x),
+    "fix": lambda jnp, x: jnp.trunc(x),
+    "square": lambda jnp, x: jnp.square(x),
+    "sqrt": lambda jnp, x: jnp.sqrt(x),
+    "rsqrt": lambda jnp, x: 1.0 / jnp.sqrt(x),
+    "exp": lambda jnp, x: jnp.exp(x),
+    "log": lambda jnp, x: jnp.log(x),
+    "log10": lambda jnp, x: jnp.log10(x),
+    "log2": lambda jnp, x: jnp.log2(x),
+    "log1p": lambda jnp, x: jnp.log1p(x),
+    "expm1": lambda jnp, x: jnp.expm1(x),
+    "sin": lambda jnp, x: jnp.sin(x),
+    "cos": lambda jnp, x: jnp.cos(x),
+    "tan": lambda jnp, x: jnp.tan(x),
+    "arcsin": lambda jnp, x: jnp.arcsin(x),
+    "arccos": lambda jnp, x: jnp.arccos(x),
+    "arctan": lambda jnp, x: jnp.arctan(x),
+    "sinh": lambda jnp, x: jnp.sinh(x),
+    "cosh": lambda jnp, x: jnp.cosh(x),
+    "tanh": lambda jnp, x: jnp.tanh(x),
+    "arcsinh": lambda jnp, x: jnp.arcsinh(x),
+    "arccosh": lambda jnp, x: jnp.arccosh(x),
+    "arctanh": lambda jnp, x: jnp.arctanh(x),
+    "sigmoid": lambda jnp, x: 1.0 / (1.0 + jnp.exp(-x)),
+    "relu": lambda jnp, x: jnp.maximum(x, 0),
+    "softsign": lambda jnp, x: x / (1.0 + jnp.abs(x)),
+    "reciprocal": lambda jnp, x: 1.0 / x,
+    "negative": lambda jnp, x: -x,
+    "gamma": lambda jnp, x: _gamma(jnp, x),
+    "gammaln": lambda jnp, x: _gammaln(jnp, x),
+    "erf": lambda jnp, x: _erf(jnp, x),
+    "degrees": lambda jnp, x: jnp.degrees(x),
+    "radians": lambda jnp, x: jnp.radians(x),
+}
+
+
+def _gammaln(jnp, x):
+    from jax.scipy.special import gammaln
+    return gammaln(x)
+
+
+def _gamma(jnp, x):
+    from jax.scipy.special import gammaln
+    return jnp.exp(gammaln(x))
+
+
+def _erf(jnp, x):
+    from jax.scipy.special import erf
+    return erf(x)
+
+
+for _name, _fn in _UNARY_TABLE.items():
+    _unary(_name, _fn)
+
+_unary("identity", lambda jnp, x: x, alias=("_copy",))
+
+
+@register("BlockGrad", alias=("stop_gradient",))
+def _block_grad(attrs, ins, octx):
+    """Identity forward, zero gradient (src/operator/tensor/elemwise_unary_op.cc
+    BlockGrad) — exactly lax.stop_gradient."""
+    import jax
+    return [jax.lax.stop_gradient(ins[0])]
+
+
+@register("Cast", alias=("cast",), attr_types={"dtype": str})
+def _cast(attrs, ins, octx):
+    """Cast to a new dtype (src/operator/tensor/elemwise_unary_op.cc Cast)."""
+    return [ins[0].astype(onp.dtype(attrs["dtype"]))]
+
+
+@register("clip", attr_types={"a_min": float, "a_max": float})
+def _clip(attrs, ins, octx):
+    """Clip values to [a_min, a_max] (src/operator/tensor/matrix_op.cc clip)."""
+    return [_jnp().clip(ins[0], attrs["a_min"], attrs["a_max"])]
+
+
+@register("smooth_l1", attr_types={"scalar": float})
+def _smooth_l1(attrs, ins, octx):
+    jnp = _jnp()
+    sigma2 = float(attrs.get("scalar", 1.0)) ** 2
+    x = ins[0]
+    return [jnp.where(jnp.abs(x) < 1.0 / sigma2,
+                      0.5 * sigma2 * x * x, jnp.abs(x) - 0.5 / sigma2)]
+
+
+# -- binary elementwise -----------------------------------------------------
+def _binary(name, fn, alias=()):
+    @register(name, arg_names=("lhs", "rhs"), alias=alias)
+    def _f(attrs, ins, octx, _fn=fn):
+        return [_fn(_jnp(), ins[0], ins[1])]
+    return _f
+
+
+_BINARY_TABLE = {
+    "_plus": (lambda jnp, a, b: a + b, ("elemwise_add", "_add")),
+    "_minus": (lambda jnp, a, b: a - b, ("elemwise_sub", "_sub")),
+    "_mul": (lambda jnp, a, b: a * b, ("elemwise_mul",)),
+    "_div": (lambda jnp, a, b: a / b, ("elemwise_div",)),
+    "_mod": (lambda jnp, a, b: jnp.mod(a, b), ()),
+    "_power": (lambda jnp, a, b: jnp.power(a, b), ("pow",)),
+    "_maximum": (lambda jnp, a, b: jnp.maximum(a, b), ()),
+    "_minimum": (lambda jnp, a, b: jnp.minimum(a, b), ()),
+    "_hypot": (lambda jnp, a, b: jnp.hypot(a, b), ()),
+    "_equal": (lambda jnp, a, b: (a == b).astype(a.dtype), ()),
+    "_not_equal": (lambda jnp, a, b: (a != b).astype(a.dtype), ()),
+    "_greater": (lambda jnp, a, b: (a > b).astype(a.dtype), ()),
+    "_greater_equal": (lambda jnp, a, b: (a >= b).astype(a.dtype), ()),
+    "_lesser": (lambda jnp, a, b: (a < b).astype(a.dtype), ()),
+    "_lesser_equal": (lambda jnp, a, b: (a <= b).astype(a.dtype), ()),
+}
+
+for _name, (_fn, _alias) in _BINARY_TABLE.items():
+    _binary(_name, _fn, _alias)
+
+
+# -- binary with scalar -----------------------------------------------------
+def _scalar_op(name, fn, alias=()):
+    @register(name, attr_types={"scalar": float}, alias=alias)
+    def _f(attrs, ins, octx, _fn=fn):
+        s = float(attrs.get("scalar", 0.0))
+        return [_fn(_jnp(), ins[0], s)]
+    return _f
+
+
+_SCALAR_TABLE = {
+    "_plus_scalar": lambda jnp, a, s: a + onp.asarray(s, a.dtype),
+    "_minus_scalar": lambda jnp, a, s: a - onp.asarray(s, a.dtype),
+    "_rminus_scalar": lambda jnp, a, s: onp.asarray(s, a.dtype) - a,
+    "_mul_scalar": lambda jnp, a, s: a * onp.asarray(s, a.dtype),
+    "_div_scalar": lambda jnp, a, s: a / onp.asarray(s, a.dtype),
+    "_rdiv_scalar": lambda jnp, a, s: onp.asarray(s, a.dtype) / a,
+    "_mod_scalar": lambda jnp, a, s: jnp.mod(a, onp.asarray(s, a.dtype)),
+    "_rmod_scalar": lambda jnp, a, s: jnp.mod(onp.asarray(s, a.dtype), a),
+    "_power_scalar": lambda jnp, a, s: jnp.power(a, onp.asarray(s, a.dtype)),
+    "_rpower_scalar": lambda jnp, a, s: jnp.power(onp.asarray(s, a.dtype), a),
+    "_maximum_scalar": lambda jnp, a, s: jnp.maximum(a, onp.asarray(s, a.dtype)),
+    "_minimum_scalar": lambda jnp, a, s: jnp.minimum(a, onp.asarray(s, a.dtype)),
+    "_hypot_scalar": lambda jnp, a, s: jnp.hypot(a, onp.asarray(s, a.dtype)),
+    "_equal_scalar": lambda jnp, a, s: (a == s).astype(a.dtype),
+    "_not_equal_scalar": lambda jnp, a, s: (a != s).astype(a.dtype),
+    "_greater_scalar": lambda jnp, a, s: (a > s).astype(a.dtype),
+    "_greater_equal_scalar": lambda jnp, a, s: (a >= s).astype(a.dtype),
+    "_lesser_scalar": lambda jnp, a, s: (a < s).astype(a.dtype),
+    "_lesser_equal_scalar": lambda jnp, a, s: (a <= s).astype(a.dtype),
+}
+
+for _name, _fn in _SCALAR_TABLE.items():
+    _scalar_op(_name, _fn)
+
+
+@register("add_n", variable_args="num_args", alias=("ElementWiseSum", "_sum"))
+def _add_n(attrs, ins, octx):
+    """Sum of N arrays in one fused op (src/ndarray/ndarray.cc:290
+    ElementwiseSum; NNVM op add_n)."""
+    out = ins[0]
+    for x in ins[1:]:
+        out = out + x
+    return [out]

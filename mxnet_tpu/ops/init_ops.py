@@ -1,0 +1,87 @@
+"""Creation ops (src/operator/tensor/init_op.h: zeros/ones/arange/*_like)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _shape_infer(attrs, in_shapes, aux):
+    shape = attrs.get("shape", ())
+    if isinstance(shape, int):
+        shape = (shape,)
+    return in_shapes, [tuple(shape)], aux
+
+
+@register("_zeros", arg_names=(), attr_types={"shape": tuple, "dtype": str},
+          infer_shape=_shape_infer, alias=("zeros",))
+def _zeros_op(attrs, ins, octx):
+    shape = attrs.get("shape", ())
+    if isinstance(shape, int):
+        shape = (shape,)
+    return [_jnp().zeros(shape, dtype=onp.dtype(attrs.get("dtype", "float32")))]
+
+
+@register("_ones", arg_names=(), attr_types={"shape": tuple, "dtype": str},
+          infer_shape=_shape_infer, alias=("ones",))
+def _ones_op(attrs, ins, octx):
+    shape = attrs.get("shape", ())
+    if isinstance(shape, int):
+        shape = (shape,)
+    return [_jnp().ones(shape, dtype=onp.dtype(attrs.get("dtype", "float32")))]
+
+
+@register("_full", arg_names=(),
+          attr_types={"shape": tuple, "dtype": str, "value": float},
+          infer_shape=_shape_infer)
+def _full_op(attrs, ins, octx):
+    shape = attrs.get("shape", ())
+    if isinstance(shape, int):
+        shape = (shape,)
+    return [_jnp().full(shape, float(attrs.get("value", 0.0)),
+                        dtype=onp.dtype(attrs.get("dtype", "float32")))]
+
+
+def _arange_infer(attrs, in_shapes, aux):
+    start = float(attrs.get("start", 0.0))
+    stop = attrs.get("stop", None)
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    if stop is None:
+        start, stop = 0.0, start
+    n = int(onp.ceil((float(stop) - start) / step)) * repeat
+    return in_shapes, [(n,)], aux
+
+
+@register("_arange", arg_names=(),
+          attr_types={"start": float, "stop": float, "step": float,
+                      "repeat": int, "dtype": str},
+          infer_shape=_arange_infer, alias=("arange_op",))
+def _arange_op(attrs, ins, octx):
+    jnp = _jnp()
+    start = float(attrs.get("start", 0.0))
+    stop = attrs.get("stop", None)
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    if stop is None:
+        start, stop = 0.0, start
+    vals = onp.arange(start, float(stop), step,
+                      dtype=onp.dtype(attrs.get("dtype", "float32")))
+    if repeat != 1:
+        vals = onp.repeat(vals, repeat)
+    return [jnp.asarray(vals)]
+
+
+@register("zeros_like")
+def _zeros_like(attrs, ins, octx):
+    return [_jnp().zeros_like(ins[0])]
+
+
+@register("ones_like")
+def _ones_like(attrs, ins, octx):
+    return [_jnp().ones_like(ins[0])]

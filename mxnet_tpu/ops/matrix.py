@@ -1,0 +1,406 @@
+"""Matrix/shape-manipulation, indexing and ordering operators.
+
+Covers src/operator/tensor/matrix_op-inl.h (1,735 LoC: transpose/reshape/
+slice/dot/batch_dot/clip/repeat/tile/reverse), indexing_op.h (Embedding/take/
+one_hot — the reference's backward-via-Thrust-sort becomes XLA scatter-add),
+ordering_op-inl.h (topk/sort/argsort) and control_flow_op.h (where).
+dot/batch_dot map straight onto the MXU via lax.dot_general.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register("dot", arg_names=("lhs", "rhs"),
+          attr_types={"transpose_a": bool, "transpose_b": bool})
+def _dot(attrs, ins, octx):
+    """Matrix product (MXU path). Mirrors tensor/matrix_op dot incl. the
+    1-D/2-D mixed semantics."""
+    jnp = _jnp()
+    a, b = ins
+    if attrs.get("transpose_a", False):
+        a = a.T
+    if attrs.get("transpose_b", False):
+        b = b.T
+    return [jnp.dot(a, b)]
+
+
+@register("batch_dot", arg_names=("lhs", "rhs"),
+          attr_types={"transpose_a": bool, "transpose_b": bool})
+def _batch_dot(attrs, ins, octx):
+    jnp = _jnp()
+    a, b = ins
+    if attrs.get("transpose_a", False):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transpose_b", False):
+        b = jnp.swapaxes(b, -1, -2)
+    return [jnp.matmul(a, b)]
+
+
+@register("linalg_gemm2", arg_names=("A", "B"),
+          attr_types={"transpose_a": bool, "transpose_b": bool, "alpha": float})
+def _linalg_gemm2(attrs, ins, octx):
+    jnp = _jnp()
+    a, b = ins
+    if attrs.get("transpose_a", False):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transpose_b", False):
+        b = jnp.swapaxes(b, -1, -2)
+    return [float(attrs.get("alpha", 1.0)) * jnp.matmul(a, b)]
+
+
+@register("transpose", attr_types={"axes": tuple})
+def _transpose(attrs, ins, octx):
+    jnp = _jnp()
+    axes = attrs.get("axes", ())
+    if not axes:
+        axes = None
+    return [jnp.transpose(ins[0], axes)]
+
+
+@register("SwapAxis", attr_types={"dim1": int, "dim2": int},
+          alias=("swapaxes",))
+def _swapaxes(attrs, ins, octx):
+    jnp = _jnp()
+    return [jnp.swapaxes(ins[0], int(attrs.get("dim1", 0)),
+                         int(attrs.get("dim2", 0)))]
+
+
+@register("expand_dims", attr_types={"axis": int})
+def _expand_dims(attrs, ins, octx):
+    return [_jnp().expand_dims(ins[0], int(attrs["axis"]))]
+
+
+def _infer_reshape_shape(target, src_shape):
+    """MXNet Reshape special codes: 0 copy dim, -1 infer, -2 copy rest,
+    -3 merge two dims, -4 split (matrix_op-inl.h ReshapeParam)."""
+    src = list(src_shape)
+    out = []
+    i = 0  # index into src
+    j = 0
+    target = list(target)
+    while j < len(target):
+        t = target[j]
+        if t == 0:
+            out.append(src[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(src[i:]); i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif t == -4:
+            d1, d2 = target[j + 1], target[j + 2]
+            cur = src[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(t)
+            if i < len(src):
+                i += 1
+        j += 1
+    if -1 in out:
+        total = 1
+        for s in src_shape:
+            total *= s
+        known = 1
+        for s in out:
+            if s != -1:
+                known *= s
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+@register("Reshape", attr_types={"shape": tuple, "reverse": bool},
+          alias=("reshape",))
+def _reshape(attrs, ins, octx):
+    tgt = _infer_reshape_shape(attrs["shape"], ins[0].shape)
+    return [ins[0].reshape(tgt)]
+
+
+@register("Flatten", alias=("flatten",))
+def _flatten(attrs, ins, octx):
+    x = ins[0]
+    return [x.reshape((x.shape[0], -1))]
+
+
+@register("slice", attr_types={"begin": tuple, "end": tuple},
+          alias=("crop",))
+def _slice(attrs, ins, octx):
+    x = ins[0]
+    begin = attrs["begin"]
+    end = attrs["end"]
+    if isinstance(begin, int):
+        begin = (begin,)
+    if isinstance(end, int):
+        end = (end,)
+    idx = []
+    for i in range(x.ndim):
+        if i < len(begin):
+            b = begin[i] if begin[i] is not None else 0
+            e = end[i] if end[i] is not None else x.shape[i]
+            idx.append(slice(b, e))
+        else:
+            idx.append(slice(None))
+    return [x[tuple(idx)]]
+
+
+@register("slice_axis", attr_types={"axis": int, "begin": int, "end": int})
+def _slice_axis(attrs, ins, octx):
+    x = ins[0]
+    ax = int(attrs["axis"]) % x.ndim
+    b = attrs.get("begin", 0) or 0
+    e = attrs.get("end", None)
+    if e is None:
+        e = x.shape[ax]
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(b, e)
+    return [x[tuple(idx)]]
+
+
+@register("reverse", attr_types={"axis": tuple}, alias=("flip",))
+def _reverse(attrs, ins, octx):
+    jnp = _jnp()
+    axis = attrs.get("axis", 0)
+    if isinstance(axis, int):
+        axis = (axis,)
+    return [jnp.flip(ins[0], axis=axis)]
+
+
+@register("repeat", attr_types={"repeats": int, "axis": int})
+def _repeat(attrs, ins, octx):
+    jnp = _jnp()
+    axis = attrs.get("axis", None)
+    if axis is None:
+        return [jnp.repeat(ins[0].reshape(-1), int(attrs["repeats"]))]
+    return [jnp.repeat(ins[0], int(attrs["repeats"]), axis=int(axis))]
+
+
+@register("tile", attr_types={"reps": tuple})
+def _tile(attrs, ins, octx):
+    return [_jnp().tile(ins[0], attrs["reps"])]
+
+
+def _concat_infer(attrs, in_shapes, aux):
+    dim = int(attrs.get("dim", 1))
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        return in_shapes, None, aux
+    total = 0
+    for s in in_shapes:
+        if s is None:
+            return in_shapes, None, aux
+        total += s[dim]
+    out = list(known[0])
+    out[dim] = total
+    return in_shapes, [tuple(out)], aux
+
+
+@register("Concat", variable_args="num_args", attr_types={"dim": int},
+          infer_shape=_concat_infer, alias=("concat",))
+def _concat(attrs, ins, octx):
+    return [_jnp().concatenate(ins, axis=int(attrs.get("dim", 1)))]
+
+
+def _slice_channel_outputs(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+@register("SliceChannel", num_outputs=_slice_channel_outputs,
+          attr_types={"num_outputs": int, "axis": int, "squeeze_axis": bool},
+          alias=("split",))
+def _slice_channel(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    n = int(attrs["num_outputs"])
+    axis = int(attrs.get("axis", 1))
+    parts = jnp.split(x, n, axis=axis)
+    if attrs.get("squeeze_axis", False):
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return parts
+
+
+@register("where", arg_names=("condition", "x", "y"))
+def _where(attrs, ins, octx):
+    jnp = _jnp()
+    cond, x, y = ins
+    if cond.ndim == 1 and x.ndim > 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return [jnp.where(cond != 0, x, y)]
+
+
+# ---------------------------------------------------------------------------
+# indexing (src/operator/tensor/indexing_op.h)
+# ---------------------------------------------------------------------------
+def _embedding_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    in_shapes[1] = (int(attrs["input_dim"]), int(attrs["output_dim"]))
+    if data is None:
+        return in_shapes, None, aux
+    return in_shapes, [tuple(data) + (int(attrs["output_dim"]),)], aux
+
+
+@register("Embedding", arg_names=("data", "weight"),
+          attr_types={"input_dim": int, "output_dim": int},
+          infer_shape=_embedding_infer)
+def _embedding(attrs, ins, octx):
+    """Embedding lookup — gather from the weight table; backward is XLA
+    scatter-add (the reference sorts indices with Thrust, indexing_op.h)."""
+    data, weight = ins
+    return [weight[data.astype("int32")]]
+
+
+@register("take", arg_names=("a", "indices"), attr_types={"axis": int,
+                                                          "mode": str})
+def _take(attrs, ins, octx):
+    jnp = _jnp()
+    a, idx = ins
+    axis = int(attrs.get("axis", 0))
+    mode = attrs.get("mode", "clip")
+    idx = idx.astype("int32")
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    return [jnp.take(a, idx, axis=axis)]
+
+
+@register("batch_take", arg_names=("a", "indices"))
+def _batch_take(attrs, ins, octx):
+    jnp = _jnp()
+    a, idx = ins
+    return [a[jnp.arange(a.shape[0]), idx.astype("int32")]]
+
+
+@register("one_hot", attr_types={"depth": int, "on_value": float,
+                                 "off_value": float, "dtype": str})
+def _one_hot(attrs, ins, octx):
+    jnp = _jnp()
+    idx = ins[0].astype("int32")
+    depth = int(attrs["depth"])
+    on = float(attrs.get("on_value", 1.0))
+    off = float(attrs.get("off_value", 0.0))
+    dt = onp.dtype(attrs.get("dtype", "float32"))
+    oh = (idx[..., None] == jnp.arange(depth)).astype(dt)
+    return [oh * onp.asarray(on - off, dt) + onp.asarray(off, dt)]
+
+
+@register("gather_nd", arg_names=("data", "indices"))
+def _gather_nd(attrs, ins, octx):
+    data, indices = ins
+    idx = tuple(indices.astype("int32"))
+    return [data[idx]]
+
+
+# ---------------------------------------------------------------------------
+# ordering (src/operator/tensor/ordering_op-inl.h)
+# ---------------------------------------------------------------------------
+@register("topk", attr_types={"axis": int, "k": int, "ret_typ": str,
+                              "is_ascend": bool},
+          num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1)
+def _topk(attrs, ins, octx):
+    import jax
+    jnp = _jnp()
+    x = ins[0]
+    axis = attrs.get("axis", -1)
+    axis = x.ndim - 1 if axis is None else int(axis) % x.ndim
+    k = int(attrs.get("k", 1))
+    ret = attrs.get("ret_typ", "indices")
+    asc = bool(attrs.get("is_ascend", False))
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idxs = jax.lax.top_k(-xm if asc else xm, k)
+    if asc:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(x.dtype)
+    if ret == "value":
+        return [vals]
+    if ret == "both":
+        return [vals, idxs]
+    if ret == "mask":
+        mask = jnp.zeros(xm.shape, x.dtype)
+        mask = mask.at[..., :].set(0)
+        onehot = jnp.sum(
+            (jnp.arange(xm.shape[-1])[None, :] ==
+             idxs.astype("int32").reshape((-1, k))[..., None]).astype(x.dtype),
+            axis=-2).reshape(xm.shape)
+        return [jnp.moveaxis(onehot, -1, axis)]
+    return [idxs]
+
+
+@register("sort", attr_types={"axis": int, "is_ascend": bool})
+def _sort(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    axis = attrs.get("axis", -1)
+    axis = x.ndim - 1 if axis is None else int(axis)
+    r = jnp.sort(x, axis=axis)
+    if not attrs.get("is_ascend", True):
+        r = jnp.flip(r, axis=axis)
+    return [r]
+
+
+@register("argsort", attr_types={"axis": int, "is_ascend": bool})
+def _argsort(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    axis = attrs.get("axis", -1)
+    axis = x.ndim - 1 if axis is None else int(axis)
+    r = jnp.argsort(x, axis=axis)
+    if not attrs.get("is_ascend", True):
+        r = jnp.flip(r, axis=axis)
+    return [r.astype(x.dtype)]
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (src/operator/sequence_{last,mask,reverse}-inl.h); layout TNC
+# ---------------------------------------------------------------------------
+@register("SequenceLast", arg_names=("data", "sequence_length"),
+          attr_types={"use_sequence_length": bool})
+def _sequence_last(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    if not attrs.get("use_sequence_length", False) or len(ins) < 2:
+        return [x[-1]]
+    seq_len = ins[1].astype("int32")
+    idx = jnp.maximum(seq_len - 1, 0)
+    return [x[idx, jnp.arange(x.shape[1])]]
+
+
+@register("SequenceMask", arg_names=("data", "sequence_length"),
+          attr_types={"use_sequence_length": bool, "value": float})
+def _sequence_mask(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    if not attrs.get("use_sequence_length", False) or len(ins) < 2:
+        return [x]
+    seq_len = ins[1].astype("int32")
+    val = float(attrs.get("value", 0.0))
+    t = jnp.arange(x.shape[0])[:, None]
+    mask = t < seq_len[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return [jnp.where(mask, x, onp.asarray(val, x.dtype))]
+
+
+@register("SequenceReverse", arg_names=("data", "sequence_length"),
+          attr_types={"use_sequence_length": bool})
+def _sequence_reverse(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    if not attrs.get("use_sequence_length", False) or len(ins) < 2:
+        return [jnp.flip(x, axis=0)]
+    seq_len = ins[1].astype("int32")
+    T = x.shape[0]
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < seq_len[None, :], seq_len[None, :] - 1 - t, t)
+    return [x[src, jnp.arange(x.shape[1])[None, :]]]

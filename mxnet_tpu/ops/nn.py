@@ -1,0 +1,498 @@
+"""Neural-network layer operators.
+
+TPU-native equivalents of the reference's legacy stateful layer ops
+(src/operator/*-inl.h, registered MXNET_REGISTER_OP_PROPERTY). Stateful
+``Operator`` objects become pure functions; BatchNorm's mutable aux state
+(moving mean/var) is expressed as explicit aux inputs/outputs; loss layers
+whose backward ignores head gradients (SoftmaxOutput & friends) use
+``jax.custom_vjp`` so whole-graph ``jax.vjp`` reproduces reference gradients.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= x
+    return p
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (src/operator/fully_connected-inl.h:60-133)
+# ---------------------------------------------------------------------------
+def _fc_args(attrs):
+    return ("data", "weight") if attrs.get("no_bias", False) else \
+        ("data", "weight", "bias")
+
+
+def _fc_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    nh = int(attrs["num_hidden"])
+    if data is not None:
+        in_shapes[1] = (nh, _prod(data[1:]))
+        if not attrs.get("no_bias", False):
+            if len(in_shapes) > 2:
+                in_shapes[2] = (nh,)
+        return in_shapes, [(data[0], nh)], aux
+    return in_shapes, None, aux
+
+
+@register("FullyConnected", arg_names=_fc_args,
+          attr_types={"num_hidden": int, "no_bias": bool},
+          infer_shape=_fc_infer)
+def _fully_connected(attrs, ins, octx):
+    """Y = X·Wᵀ + b. Flattens input to 2-D like the reference; the matmul is
+    the MXU fast path (reference: mshadow dot() + repmat)."""
+    jnp = _jnp()
+    x = ins[0]
+    w = ins[1]
+    x2 = x.reshape((x.shape[0], -1))
+    y = jnp.dot(x2, w.T)
+    if not attrs.get("no_bias", False):
+        y = y + ins[2][None, :]
+    return [y]
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+@register("Activation", attr_types={"act_type": str})
+def _activation(attrs, ins, octx):
+    """relu/sigmoid/tanh/softrelu (src/operator/activation-inl.h)."""
+    jnp = _jnp()
+    x = ins[0]
+    t = attrs.get("act_type", "relu")
+    if t == "relu":
+        return [jnp.maximum(x, 0)]
+    if t == "sigmoid":
+        return [1.0 / (1.0 + jnp.exp(-x))]
+    if t == "tanh":
+        return [jnp.tanh(x)]
+    if t == "softrelu":
+        return [jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0)]
+    raise ValueError("unknown act_type %s" % t)
+
+
+def _leaky_args(attrs):
+    return ("data", "gamma") if attrs.get("act_type") == "prelu" else ("data",)
+
+
+@register("LeakyReLU", arg_names=_leaky_args,
+          attr_types={"act_type": str, "slope": float, "lower_bound": float,
+                      "upper_bound": float},
+          needs_rng=True)
+def _leaky_relu(attrs, ins, octx):
+    """leaky/prelu/elu/rrelu (src/operator/leaky_relu-inl.h)."""
+    import jax
+    jnp = _jnp()
+    x = ins[0]
+    t = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if t == "leaky":
+        return [jnp.where(x > 0, x, slope * x)]
+    if t == "elu":
+        return [jnp.where(x > 0, x, slope * (jnp.exp(x) - 1.0))]
+    if t == "prelu":
+        gamma = ins[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return [jnp.where(x > 0, x, gamma * x)]
+    if t == "rrelu":
+        lo = float(attrs.get("lower_bound", 0.125))
+        hi = float(attrs.get("upper_bound", 0.334))
+        if octx.is_train:
+            a = jax.random.uniform(octx.rng, x.shape, dtype=x.dtype,
+                                   minval=lo, maxval=hi)
+        else:
+            a = (lo + hi) / 2.0
+        return [jnp.where(x > 0, x, a * x)]
+    raise ValueError("unknown act_type %s" % t)
+
+
+def _softmax(jnp, x, axis):
+    m = jnp.max(x, axis=axis, keepdims=True)
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register("softmax", attr_types={"axis": int, "temperature": float})
+def _softmax_op(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    tmp = attrs.get("temperature") or 1.0
+    return [_softmax(jnp, x / tmp, int(attrs.get("axis", -1)))]
+
+
+@register("log_softmax", attr_types={"axis": int})
+def _log_softmax(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    axis = int(attrs.get("axis", -1))
+    m = jnp.max(x, axis=axis, keepdims=True)
+    s = x - m
+    return [s - jnp.log(jnp.sum(jnp.exp(s), axis=axis, keepdims=True))]
+
+
+@register("SoftmaxActivation", attr_types={"mode": str})
+def _softmax_activation(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    if attrs.get("mode", "instance") == "channel":
+        return [_softmax(jnp, x, 1)]
+    return [_softmax(jnp, x.reshape((x.shape[0], -1)), -1).reshape(x.shape)]
+
+
+# ---------------------------------------------------------------------------
+# Loss layers — custom VJP, backward ignores head grads
+# ---------------------------------------------------------------------------
+def _normalizer(jnp, attrs, label, valid_mask):
+    norm = attrs.get("normalization", "null")
+    if norm == "batch":
+        return float(_prod(label.shape))
+    if norm == "valid":
+        return jnp.maximum(jnp.sum(valid_mask), 1.0)
+    return 1.0
+
+
+def _softmax_out_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    if in_shapes[1] is None:
+        if attrs.get("multi_output", False):
+            in_shapes[1] = (data[0],) + tuple(data[2:])
+        elif attrs.get("preserve_shape", False):
+            in_shapes[1] = tuple(data[:-1])
+        else:
+            in_shapes[1] = (data[0],)
+    return in_shapes, [tuple(data)], aux
+
+
+@register("SoftmaxOutput", arg_names=("data", "label"),
+          attr_types={"grad_scale": float, "ignore_label": float,
+                      "multi_output": bool, "use_ignore": bool,
+                      "preserve_shape": bool, "normalization": str,
+                      "out_grad": bool, "smooth_alpha": float},
+          infer_shape=_softmax_out_infer,
+          backward_ignores_head_grads=True)
+def _softmax_output(attrs, ins, octx):
+    """Softmax forward; backward = (p - onehot(label)) * grad_scale
+    (src/operator/softmax_output-inl.h). Gradient w.r.t. data only — the
+    incoming head gradient is ignored (out_grad=False path)."""
+    import jax
+    jnp = _jnp()
+
+    multi = attrs.get("multi_output", False)
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+    use_ignore = attrs.get("use_ignore", False)
+    ignore_label = float(attrs.get("ignore_label", -1.0))
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _fwd_only(data)
+
+    def _fwd_only(data):
+        if multi:
+            return _softmax(jnp, data, 1)
+        return _softmax(jnp, data.reshape((data.shape[0], -1)),
+                        -1).reshape(data.shape)
+
+    def f_fwd(data, label):
+        out = _fwd_only(data)
+        return out, (out, label)
+
+    def f_bwd(res, g):
+        out, label = res
+        if label.shape == out.shape:  # dense label distribution
+            grad = out - label
+            valid = jnp.ones(label.shape[:1], out.dtype)
+        elif multi:
+            # out: (n, c, d...), label: (n, d...)
+            lab = label.astype("int32")
+            onehot = (lab[:, None] == jnp.arange(out.shape[1]).reshape(
+                (1, -1) + (1,) * (out.ndim - 2))).astype(out.dtype)
+            grad = out - onehot
+            valid = jnp.ones(lab.shape, out.dtype)
+            if use_ignore:
+                keep = (label != ignore_label).astype(out.dtype)
+                grad = grad * keep[:, None]
+                valid = keep
+        else:
+            lab = label.reshape(-1).astype("int32")
+            flat = out.reshape((-1, out.shape[-1]))
+            onehot = (lab[:, None] == jnp.arange(flat.shape[-1])).astype(
+                out.dtype)
+            grad = flat - onehot
+            valid = jnp.ones(lab.shape, out.dtype)
+            if use_ignore:
+                keep = (lab.astype(out.dtype) != ignore_label).astype(out.dtype)
+                grad = grad * keep[:, None]
+                valid = keep
+            grad = grad.reshape(out.shape)
+        norm = _normalizer(jnp, attrs, label, valid)
+        grad = grad * (grad_scale / norm)
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(ins[0], ins[1] if len(ins) > 1 else
+              jnp.zeros(ins[0].shape[:1], ins[0].dtype))]
+
+
+def _label_like_data_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    if in_shapes[1] is None:
+        in_shapes[1] = tuple(data)
+    return in_shapes, [tuple(data)], aux
+
+
+def _make_reg_output(name, fwd_fn, grad_fn):
+    @register(name, arg_names=("data", "label"),
+              attr_types={"grad_scale": float},
+              infer_shape=_label_like_data_infer,
+              backward_ignores_head_grads=True)
+    def _f(attrs, ins, octx, _fwd=fwd_fn, _grad=grad_fn):
+        import jax
+        jnp = _jnp()
+        scale = float(attrs.get("grad_scale", 1.0))
+
+        @jax.custom_vjp
+        def f(data, label):
+            return _fwd(jnp, data)
+
+        def f_fwd(data, label):
+            return _fwd(jnp, data), (data, label)
+
+        def f_bwd(res, g):
+            data, label = res
+            out = _fwd(jnp, data)
+            num = _prod(label.shape[1:]) or 1
+            grad = _grad(jnp, out, label.reshape(out.shape)) * \
+                onp.asarray(scale / num, out.dtype)
+            return grad, jnp.zeros_like(label)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(ins[0], ins[1])]
+    return _f
+
+
+# (src/operator/regression_output-inl.h)
+_make_reg_output("LinearRegressionOutput",
+                 lambda jnp, d: d,
+                 lambda jnp, o, l: o - l)
+_make_reg_output("LogisticRegressionOutput",
+                 lambda jnp, d: 1.0 / (1.0 + jnp.exp(-d)),
+                 lambda jnp, o, l: o - l)
+_make_reg_output("MAERegressionOutput",
+                 lambda jnp, d: d,
+                 lambda jnp, o, l: jnp.sign(o - l))
+
+
+def _svm_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    if in_shapes[1] is None:
+        in_shapes[1] = (data[0],)
+    return in_shapes, [tuple(data)], aux
+
+
+@register("SVMOutput", arg_names=("data", "label"),
+          attr_types={"margin": float, "regularization_coefficient": float,
+                      "use_linear": bool},
+          infer_shape=_svm_infer,
+          backward_ignores_head_grads=True)
+def _svm_output(attrs, ins, octx):
+    """Hinge-loss output layer (src/operator/svm_output-inl.h)."""
+    import jax
+    jnp = _jnp()
+    margin = float(attrs.get("margin", 1.0))
+    reg = float(attrs.get("regularization_coefficient", 1.0))
+    linear = attrs.get("use_linear", False)
+
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def f_fwd(data, label):
+        return data, (data, label)
+
+    def f_bwd(res, g):
+        data, label = res
+        lab = label.astype("int32")
+        onehot = (lab[:, None] == jnp.arange(data.shape[1])).astype(data.dtype)
+        sign = 2.0 * onehot - 1.0  # +1 at true class, -1 elsewhere
+        viol = (margin - sign * data) > 0
+        if linear:
+            grad = jnp.where(viol, -sign * reg, 0.0)
+        else:
+            grad = jnp.where(viol, -2.0 * reg * sign * (margin - sign * data),
+                             0.0)
+        return grad.astype(data.dtype), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(ins[0], ins[1])]
+
+
+@register("MakeLoss", attr_types={"grad_scale": float, "normalization": str,
+                                  "valid_thresh": float},
+          backward_ignores_head_grads=True)
+def _make_loss(attrs, ins, octx):
+    """Forward identity; backward seeds grad_scale (src/operator/make_loss-inl.h)."""
+    import jax
+    jnp = _jnp()
+    scale = float(attrs.get("grad_scale", 1.0))
+    norm = attrs.get("normalization", "null")
+
+    @jax.custom_vjp
+    def f(data):
+        return data
+
+    def f_fwd(data):
+        return data, (data,)
+
+    def f_bwd(res, g):
+        (data,) = res
+        denom = float(_prod(data.shape)) if norm == "batch" else 1.0
+        return (jnp.full(data.shape, scale / denom, data.dtype),)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(ins[0])]
+
+
+# ---------------------------------------------------------------------------
+# Dropout (src/operator/dropout-inl.h) — mask from the executor-threaded PRNG
+# ---------------------------------------------------------------------------
+@register("Dropout", attr_types={"p": float}, needs_rng=True)
+def _dropout(attrs, ins, octx):
+    import jax
+    jnp = _jnp()
+    x = ins[0]
+    p = float(attrs.get("p", 0.5))
+    if not octx.is_train or p <= 0.0:
+        return [x]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(octx.rng, keep, x.shape)
+    return [jnp.where(mask, x / onp.asarray(keep, x.dtype),
+                      onp.asarray(0.0, x.dtype))]
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (src/operator/batch_norm-inl.h) — aux moving stats in/out
+# ---------------------------------------------------------------------------
+def _bn_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    c = data[1] if len(data) > 1 else data[0]
+    for i in (1, 2):
+        if i < len(in_shapes):
+            in_shapes[i] = (c,)
+    aux = [(c,), (c,)]
+    return in_shapes, [tuple(data)], aux
+
+
+@register("BatchNorm", arg_names=("data", "gamma", "beta"),
+          aux_names=("moving_mean", "moving_var"),
+          attr_types={"eps": float, "momentum": float, "fix_gamma": bool,
+                      "use_global_stats": bool, "output_mean_var": bool},
+          infer_shape=_bn_infer)
+def _batch_norm(attrs, ins, octx):
+    """Normalize over all axes but channel (axis 1). In training, use batch
+    stats and update moving stats (returned as aux updates; the executor
+    writes them back — replacing FMutateInputs on aux states)."""
+    import jax
+    jnp = _jnp()
+    x, gamma, beta, mmean, mvar = ins
+    eps = float(attrs.get("eps", 1e-3))
+    mom = float(attrs.get("momentum", 0.9))
+    fix_gamma = attrs.get("fix_gamma", True)
+    use_global = attrs.get("use_global_stats", False)
+
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if octx.is_train and not use_global:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=axes)
+        new_mmean = mmean * mom + jax.lax.stop_gradient(mean) * (1 - mom)
+        new_mvar = mvar * mom + jax.lax.stop_gradient(var) * (1 - mom)
+    else:
+        mean, var = mmean, mvar
+        new_mmean, new_mvar = mmean, mvar
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    out = (x - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + eps)
+    out = out * g.reshape(bshape) + beta.reshape(bshape)
+    return [out, new_mmean, new_mvar]
+
+
+@register("InstanceNorm", arg_names=("data", "gamma", "beta"),
+          attr_types={"eps": float})
+def _instance_norm(attrs, ins, octx):
+    jnp = _jnp()
+    x, gamma, beta = ins
+    eps = float(attrs.get("eps", 1e-3))
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    out = (x - mean) / jnp.sqrt(var + eps)
+    return [out * gamma.reshape(bshape) + beta.reshape(bshape)]
+
+
+@register("L2Normalization", attr_types={"eps": float, "mode": str})
+def _l2_normalization(attrs, ins, octx):
+    jnp = _jnp()
+    x = ins[0]
+    eps = float(attrs.get("eps", 1e-10))
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+        keep = True
+    elif mode == "channel":
+        axes = (1,)
+        keep = True
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+        keep = True
+    else:
+        raise ValueError("unknown mode " + mode)
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=keep) + eps)
+    return [x / denom]
+
+
+@register("LRN", attr_types={"alpha": float, "beta": float, "knorm": float,
+                             "nsize": int})
+def _lrn(attrs, ins, octx):
+    """Local response norm across channels (src/operator/lrn-inl.h)."""
+    import jax
+    jnp = _jnp()
+    x = ins[0]
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    knorm = float(attrs.get("knorm", 2.0))
+    nsize = int(attrs.get("nsize", 5))
+    sq = jnp.square(x)
+    half = nsize // 2
+    window_sum = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        window_dimensions=(1, nsize) + (1,) * (x.ndim - 2),
+        window_strides=(1,) * x.ndim,
+        padding=((0, 0), (half, half)) + ((0, 0),) * (x.ndim - 2))
+    return [x / jnp.power(knorm + (alpha / nsize) * window_sum, beta)]
+
+
+@register("IdentityAttachKLSparseReg",
+          attr_types={"sparseness_target": float, "penalty": float,
+                      "momentum": float})
+def _identity_kl_sparse(attrs, ins, octx):
+    # Forward identity; the sparse-reg penalty shapes gradients in the
+    # reference — approximated as pure identity pending demand.
+    return [ins[0]]

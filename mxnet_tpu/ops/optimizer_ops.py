@@ -1,0 +1,118 @@
+"""Fused optimizer update ops (src/operator/optimizer_op.cc).
+
+The Python Optimizer calls these exactly like the reference does
+(python/mxnet/optimizer.py:310-322): one op application per parameter, fully
+fused by XLA. All mutate ``weight`` in place through the ``out=`` convention.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_SGD_ATTRS = {"lr": float, "wd": float, "rescale_grad": float,
+              "clip_gradient": float, "momentum": float}
+
+
+def _prep(jnp, attrs, grad):
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = attrs.get("clip_gradient", None)
+    g = grad * onp.asarray(rescale, grad.dtype)
+    if clip is not None and float(clip) > 0:
+        c = float(clip)
+        g = jnp.clip(g, -c, c)
+    return g
+
+
+@register("sgd_update", arg_names=("weight", "grad"), attr_types=_SGD_ATTRS)
+def _sgd_update(attrs, ins, octx):
+    jnp = _jnp()
+    w, grad = ins
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    g = _prep(jnp, attrs, grad)
+    return [w - lr * (g + wd * w)]
+
+
+@register("sgd_mom_update", arg_names=("weight", "grad", "mom"),
+          attr_types=_SGD_ATTRS)
+def _sgd_mom_update(attrs, ins, octx):
+    jnp = _jnp()
+    w, grad, mom = ins
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    momentum = float(attrs.get("momentum", 0.0))
+    g = _prep(jnp, attrs, grad)
+    new_mom = momentum * mom - lr * (g + wd * w)
+    return [w + new_mom, new_mom]
+
+
+@register("adam_update", arg_names=("weight", "grad", "mean", "var"),
+          attr_types={"lr": float, "beta1": float, "beta2": float,
+                      "epsilon": float, "wd": float, "rescale_grad": float,
+                      "clip_gradient": float})
+def _adam_update(attrs, ins, octx):
+    jnp = _jnp()
+    w, grad, mean, var = ins
+    lr = float(attrs["lr"])
+    beta1 = float(attrs.get("beta1", 0.9))
+    beta2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    wd = float(attrs.get("wd", 0.0))
+    g = _prep(jnp, attrs, grad) + wd * w
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = w - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return [new_w, new_mean, new_var]
+
+
+@register("rmsprop_update", arg_names=("weight", "grad", "n"),
+          attr_types={"lr": float, "gamma1": float, "epsilon": float,
+                      "wd": float, "rescale_grad": float,
+                      "clip_gradient": float, "clip_weights": float})
+def _rmsprop_update(attrs, ins, octx):
+    jnp = _jnp()
+    w, grad, n = ins
+    lr = float(attrs["lr"])
+    gamma1 = float(attrs.get("gamma1", 0.95))
+    eps = float(attrs.get("epsilon", 1e-8))
+    wd = float(attrs.get("wd", 0.0))
+    g = _prep(jnp, attrs, grad) + wd * w
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = w - lr * g / jnp.sqrt(new_n + eps)
+    cw = attrs.get("clip_weights", None)
+    if cw is not None and float(cw) > 0:
+        new_w = jnp.clip(new_w, -float(cw), float(cw))
+    return [new_w, new_n]
+
+
+@register("rmspropalex_update",
+          arg_names=("weight", "grad", "n", "g", "delta"),
+          attr_types={"lr": float, "gamma1": float, "gamma2": float,
+                      "epsilon": float, "wd": float, "rescale_grad": float,
+                      "clip_gradient": float, "clip_weights": float})
+def _rmspropalex_update(attrs, ins, octx):
+    """Graves-form RMSProp (optimizer_op.cc rmspropalex_update)."""
+    jnp = _jnp()
+    w, grad, n, gbar, delta = ins
+    lr = float(attrs["lr"])
+    gamma1 = float(attrs.get("gamma1", 0.95))
+    gamma2 = float(attrs.get("gamma2", 0.9))
+    eps = float(attrs.get("epsilon", 1e-8))
+    wd = float(attrs.get("wd", 0.0))
+    g = _prep(jnp, attrs, grad) + wd * w
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_gbar = (1 - gamma1) * g + gamma1 * gbar
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_gbar) + eps)
+    new_w = w + new_delta
+    cw = attrs.get("clip_weights", None)
+    if cw is not None and float(cw) > 0:
+        new_w = jnp.clip(new_w, -float(cw), float(cw))
+    return [new_w, new_n, new_gbar, new_delta]

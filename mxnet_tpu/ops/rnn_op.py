@@ -1,0 +1,190 @@
+"""Fused multi-layer RNN op — the cuDNN RNN replacement.
+
+The reference's RNN op is GPU-only cuDNN (src/operator/rnn.cc:14 "RNN is only
+available for gpu"; cudnn_rnn-inl.h). Here it is a ``lax.scan`` over time with
+per-layer weights sliced out of the single flat parameter vector in cuDNN
+canonical layout (all W/R matrices layer-major first, then all biases), so
+``FusedRNNCell.unfuse()``-style weight sharing keeps working. The scan is
+jit-friendly (static T) and XLA pipelines the per-step matmuls onto the MXU.
+
+Modes: rnn_relu / rnn_tanh / lstm / gru; bidirectional; multi-layer.
+Gate order matches cuDNN: LSTM [i, f, g, o], GRU [r, z, n].
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def _rnn_args(attrs):
+    if attrs.get("mode", "lstm") == "lstm":
+        return ("data", "parameters", "state", "state_cell")
+    return ("data", "parameters", "state")
+
+
+def _rnn_num_outputs(attrs):
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total flat parameter count (matches cudnn_rnn-inl.h GetParamSize)."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        per_dir = g * state_size * (in_sz + state_size + 2)
+        size += per_dir * d
+    return size
+
+
+def _rnn_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    t, n, i = data
+    h = int(attrs["state_size"])
+    layers = int(attrs["num_layers"])
+    bi = attrs.get("bidirectional", False)
+    d = 2 if bi else 1
+    mode = attrs.get("mode", "lstm")
+    in_shapes[1] = (rnn_param_size(layers, i, h, bi, mode),)
+    in_shapes[2] = (layers * d, n, h)
+    if mode == "lstm" and len(in_shapes) > 3:
+        in_shapes[3] = (layers * d, n, h)
+    outs = [(t, n, h * d)]
+    if attrs.get("state_outputs", False):
+        outs.append((layers * d, n, h))
+        if mode == "lstm":
+            outs.append((layers * d, n, h))
+    return in_shapes, outs, aux
+
+
+def _split_params(jnp, params, num_layers, input_size, state_size, d, g):
+    """Slice the flat vector into per-(layer,dir) (W, R, bW, bR)."""
+    mats, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        for _ in range(d):
+            w = params[off:off + g * state_size * in_sz].reshape(
+                (g * state_size, in_sz))
+            off += g * state_size * in_sz
+            r = params[off:off + g * state_size * state_size].reshape(
+                (g * state_size, state_size))
+            off += g * state_size * state_size
+            mats.append((w, r))
+    for layer in range(num_layers):
+        for _ in range(d):
+            bw = params[off:off + g * state_size]
+            off += g * state_size
+            br = params[off:off + g * state_size]
+            off += g * state_size
+            biases.append((bw, br))
+    return [(mats[i][0], mats[i][1], biases[i][0], biases[i][1])
+            for i in range(len(mats))]
+
+
+def _cell_step(jnp, mode, h_prev, c_prev, pre, state_size):
+    """One timestep given preactivations pre = x·Wᵀ + h·Rᵀ + b."""
+    if mode == "rnn_relu":
+        h = jnp.maximum(pre, 0)
+        return h, c_prev
+    if mode == "rnn_tanh":
+        h = jnp.tanh(pre)
+        return h, c_prev
+    if mode == "lstm":
+        i, f, gt, o = [pre[:, k * state_size:(k + 1) * state_size]
+                       for k in range(4)]
+        i = 1 / (1 + jnp.exp(-i))
+        f = 1 / (1 + jnp.exp(-f))
+        gt = jnp.tanh(gt)
+        o = 1 / (1 + jnp.exp(-o))
+        c = f * c_prev + i * gt
+        return o * jnp.tanh(c), c
+    raise ValueError(mode)
+
+
+def _scan_layer(jax, jnp, mode, x, h0, c0, w, r, bw, br, state_size, reverse):
+    """Scan one direction of one layer. x: (T, N, in). Returns (T,N,H), hT, cT."""
+    xw = jnp.einsum("tni,gi->tng", x, w) + bw[None, None, :]
+
+    if mode == "gru":
+        def step(carry, xt):
+            h_prev, _ = carry
+            hr = jnp.dot(h_prev, r.T) + br[None, :]
+            rg = 1 / (1 + jnp.exp(-(xt[:, :state_size] + hr[:, :state_size])))
+            zg = 1 / (1 + jnp.exp(-(xt[:, state_size:2 * state_size]
+                                    + hr[:, state_size:2 * state_size])))
+            ng = jnp.tanh(xt[:, 2 * state_size:] + rg * hr[:, 2 * state_size:])
+            h = (1 - zg) * ng + zg * h_prev
+            return (h, h), h
+    else:
+        def step(carry, xt):
+            h_prev, c_prev = carry
+            pre = xt + jnp.dot(h_prev, r.T) + br[None, :]
+            h, c = _cell_step(jnp, mode, h_prev, c_prev, pre, state_size)
+            return (h, c), h
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), xw, reverse=reverse)
+    return ys, hT, cT
+
+
+@register("RNN", arg_names=_rnn_args, num_outputs=_rnn_num_outputs,
+          attr_types={"state_size": int, "num_layers": int,
+                      "bidirectional": bool, "mode": str, "p": float,
+                      "state_outputs": bool, "lstm_state_clip_min": float,
+                      "lstm_state_clip_max": float},
+          infer_shape=_rnn_infer, needs_rng=True)
+def _rnn(attrs, ins, octx):
+    import jax
+    import jax.numpy as jnp
+
+    mode = attrs.get("mode", "lstm")
+    state_size = int(attrs["state_size"])
+    num_layers = int(attrs["num_layers"])
+    bi = attrs.get("bidirectional", False)
+    d = 2 if bi else 1
+    g = _gates(mode)
+    pdrop = float(attrs.get("p", 0.0))
+
+    data, params, state0 = ins[0], ins[1], ins[2]
+    cell0 = ins[3] if mode == "lstm" and len(ins) > 3 else jnp.zeros_like(state0)
+    T, N, input_size = data.shape
+
+    layers = _split_params(jnp, params, num_layers, input_size, state_size, d, g)
+
+    x = data
+    h_finals, c_finals = [], []
+    rng = octx.rng
+    for layer in range(num_layers):
+        outs_dir = []
+        for di in range(d):
+            idx = layer * d + di
+            w, r, bw, br = layers[idx]
+            h0 = state0[idx]
+            c0 = cell0[idx]
+            ys, hT, cT = _scan_layer(jax, jnp, mode, x, h0, c0, w, r, bw, br,
+                                     state_size, reverse=(di == 1))
+            outs_dir.append(ys)
+            h_finals.append(hT)
+            c_finals.append(cT)
+        x = outs_dir[0] if d == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if pdrop > 0 and octx.is_train and layer < num_layers - 1 and rng is not None:
+            rng, sub = jax.random.split(rng)
+            mask = jax.random.bernoulli(sub, 1 - pdrop, x.shape)
+            x = jnp.where(mask, x / (1 - pdrop), 0.0)
+
+    outs = [x]
+    if attrs.get("state_outputs", False):
+        outs.append(jnp.stack(h_finals, axis=0))
+        if mode == "lstm":
+            outs.append(jnp.stack(c_finals, axis=0))
+    return outs

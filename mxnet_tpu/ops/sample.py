@@ -1,0 +1,89 @@
+"""Random sampling ops (src/operator/tensor/sample_op.h).
+
+Each op consumes a JAX PRNG key from OpContext.rng (threaded by the executor
+/ imperative invoke from the global seed state, replacing the per-context
+kRandom resource, src/resource.cc:70-77).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+_COMMON = {"shape": tuple, "dtype": str}
+
+
+def _shape_of(attrs):
+    shape = attrs.get("shape", (1,))
+    if isinstance(shape, int):
+        shape = (shape,)
+    return tuple(shape)
+
+
+def _dtype_of(attrs, default="float32"):
+    return onp.dtype(attrs.get("dtype") or default)
+
+
+def _shape_infer(attrs, in_shapes, aux):
+    return in_shapes, [_shape_of(attrs)], aux
+
+
+def _sample(name, fn, extra_attrs, alias=()):
+    attr_types = dict(_COMMON)
+    attr_types.update(extra_attrs)
+
+    @register(name, arg_names=(), attr_types=attr_types, needs_rng=True,
+              infer_shape=_shape_infer, alias=alias)
+    def _f(attrs, ins, octx, _fn=fn):
+        import jax
+        return [_fn(jax, octx.rng, _shape_of(attrs), _dtype_of(attrs), attrs)]
+    return _f
+
+
+_sample("_random_uniform",
+        lambda jax, key, shape, dt, a: jax.random.uniform(
+            key, shape, dtype=dt, minval=float(a.get("low", 0.0)),
+            maxval=float(a.get("high", 1.0))),
+        {"low": float, "high": float},
+        alias=("uniform", "random_uniform", "_sample_uniform"))
+
+_sample("_random_normal",
+        lambda jax, key, shape, dt, a: float(a.get("scale", 1.0))
+        * jax.random.normal(key, shape, dtype=dt) + float(a.get("loc", 0.0)),
+        {"loc": float, "scale": float},
+        alias=("normal", "random_normal", "_sample_normal"))
+
+_sample("_random_gamma",
+        lambda jax, key, shape, dt, a: float(a.get("beta", 1.0))
+        * jax.random.gamma(key, float(a.get("alpha", 1.0)), shape, dtype=dt),
+        {"alpha": float, "beta": float},
+        alias=("random_gamma", "_sample_gamma"))
+
+_sample("_random_exponential",
+        lambda jax, key, shape, dt, a: jax.random.exponential(
+            key, shape, dtype=dt) / float(a.get("lam", 1.0)),
+        {"lam": float},
+        alias=("random_exponential", "_sample_exponential"))
+
+_sample("_random_poisson",
+        lambda jax, key, shape, dt, a: jax.random.poisson(
+            key, float(a.get("lam", 1.0)), shape).astype(dt),
+        {"lam": float},
+        alias=("random_poisson", "_sample_poisson"))
+
+_sample("_random_negative_binomial",
+        lambda jax, key, shape, dt, a: _neg_binomial(
+            jax, key, shape, dt, int(a.get("k", 1)), float(a.get("p", 0.5))),
+        {"k": int, "p": float},
+        alias=("random_negative_binomial", "_sample_negbinomial"))
+
+_sample("random_randint",
+        lambda jax, key, shape, dt, a: jax.random.randint(
+            key, shape, int(a.get("low", 0)), int(a.get("high", 2))).astype(dt),
+        {"low": int, "high": int})
+
+
+def _neg_binomial(jax, key, shape, dt, k, p):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(dt)

@@ -1,0 +1,440 @@
+"""Optimizers (python/mxnet/optimizer.py:755).
+
+Same registry + Updater contract as the reference. SGD/Adam/RMSProp call the
+fused update ops (ops/optimizer_ops.py — reference optimizer_op.cc) so each
+parameter update is one XLA kernel; the long tail (NAG, SGLD, AdaGrad,
+AdaDelta, Ftrl, DCASGD) composes NDArray ops which XLA fuses per update.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy
+
+from .ndarray import NDArray, zeros, clip, sqrt, square
+from .ndarray import sgd_update, sgd_mom_update, adam_update, rmsprop_update, \
+    rmspropalex_update
+from .random import normal
+
+__all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Test", "Updater",
+           "get_updater", "create", "register"]
+
+
+class Optimizer(object):
+    """Base optimizer with lr/wd multipliers and the name registry."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("WARNING: New optimizer %s.%s is overriding "
+                            "existing optimizer %s.%s", klass.__module__,
+                            klass.__name__,
+                            Optimizer.opt_registry[name].__module__,
+                            Optimizer.opt_registry[name].__name__)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        """Create optimizer state (momentum etc.) for a parameter."""
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_scale(self, args_lrscale):  # deprecated in reference too
+        raise DeprecationWarning
+
+    def set_lr_mult(self, args_lr_mult):
+        """Set per-parameter lr multipliers; reads __lr_mult__ symbol attrs
+        like the reference (optimizer.py:117-133)."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Defaults: no decay on bias/gamma/beta (optimizer.py:135-160)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum via the fused sgd(_mom)_update ops."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = {"rescale_grad": self.rescale_grad, "lr": lr, "wd": wd}
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        if state is not None:
+            sgd_mom_update(weight, grad, state, out=[weight, state],
+                           momentum=self.momentum, **kwargs)
+        else:
+            sgd_update(weight, grad, out=weight, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        comp = grad + wd * weight + \
+            self.lamda * grad * grad * (weight - previous_weight)
+        if mom is not None:
+            mom *= self.momentum
+            mom += -lr * comp
+            delta = mom
+        else:
+            delta = -lr * comp
+        weight.copyto(previous_weight)
+        weight += delta
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (optimizer.py NAG)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        weight += -lr / 2 * (grad + wd * weight) + normal(
+            0, math.sqrt(lr), weight.shape, weight.context)
+
+
+@register
+class ccSGD(SGD):
+    """Kept for backward compatibility (alias of SGD in the reference)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+@register
+class Adam(Optimizer):
+    """Adam via the fused adam_update op (optimizer.py:451)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        kwargs = {"rescale_grad": self.rescale_grad, "lr": lr, "wd": wd,
+                  "beta1": self.beta1, "beta2": self.beta2,
+                  "epsilon": self.epsilon}
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        adam_update(weight, grad, mean, var, out=[weight, mean, var], **kwargs)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (optimizer.py AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        history = state
+        history += square(grad)
+        weight += -lr * (grad / sqrt(history + self.float_stable_eps)
+                         + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """Tieleman (centered=False) and Graves (centered=True) RMSProp via the
+    fused ops (optimizer.py:536-601)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context))
+        return (zeros(weight.shape, weight.context),)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = {"rescale_grad": self.rescale_grad, "lr": lr, "wd": wd,
+                  "gamma1": self.gamma1, "epsilon": self.epsilon}
+        if self.centered:
+            kwargs["gamma2"] = self.gamma2
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        if self.clip_weights:
+            kwargs["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            rmsprop_update(weight, grad, n, out=[weight, n], **kwargs)
+        else:
+            n, g, delta = state
+            rmspropalex_update(weight, grad, n, g, delta,
+                               out=[weight, n, g, delta], **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (optimizer.py AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g *= self.rho
+        acc_g += (1.0 - self.rho) * grad * grad
+        current_delta = sqrt(acc_delta + self.epsilon) / \
+            sqrt(acc_g + self.epsilon) * grad
+        acc_delta *= self.rho
+        acc_delta += (1.0 - self.rho) * current_delta * current_delta
+        weight -= current_delta + wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (optimizer.py Ftrl)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(**kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+        self.lr = learning_rate
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        lr = self._get_lr(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        dn, n = state
+        dn += grad - (sqrt(n + grad * grad) - sqrt(n)) * weight / lr
+        n += grad * grad
+        import numpy as onp
+        dn_np = dn.asnumpy()
+        n_np = n.asnumpy()
+        w = -(dn_np - onp.sign(dn_np) * self.lamda1) / \
+            ((self.beta + onp.sqrt(n_np)) / lr + wd)
+        w *= (onp.abs(dn_np) > self.lamda1)
+        weight[:] = w
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w += -lr * rescale_grad * grad (optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad * (-self.lr)
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater(object):
+    """Apply an optimizer locally, lazily creating state per index
+    (optimizer.py:722 get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

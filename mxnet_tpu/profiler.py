@@ -1,0 +1,87 @@
+"""Profiler (python/mxnet/profiler.py + src/engine/profiler.{h,cc}).
+
+The reference stamps per-op OprExecStat inside the engine and dumps Chrome
+trace JSON. TPU-natively, per-op timing lives in the XLA/TPU runtime: we
+bridge to ``jax.profiler`` (XPlane traces, viewable in TensorBoard/Perfetto)
+while preserving the reference API (profiler_set_config / set_state /
+dump_profile) and emitting a Chrome-trace JSON of host-side step events.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "Scope"]
+
+_config = {"mode": "symbolic", "filename": "profile.json"}
+_state = "stop"
+_events = []
+_lock = threading.Lock()
+_jax_tracing = False
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """mode: 'symbolic' or 'all' (MXSetProfilerConfig)."""
+    _config["mode"] = mode
+    _config["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """state: 'run' or 'stop' (MXSetProfilerState); also starts/stops a
+    jax.profiler trace next to the chrome-trace output."""
+    global _state, _jax_tracing
+    if state == _state:
+        return
+    _state = state
+    trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+    if state == "run":
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            _jax_tracing = True
+        except Exception:
+            _jax_tracing = False
+    else:
+        if _jax_tracing:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def record_event(name, begin_us, end_us, pid=0):
+    """Append one duration event (engine's AddOprStat equivalent)."""
+    if _state != "run":
+        return
+    with _lock:
+        _events.append({"name": name, "cat": "operator", "ph": "B",
+                        "ts": begin_us, "pid": pid, "tid": pid})
+        _events.append({"name": name, "cat": "operator", "ph": "E",
+                        "ts": end_us, "pid": pid, "tid": pid})
+
+
+class Scope(object):
+    """Context manager timing a named region into the trace."""
+
+    def __init__(self, name, pid=0):
+        self.name = name
+        self.pid = pid
+
+    def __enter__(self):
+        self.begin = time.time() * 1e6
+        return self
+
+    def __exit__(self, *args):
+        record_event(self.name, self.begin, time.time() * 1e6, self.pid)
+
+
+def dump_profile():
+    """Write accumulated events as Chrome tracing JSON (MXDumpProfile)."""
+    with _lock:
+        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        with open(_config["filename"], "w") as f:
+            json.dump(data, f)

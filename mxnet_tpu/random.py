@@ -1,0 +1,62 @@
+"""Global PRNG state + random sampling frontends.
+
+Replaces the reference's per-context kRandom resource with a global seed
+(src/resource.cc:70-77, python/mxnet/random.py). JAX PRNG is counter-based
+and functional; we keep one module-level root key and split it per request,
+which preserves the reference semantics ("mx.random.seed(s) makes subsequent
+sampling deterministic") while staying jit-friendly inside executors (the
+executor threads an explicit key derived from this state).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as onp
+
+__all__ = ["seed", "uniform", "normal", "randint", "next_key"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        import jax
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global random number generators (mx.random.seed)."""
+    import jax
+    _state.key = jax.random.PRNGKey(int(seed_state))
+    onp.random.seed(int(seed_state) % (2 ** 32))
+
+
+def next_key():
+    """Split and return a fresh PRNG key (advances global state)."""
+    import jax
+    k = _get()
+    k, sub = jax.random.split(k)
+    _state.key = k
+    return sub
+
+
+def uniform(low=0, high=1, shape=None, ctx=None, out=None, dtype=None):
+    """Draw samples from a uniform distribution (mx.random.uniform)."""
+    from . import ndarray as nd
+    return nd.uniform(low=low, high=high, shape=shape, ctx=ctx, out=out,
+                      dtype=dtype)
+
+
+def normal(loc=0, scale=1, shape=None, ctx=None, out=None, dtype=None):
+    """Draw samples from a normal distribution (mx.random.normal)."""
+    from . import ndarray as nd
+    return nd.normal(loc=loc, scale=scale, shape=shape, ctx=ctx, out=out,
+                     dtype=dtype)
+
+
+def randint(low, high, shape=None, ctx=None, dtype="int32"):
+    from . import ndarray as nd
+    return nd.random_randint(low=low, high=high, shape=shape, ctx=ctx,
+                             dtype=dtype)

@@ -1,0 +1,5 @@
+"""RNN toolkit (python/mxnet/rnn)."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ZoneoutCell, ResidualCell, RNNParams)
+from .io import BucketSentenceIter, encode_sentences

@@ -1,0 +1,127 @@
+"""Network visualization (python/mxnet/visualization.py): print_summary +
+plot_network (graphviz optional — falls back to returning DOT source).
+"""
+from __future__ import annotations
+
+import json
+
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Print a layer summary table (visualization.py print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+        cur_param = 0
+        if op != "null":
+            for item in node["inputs"]:
+                input_node = nodes[item[0]]
+                if input_node["op"] == "null" and \
+                        not input_node["name"].endswith("label") and \
+                        input_node["name"] != "data":
+                    key = input_node["name"] + "_output"
+                    # count via shape of the variable itself
+                    vshape = shape_dict.get(input_node["name"] + "_output")
+        name = node["name"]
+        first_connection = "" if not pre_node else pre_node[0]
+        fields = ["%s(%s)" % (name, op), str(out_shape), cur_param,
+                  first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+
+    heads = set(h[0] for h in conf["heads"])
+    for node in nodes:
+        out_shape = None
+        op = node["op"]
+        if op != "null":
+            key = node["name"] + "_output"
+            if show_shape and key in shape_dict:
+                out_shape = shape_dict[key]
+        print_layer_summary(node, out_shape)
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph (or DOT text if graphviz isn't installed)."""
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    hidden = set()
+    if hide_weights:
+        for node in nodes:
+            if node["op"] == "null" and (
+                    node["name"].endswith("_weight")
+                    or node["name"].endswith("_bias")
+                    or node["name"].endswith("_gamma")
+                    or node["name"].endswith("_beta")
+                    or node["name"].endswith("_moving_mean")
+                    or node["name"].endswith("_moving_var")):
+                hidden.add(node["name"])
+
+    lines = ["digraph %s {" % title.replace(" ", "_")]
+    for i, node in enumerate(nodes):
+        if node["name"] in hidden:
+            continue
+        label = node["name"] if node["op"] == "null" else \
+            "%s\\n%s" % (node["op"], node["name"])
+        shape_attr = "oval" if node["op"] == "null" else "box"
+        lines.append('  n%d [label="%s", shape=%s];' % (i, label, shape_attr))
+    for i, node in enumerate(nodes):
+        for item in node.get("inputs", []):
+            src = nodes[item[0]]
+            if src["name"] in hidden:
+                continue
+            lines.append("  n%d -> n%d;" % (item[0], i))
+    lines.append("}")
+    dot_src = "\n".join(lines)
+    try:
+        from graphviz import Source
+        return Source(dot_src, format=save_format)
+    except ImportError:
+        return dot_src

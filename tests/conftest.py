@@ -1,0 +1,39 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The reference tests multi-device semantics on multiple *cpu* contexts in one
+process (tests/python/unittest/test_model_parallel.py:12-30); we do the same
+with an 8-device virtual CPU platform so sharding/collective paths are
+exercised without TPU hardware.
+
+The axon TPU plugin registers itself from sitecustomize whenever
+``PALLAS_AXON_POOL_IPS`` is set and would initialize the (single) TPU tunnel
+for every test run; since its hooks are installed at interpreter startup, the
+only reliable way to get a pure-CPU JAX here is to re-exec pytest once with a
+cleaned environment.
+"""
+import os
+import sys
+
+_NEEDS_REEXEC = (
+    os.environ.get("MXNET_TPU_TEST_REEXEC") != "1"
+    and (os.environ.get("PALLAS_AXON_POOL_IPS")
+         or "axon" in os.environ.get("JAX_PLATFORMS", ""))
+)
+
+if _NEEDS_REEXEC:
+    env = dict(os.environ)
+    env["MXNET_TPU_TEST_REEXEC"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PYTHONPATH", None)  # drops the axon sitecustomize dir
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
