@@ -155,7 +155,7 @@ def compute_gradient(outputs, out_grads=None, retain_graph=False):
     else:
         head = [g._read() if hasattr(g, "_read") else jnp.asarray(g)
                 for g in out_grads]
-    (grads,) = vjp_fn(tuple(head))
+    (grads,) = vjp_fn(list(head))
     for cid, g in zip(marked_ids, grads):
         _, gbuf, req = st.marked[cid]
         if req == "null" or gbuf is None:

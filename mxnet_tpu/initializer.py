@@ -26,6 +26,18 @@ def register(klass):
     return klass
 
 
+def create(spec):
+    """Create an initializer from an instance or a ``dumps()`` JSON string
+    (["classname", kwargs])."""
+    if not isinstance(spec, str):
+        return spec
+    name, kwargs = json.loads(spec)
+    klass = _INIT_REGISTRY[name.lower()]
+    if name.lower() == "fusedrnn" and isinstance(kwargs.get("init"), str):
+        kwargs["init"] = create(kwargs["init"])
+    return klass(**kwargs)
+
+
 class InitDesc(str):
     """Parameter name + attrs descriptor (initializer.py InitDesc)."""
 
@@ -48,6 +60,12 @@ class Initializer(object):
     def __call__(self, name, arr):
         if not isinstance(name, string_types):
             raise TypeError("name must be string")
+        # honour a per-variable __init__ attr (InitDesc), e.g. the FusedRNN
+        # initializer attached to the fused parameter vector
+        attrs = getattr(name, "attrs", None)
+        if attrs and attrs.get("__init__"):
+            create(attrs["__init__"])._init_weight(name, arr)
+            return
         if name.startswith("upsampling"):
             self._init_bilinear(name, arr)
         elif name.endswith("bias"):
@@ -66,6 +84,9 @@ class Initializer(object):
             self._init_zero(name, arr)
         elif name.endswith("moving_avg"):
             self._init_zero(name, arr)
+        elif "begin_state" in name or "init_state" in name or \
+                ("init_" in name and ("_c" in name or "_h" in name)):
+            self._init_zero(name, arr)  # RNN initial states
         else:
             self._init_default(name, arr)
 

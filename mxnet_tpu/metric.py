@@ -107,7 +107,9 @@ class Accuracy(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
             p = pred_label.asnumpy()
-            if p.ndim > 1 and p.shape[-1] > 1 and p.ndim >= label.ndim + 1:
+            # reference: argmax over channels whenever shapes differ
+            # (metric.py Accuracy / argmax_channel)
+            if p.shape != tuple(label.shape) and p.ndim > 1:
                 p = numpy.argmax(p, axis=-1)
             p = p.astype("int32").reshape(-1)
             l = label.asnumpy().astype("int32").reshape(-1)

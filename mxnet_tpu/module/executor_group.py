@@ -172,16 +172,13 @@ class DataParallelExecutorGroup(object):
         grad_arrays = {} if self.for_training else None
 
         def _get_or_reshape(name, shared_pool, arg_shape, context):
-            """Reuse a pooled array when big enough (executor_group.py:560)."""
-            if name in shared_pool:
-                arg_arr = shared_pool[name]
-                if onp.prod(arg_arr.shape) >= onp.prod(arg_shape):
-                    arg_arr = arg_arr.reshape(
-                        (-1,))[:int(onp.prod(arg_shape))].reshape(arg_shape)
-                else:
-                    arg_arr = nd.zeros(arg_shape, ctx=context)
-                    shared_pool[name] = arg_arr
-            else:
+            """Reuse a pooled array when the shape matches
+            (executor_group.py:560 _get_or_reshape). The reference carves a
+            view out of a larger pooled buffer to save device memory; under
+            XLA, buffers are assigned by the compiler, so an exact-shape
+            cache is all that's needed."""
+            arg_arr = shared_pool.get(name)
+            if arg_arr is None or tuple(arg_arr.shape) != tuple(arg_shape):
                 arg_arr = nd.zeros(arg_shape, ctx=context)
                 shared_pool[name] = arg_arr
             return arg_arr

@@ -589,18 +589,17 @@ def save(fname, data):
     """Save a list or str->NDArray dict of NDArrays to file."""
     if isinstance(data, NDArray):
         data = [data]
+    # pass an open handle so numpy does not append ".npz" to the filename
     if isinstance(data, dict):
         arrs = {k: v.asnumpy() for k, v in data.items()}
-        onp.savez(_ensure_ext(fname), __mx_format__="dict", **arrs)
+        with open(fname, "wb") as f:
+            onp.savez(f, __mx_format__="dict", **arrs)
     elif isinstance(data, (list, tuple)):
         arrs = {"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)}
-        onp.savez(_ensure_ext(fname), __mx_format__="list", **arrs)
+        with open(fname, "wb") as f:
+            onp.savez(f, __mx_format__="list", **arrs)
     else:
         raise ValueError("data needs to either be a NDArray, dict or list")
-
-
-def _ensure_ext(fname):
-    return fname
 
 
 def load(fname):

@@ -110,7 +110,13 @@ def _deconv_infer(attrs, in_shapes, aux):
     return in_shapes, [(data[0], nf) + out_sp], aux
 
 
-@register("Deconvolution", arg_names=_conv_args,
+def _deconv_args(attrs):
+    # Deconvolution's no_bias defaults to True in the reference
+    return ("data", "weight") if attrs.get("no_bias", True) else \
+        ("data", "weight", "bias")
+
+
+@register("Deconvolution", arg_names=_deconv_args,
           attr_types={"kernel": tuple, "stride": tuple, "pad": tuple,
                       "adj": tuple, "target_shape": tuple, "num_filter": int,
                       "num_group": int, "workspace": int, "no_bias": bool},

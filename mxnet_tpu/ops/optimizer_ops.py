@@ -20,10 +20,17 @@ _SGD_ATTRS = {"lr": float, "wd": float, "rescale_grad": float,
               "clip_gradient": float, "momentum": float}
 
 
+def _sc(attrs, key, default):
+    """Scalar attr that may be a python number OR a traced jax value (the
+    sharded train step passes lr as a jit argument to avoid recompiles)."""
+    v = attrs.get(key, default)
+    return float(v) if isinstance(v, (int, float, str)) else v
+
+
 def _prep(jnp, attrs, grad):
-    rescale = float(attrs.get("rescale_grad", 1.0))
+    rescale = _sc(attrs, "rescale_grad", 1.0)
     clip = attrs.get("clip_gradient", None)
-    g = grad * onp.asarray(rescale, grad.dtype)
+    g = grad * rescale
     if clip is not None and float(clip) > 0:
         c = float(clip)
         g = jnp.clip(g, -c, c)
@@ -34,19 +41,19 @@ def _prep(jnp, attrs, grad):
 def _sgd_update(attrs, ins, octx):
     jnp = _jnp()
     w, grad = ins
-    lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
+    lr = _sc(attrs, "lr", 0.01)
+    wd = _sc(attrs, "wd", 0.0)
     g = _prep(jnp, attrs, grad)
     return [w - lr * (g + wd * w)]
 
 
 @register("sgd_mom_update", arg_names=("weight", "grad", "mom"),
-          attr_types=_SGD_ATTRS)
+          out_names=("weight", "mom"), attr_types=_SGD_ATTRS)
 def _sgd_mom_update(attrs, ins, octx):
     jnp = _jnp()
     w, grad, mom = ins
-    lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
+    lr = _sc(attrs, "lr", 0.01)
+    wd = _sc(attrs, "wd", 0.0)
     momentum = float(attrs.get("momentum", 0.0))
     g = _prep(jnp, attrs, grad)
     new_mom = momentum * mom - lr * (g + wd * w)
@@ -54,17 +61,17 @@ def _sgd_mom_update(attrs, ins, octx):
 
 
 @register("adam_update", arg_names=("weight", "grad", "mean", "var"),
-          attr_types={"lr": float, "beta1": float, "beta2": float,
+          out_names=("weight", "mean", "var"), attr_types={"lr": float, "beta1": float, "beta2": float,
                       "epsilon": float, "wd": float, "rescale_grad": float,
                       "clip_gradient": float})
 def _adam_update(attrs, ins, octx):
     jnp = _jnp()
     w, grad, mean, var = ins
-    lr = float(attrs["lr"])
+    lr = _sc(attrs, "lr", 0.01)
     beta1 = float(attrs.get("beta1", 0.9))
     beta2 = float(attrs.get("beta2", 0.999))
     eps = float(attrs.get("epsilon", 1e-8))
-    wd = float(attrs.get("wd", 0.0))
+    wd = _sc(attrs, "wd", 0.0)
     g = _prep(jnp, attrs, grad) + wd * w
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -73,16 +80,16 @@ def _adam_update(attrs, ins, octx):
 
 
 @register("rmsprop_update", arg_names=("weight", "grad", "n"),
-          attr_types={"lr": float, "gamma1": float, "epsilon": float,
+          out_names=("weight", "n"), attr_types={"lr": float, "gamma1": float, "epsilon": float,
                       "wd": float, "rescale_grad": float,
                       "clip_gradient": float, "clip_weights": float})
 def _rmsprop_update(attrs, ins, octx):
     jnp = _jnp()
     w, grad, n = ins
-    lr = float(attrs["lr"])
+    lr = _sc(attrs, "lr", 0.01)
     gamma1 = float(attrs.get("gamma1", 0.95))
     eps = float(attrs.get("epsilon", 1e-8))
-    wd = float(attrs.get("wd", 0.0))
+    wd = _sc(attrs, "wd", 0.0)
     g = _prep(jnp, attrs, grad) + wd * w
     new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     new_w = w - lr * g / jnp.sqrt(new_n + eps)
@@ -94,18 +101,18 @@ def _rmsprop_update(attrs, ins, octx):
 
 @register("rmspropalex_update",
           arg_names=("weight", "grad", "n", "g", "delta"),
-          attr_types={"lr": float, "gamma1": float, "gamma2": float,
+          out_names=("weight", "n", "g", "delta"), attr_types={"lr": float, "gamma1": float, "gamma2": float,
                       "epsilon": float, "wd": float, "rescale_grad": float,
                       "clip_gradient": float, "clip_weights": float})
 def _rmspropalex_update(attrs, ins, octx):
     """Graves-form RMSProp (optimizer_op.cc rmspropalex_update)."""
     jnp = _jnp()
     w, grad, n, gbar, delta = ins
-    lr = float(attrs["lr"])
+    lr = _sc(attrs, "lr", 0.01)
     gamma1 = float(attrs.get("gamma1", 0.95))
     gamma2 = float(attrs.get("gamma2", 0.9))
     eps = float(attrs.get("epsilon", 1e-8))
-    wd = float(attrs.get("wd", 0.0))
+    wd = _sc(attrs, "wd", 0.0)
     g = _prep(jnp, attrs, grad) + wd * w
     new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     new_gbar = (1 - gamma1) * g + gamma1 * gbar

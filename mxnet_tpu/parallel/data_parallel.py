@@ -120,11 +120,14 @@ class DataParallelTrainStep:
             new_aux_d = dict(zip(self.aux_names, new_aux))
             return new_params, new_states, new_aux_d, outs
 
+        # donate param/state buffers for in-place HBM updates on real
+        # accelerators; the CPU backend's donation path is unreliable
+        donate = (0, 1) if mesh.devices.flat[0].platform != "cpu" else ()
         self._step = jax.jit(
             train_step,
             in_shardings=(repl, repl, repl, batch, None, None),
             out_shardings=(repl, repl, repl, batch),
-            donate_argnums=(0, 1),
+            donate_argnums=donate,
         )
 
         def fwd(params, aux, inputs, rng):

@@ -116,7 +116,7 @@ def ring_self_attention(mesh, axis="sp"):
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, axis, None)
 

@@ -70,22 +70,25 @@ class BaseRNNCell(object):
     def _gate_names(self):
         return ()
 
-    def begin_state(self, func=symbol.zeros, **kwargs):
+    def begin_state(self, func=None, **kwargs):
+        """Initial states. By default these are zero-initialized, non-learned
+        Variables (lr_mult=0) so the unrolled graph stays shape-inferable and
+        bindable; pass ``func=symbol.zeros`` etc. to override (the reference
+        signature, rnn_cell.py begin_state)."""
         assert not self._modified, \
             "After applying modifier cells the base cell cannot be called directly. " \
             "Call the modifier cell instead."
         states = []
         for info in self.state_info:
             self._init_counter += 1
-            if info is None:
-                state = func(name="%sbegin_state_%d" % (self._prefix,
-                                                        self._init_counter),
-                             **kwargs)
+            name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
+            if func is None:
+                state = symbol.Variable(name, lr_mult=0.0)
             else:
-                kwargs.update(info)
-                state = func(name="%sbegin_state_%d" % (self._prefix,
-                                                        self._init_counter),
-                             **kwargs)
+                if info is not None:
+                    kwargs.update({k: v for k, v in info.items()
+                                   if not k.startswith("__")})
+                state = func(name=name, **kwargs)
             states.append(state)
         return states
 
@@ -575,10 +578,10 @@ class ModifierCell(BaseRNNCell):
     def state_info(self):
         return self.base_cell.state_info
 
-    def begin_state(self, init_sym=symbol.zeros, **kwargs):
+    def begin_state(self, func=None, **kwargs):
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(init_sym, **kwargs)
+        begin = self.base_cell.begin_state(func, **kwargs)
         self.base_cell._modified = True
         return begin
 

@@ -119,25 +119,26 @@ class Symbol:
         return s
 
     def _compose(self, *args, **kwargs):
+        """Substitute free variables with symbols (nnvm Symbol::Compose):
+        kwargs match variable *names* anywhere in the graph; positional args
+        match free variables in list_arguments order."""
         name = kwargs.pop("name", None)
-        if len(self._heads) != 1 or self._heads[0][0].op is None:
-            raise MXNetError("can only compose a single-op symbol")
-        node = self._heads[0][0]
-        if name:
-            node.name = name
-        arg_syms = list(args) + [kwargs[k] for k in sorted(kwargs)]
-        by_name = dict(kwargs)
-        new_inputs = []
-        arg_names = node.op.list_arguments(node.attrs)
-        for i, (src, oi) in enumerate(node.inputs):
-            nm = arg_names[i] if i < len(arg_names) else None
-            if nm is not None and nm in by_name:
-                new_inputs.append(by_name[nm]._heads[0])
-            elif src.op is None and arg_syms and not by_name:
-                new_inputs.append(arg_syms.pop(0)._heads[0])
-            else:
-                new_inputs.append((src, oi))
-        node.inputs = new_inputs
+        if name and len(self._heads) == 1 and self._heads[0][0].op is not None:
+            self._heads[0][0].name = name
+        order = self._topo()
+        free_vars = [n for n in order if n.op is None]
+        repl = {}  # id(var node) -> (node, out_idx) replacement head
+        for var, s in zip(free_vars, args):
+            repl[id(var)] = s._heads[0]
+        by_name = {n.name: n for n in free_vars}
+        for k, v in kwargs.items():
+            if k not in by_name:
+                raise MXNetError("cannot compose: no variable named %s" % k)
+            repl[id(by_name[k])] = v._heads[0]
+        for n in order:
+            n.inputs = [repl.get(id(src), (src, oi))
+                        for (src, oi) in n.inputs]
+        self._heads = [repl.get(id(n), (n, oi)) for (n, oi) in self._heads]
 
     def __copy__(self):
         # deep copy of reachable graph
@@ -273,7 +274,12 @@ class Symbol:
             for nm, s in zip(arg_names, args):
                 if s is not None:
                     known[nm] = tuple(s)
+        valid = set(arg_names) | set(self.list_auxiliary_states())
         for k, v in kwargs.items():
+            if k not in valid:
+                raise ValueError(
+                    "Unknown argument %s in infer_shape (arguments: %s)"
+                    % (k, arg_names))
             if v is not None:
                 known[k] = tuple(v)
 

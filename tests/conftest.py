@@ -7,9 +7,10 @@ exercised without TPU hardware.
 
 The axon TPU plugin registers itself from sitecustomize whenever
 ``PALLAS_AXON_POOL_IPS`` is set and would initialize the (single) TPU tunnel
-for every test run; since its hooks are installed at interpreter startup, the
+for every test run; its hooks are installed at interpreter startup, so the
 only reliable way to get a pure-CPU JAX here is to re-exec pytest once with a
-cleaned environment.
+cleaned environment. The exec happens in pytest_configure with capture
+suspended so the replacement process writes to the real stdout.
 """
 import os
 import sys
@@ -20,7 +21,23 @@ _NEEDS_REEXEC = (
          or "axon" in os.environ.get("JAX_PLATFORMS", ""))
 )
 
-if _NEEDS_REEXEC:
+if not _NEEDS_REEXEC:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    if not _NEEDS_REEXEC:
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
     env = dict(os.environ)
     env["MXNET_TPU_TEST_REEXEC"] = "1"
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -29,11 +46,5 @@ if _NEEDS_REEXEC:
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8").strip()
     os.execve(sys.executable,
-              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8").strip()
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+              [sys.executable, "-m", "pytest"]
+              + list(config.invocation_params.args), env)

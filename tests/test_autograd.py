@@ -1,0 +1,80 @@
+"""Imperative autograd tests (mirrors tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import ndarray as nd
+
+
+def grad_and_loss_check(fn, args, expected_grad_fn):
+    grads, loss = ag.grad_and_loss(fn)(*args)
+    for g, a in zip(grads, args):
+        np.testing.assert_allclose(g.asnumpy(),
+                                   expected_grad_fn(a.asnumpy()), rtol=1e-4)
+
+
+def test_unary_func_grads():
+    x = nd.array(np.random.rand(3, 3).astype(np.float32) + 0.5)
+    grad_and_loss_check(lambda x: x * 2, [x], lambda v: 2 * np.ones_like(v))
+    grad_and_loss_check(lambda x: nd.exp(x), [x], np.exp)
+    grad_and_loss_check(lambda x: nd.log(x), [x], lambda v: 1.0 / v)
+
+
+def test_mark_variables_backward():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    gx = nd.zeros((2, 2))
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = nd.sum(x * x)
+    ag.compute_gradient([y])
+    np.testing.assert_allclose(gx.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_chain_of_ops():
+    x = nd.array(np.random.rand(4).astype(np.float32) + 0.1)
+    gx = nd.zeros(4)
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = nd.exp(nd.log(x) * 2)  # = x^2
+    ag.compute_gradient([y])
+    np.testing.assert_allclose(gx.asnumpy(), 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_grad_req_add_autograd():
+    x = nd.array([1.0, 2.0])
+    gx = nd.ones(2)
+    ag.mark_variables([x], [gx], grad_reqs="add")
+    with ag.train_section():
+        y = x * 3
+    ag.compute_gradient([y])
+    np.testing.assert_allclose(gx.asnumpy(), 1 + 3 * np.ones(2), rtol=1e-6)
+
+
+def test_multiple_outputs():
+    x = nd.array([2.0])
+    gx = nd.zeros(1)
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y1 = x * 2
+        y2 = x * x
+    ag.compute_gradient([y1, y2])
+    np.testing.assert_allclose(gx.asnumpy(), [2 + 2 * 2.0], rtol=1e-5)
+
+
+def test_training_flag():
+    assert not ag.is_training()
+    with ag.train_section():
+        assert ag.is_training()
+        with ag.test_section():
+            assert not ag.is_training()
+        assert ag.is_training()
+    assert not ag.is_training()
+
+
+def test_dropout_respects_training_mode():
+    x = nd.ones((50, 50))
+    out_eval = nd.Dropout(x, p=0.5)
+    assert np.array_equal(out_eval.asnumpy(), x.asnumpy())
+    with ag.train_section():
+        out_train = nd.Dropout(x, p=0.5)
+    assert (out_train.asnumpy() == 0).mean() > 0.2

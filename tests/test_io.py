@@ -1,0 +1,120 @@
+"""IO iterator tests (mirrors tests/python/unittest/test_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    labels = np.arange(10).astype(np.float32)
+    it = mio.NDArrayIter(data, labels, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert batches[0].label[0].shape == (5,)
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), data[:5])
+
+
+def test_ndarray_iter_pad():
+    data = np.arange(12).reshape(6, 2).astype(np.float32)
+    it = mio.NDArrayIter(data, np.zeros(6), batch_size=4,
+                         last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 2
+    # padded batch wraps around
+    np.testing.assert_array_equal(batches[1].data[0].asnumpy()[2:],
+                                  data[:2])
+
+
+def test_ndarray_iter_discard():
+    data = np.arange(12).reshape(6, 2).astype(np.float32)
+    it = mio.NDArrayIter(data, np.zeros(6), batch_size=4,
+                         last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 1
+
+
+def test_ndarray_iter_reset():
+    data = np.arange(8).reshape(4, 2).astype(np.float32)
+    it = mio.NDArrayIter(data, np.zeros(4), batch_size=2)
+    n1 = len(list(it))
+    it.reset()
+    n2 = len(list(it))
+    assert n1 == n2 == 2
+
+
+def test_ndarray_iter_dict_data():
+    data = {"a": np.zeros((6, 2), np.float32),
+            "b": np.ones((6, 3), np.float32)}
+    it = mio.NDArrayIter(data, np.zeros(6), batch_size=3)
+    names = [d.name for d in it.provide_data]
+    assert set(names) == {"a", "b"}
+    batch = next(iter(it))
+    assert len(batch.data) == 2
+
+
+def test_resize_iter():
+    data = np.arange(20).reshape(10, 2).astype(np.float32)
+    base = mio.NDArrayIter(data, np.zeros(10), batch_size=5)
+    resized = mio.ResizeIter(base, size=5)
+    assert len(list(resized)) == 5
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    base = mio.NDArrayIter(data, np.zeros(10), batch_size=5)
+    pre = mio.PrefetchingIter(base)
+    batches = list(pre)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    pre.reset()
+    assert len(list(pre)) == 2
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(8, 3).astype(np.float32)
+    labels = np.arange(8).astype(np.float32)
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    np.savetxt(data_path, data, delimiter=",")
+    np.savetxt(label_path, labels, delimiter=",")
+    it = mio.CSVIter(data_csv=data_path, data_shape=(3,),
+                     label_csv=label_path, batch_size=4)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                               rtol=1e-5)
+
+
+def test_mnist_iter_idx_format(tmp_path):
+    """Write a tiny idx file pair and read through MNISTIter."""
+    import struct
+    img_path = str(tmp_path / "imgs")
+    lbl_path = str(tmp_path / "lbls")
+    imgs = (np.random.rand(20, 8, 8) * 255).astype(np.uint8)
+    lbls = np.random.randint(0, 10, 20).astype(np.uint8)
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803 & 0xFFFF | 3))  # magic w/ ndim 3
+        f.write(struct.pack(">III", 20, 8, 8))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">I", 1))
+        f.write(struct.pack(">I", 20))
+        f.write(lbls.tobytes())
+    it = mio.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                       shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (5, 1, 8, 8)
+    assert batch.data[0].asnumpy().max() <= 1.0
+
+
+def test_databatch_provide():
+    d = mio.DataDesc("data", (4, 3))
+    assert d.name == "data" and d.shape == (4, 3)
+    assert mio.DataDesc.get_batch_axis("NCHW") == 0
+    assert mio.DataDesc.get_batch_axis("TNC") == 1

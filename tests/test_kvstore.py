@@ -1,0 +1,109 @@
+"""KVStore tests (mirrors tests/python/unittest/test_kvstore.py — local
+types, multi-"device" aggregation purely in one process)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import ndarray as nd
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kind="local"):
+    kv = kvs.create(kind)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def check_diff_to_scalar(A, x):
+    assert np.sum(np.abs(A.asnumpy() - x)) == 0, A.asnumpy()
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, nd.ones(SHAPE))
+    val = nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_aggregator_multi_devs():
+    """Values from N "devices" are summed deterministically."""
+    kv = init_kv()
+    num_devs = 4
+    devs = [mx.cpu(i) for i in range(num_devs)]
+    vals = [nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push(3, vals)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, num_devs)
+
+    # list interface
+    kv.push(KEYS, [[nd.ones(SHAPE, ctx=d) * 2.0 for d in devs]] * len(KEYS))
+    outs = [nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        check_diff_to_scalar(o, num_devs * 2.0)
+
+
+def test_updater():
+    kv = init_kv()
+
+    def updater(key, recv, local):
+        local += recv
+
+    kv._set_updater(updater)
+    kv.push(3, nd.ones(SHAPE))
+    kv.push(3, nd.ones(SHAPE))
+    val = nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 2)
+
+
+def test_set_optimizer_updates_weights():
+    kv = init_kv()
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.SGD(learning_rate=0.1, rescale_grad=1.0))
+    # stored weight 0; push grad 1 → w = -0.1
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), -0.1 * np.ones(SHAPE),
+                               rtol=1e-6)
+
+
+def test_pull_broadcast_multi_devs():
+    kv = init_kv()
+    kv.push(3, nd.ones(SHAPE) * 3)
+    outs = [nd.empty(SHAPE, ctx=mx.cpu(i)) for i in range(3)]
+    kv.pull(3, out=outs)
+    for o in outs:
+        check_diff_to_scalar(o, 3)
+
+
+def test_kvstore_types():
+    for kind in ["local", "device", "dist_sync", "dist_async"]:
+        kv = kvs.create(kind)
+        assert kv.type == kind
+        assert kv.rank == 0
+        assert kv.num_workers == 1
+    with pytest.raises(Exception):
+        kvs.create("bogus_type")
+
+
+def test_get_num_dead_node():
+    kv = kvs.create("local")
+    assert kv.get_num_dead_node(0) == 0
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = init_kv()
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(3, nd.ones(SHAPE))
+    fname = str(tmp_path / "states.bin")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)

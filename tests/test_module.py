@@ -1,0 +1,225 @@
+"""Module API tests (mirrors tests/python/unittest/test_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _softmax_mlp(nhidden=16, nclass=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=nhidden, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=nclass, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=160, dim=8, nclass=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    w = rng.randn(dim, nclass)
+    y = np.argmax(X.dot(w), axis=1).astype(np.float32)
+    return X, y
+
+
+def test_module_bind_forward():
+    net = _softmax_mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 8))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params()
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch([mx.nd.ones((10, 8))], [mx.nd.zeros((10,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (10, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                               np.ones(10), rtol=1e-5)
+
+
+def test_module_fit_sgd():
+    np.random.seed(11)
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+    mod = mx.mod.Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=8,
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+def test_module_fit_adam():
+    np.random.seed(12)
+    X, y = _toy_data(seed=1)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05})
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+def test_module_multi_device_data_parallel():
+    """The reference tests multi-device on cpu contexts
+    (test_module / test_kvstore pattern)."""
+    np.random.seed(7)  # initializer draws from the global numpy RNG
+    X, y = _toy_data(seed=2)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_softmax_mlp(),
+                        context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=10, kvstore="local",
+            optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+def test_module_predict():
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_softmax_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (160, 4)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2)
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label)
+    mod2.init_params()
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_module_input_grads():
+    net = _softmax_mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch([mx.nd.ones((4, 8))], [mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 8)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_fixed_params():
+    net = _softmax_mlp()
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        fixed_param_names=["fc1_weight", "fc1_bias"])
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    before, _ = mod.get_params()
+    w1_before = before["fc1_weight"].asnumpy().copy()
+    w2_before = before["fc2_weight"].asnumpy().copy()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    after, _ = mod.get_params()
+    np.testing.assert_array_equal(w1_before, after["fc1_weight"].asnumpy())
+    assert not np.array_equal(w2_before, after["fc2_weight"].asnumpy())
+
+
+def test_module_reshape():
+    net = _softmax_mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    mod.reshape(data_shapes=[("data", (8, 8))],
+                label_shapes=[("softmax_label", (8,))])
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch([mx.nd.ones((8, 8))], [mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (8, 4)
+
+
+def test_bucketing_module():
+    """Bucketed training shares params across per-length graphs
+    (bucketing_module.py:302)."""
+    buckets = [4, 8]
+
+    def sym_gen(seq_len):
+        # params must be length-independent to share across buckets
+        data = sym.Variable("data")
+        net = sym.Embedding(data, input_dim=20, output_dim=8, name="embed")
+        net = sym.sum(net, axis=1)
+        net = sym.FullyConnected(net, num_hidden=2, name="fc_out")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataBatch, DataDesc
+    mod.bind(data_shapes=[DataDesc("data", (4, 8))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for key in [8, 4, 8, 4]:
+        batch = DataBatch([mx.nd.ones((4, key))], [mx.nd.zeros((4,))],
+                          bucket_key=key,
+                          provide_data=[DataDesc("data", (4, key))],
+                          provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets.keys()) == {4, 8}
+
+
+def test_sequential_module():
+    net1 = sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                              name="fc1")
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(sym.Variable("fc1_output"),
+                                                num_hidden=3, name="fc2"),
+                             name="softmax")
+    smod = mx.mod.SequentialModule()
+    smod.add(mx.mod.Module(net1, label_names=[], context=mx.cpu()))
+    smod.add(mx.mod.Module(net2, data_names=["fc1_output"],
+                           context=mx.cpu()),
+             take_labels=True, auto_wiring=True)
+    X, y = _toy_data(nclass=3)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    smod.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label)
+    smod.init_params()
+    smod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    from mxnet_tpu.metric import Accuracy
+    metric = Accuracy()
+    for _ in range(4):
+        train.reset()
+        for batch in train:
+            smod.forward_backward(batch)
+            smod.update()
+            smod.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.5
+
+
+def test_feedforward_api():
+    np.random.seed(13)
+    X, y = _toy_data()
+    model = mx.model.FeedForward(_softmax_mlp(), ctx=mx.cpu(), num_epoch=6,
+                                 numpy_batch_size=16, learning_rate=0.5)
+    model.fit(X, y)
+    acc = model.score(X, y)
+    assert acc > 0.85, acc
+    preds = model.predict(X)
+    assert preds.shape == (160, 4)

@@ -1,0 +1,201 @@
+"""NDArray tests (mirrors tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def reldiff(a, b):
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
+    return 0 if diff == 0 else diff / norm
+
+
+def test_ndarray_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.all(a.asnumpy() == 0)
+    b = nd.ones((2, 2), dtype=np.int32)
+    assert b.asnumpy().sum() == 4
+    c = nd.full((2, 2), 3.5)
+    assert np.all(c.asnumpy() == 3.5)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert np.array_equal(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_ndarray_elementwise():
+    rng = np.random.RandomState(0)
+    for shape in [(4,), (3, 5), (2, 3, 4)]:
+        x = rng.randn(*shape).astype(np.float32)
+        y = rng.rand(*shape).astype(np.float32) + 0.5
+        a, b = nd.array(x), nd.array(y)
+        assert reldiff((a + b).asnumpy(), x + y) < 1e-6
+        assert reldiff((a - b).asnumpy(), x - y) < 1e-6
+        assert reldiff((a * b).asnumpy(), x * y) < 1e-6
+        assert reldiff((a / b).asnumpy(), x / y) < 1e-5
+        assert reldiff((a + 2).asnumpy(), x + 2) < 1e-6
+        assert reldiff((2 - a).asnumpy(), 2 - x) < 1e-6
+        assert reldiff((a * 0.5).asnumpy(), x * 0.5) < 1e-6
+        assert reldiff((-a).asnumpy(), -x) < 1e-6
+
+
+def test_ndarray_inplace():
+    x = np.ones((3, 3), dtype=np.float32)
+    a = nd.array(x)
+    a += 2
+    assert np.all(a.asnumpy() == 3)
+    a *= 2
+    assert np.all(a.asnumpy() == 6)
+    a /= 3
+    assert np.all(a.asnumpy() == 2)
+    a -= 1
+    assert np.all(a.asnumpy() == 1)
+
+
+def test_ndarray_setitem():
+    a = nd.zeros((4, 3))
+    a[:] = 1
+    assert np.all(a.asnumpy() == 1)
+    a[1] = 2
+    expected = np.ones((4, 3), dtype=np.float32)
+    expected[1] = 2
+    assert np.array_equal(a.asnumpy(), expected)
+    a[1:3] = 3
+    expected[1:3] = 3
+    assert np.array_equal(a.asnumpy(), expected)
+    a[0] = np.array([7, 8, 9])
+    expected[0] = [7, 8, 9]
+    assert np.array_equal(a.asnumpy(), expected)
+
+
+def test_ndarray_slice_view_write():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    v = a[1:3]
+    assert v.shape == (2, 3)
+    v[:] = 0
+    out = a.asnumpy()
+    assert np.all(out[1:3] == 0)
+    assert np.all(out[0] == [0, 1, 2])
+
+
+def test_ndarray_at_view():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    row = a[1]
+    assert row.shape == (2,)
+    assert np.array_equal(row.asnumpy(), [2, 3])
+
+
+def test_ndarray_reshape_shares():
+    a = nd.array(np.arange(6, dtype=np.float32))
+    b = a.reshape((2, 3))
+    b[:] = 0
+    assert np.all(a.asnumpy() == 0)
+    c = a.reshape((3, -1))
+    assert c.shape == (3, 2)
+
+
+def test_ndarray_copy():
+    a = nd.array(np.random.randn(3, 3).astype(np.float32))
+    b = a.copy()
+    b[:] = 0
+    assert not np.all(a.asnumpy() == 0)
+    c = nd.zeros((3, 3))
+    a.copyto(c)
+    assert np.array_equal(a.asnumpy(), c.asnumpy())
+
+
+def test_ndarray_scalar_ops():
+    x = np.array([[1.0, 4.0], [9.0, 16.0]], dtype=np.float32)
+    a = nd.array(x)
+    assert reldiff(nd.sqrt(a).asnumpy(), np.sqrt(x)) < 1e-6
+    assert reldiff(nd.square(a).asnumpy(), x ** 2) < 1e-6
+    assert reldiff(nd.exp(a).asnumpy(), np.exp(x)) < 1e-5
+    assert reldiff(nd.log(a).asnumpy(), np.log(x)) < 1e-6
+    assert reldiff((a ** 2).asnumpy(), x ** 2) < 1e-6
+
+
+def test_ndarray_comparison():
+    a = nd.array([[1, 2], [3, 4]])
+    b = nd.array([[1, 3], [2, 4]])
+    assert np.array_equal((a == b).asnumpy(), [[1, 0], [0, 1]])
+    assert np.array_equal((a > b).asnumpy(), [[0, 0], [1, 0]])
+    assert np.array_equal((a >= 2).asnumpy(), [[0, 1], [1, 1]])
+
+
+def test_ndarray_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert abs(nd.sum(a).asnumpy() - x.sum()) < 1e-3
+    assert reldiff(nd.sum(a, axis=1).asnumpy(), x.sum(axis=1)) < 1e-5
+    assert reldiff(nd.max(a, axis=(0, 2)).asnumpy(),
+                   x.max(axis=(0, 2))) < 1e-6
+    assert reldiff(nd.mean(a, axis=2, keepdims=True).asnumpy(),
+                   x.mean(axis=2, keepdims=True)) < 1e-5
+
+
+def test_ndarray_dot():
+    x = np.random.randn(4, 5).astype(np.float32)
+    y = np.random.randn(5, 6).astype(np.float32)
+    assert reldiff(nd.dot(nd.array(x), nd.array(y)).asnumpy(),
+                   x.dot(y)) < 1e-5
+    assert reldiff(nd.dot(nd.array(x), nd.array(y.T),
+                          transpose_b=True).asnumpy(), x.dot(y)) < 1e-5
+
+
+def test_ndarray_concatenate():
+    parts = [np.random.randn(2, 3).astype(np.float32) for _ in range(3)]
+    merged = nd.concatenate([nd.array(p) for p in parts], axis=0)
+    assert np.array_equal(merged.asnumpy(), np.concatenate(parts, axis=0))
+
+
+def test_ndarray_saveload(tmp_path):
+    fname = str(tmp_path / "nd.npz")
+    data = [nd.array(np.random.rand(3, 3).astype(np.float32))
+            for _ in range(3)]
+    nd.save(fname, data)
+    loaded = nd.load(fname)
+    assert len(loaded) == 3
+    for a, b in zip(data, loaded):
+        assert np.array_equal(a.asnumpy(), b.asnumpy())
+    dmap = {"w1": data[0], "w2": data[1]}
+    nd.save(fname, dmap)
+    loaded = nd.load(fname)
+    assert set(loaded.keys()) == {"w1", "w2"}
+    assert np.array_equal(loaded["w1"].asnumpy(), data[0].asnumpy())
+
+
+def test_ndarray_onehot():
+    a = nd.array([1, 0, 2])
+    out = nd.zeros((3, 3))
+    nd.onehot_encode(a, out)
+    assert np.array_equal(out.asnumpy(),
+                          [[0, 1, 0], [1, 0, 0], [0, 0, 1]])
+
+
+def test_ndarray_astype_context():
+    a = nd.array([[1.5, 2.5]])
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type in ("cpu",)
+
+
+def test_ndarray_broadcast_ops():
+    x = np.random.randn(3, 1).astype(np.float32)
+    y = np.random.randn(1, 4).astype(np.float32)
+    out = nd.broadcast_add(nd.array(x), nd.array(y))
+    assert reldiff(out.asnumpy(), x + y) < 1e-6
+    out = nd.broadcast_to(nd.array(x), shape=(3, 5))
+    assert out.shape == (3, 5)
+
+
+def test_waitall_and_wait_to_read():
+    a = nd.ones((10, 10))
+    b = a * 2
+    b.wait_to_read()
+    nd.waitall()
+    assert np.all(b.asnumpy() == 2)

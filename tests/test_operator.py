@@ -1,0 +1,439 @@
+"""Operator fwd/bwd vs numpy (mirrors tests/python/unittest/test_operator.py).
+
+numpy is the reference implementation; gradients are additionally verified
+against finite differences via check_numeric_gradient — the reference's
+oracle (test_utils.py:360).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward, default_context,
+                                  reldiff)
+
+
+def test_elemwise_binary_ops():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    x = np.random.randn(3, 4).astype(np.float32)
+    y = np.random.rand(3, 4).astype(np.float32) + 0.5
+    check_symbolic_forward(a + b, {"a": x, "b": y}, [x + y])
+    check_symbolic_forward(a - b, {"a": x, "b": y}, [x - y])
+    check_symbolic_forward(a * b, {"a": x, "b": y}, [x * y])
+    check_symbolic_forward(a / b, {"a": x, "b": y}, [x / y], rtol=1e-4)
+    # gradient of product
+    check_symbolic_backward(a * b, {"a": x, "b": y},
+                            [np.ones_like(x)], {"a": y, "b": x})
+
+
+def test_unary_math():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    v = sym.Variable("x")
+    for name, fn in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                     ("sigmoid", lambda t: 1 / (1 + np.exp(-t))),
+                     ("tanh", np.tanh), ("abs", np.abs),
+                     ("square", np.square)]:
+        s = getattr(sym, name)(v)
+        check_symbolic_forward(s, {"x": x}, [fn(x)], rtol=1e-4)
+
+
+def test_scalar_pow():
+    data = sym.Variable("data")
+    shape = (1, 1)
+    data_tmp = np.ones(shape) * 3
+    check_symbolic_forward(data ** 2, {"data": data_tmp}, [data_tmp ** 2])
+    check_symbolic_backward(data ** 2, {"data": data_tmp},
+                            [np.ones(shape)], {"data": 2 * data_tmp})
+
+
+def test_fully_connected():
+    x = np.random.randn(4, 10).astype(np.float32)
+    w = np.random.randn(5, 10).astype(np.float32)
+    b = np.random.randn(5).astype(np.float32)
+    fc = sym.FullyConnected(sym.Variable("data"), num_hidden=5, name="fc")
+    check_symbolic_forward(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           [x.dot(w.T) + b], rtol=1e-4)
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           numeric_eps=1e-2, rtol=5e-2)
+
+
+def test_activation_relu():
+    x = np.random.randn(3, 4).astype(np.float32)
+    act = sym.Activation(sym.Variable("data"), act_type="relu")
+    check_symbolic_forward(act, {"data": x}, [np.maximum(x, 0)])
+    check_symbolic_backward(act, {"data": x}, [np.ones_like(x)],
+                            {"data": (x > 0).astype(np.float32)})
+
+
+def test_leaky_relu():
+    x = np.random.randn(3, 4).astype(np.float32)
+    out = sym.LeakyReLU(sym.Variable("data"), act_type="leaky", slope=0.1)
+    check_symbolic_forward(out, {"data": x},
+                           [np.where(x > 0, x, 0.1 * x)])
+    out = sym.LeakyReLU(sym.Variable("data"), act_type="elu", slope=0.25)
+    check_symbolic_forward(out, {"data": x},
+                           [np.where(x > 0, x, 0.25 * (np.exp(x) - 1))],
+                           rtol=1e-4)
+
+
+def test_softmax_output_forward_backward():
+    x = np.random.randn(4, 5).astype(np.float32)
+    label = np.array([0, 2, 1, 4], dtype=np.float32)
+    s = sym.SoftmaxOutput(sym.Variable("data"), name="softmax")
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    check_symbolic_forward(s, {"data": x, "softmax_label": label}, [p],
+                           rtol=1e-4)
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    check_symbolic_backward(s, {"data": x, "softmax_label": label},
+                            None, {"data": p - onehot}, rtol=1e-4)
+
+
+def test_softmax_output_normalization():
+    x = np.random.randn(4, 5).astype(np.float32)
+    label = np.array([0, 2, 1, 4], dtype=np.float32)
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    s = sym.SoftmaxOutput(sym.Variable("data"), normalization="batch",
+                          grad_scale=2.0, name="softmax")
+    check_symbolic_backward(s, {"data": x, "softmax_label": label},
+                            None, {"data": (p - onehot) * 2.0 / 4},
+                            rtol=1e-4)
+
+
+def test_regression_outputs():
+    x = np.random.randn(4, 3).astype(np.float32)
+    y = np.random.randn(4, 3).astype(np.float32)
+    lin = sym.LinearRegressionOutput(sym.Variable("data"),
+                                     sym.Variable("label"), name="lin")
+    check_symbolic_forward(lin, {"data": x, "label": y}, [x])
+    check_symbolic_backward(lin, {"data": x, "label": y}, None,
+                            {"data": (x - y) / 3}, rtol=1e-4)
+    logi = sym.LogisticRegressionOutput(sym.Variable("data"),
+                                        sym.Variable("label"), name="logi")
+    sig = 1 / (1 + np.exp(-x))
+    check_symbolic_forward(logi, {"data": x, "label": y}, [sig],
+                           rtol=1e-4)
+
+
+def test_block_grad():
+    x = np.random.randn(3, 3).astype(np.float32)
+    v = sym.Variable("x")
+    s = sym.BlockGrad(v * 2) + v
+    check_symbolic_backward(s, {"x": x}, [np.ones_like(x)],
+                            {"x": np.ones_like(x)})
+
+
+def test_convolution_forward():
+    # compare against explicit correlation computed in numpy
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.zeros(4, dtype=np.float32)
+    conv = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=4,
+                           name="conv")
+    expected = np.zeros((2, 4, 3, 3), dtype=np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(3):
+                for j in range(3):
+                    expected[n, f, i, j] = np.sum(
+                        x[n, :, i:i + 3, j:j + 3] * w[f])
+    check_symbolic_forward(conv, {"data": x, "conv_weight": w,
+                                  "conv_bias": b}, [expected], rtol=1e-3)
+
+
+def test_convolution_gradient():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    w = np.random.randn(2, 2, 3, 3).astype(np.float32)
+    b = np.random.randn(2).astype(np.float32)
+    conv = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=2,
+                           pad=(1, 1), name="conv")
+    check_numeric_gradient(conv, {"data": x, "conv_weight": w,
+                                  "conv_bias": b},
+                           numeric_eps=1e-2, rtol=1e-1)
+
+
+def test_pooling():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    pool = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    expected = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    check_symbolic_forward(pool, {"data": x}, [expected])
+    pool = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2),
+                       pool_type="avg")
+    expected = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_symbolic_forward(pool, {"data": x}, [expected], rtol=1e-5)
+    gpool = sym.Pooling(sym.Variable("data"), kernel=(1, 1),
+                        global_pool=True, pool_type="max")
+    check_symbolic_forward(gpool, {"data": x},
+                           [x.max(axis=(2, 3), keepdims=True)])
+
+
+def test_batchnorm_training_stats():
+    x = np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1
+    bn = sym.BatchNorm(sym.Variable("data"), fix_gamma=False, name="bn")
+    ctx = default_context()
+    e = bn.simple_bind(ctx, data=x.shape)
+    e.arg_dict["data"][:] = x
+    e.arg_dict["bn_gamma"][:] = 1
+    e.arg_dict["bn_beta"][:] = 0
+    e.aux_dict["bn_moving_var"][:] = 1
+    e.forward(is_train=True)
+    out = e.outputs[0].asnumpy()
+    # per-channel normalized output should have ~zero mean, unit var
+    assert np.abs(out.mean(axis=(0, 2, 3))).max() < 1e-4
+    assert np.abs(out.var(axis=(0, 2, 3)) - 1).max() < 1e-2
+    # moving stats updated toward batch stats
+    mm = e.aux_dict["bn_moving_mean"].asnumpy()
+    assert reldiff(mm, 0.1 * x.mean(axis=(0, 2, 3))) < 1e-3
+
+
+def test_flatten_reshape_transpose():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(sym.Flatten(sym.Variable("x")), {"x": x},
+                           [x.reshape(2, 12)])
+    check_symbolic_forward(sym.Reshape(sym.Variable("x"), shape=(4, 6)),
+                           {"x": x}, [x.reshape(4, 6)])
+    check_symbolic_forward(sym.Reshape(sym.Variable("x"), shape=(0, -1)),
+                           {"x": x}, [x.reshape(2, 12)])
+    check_symbolic_forward(sym.transpose(sym.Variable("x"),
+                                         axes=(1, 0, 2)),
+                           {"x": x}, [x.transpose(1, 0, 2)])
+
+
+def test_concat_slicechannel():
+    xs = [np.random.randn(2, 3).astype(np.float32) for _ in range(3)]
+    syms = [sym.Variable("x%d" % i) for i in range(3)]
+    cat = sym.Concat(*syms, dim=1)
+    check_symbolic_forward(cat, {"x%d" % i: xs[i] for i in range(3)},
+                           [np.concatenate(xs, axis=1)])
+    x = np.random.randn(2, 6).astype(np.float32)
+    sliced = sym.SliceChannel(sym.Variable("x"), num_outputs=3, axis=1)
+    outs = check_symbolic_forward(sliced, {"x": x},
+                                  list(np.split(x, 3, axis=1)))
+    assert len(outs) == 3
+
+
+def test_embedding():
+    data = np.array([[0, 2], [1, 3]], dtype=np.float32)
+    weight = np.random.randn(4, 5).astype(np.float32)
+    emb = sym.Embedding(sym.Variable("data"), input_dim=4, output_dim=5,
+                        name="emb")
+    check_symbolic_forward(emb, {"data": data, "emb_weight": weight},
+                           [weight[data.astype(int)]])
+    # backward is scatter-add of ones
+    grads = check_symbolic_backward(
+        emb, {"data": data, "emb_weight": weight},
+        [np.ones((2, 2, 5), np.float32)],
+        {"emb_weight": np.ones((4, 5), np.float32)})
+
+
+def test_take_onehot():
+    a = np.random.randn(5, 4).astype(np.float32)
+    idx = np.array([0, 3, 1], dtype=np.float32)
+    check_symbolic_forward(sym.take(sym.Variable("a"), sym.Variable("i")),
+                           {"a": a, "i": idx}, [a[idx.astype(int)]])
+    oh = sym.one_hot(sym.Variable("i"), depth=4)
+    check_symbolic_forward(oh, {"i": idx},
+                           [np.eye(4, dtype=np.float32)[idx.astype(int)]])
+
+
+def test_ordering_ops():
+    x = np.random.randn(4, 6).astype(np.float32)
+    s = sym.sort(sym.Variable("x"), axis=1)
+    check_symbolic_forward(s, {"x": x}, [np.sort(x, axis=1)])
+    s = sym.argsort(sym.Variable("x"), axis=1)
+    check_symbolic_forward(s, {"x": x},
+                           [np.argsort(x, axis=1).astype(np.float32)])
+    s = sym.topk(sym.Variable("x"), k=2, axis=1, ret_typ="value")
+    expected = np.sort(x, axis=1)[:, ::-1][:, :2]
+    check_symbolic_forward(s, {"x": x}, [expected])
+
+
+def test_where():
+    cond = np.array([[1, 0], [0, 1]], dtype=np.float32)
+    x = np.ones((2, 2), dtype=np.float32)
+    y = np.zeros((2, 2), dtype=np.float32)
+    s = sym.where(sym.Variable("c"), sym.Variable("x"), sym.Variable("y"))
+    check_symbolic_forward(s, {"c": cond, "x": x, "y": y},
+                           [np.where(cond != 0, x, y)])
+
+
+def test_sequence_ops():
+    x = np.random.randn(4, 3, 2).astype(np.float32)  # TNC
+    seq_len = np.array([2, 4, 1], dtype=np.float32)
+    last = sym.SequenceLast(sym.Variable("x"), sym.Variable("l"),
+                            use_sequence_length=True)
+    expected = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    check_symbolic_forward(last, {"x": x, "l": seq_len}, [expected])
+    mask = sym.SequenceMask(sym.Variable("x"), sym.Variable("l"),
+                            use_sequence_length=True, value=-1.0)
+    out = x.copy()
+    out[2:, 0] = -1
+    out[1:, 2] = -1
+    check_symbolic_forward(mask, {"x": x, "l": seq_len}, [out])
+
+
+def test_dot_batch_dot():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    check_symbolic_forward(sym.dot(sym.Variable("a"), sym.Variable("b")),
+                           {"a": a, "b": b}, [a.dot(b)], rtol=1e-4)
+    a = np.random.randn(2, 3, 4).astype(np.float32)
+    b = np.random.randn(2, 4, 5).astype(np.float32)
+    check_symbolic_forward(sym.batch_dot(sym.Variable("a"),
+                                         sym.Variable("b")),
+                           {"a": a, "b": b}, [np.matmul(a, b)], rtol=1e-4)
+
+
+def test_broadcast_binary_grad():
+    a = np.random.rand(3, 1).astype(np.float32) + 0.5
+    b = np.random.rand(1, 4).astype(np.float32) + 0.5
+    s = sym.broadcast_mul(sym.Variable("a"), sym.Variable("b"))
+    head = np.ones((3, 4), dtype=np.float32)
+    check_symbolic_backward(s, {"a": a, "b": b}, [head],
+                            {"a": (b * head).sum(axis=1, keepdims=True),
+                             "b": (a * head).sum(axis=0, keepdims=True)},
+                            rtol=1e-4)
+
+
+def test_clip_and_norm():
+    x = np.random.randn(4, 4).astype(np.float32) * 3
+    check_symbolic_forward(sym.clip(sym.Variable("x"), a_min=-1, a_max=1),
+                           {"x": x}, [np.clip(x, -1, 1)])
+    out = nd.norm(nd.array(x)).asnumpy()
+    assert abs(out[0] - np.linalg.norm(x)) < 1e-3
+
+
+def test_dropout_train_eval():
+    x = np.ones((100, 100), dtype=np.float32)
+    do = sym.Dropout(sym.Variable("x"), p=0.5)
+    ctx = default_context()
+    e = do.simple_bind(ctx, grad_req="null", x=x.shape)
+    e.arg_dict["x"][:] = x
+    e.forward(is_train=False)
+    assert np.array_equal(e.outputs[0].asnumpy(), x)  # identity at eval
+    e.forward(is_train=True)
+    out = e.outputs[0].asnumpy()
+    frac_zero = (out == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    kept = out[out != 0]
+    assert np.allclose(kept, 2.0)  # scaled by 1/(1-p)
+
+
+def test_upsampling_nearest():
+    x = np.random.randn(1, 2, 3, 3).astype(np.float32)
+    up = sym.UpSampling(sym.Variable("x"), scale=2, sample_type="nearest")
+    expected = x.repeat(2, axis=2).repeat(2, axis=3)
+    check_symbolic_forward(up, {"x": x}, [expected])
+
+
+def test_pad():
+    x = np.random.randn(1, 1, 3, 3).astype(np.float32)
+    p = sym.Pad(sym.Variable("x"), mode="constant",
+                pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=5)
+    expected = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="constant",
+                      constant_values=5)
+    check_symbolic_forward(p, {"x": x}, [expected])
+
+
+def test_swapaxis_expand_dims():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(sym.SwapAxis(sym.Variable("x"), dim1=0, dim2=2),
+                           {"x": x}, [x.swapaxes(0, 2)])
+    check_symbolic_forward(sym.expand_dims(sym.Variable("x"), axis=1),
+                           {"x": x}, [x[:, None]])
+
+
+def test_slice_axis_reverse_repeat_tile():
+    x = np.random.randn(4, 6).astype(np.float32)
+    check_symbolic_forward(
+        sym.slice_axis(sym.Variable("x"), axis=1, begin=1, end=4),
+        {"x": x}, [x[:, 1:4]])
+    check_symbolic_forward(sym.reverse(sym.Variable("x"), axis=1),
+                           {"x": x}, [x[:, ::-1]])
+    check_symbolic_forward(sym.repeat(sym.Variable("x"), repeats=2, axis=0),
+                           {"x": x}, [np.repeat(x, 2, axis=0)])
+    check_symbolic_forward(sym.tile(sym.Variable("x"), reps=(2, 1)),
+                           {"x": x}, [np.tile(x, (2, 1))])
+
+
+def test_instance_norm_l2_norm():
+    x = np.random.randn(2, 3, 4, 4).astype(np.float32)
+    innorm = sym.InstanceNorm(sym.Variable("data"), name="in")
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-3)
+    check_symbolic_forward(innorm, {"data": x, "in_gamma": np.ones(3, np.float32),
+                                    "in_beta": np.zeros(3, np.float32)},
+                           [expected], rtol=1e-3)
+    l2 = sym.L2Normalization(sym.Variable("data"), mode="instance")
+    denom = np.sqrt((x.reshape(2, -1) ** 2).sum(axis=1) + 1e-10)
+    check_symbolic_forward(l2, {"data": x},
+                           [x / denom.reshape(2, 1, 1, 1)], rtol=1e-4)
+
+
+def test_makeloss_grad():
+    x = np.random.rand(3, 3).astype(np.float32) + 0.1
+    loss = sym.MakeLoss(sym.log(sym.Variable("x")))
+    check_symbolic_backward(loss, {"x": x}, None, {"x": 1.0 / x}, rtol=1e-4)
+
+
+def test_deconvolution_shape():
+    x = np.random.randn(1, 3, 4, 4).astype(np.float32)
+    deconv = sym.Deconvolution(sym.Variable("data"), kernel=(2, 2),
+                               stride=(2, 2), num_filter=2, name="dc")
+    _, out_shapes, _ = deconv.infer_shape(data=x.shape)
+    assert out_shapes[0] == (1, 2, 8, 8)
+    w = np.random.randn(3, 2, 2, 2).astype(np.float32)
+    e = deconv.simple_bind(default_context(), data=x.shape)
+    e.arg_dict["data"][:] = x
+    e.arg_dict["dc_weight"][:] = w
+    e.forward(is_train=False)
+    out = e.outputs[0].asnumpy()
+    # nearest check: deconv with stride=kernel=2 scatters each pixel
+    expected = np.zeros((1, 2, 8, 8), dtype=np.float32)
+    for f in range(2):
+        for c in range(3):
+            for i in range(4):
+                for j in range(4):
+                    expected[0, f, 2*i:2*i+2, 2*j:2*j+2] += \
+                        x[0, c, i, j] * w[c, f]
+    assert reldiff(out, expected) < 1e-4
+
+
+def test_roipooling_basic():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], dtype=np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    assert out.asnumpy()[0, 0, 1, 1] == 15.0
+
+
+def test_fft_ifft():
+    x = np.random.randn(2, 8).astype(np.float32)
+    out = nd.fft(nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    interleaved = np.stack([ref.real, ref.imag], axis=-1).reshape(2, 16)
+    assert reldiff(out, interleaved.astype(np.float32)) < 1e-4
+    back = nd.ifft(nd.array(out)).asnumpy()
+    assert reldiff(back, x * 8) < 1e-4  # unnormalized like cuFFT
+
+
+def test_grad_req_add():
+    x = np.random.randn(3, 3).astype(np.float32)
+    v = sym.Variable("x")
+    s = v * 2
+    ctx = default_context()
+    gbuf = nd.ones((3, 3), ctx=ctx)
+    e = s.bind(ctx, {"x": nd.array(x, ctx=ctx)}, args_grad={"x": gbuf},
+               grad_req="add")
+    e.forward(is_train=True)
+    e.backward()
+    assert_almost_equal(gbuf.asnumpy(), np.ones((3, 3)) + 2)

@@ -1,0 +1,145 @@
+"""Optimizer tests vs numpy reference updates (mirrors tests/python/
+unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import optimizer as opt
+
+
+def test_sgd_update_matches_numpy():
+    w = np.random.randn(5, 4).astype(np.float32)
+    g = np.random.randn(5, 4).astype(np.float32)
+    lr, wd = 0.1, 0.01
+    sgd = opt.SGD(learning_rate=lr, wd=wd, rescale_grad=1.0)
+    weight, grad = nd.array(w), nd.array(g)
+    state = sgd.create_state(0, weight)
+    sgd.update(0, weight, grad, state)
+    expected = w - lr * (g + wd * w)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-5)
+
+
+def test_sgd_momentum():
+    w = np.random.randn(3, 3).astype(np.float32)
+    g = np.random.randn(3, 3).astype(np.float32)
+    lr, mom, wd = 0.1, 0.9, 0.0
+    sgd = opt.SGD(learning_rate=lr, momentum=mom, wd=wd)
+    weight, grad = nd.array(w), nd.array(g)
+    state = sgd.create_state(0, weight)
+    mom_np = np.zeros_like(w)
+    w_np = w.copy()
+    for _ in range(3):
+        sgd.update(0, weight, grad, state)
+        mom_np = mom * mom_np - lr * (g + wd * w_np)
+        w_np = w_np + mom_np
+    np.testing.assert_allclose(weight.asnumpy(), w_np, rtol=1e-4)
+    np.testing.assert_allclose(state.asnumpy(), mom_np, rtol=1e-4)
+
+
+def test_adam_matches_numpy():
+    w = np.random.randn(4, 4).astype(np.float32)
+    g = np.random.randn(4, 4).astype(np.float32)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    adam = opt.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    weight, grad = nd.array(w), nd.array(g)
+    state = adam.create_state(0, weight)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    w_np = w.copy()
+    for t in range(1, 4):
+        adam.update(0, weight, grad, state)
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w_np = w_np - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(weight.asnumpy(), w_np, rtol=1e-4)
+
+
+def test_rmsprop():
+    w = np.random.randn(4,).astype(np.float32)
+    g = np.random.randn(4,).astype(np.float32)
+    lr, gamma1, eps = 0.01, 0.9, 1e-8
+    rms = opt.RMSProp(learning_rate=lr, gamma1=gamma1, epsilon=eps)
+    weight, grad = nd.array(w), nd.array(g)
+    state = rms.create_state(0, weight)
+    n = np.zeros_like(w)
+    w_np = w.copy()
+    for _ in range(3):
+        rms.update(0, weight, grad, state)
+        n = (1 - gamma1) * g * g + gamma1 * n
+        w_np = w_np - lr * g / np.sqrt(n + eps)
+    np.testing.assert_allclose(weight.asnumpy(), w_np, rtol=1e-4)
+
+
+def test_clip_gradient():
+    w = np.zeros(4, dtype=np.float32)
+    g = np.array([10.0, -10.0, 0.5, -0.5], dtype=np.float32)
+    sgd = opt.SGD(learning_rate=1.0, clip_gradient=1.0)
+    weight, grad = nd.array(w), nd.array(g)
+    sgd.update(0, weight, grad, None)
+    np.testing.assert_allclose(weight.asnumpy(), [-1, 1, -0.5, 0.5],
+                               rtol=1e-6)
+
+
+def test_lr_wd_mult():
+    sgd = opt.SGD(learning_rate=1.0,
+                  param_idx2name={0: "w1_weight", 1: "w2_weight"})
+    sgd.set_lr_mult({"w1_weight": 0.0})
+    w1 = nd.ones(3)
+    w2 = nd.ones(3)
+    g = nd.ones(3)
+    sgd.update(0, w1, g, None)
+    sgd.update(1, w2, g, None)
+    np.testing.assert_allclose(w1.asnumpy(), np.ones(3))  # lr_mult 0
+    np.testing.assert_allclose(w2.asnumpy(), np.zeros(3))
+
+
+def test_updater_state_saveload():
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    updater = opt.get_updater(sgd)
+    w = nd.ones((2, 2))
+    g = nd.ones((2, 2))
+    updater(0, g, w)
+    states = updater.get_states()
+    updater2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    updater2.set_states(states)
+    assert 0 in updater2.states
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+    sched = FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    msched = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(3) == 1.0
+    assert abs(msched(7) - 0.1) < 1e-12
+    assert abs(msched(16) - 0.01) < 1e-12
+
+
+def test_optimizer_registry():
+    o = opt.create("sgd", learning_rate=0.3)
+    assert isinstance(o, opt.SGD)
+    assert o.lr == 0.3
+    for name in ["adam", "rmsprop", "adagrad", "adadelta", "nag", "sgld",
+                 "ftrl", "test", "dcasgd", "ccsgd"]:
+        assert name in opt.Optimizer.opt_registry
+
+
+def test_adagrad_adadelta_converge():
+    # quadratic bowl: all optimizers should reduce ||w||
+    for name, params in [("adagrad", {"learning_rate": 0.5}),
+                         ("adadelta", {}),
+                         ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+                         ("ftrl", {"learning_rate": 0.5})]:
+        o = opt.create(name, **params)
+        w = nd.array(np.ones(4, dtype=np.float32) * 5)
+        state = o.create_state(0, w)
+        for _ in range(20):
+            g = w * 2  # grad of w^2
+            o.update(0, w, g, state)
+        assert np.abs(w.asnumpy()).max() < 5, name

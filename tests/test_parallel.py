@@ -1,0 +1,158 @@
+"""Parallelism tests on the 8-device virtual CPU mesh: sharded train step,
+ring attention, mesh helpers. This is the TPU-native analog of the
+reference's multi-device tests (test_multi_device_exec / test_model_parallel
+on cpu contexts)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.parallel import mesh as pmesh
+from mxnet_tpu.parallel import data_parallel as dp
+from mxnet_tpu.parallel import ring_attention as ra
+
+
+def _require_devices(n):
+    import jax
+    if len(jax.devices()) < n:
+        pytest.skip("needs %d virtual devices" % n)
+
+
+def test_make_mesh():
+    _require_devices(8)
+    m = pmesh.make_mesh({"dp": 4, "tp": 2})
+    assert m.shape == {"dp": 4, "tp": 2}
+    m2 = pmesh.make_mesh({"dp": -1})
+    assert m2.shape["dp"] == 8
+    m3 = pmesh.data_parallel_mesh(4)
+    assert m3.shape["dp"] == 4
+
+
+def test_mesh_from_contexts():
+    _require_devices(4)
+    m = pmesh.mesh_from_contexts([mx.cpu(i) for i in range(4)])
+    assert m.shape["dp"] == 4
+
+
+def _softmax_mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_data_parallel_train_step_converges():
+    """Fused sharded train step learns the toy problem; grads are summed
+    across the dp axis by GSPMD (replacing KVStore reduce)."""
+    _require_devices(8)
+    from mxnet_tpu.initializer import Xavier
+    mesh = pmesh.data_parallel_mesh(8)
+    step = dp.DataParallelTrainStep(_softmax_mlp(), mesh,
+                                    dp.sgd_step_fn(momentum=0.9,
+                                                   rescale_grad=1.0 / 64))
+    params, states, aux = step.init(Xavier(), {"data": (64, 8)})
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    w = rng.randn(8, 4)
+    y = np.argmax(X.dot(w), axis=1).astype(np.float32)
+
+    inputs = step.shard_batch({"data": X, "softmax_label": y})
+    for _ in range(60):
+        params, states, aux, outs = step(params, states, aux, inputs, 0.5)
+    (probs,) = step.forward(params, aux, inputs)
+    acc = (np.asarray(probs).argmax(axis=1) == y).mean()
+    assert acc > 0.9, acc
+
+
+def test_data_parallel_matches_single_device():
+    """One sharded step == one single-device step (numerical equivalence of
+    the psum path vs local compute)."""
+    _require_devices(8)
+    from mxnet_tpu.initializer import Constant
+    net = _softmax_mlp()
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+
+    def run(n_dev):
+        mesh = pmesh.data_parallel_mesh(n_dev)
+        step = dp.DataParallelTrainStep(
+            net, mesh, dp.sgd_step_fn(rescale_grad=1.0 / 16))
+        params, states, aux = step.init(Constant(0.05), {"data": (16, 8)})
+        inputs = step.shard_batch({"data": X, "softmax_label": y})
+        params, states, aux, _ = step(params, states, aux, inputs, 0.1)
+        return {k: np.asarray(v) for k, v in params.items()}
+
+    p1 = run(1)
+    p8 = run(8)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p8[k], rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_matches_local():
+    """Ring attention over a sequence-sharded mesh == dense attention."""
+    _require_devices(8)
+    import jax
+    import jax.numpy as jnp
+    mesh = pmesh.make_mesh({"sp": 8})
+    B, H, S, D = 2, 2, 64, 8
+    rng = np.random.RandomState(0)
+    q = rng.randn(B, H, S, D).astype(np.float32)
+    k = rng.randn(B, H, S, D).astype(np.float32)
+    v = rng.randn(B, H, S, D).astype(np.float32)
+
+    attn = ra.ring_self_attention(mesh, axis="sp")
+    out_ring = np.asarray(attn(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v)))
+    out_ref = np.asarray(ra.local_attention(jnp.asarray(q), jnp.asarray(k),
+                                            jnp.asarray(v)))
+    np.testing.assert_allclose(out_ring, out_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_causal():
+    _require_devices(8)
+    import jax.numpy as jnp
+    mesh = pmesh.make_mesh({"sp": 8})
+    B, H, S, D = 1, 2, 32, 4
+    rng = np.random.RandomState(1)
+    q = rng.randn(B, H, S, D).astype(np.float32)
+    k = rng.randn(B, H, S, D).astype(np.float32)
+    v = rng.randn(B, H, S, D).astype(np.float32)
+    attn = ra.ring_self_attention(mesh, axis="sp")
+    out_ring = np.asarray(attn(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True))
+    out_ref = np.asarray(ra.local_attention(jnp.asarray(q), jnp.asarray(k),
+                                            jnp.asarray(v), causal=True))
+    np.testing.assert_allclose(out_ring, out_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_model_parallel_ctx_group():
+    """Layer placement across two cpu contexts still computes correctly —
+    the reference's test_model_parallel.py pattern. In the TPU build devices
+    come from sharding, so ctx_group is honoured as data placement of
+    executor contexts (single-program here)."""
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = sym.FullyConnected(fc1, num_hidden=4, name="fc2")
+        out = sym.LinearRegressionOutput(fc2, sym.Variable("label"),
+                                         name="lin")
+    # group2ctx binding: runs on the first context (XLA owns placement)
+    e = out.simple_bind(mx.cpu(0), group2ctx={"dev1": mx.cpu(0),
+                                              "dev2": mx.cpu(1)},
+                        data=(4, 6), label=(4, 4))
+    e.forward(is_train=True)
+    e.backward()
+    assert e.outputs[0].shape == (4, 4)
+
+
+def test_dist_runtime_single_process():
+    from mxnet_tpu.parallel import dist
+    rt = dist.get_runtime()
+    assert rt.rank == 0 and rt.size >= 1
+    a = mx.nd.ones((3, 3))
+    out = rt.allreduce(a)
+    np.testing.assert_array_equal(out.asnumpy(), a.asnumpy())

@@ -1,0 +1,128 @@
+"""Symbol composition/serialization tests (mirrors tests/python/unittest/
+test_symbol.py)."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=5, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_basic():
+    m = _mlp()
+    assert m.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                  "fc2_weight", "fc2_bias", "softmax_label"]
+    assert m.list_outputs() == ["softmax_output"]
+    assert m.name == "softmax"
+
+
+def test_symbol_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = sym.FullyConnected(data=net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+    net2 = sym.FullyConnected(name="fc3", num_hidden=10)
+    net2 = sym.Activation(data=net2, act_type="relu")
+    net2 = sym.FullyConnected(data=net2, name="fc4", num_hidden=20)
+    composed = net2(fc3_data=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc3_weight" in args and "fc1_weight" in args
+
+
+def test_symbol_group():
+    data = sym.Variable("data")
+    a = sym.FullyConnected(data, num_hidden=4, name="fca")
+    b = sym.FullyConnected(data, num_hidden=3, name="fcb")
+    g = sym.Group([a, b])
+    assert len(g.list_outputs()) == 2
+    assert g[0].list_outputs() == ["fca_output"]
+
+
+def test_symbol_internals():
+    m = _mlp()
+    internals = m.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_outputs() == ["fc1_output"]
+
+
+def test_symbol_json_roundtrip():
+    m = _mlp()
+    js = m.tojson()
+    data = json.loads(js)
+    assert "nodes" in data and "heads" in data
+    m2 = sym.load_json(js)
+    assert m2.list_arguments() == m.list_arguments()
+    assert m2.list_outputs() == m.list_outputs()
+    # loaded symbol is executable
+    e = m2.simple_bind(mx.cpu(), data=(2, 8))
+    e.forward(is_train=False)
+    assert e.outputs[0].shape == (2, 5)
+
+
+def test_symbol_save_load_file(tmp_path):
+    m = _mlp()
+    fname = str(tmp_path / "sym.json")
+    m.save(fname)
+    m2 = sym.load(fname)
+    assert m2.list_arguments() == m.list_arguments()
+
+
+def test_symbol_attr():
+    data = sym.Variable("data", attr={"mood": "angry"})
+    op = sym.Convolution(data=data, name="conv", kernel=(1, 1), num_filter=1,
+                         attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    attrs = op.attr_dict()
+    assert attrs["conv"]["__mood__"] == "so so"
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="stage1"):
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    assert data.attr("ctx_group") == "stage1"
+    assert fc1.attr("ctx_group") == "stage1"
+
+
+def test_symbol_arithmetic_exec():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2) / (a - b + 4)
+    x = np.random.rand(3, 3).astype(np.float32)
+    y = np.random.rand(3, 3).astype(np.float32)
+    e = c.bind(mx.cpu(), {"a": mx.nd.array(x), "b": mx.nd.array(y)},
+               grad_req="null")
+    e.forward()
+    expected = (x + y * 2) / (x - y + 4)
+    np.testing.assert_allclose(e.outputs[0].asnumpy(), expected, rtol=1e-5)
+
+
+def test_variable_shape_hint():
+    v = sym.Variable("w", shape=(3, 4), lr_mult=2.0)
+    assert v.attr("__shape__") == "(3, 4)"
+    assert v.attr("__lr_mult__") == "2.0"
+
+
+def test_multi_output_indexing():
+    x = sym.Variable("x")
+    s = sym.SliceChannel(x, num_outputs=3, axis=1, name="split")
+    assert len(s) == 3
+    one = s[1]
+    assert len(one.list_outputs()) == 1
+
+
+def test_name_manager_uniqueness():
+    a = sym.FullyConnected(sym.Variable("d1"), num_hidden=2)
+    b = sym.FullyConnected(sym.Variable("d2"), num_hidden=2)
+    assert a.name != b.name
