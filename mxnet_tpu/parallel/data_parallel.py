@@ -121,8 +121,11 @@ class DataParallelTrainStep:
             return new_params, new_states, new_aux_d, outs
 
         # donate param/state buffers for in-place HBM updates on real
-        # accelerators; the CPU backend's donation path is unreliable
-        donate = (0, 1) if mesh.devices.flat[0].platform != "cpu" else ()
+        # accelerators; the CPU backend's donation path is unreliable, and
+        # its async dispatch aborts under a deep queue of SPMD executions —
+        # throttle per-call there (TPU stays fully async)
+        self._throttle = mesh.devices.flat[0].platform == "cpu"
+        donate = (0, 1) if not self._throttle else ()
         self._step = jax.jit(
             train_step,
             in_shardings=(repl, repl, repl, batch, None, None),
@@ -171,10 +174,14 @@ class DataParallelTrainStep:
         return {k: jax.device_put(v, self._batch) for k, v in inputs.items()}
 
     def __call__(self, params, states, aux, inputs, lr):
+        import jax
         rng = _random.next_key() if self._needs_rng else \
             onp.zeros((2,), onp.uint32)
-        return self._step(params, states, aux, inputs,
-                          onp.asarray(lr, onp.float32), rng)
+        out = self._step(params, states, aux, inputs,
+                         onp.asarray(lr, onp.float32), rng)
+        if self._throttle:
+            jax.block_until_ready(out[3])
+        return out
 
     def forward(self, params, aux, inputs):
         rng = _random.next_key() if self._needs_rng else \

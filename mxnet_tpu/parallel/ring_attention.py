@@ -124,7 +124,7 @@ def ring_self_attention(mesh, axis="sp"):
         fn = shard_map(
             partial(ring_attention, axis_name=axis, causal=causal),
             mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec, check_rep=False)
+            out_specs=spec, check_vma=False)
         return jax.jit(fn)
 
     cache = {}
