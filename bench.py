@@ -1,0 +1,94 @@
+"""Benchmark: ResNet-50 synthetic-data training throughput (images/sec).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published ResNet-50 training throughput at batch 32
+on its best single GPU — 181.53 img/s on P100 (docs/how_to/perf.md:179-189,
+BASELINE.md). vs_baseline = ours / 181.53. The whole train step (fwd + bwd +
+SGD-momentum update) is one donated, jitted XLA program via
+mxnet_tpu.parallel.DataParallelTrainStep over every visible device.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+BASELINE_IMG_S = 181.53  # P100, reference perf.md
+
+
+def _emit(value, extra=None):
+    rec = {"metric": "resnet50_train_throughput", "value": round(value, 2),
+           "unit": "images/sec", "vs_baseline": round(value / BASELINE_IMG_S,
+                                                      3)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _watchdog(seconds):
+    def fire(signum, frame):
+        _emit(0.0, {"error": "timeout initializing device backend"})
+        os._exit(2)
+
+    signal.signal(signal.SIGALRM, fire)
+    signal.alarm(seconds)
+
+
+def main():
+    _watchdog(int(os.environ.get("BENCH_INIT_TIMEOUT", "600")))
+
+    import numpy as np
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    signal.alarm(0)
+
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu import models
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.parallel import mesh as pmesh
+    from mxnet_tpu.parallel import data_parallel as dp
+
+    n_dev = len(devices)
+    per_dev_batch = int(os.environ.get("BENCH_BATCH", "64"))
+    batch = per_dev_batch * n_dev
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    img = 224
+
+    net = models.get_symbol("resnet-50", num_classes=1000)
+    mesh = pmesh.data_parallel_mesh(n_dev)
+    step = dp.DataParallelTrainStep(
+        net, mesh, dp.sgd_step_fn(momentum=0.9, wd=1e-4,
+                                  rescale_grad=1.0 / batch))
+    params, states, aux = step.init(Xavier(rnd_type="gaussian",
+                                           factor_type="in", magnitude=2),
+                                    {"data": (batch, 3, img, img)})
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(batch, 3, img, img).astype(np.float32)
+    y = rng.randint(0, 1000, batch).astype(np.float32)
+    inputs = step.shard_batch({"data": X, "softmax_label": y})
+
+    # compile + warmup
+    for _ in range(3):
+        params, states, aux, outs = step(params, states, aux, inputs, 0.1)
+    jax.block_until_ready(outs)
+
+    t0 = time.time()
+    for _ in range(steps):
+        params, states, aux, outs = step(params, states, aux, inputs, 0.1)
+    jax.block_until_ready(outs)
+    jax.block_until_ready(params)
+    dt = time.time() - t0
+
+    img_per_sec = steps * batch / dt
+    _emit(img_per_sec, {"platform": platform, "devices": n_dev,
+                        "batch": batch, "steps": steps})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Model zoo — symbol builders for the reference's example networks
+(example/image-classification/symbols/ + example/rnn).
+
+Each module exposes ``get_symbol(num_classes, ...)`` with the same signature
+style as the reference's symbol scripts, built on mxnet_tpu.symbol. These
+drive the benchmarks (bench.py) and the example entry points.
+"""
+from . import mlp
+from . import lenet
+from . import alexnet
+from . import vgg
+from . import resnet
+from . import inception_bn
+from . import inception_v3
+from . import googlenet
+from . import lstm
+
+_MODELS = {
+    "mlp": mlp, "lenet": lenet, "alexnet": alexnet, "vgg": vgg,
+    "resnet": resnet, "inception-bn": inception_bn,
+    "inception-v3": inception_v3, "googlenet": googlenet,
+}
+
+
+def get_symbol(name, **kwargs):
+    """Look up a model by the reference's --network names."""
+    if name.startswith("resnet"):
+        num_layers = int(name[len("resnet-"):]) if "-" in name else 50
+        return resnet.get_symbol(num_layers=num_layers, **kwargs)
+    return _MODELS[name].get_symbol(**kwargs)
