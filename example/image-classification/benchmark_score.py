@@ -1,0 +1,55 @@
+"""Inference throughput benchmark (reference example/image-classification/
+benchmark_score.py; numbers table docs/how_to/perf.md:116-148)."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def score(network, dev, batch_size, num_batches):
+    if network == "inception-v3":
+        data_shape = (batch_size, 3, 299, 299)
+    else:
+        data_shape = (batch_size, 3, 224, 224)
+    sym = models.get_symbol(network, num_classes=1000)
+
+    mod = mx.mod.Module(sym, context=dev, label_names=[])
+    mod.bind(for_training=False, inputs_need_grad=False,
+             data_shapes=[("data", data_shape)], label_shapes=None)
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch([mx.nd.array(np.random.rand(*data_shape)
+                                   .astype(np.float32))], [])
+    # warm up (compile)
+    for _ in range(2):
+        mod.forward(batch, is_train=False)
+        for o in mod.get_outputs():
+            o.wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+        for o in mod.get_outputs():
+            o.wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--networks", default="resnet-50")
+    parser.add_argument("--tpus", "--gpus", dest="tpus", default=None)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-batches", type=int, default=10)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    dev = mx.tpu(0) if args.tpus is not None else mx.cpu()
+    for net in args.networks.split(","):
+        speed = score(net, dev, args.batch_size, args.num_batches)
+        logging.info("network: %s, batch %d: %.1f images/sec", net,
+                     args.batch_size, speed)

@@ -1,0 +1,62 @@
+"""Bucketed LSTM training (reference example/rnn/bucketing / bucket_io) —
+BucketingModule + BucketSentenceIter over variable-length sequences."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+from mxnet_tpu import symbol as sym
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=32)
+    parser.add_argument("--num-layers", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--tpus", "--gpus", dest="tpus", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    vocab_size = 50
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, vocab_size,
+                                  rng.randint(5, 30)))
+                 for _ in range(800)]
+    buckets = [10, 20, 30]
+    it = rnn.BucketSentenceIter(sentences, args.batch_size, buckets=buckets,
+                                invalid_label=0)
+
+    def sym_gen(seq_len):
+        cell = rnn.FusedRNNCell(args.num_hidden, num_layers=args.num_layers,
+                                mode="lstm", prefix="lstm_")
+        data = sym.Variable("data")
+        embed = sym.Embedding(data, input_dim=vocab_size,
+                              output_dim=args.num_embed, name="embed")
+        output, _ = cell.unroll(seq_len, inputs=embed, layout="NTC",
+                                merge_outputs=True)
+        pred = sym.Reshape(output, shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    ctx = mx.tpu(0) if args.tpus is not None else mx.cpu()
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=ctx)
+    mod.fit(it, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.Perplexity(ignore_label=0),
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+                              "clip_gradient": 5.0},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+
+if __name__ == "__main__":
+    main()

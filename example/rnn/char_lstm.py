@@ -1,0 +1,64 @@
+"""char-LSTM language model (reference example/rnn/char-rnn / lstm.py).
+
+Trains on a text file if given, else on synthetic text. Uses the fused
+RNN op (one lax.scan XLA program) through FusedRNNCell.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.models import lstm as lstm_model
+
+
+def load_data(path, seq_len):
+    if path and os.path.exists(path):
+        with open(path) as f:
+            text = f.read()
+    else:
+        logging.warning("no text file; using synthetic periodic text")
+        text = ("hello tpu world. " * 4000)
+    vocab = {c: i for i, c in enumerate(sorted(set(text)))}
+    arr = np.array([vocab[c] for c in text], dtype=np.float32)
+    n = (len(arr) - 1) // seq_len
+    X = arr[:n * seq_len].reshape(n, seq_len)
+    Y = arr[1:n * seq_len + 1].reshape(n, seq_len)
+    return X, Y, vocab
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", default=None)
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--num-hidden", type=int, default=256)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--tpus", "--gpus", dest="tpus", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    X, Y, vocab = load_data(args.data, args.seq_len)
+    ctx = mx.tpu(0) if args.tpus is not None else mx.cpu()
+    net = lstm_model.get_symbol(args.seq_len, len(vocab),
+                                num_hidden=args.num_hidden,
+                                num_embed=args.num_embed,
+                                num_layers=args.num_layers)
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True,
+                           last_batch_handle="discard")
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(it, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "clip_gradient": 5.0},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+
+if __name__ == "__main__":
+    main()
