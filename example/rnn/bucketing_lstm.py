@@ -53,7 +53,7 @@ def main():
                                  context=ctx)
     mod.fit(it, num_epoch=args.num_epochs,
             eval_metric=mx.metric.Perplexity(ignore_label=0),
-            optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+            optimizer_params={"learning_rate": 0.05,
                               "clip_gradient": 5.0},
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
 
