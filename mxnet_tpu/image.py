@@ -1,0 +1,388 @@
+"""Image iterators + augmentations (python/mxnet/image.py:559 and the C++
+augmenter chain src/io/image_aug_default.cc).
+
+Decode uses PIL (cv2 when present); augmentation math is numpy; the batch
+assembly hot loop (normalize/mirror/crop, HWC→CHW) runs in the native
+OpenMP runtime (runtime/recordio.cpp assemble_batch).
+"""
+from __future__ import annotations
+
+import io as _pyio
+import logging
+import os
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as onp
+
+from . import ndarray as nd
+from . import recordio
+from .io import DataIter, DataBatch, DataDesc
+from . import runtime
+
+__all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "ResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "HorizontalFlipAug",
+           "ColorNormalizeAug", "CastAug", "CreateAugmenter", "ImageIter",
+           "ImageRecordIter"]
+
+
+def imdecode(buf, to_rgb=True):
+    """Decode image bytes to a HWC uint8 numpy array."""
+    try:
+        import cv2
+        img = cv2.imdecode(onp.frombuffer(buf, dtype=onp.uint8), 1)
+        if to_rgb:
+            img = img[:, :, ::-1]
+        return img
+    except ImportError:
+        from PIL import Image
+        img = onp.asarray(Image.open(_pyio.BytesIO(bytes(buf))).convert("RGB"))
+        if not to_rgb:
+            img = img[:, :, ::-1]
+        return img
+
+
+def _resize(img, w, h):
+    try:
+        import cv2
+        return cv2.resize(img, (w, h), interpolation=cv2.INTER_LINEAR)
+    except ImportError:
+        from PIL import Image
+        return onp.asarray(Image.fromarray(img).resize((w, h),
+                                                       Image.BILINEAR))
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit in src_size (image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size):
+    """Resize so the shorter edge == size."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return _resize(src, new_w, new_h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize(out, size[0], size[1])
+    return out
+
+
+def random_crop(src, size):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(onp.float32) - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """Random area+aspect crop (GoogLeNet-style, image.py random_size_crop)."""
+    h, w = src.shape[:2]
+    area = h * w
+    for _ in range(10):
+        new_area = random.uniform(min_area, 1.0) * area
+        new_ratio = random.uniform(*ratio)
+        new_w = int(round((new_area * new_ratio) ** 0.5))
+        new_h = int(round((new_area / new_ratio) ** 0.5))
+        if random.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size), \
+                (x0, y0, new_w, new_h)
+    return center_crop(src, size)
+
+
+# -- augmenter functors (image.py CreateAugmenter building blocks) ----------
+def ResizeAug(size):
+    def aug(src):
+        return resize_short(src, size)
+    return aug
+
+
+def RandomCropAug(size):
+    def aug(src):
+        return random_crop(src, size)[0]
+    return aug
+
+
+def RandomSizedCropAug(size, min_area=0.08, ratio=(3. / 4., 4. / 3.)):
+    def aug(src):
+        return random_size_crop(src, size, min_area, ratio)[0]
+    return aug
+
+
+def CenterCropAug(size):
+    def aug(src):
+        return center_crop(src, size)[0]
+    return aug
+
+
+def HorizontalFlipAug(p=0.5):
+    def aug(src):
+        if random.random() < p:
+            return src[:, ::-1]
+        return src
+    return aug
+
+
+def ColorNormalizeAug(mean, std=None):
+    def aug(src):
+        return color_normalize(src, mean, std)
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return src.astype(onp.float32)
+    return aug
+
+
+def BrightnessJitterAug(brightness):
+    def aug(src):
+        alpha = 1.0 + random.uniform(-brightness, brightness)
+        return onp.clip(src.astype(onp.float32) * alpha, 0, 255)
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, **kwargs):
+    """Build the standard augmenter list (image.py CreateAugmenter)."""
+    auglist = []
+    size = (data_shape[2], data_shape[1])
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(size))
+    elif rand_crop:
+        auglist.append(RandomCropAug(size))
+    else:
+        auglist.append(CenterCropAug(size))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(CastAug())
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Pure-python image iterator over .lst/imglist or RecordIO
+    (python/mxnet/image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        if path_imgrec:
+            self.rec = runtime.RecordFile(path_imgrec)
+            self.imglist = None
+            self.seq = list(range(len(self.rec)))
+        else:
+            self.rec = None
+            if path_imglist:
+                imglist = []
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        label = onp.array([float(x) for x in parts[1:-1]],
+                                          dtype=onp.float32)
+                        imglist.append((label, parts[-1]))
+            else:
+                imglist = [(onp.array([float(x[0])], dtype=onp.float32), x[1])
+                           for x in imglist]
+            self.imglist = imglist
+            self.path_root = path_root or ""
+            self.seq = list(range(len(imglist)))
+
+        self.shuffle = shuffle
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.aug_list = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self.cur = 0
+        self.data_name = data_name
+        self.label_name = label_name
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name, (batch_size, label_width)
+                                       if label_width > 1 else (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.rec is not None:
+            header, img_bytes = recordio.unpack(self.rec.read(idx))
+            label = header.label
+            img = imdecode(img_bytes)
+            return label, img
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            img = imdecode(f.read())
+        return label, img
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = onp.zeros((self.batch_size, c, h, w), onp.float32)
+        batch_label = onp.zeros((self.batch_size, self.label_width),
+                                onp.float32)
+        i = 0
+        while i < self.batch_size:
+            try:
+                label, img = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                break
+            for aug in self.aug_list:
+                img = aug(img)
+            batch_data[i] = onp.asarray(img, onp.float32).transpose(2, 0, 1)
+            batch_label[i] = onp.atleast_1d(label)[:self.label_width]
+            i += 1
+        pad = self.batch_size - i
+        label_out = batch_label if self.label_width > 1 else \
+            batch_label[:, 0]
+        return DataBatch([nd.array(batch_data)], [nd.array(label_out)],
+                         pad=pad)
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with threaded decode + native batch assembly
+    (src/io/iter_image_recordio_2.cc ImageRecordIter).
+
+    Decode runs on a thread pool (PIL/cv2 release the GIL), augmentation
+    geometry is chosen per-sample, and the normalize/mirror/crop/transpose
+    hot loop runs in the native OpenMP runtime. Wrap with PrefetchingIter
+    (io.py) for background double-buffering like the reference's
+    PrefetcherIter.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, scale=1.0, resize=-1, preprocess_threads=4,
+                 round_batch=True, data_name="data",
+                 label_name="softmax_label", seed=0, **kwargs):
+        super().__init__(batch_size)
+        self.rec = runtime.RecordFile(path_imgrec)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = onp.array([mean_r, mean_g, mean_b], onp.float32)
+        self.std = onp.array([std_r, std_g, std_b], onp.float32)
+        self.scale = scale
+        self.resize = resize
+        self.round_batch = round_batch
+        self.rng = random.Random(seed)
+        self.pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self.seq = list(range(len(self.rec)))
+        self.cur = 0
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name, (batch_size, label_width)
+                                       if label_width > 1 else (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            self.rng.shuffle(self.seq)
+        self.cur = 0
+
+    def _decode_one(self, idx):
+        header, img_bytes = recordio.unpack(self.rec.read(idx))
+        img = imdecode(img_bytes)
+        c, th, tw = self.data_shape
+        if self.resize > 0:
+            img = resize_short(img, self.resize)
+        h, w = img.shape[:2]
+        if h < th or w < tw:
+            img = _resize(img, max(tw, w), max(th, h))
+            h, w = img.shape[:2]
+        if self.rand_crop:
+            y0 = self.rng.randint(0, h - th)
+            x0 = self.rng.randint(0, w - tw)
+        else:
+            y0 = (h - th) // 2
+            x0 = (w - tw) // 2
+        img = img[y0:y0 + th, x0:x0 + tw]
+        label = header.label
+        return img, onp.atleast_1d(label)
+
+    def next(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idxs = self.seq[self.cur:self.cur + self.batch_size]
+        self.cur += self.batch_size
+        pad = self.batch_size - len(idxs)
+        if pad > 0:
+            if self.round_batch:
+                idxs = idxs + self.seq[:pad]
+            else:
+                pass
+        results = list(self.pool.map(self._decode_one, idxs))
+        imgs = onp.stack([r[0] for r in results])
+        labels = onp.stack([r[1] for r in results])
+        mirror = None
+        if self.rand_mirror:
+            mirror = onp.array(
+                [self.rng.random() < 0.5 for _ in range(len(idxs))],
+                onp.uint8)
+        std = self.std / self.scale
+        batch = runtime.assemble_batch(imgs, mean=self.mean, std=std,
+                                       mirror=mirror)
+        label_out = labels if self.label_width > 1 else labels[:, 0]
+        return DataBatch([nd.array(batch)], [nd.array(label_out)], pad=pad)

@@ -444,3 +444,14 @@ class CSVIter(DataIter):
 
     def next(self):
         return self._iter.next()
+
+
+def __getattr__(name):
+    """Lazy re-exports from image.py (mx.io.ImageRecordIter compat —
+    registered in src/io/iter_image_recordio_2.cc in the reference)."""
+    if name in ("ImageRecordIter", "ImageIter", "ImageRecordUInt8Iter"):
+        from . import image
+        if name == "ImageRecordUInt8Iter":
+            return image.ImageRecordIter
+        return getattr(image, name)
+    raise AttributeError("module 'mxnet_tpu.io' has no attribute %r" % name)

