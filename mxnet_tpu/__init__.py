@@ -44,6 +44,7 @@ from . import rnn
 from . import attribute
 from . import name
 from . import test_utils
+from . import operator
 from . import parallel
 
 from .attribute import AttrScope

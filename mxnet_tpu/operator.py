@@ -1,0 +1,232 @@
+"""Custom python operators (python/mxnet/operator.py:855).
+
+The reference runs python CustomOps on a dedicated worker thread pushed as a
+kAsync engine op (src/operator/custom/custom-inl.h:35-104). Here a CustomOp
+participates in *jitted* graphs through ``jax.pure_callback``: forward and
+backward callbacks execute host-side python/numpy, while XLA treats them as
+opaque calls with declared shapes — so custom ops compose with the compiled
+executor exactly like native ops, including gradients (``jax.custom_vjp``
+wires CustomOp.backward in).
+
+API mirrors the reference: subclass CustomOp (forward/backward with
+req/assign), subclass CustomOpProp (list_arguments/list_outputs/infer_shape/
+create_operator), then ``@mx.operator.register("name")``; invoke with
+``mx.nd.Custom(..., op_type="name")`` / ``mx.sym.Custom(...)``.
+Legacy NumpyOp/NDArrayOp are provided as thin aliases.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .base import MXNetError
+from . import registry as _registry
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "NumpyOp", "NDArrayOp",
+           "get_prop"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp(object):
+    """Base class for python operators."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the grad request."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] += src
+        else:
+            raise ValueError("Invalid req: %s" % req)
+
+
+class CustomOpProp(object):
+    """Operator properties: shapes, arity, and the op factory."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type`` name."""
+
+    def do_register(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_prop(op_type, kwargs=None):
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError("Custom op type %s is not registered" % op_type)
+    str_kwargs = {k: str(v) for k, v in (kwargs or {}).items()}
+    return _CUSTOM_REGISTRY[op_type](**str_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# the Custom op bridging into the registry/executor
+# ---------------------------------------------------------------------------
+class _NumpyView(object):
+    """Minimal NDArray-like view handed to CustomOp callbacks: supports
+    [:] assignment, asnumpy(), shape/dtype — enough for the reference's
+    CustomOp idioms."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+    def asnumpy(self):
+        return self.arr
+
+    def __getitem__(self, k):
+        return self.arr[k]
+
+    def __setitem__(self, k, v):
+        self.arr[k] = onp.asarray(v, dtype=self.arr.dtype) \
+            if not isinstance(v, _NumpyView) else v.arr
+
+    def __iadd__(self, v):
+        self.arr += onp.asarray(v, dtype=self.arr.dtype) \
+            if not isinstance(v, _NumpyView) else v.arr
+        return self
+
+
+def _custom_args(attrs):
+    prop = get_prop(attrs["op_type"],
+                    {k: v for k, v in attrs.items() if k != "op_type"})
+    return tuple(prop.list_arguments())
+
+
+def _custom_infer(attrs, in_shapes, aux):
+    prop = get_prop(attrs["op_type"],
+                    {k: v for k, v in attrs.items() if k != "op_type"})
+    if any(s is None for s in in_shapes):
+        return in_shapes, None, aux
+    ins, outs, auxs = prop.infer_shape([list(s) for s in in_shapes])
+    return ([tuple(s) for s in ins], [tuple(s) for s in outs],
+            [tuple(s) for s in auxs])
+
+
+def _custom_num_outputs(attrs):
+    prop = get_prop(attrs["op_type"],
+                    {k: v for k, v in attrs.items() if k != "op_type"})
+    return len(prop.list_outputs())
+
+
+@_registry.register("Custom", arg_names=_custom_args,
+                    num_outputs=_custom_num_outputs,
+                    infer_shape=_custom_infer,
+                    attr_types={"op_type": str})
+def _custom_fcompute(attrs, ins, octx):
+    import jax
+
+    op_kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    prop = get_prop(attrs["op_type"], op_kwargs)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(x.shape) for x in ins]
+    in_dtypes = [onp.dtype(x.dtype) for x in ins]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    out_dtype = in_dtypes[0] if in_dtypes else onp.float32
+    is_train = bool(octx.is_train)
+
+    def _make_op():
+        return prop.create_operator(None, in_shapes, in_dtypes)
+
+    def host_forward(*arrays):
+        op = _make_op()
+        in_views = [_NumpyView(onp.array(a)) for a in arrays]
+        out_views = [_NumpyView(onp.zeros(s, out_dtype)) for s in out_shapes]
+        op.forward(is_train, ["write"] * n_out, in_views, out_views, [])
+        return tuple(v.arr for v in out_views)
+
+    out_struct = tuple(jax.ShapeDtypeStruct(tuple(s), out_dtype)
+                       for s in out_shapes)
+
+    @jax.custom_vjp
+    def f(*xs):
+        return jax.pure_callback(host_forward, out_struct, *xs)
+
+    def f_fwd(*xs):
+        outs = jax.pure_callback(host_forward, out_struct, *xs)
+        return outs, (xs, outs)
+
+    def f_bwd(res, gs):
+        xs, outs = res
+
+        def host_backward(*args):
+            k = len(gs)
+            out_grads = [onp.array(a) for a in args[:k]]
+            xs_np = [onp.array(a) for a in args[k:k + len(xs)]]
+            outs_np = [onp.array(a) for a in args[k + len(xs):]]
+            op = _make_op()
+            in_grads = [_NumpyView(onp.zeros(s, out_dtype))
+                        for s in in_shapes]
+            op.backward(["write"] * len(xs),
+                        [_NumpyView(g) for g in out_grads],
+                        [_NumpyView(x) for x in xs_np],
+                        [_NumpyView(o) for o in outs_np], in_grads, [])
+            return tuple(v.arr for v in in_grads)
+
+        in_struct = tuple(jax.ShapeDtypeStruct(tuple(s), dt)
+                          for s, dt in zip(in_shapes, in_dtypes))
+        grads = jax.pure_callback(host_backward, in_struct,
+                                  *(tuple(gs) + tuple(xs) + tuple(outs)))
+        return tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*ins)
+    return list(outs)
+
+
+# Legacy aliases (operator.py NumpyOp / NDArrayOp): users subclass these
+# with forward/backward taking numpy arrays — the CustomOp protocol already
+# passes numpy-backed views, so the base class is shared.
+NumpyOp = CustomOp
+NDArrayOp = CustomOp

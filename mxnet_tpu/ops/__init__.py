@@ -15,3 +15,5 @@ from . import conv  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import contrib  # noqa: F401
+from . import detection  # noqa: F401
+from .. import operator  # noqa: F401  (registers the Custom op)

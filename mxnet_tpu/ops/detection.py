@@ -1,0 +1,278 @@
+"""Detection operators (src/operator/contrib/: multibox_target,
+multibox_detection, proposal; src/operator/roi_pooling handled in conv.py).
+
+All computations are static-shape XLA programs: IoU matrices are dense
+(anchors × gt), NMS is an O(N²) mask-suppression loop via lax.fori_loop —
+the idiomatic TPU formulation (no dynamic shapes, no host sync), replacing
+the reference's CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _iou_matrix(jnp, a, b):
+    """IoU between (N,4) and (M,4) corner-format boxes -> (N,M)."""
+    ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
+    bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], \
+        b[None, :, 3]
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _mbt_infer(attrs, in_shapes, aux):
+    anchor, label, cls_pred = in_shapes
+    if anchor is None or label is None or cls_pred is None:
+        return in_shapes, None, aux
+    num_anchors = anchor[1]
+    batch = label[0]
+    return in_shapes, [(batch, num_anchors * 4), (batch, num_anchors * 4),
+                       (batch, num_anchors)], aux
+
+
+@register("_contrib_MultiBoxTarget",
+          arg_names=("anchor", "label", "cls_pred"),
+          attr_types={"overlap_threshold": float, "ignore_label": float,
+                      "negative_mining_ratio": float,
+                      "negative_mining_thresh": float, "variances": tuple,
+                      "minimum_negative_samples": int},
+          infer_shape=_mbt_infer, num_outputs=3,
+          backward_ignores_head_grads=True)
+def _multibox_target(attrs, ins, octx):
+    """Assign ground-truth to anchors (multibox_target-inl.h).
+
+    anchor (1, A, 4); label (B, M, 5) [cls, x1, y1, x2, y2], cls<0 = pad;
+    cls_pred (B, C, A). Outputs loc_target (B, A*4), loc_mask (B, A*4),
+    cls_target (B, A) with 0 = background, k+1 = class k.
+    """
+    import jax
+    jnp = _jnp()
+    anchor, label, cls_pred = ins
+    A = anchor.shape[1]
+    anchors = anchor.reshape(A, 4)
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    variances = attrs.get("variances", (0.1, 0.1, 0.2, 0.2))
+
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-8)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-8)
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one_sample(lab):
+        valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        iou = _iou_matrix(jnp, anchors, gt)  # (A, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)          # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= thresh
+        # force-match the best anchor for each valid gt
+        best_anchor = jnp.argmax(iou, axis=0)      # (M,)
+        forced = jnp.zeros((A,), bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros((A,), "int32").at[best_anchor].set(
+            jnp.arange(lab.shape[0], dtype="int32"))
+        use_forced = forced
+        gt_idx = jnp.where(use_forced, forced_gt, best_gt.astype("int32"))
+        pos = matched | forced
+
+        g = gt[gt_idx]
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        tx = (gcx - acx) / aw / variances[0]
+        ty = (gcy - acy) / ah / variances[1]
+        tw = jnp.log(gw / aw) / variances[2]
+        th = jnp.log(gh / ah) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=1)  # (A,4)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0)
+        loc_m = jnp.broadcast_to(pos[:, None], (A, 4)).astype(loc_t.dtype)
+        cls_t = jnp.where(pos, lab[gt_idx, 0] + 1.0, 0.0)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label)
+    dt = cls_pred.dtype
+    return [loc_t.astype(dt), loc_m.astype(dt), cls_t.astype(dt)]
+
+
+def _nms_suppress(jnp, boxes, scores, iou_thresh, topk):
+    """Mask-based NMS: returns keep mask (N,), static shapes (lax loop)."""
+    import jax
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    iou = _iou_matrix(jnp, boxes_s, boxes_s)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_thresh) & keep[i] & \
+            (jnp.arange(N) > i)
+        return keep & ~sup
+
+    keep_sorted = jax.lax.fori_loop(0, N, body, jnp.ones((N,), bool))
+    keep = jnp.zeros((N,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def _mbd_infer(attrs, in_shapes, aux):
+    cls_prob, loc_pred, anchor = in_shapes
+    if cls_prob is None or anchor is None:
+        return in_shapes, None, aux
+    return in_shapes, [(cls_prob[0], anchor[1], 6)], aux
+
+
+@register("_contrib_MultiBoxDetection",
+          arg_names=("cls_prob", "loc_pred", "anchor"),
+          attr_types={"clip": bool, "threshold": float,
+                      "background_id": int, "nms_threshold": float,
+                      "force_suppress": bool, "variances": tuple,
+                      "nms_topk": int},
+          infer_shape=_mbd_infer, backward_ignores_head_grads=True)
+def _multibox_detection(attrs, ins, octx):
+    """Decode + NMS (multibox_detection-inl.h). Output (B, A, 6):
+    [cls_id, score, x1, y1, x2, y2], cls_id = -1 for suppressed slots."""
+    import jax
+    jnp = _jnp()
+    cls_prob, loc_pred, anchor = ins
+    B, C, A = cls_prob.shape
+    anchors = anchor.reshape(A, 4)
+    variances = attrs.get("variances", (0.1, 0.1, 0.2, 0.2))
+    thresh = float(attrs.get("threshold", 0.01))
+    nms_thresh = float(attrs.get("nms_threshold", 0.5))
+    clip = attrs.get("clip", True)
+
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one_sample(cp, lp):
+        loc = lp.reshape(A, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores = jnp.max(cp[1:], axis=0)             # best fg score (A,)
+        cls_id = jnp.argmax(cp[1:], axis=0).astype(cp.dtype)
+        valid = scores > thresh
+        keep = _nms_suppress(jnp, boxes, jnp.where(valid, scores, -1.0),
+                             nms_thresh, A)
+        final = valid & keep
+        out_id = jnp.where(final, cls_id, -1.0)
+        return jnp.concatenate([out_id[:, None], scores[:, None], boxes],
+                               axis=1)
+
+    return [jax.vmap(one_sample)(cls_prob, loc_pred)]
+
+
+def _proposal_infer(attrs, in_shapes, aux):
+    cls_prob = in_shapes[0]
+    if cls_prob is None:
+        return in_shapes, None, aux
+    n = int(attrs.get("rpn_post_nms_top_n", 300))
+    return in_shapes, [(cls_prob[0] * n, 5)], aux
+
+
+@register("_contrib_Proposal",
+          arg_names=("cls_prob", "bbox_pred", "im_info"),
+          attr_types={"rpn_pre_nms_top_n": int, "rpn_post_nms_top_n": int,
+                      "threshold": float, "rpn_min_size": int,
+                      "scales": tuple, "ratios": tuple,
+                      "feature_stride": int, "output_score": bool,
+                      "iou_loss": bool},
+          infer_shape=_proposal_infer, backward_ignores_head_grads=True,
+          alias=("Proposal",))
+def _proposal(attrs, ins, octx):
+    """RPN proposal generation (src/operator/contrib/proposal-inl.h):
+    enumerate anchors on the feature grid, decode bbox deltas, clip, topk by
+    fg score, NMS, emit (B*post_nms, 5) rois [batch_idx, x1, y1, x2, y2]."""
+    import jax
+    jnp = _jnp()
+    cls_prob, bbox_pred, im_info = ins
+    B, twoA, H, W = cls_prob.shape
+    stride = int(attrs.get("feature_stride", 16))
+    scales = attrs.get("scales", (4, 8, 16, 32))
+    ratios = attrs.get("ratios", (0.5, 1, 2))
+    pre_n = int(attrs.get("rpn_pre_nms_top_n", 6000))
+    post_n = int(attrs.get("rpn_post_nms_top_n", 300))
+    nms_thresh = float(attrs.get("threshold", 0.7))
+    if isinstance(scales, (int, float)):
+        scales = (scales,)
+    if isinstance(ratios, (int, float)):
+        ratios = (ratios,)
+
+    # base anchors centered at stride/2 (numpy, compile-time constant)
+    base = []
+    base_size = stride
+    ctr = (base_size - 1) / 2.0
+    for r in ratios:
+        size = base_size * base_size
+        size_r = size / r
+        ws = onp.round(onp.sqrt(size_r))
+        hs = onp.round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            base.append([ctr - (w - 1) / 2, ctr - (h - 1) / 2,
+                         ctr + (w - 1) / 2, ctr + (h - 1) / 2])
+    base = onp.asarray(base, onp.float32)  # (K,4)
+    K = base.shape[0]
+    sx = onp.arange(W) * stride
+    sy = onp.arange(H) * stride
+    gx, gy = onp.meshgrid(sx, sy)
+    shifts = onp.stack([gx.ravel(), gy.ravel(), gx.ravel(), gy.ravel()],
+                       axis=1)  # (HW, 4)
+    all_anchors = (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)
+    all_anchors = jnp.asarray(all_anchors)
+    A = all_anchors.shape[0]
+
+    pre_n = min(pre_n, A)
+    post_n = min(post_n, pre_n)
+
+    def one_sample(cp, bp, info):
+        scores = cp[K:].reshape(K, H, W).transpose(1, 2, 0).reshape(-1)
+        deltas = bp.reshape(K, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = all_anchors[:, 2] - all_anchors[:, 0] + 1.0
+        ah = all_anchors[:, 3] - all_anchors[:, 1] + 1.0
+        acx = all_anchors[:, 0] + 0.5 * (aw - 1)
+        acy = all_anchors[:, 1] + 0.5 * (ah - 1)
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                           cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)], axis=1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=1)
+        top_scores, top_idx = jax.lax.top_k(scores, pre_n)
+        top_boxes = boxes[top_idx]
+        keep = _nms_suppress(jnp, top_boxes, top_scores, nms_thresh, pre_n)
+        ranked = jnp.argsort(-jnp.where(keep, top_scores, -jnp.inf))
+        sel = ranked[:post_n]
+        return top_boxes[sel]
+
+    rois = jax.vmap(one_sample)(cls_prob, bbox_pred, im_info)  # (B,post,4)
+    bidx = jnp.repeat(jnp.arange(B, dtype=cls_prob.dtype), post_n)
+    out = jnp.concatenate([bidx[:, None], rois.reshape(-1, 4)], axis=1)
+    return [out]

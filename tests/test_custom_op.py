@@ -1,0 +1,119 @@
+"""CustomOp tests (mirrors the reference test_operator.py custom-op cases +
+example/numpy-ops)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import operator as mxop
+from mxnet_tpu import symbol as sym
+
+
+@mxop.register("sqr")
+class SqrProp(mxop.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mxop.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0].asnumpy() ** 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    2 * in_data[0].asnumpy() * out_grad[0].asnumpy())
+
+
+@mxop.register("custom_softmax")
+class CustomSoftmaxProp(mxop.CustomOpProp):
+    """The canonical example (example/numpy-ops/custom_softmax.py)."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return CustomSoftmax()
+
+
+class CustomSoftmax(mxop.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(int)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], y)
+
+
+def test_custom_op_imperative():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    out = mx.nd.Custom(x, op_type="sqr")
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_custom_op_symbolic_forward_backward():
+    data = sym.Variable("data")
+    s = sym.Custom(data, op_type="sqr", name="sqr0")
+    x = np.random.randn(3, 4).astype(np.float32)
+    e = s.simple_bind(mx.cpu(), data=(3, 4))
+    e.arg_dict["data"][:] = x
+    e.forward(is_train=True)
+    np.testing.assert_allclose(e.outputs[0].asnumpy(), x ** 2, rtol=1e-5)
+    e.backward()
+    np.testing.assert_allclose(e.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-5)
+
+
+def test_custom_softmax_trains():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = sym.Custom(net, sym.Variable("softmax_label"),
+                     op_type="custom_softmax", name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 6).astype(np.float32)
+    w = rng.randn(6, 4)
+    y = X.dot(w).argmax(axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.8, acc
+
+
+def test_custom_op_in_middle_of_graph():
+    data = sym.Variable("data")
+    s = sym.Custom(data, op_type="sqr", name="sq")
+    s = sym.sum(s)
+    x = np.random.rand(3, 3).astype(np.float32) + 0.5
+    e = s.simple_bind(mx.cpu(), data=(3, 3))
+    e.arg_dict["data"][:] = x
+    e.forward(is_train=True)
+    e.backward()
+    np.testing.assert_allclose(e.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-5)

@@ -1,0 +1,72 @@
+"""Detection op tests (contrib MultiBox* / Proposal / ROIPooling)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd._contrib_MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor centered at (0.125, 0.125) with size 0.5
+    np.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                      0.125 + 0.25, 0.125 + 0.25], atol=1e-6)
+
+
+def test_multibox_target():
+    anchors = nd.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.3, 1.0]]])  # (1,3,4)
+    # one gt box matching anchor 1 (class 2)
+    label = nd.array([[[2.0, 0.55, 0.55, 0.95, 0.95],
+                       [-1.0, 0, 0, 0, 0]]])  # (1,2,5)
+    cls_pred = nd.zeros((1, 4, 3))
+    loc_t, loc_m, cls_t = nd._contrib_MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[1] == 3.0  # class 2 -> target 3 (background=0)
+    assert ct[0] == 0.0
+    lm = loc_m.asnumpy()[0].reshape(3, 4)
+    assert lm[1].sum() == 4 and lm[0].sum() == 0
+
+
+def test_multibox_detection():
+    anchors = nd.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9]]])
+    cls_prob = nd.array([[[0.1, 0.9],    # background prob
+                          [0.8, 0.05],   # class 0
+                          [0.1, 0.05]]])  # class 1  -> shape (1,3,2)
+    loc_pred = nd.zeros((1, 8))
+    out = nd._contrib_MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                        threshold=0.5)
+    res = out.asnumpy()[0]
+    assert res.shape == (2, 6)
+    assert res[0][0] == 0.0 and abs(res[0][1] - 0.8) < 1e-6  # kept, class 0
+    assert res[1][0] == -1.0  # suppressed by threshold
+
+
+def test_proposal_shapes():
+    B, K, H, W = 1, 12, 8, 8  # K = 4 scales x 3 ratios
+    cls_prob = nd.array(np.random.rand(B, 2 * K, H, W).astype(np.float32))
+    bbox_pred = nd.array(np.random.randn(B, 4 * K, H, W).astype(np.float32)
+                         * 0.1)
+    im_info = nd.array([[128.0, 128.0, 1.0]])
+    rois = nd._contrib_Proposal(cls_prob, bbox_pred, im_info,
+                                feature_stride=16, rpn_pre_nms_top_n=200,
+                                rpn_post_nms_top_n=50)
+    assert rois.shape == (50, 5)
+    r = rois.asnumpy()
+    assert (r[:, 1:] >= 0).all() and (r[:, [1, 3]] <= 127).all()
+
+
+def test_nms_suppression_logic():
+    from mxnet_tpu.ops.detection import _nms_suppress
+    import jax.numpy as jnp
+    boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 10.5, 10.5],
+                         [20, 20, 30, 30]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    keep = _nms_suppress(jnp, boxes, scores, 0.5, 3)
+    assert list(np.asarray(keep)) == [True, False, True]
