@@ -45,6 +45,8 @@ from . import attribute
 from . import name
 from . import test_utils
 from . import operator
+from . import rtc
+from . import torch
 from . import parallel
 
 from .attribute import AttrScope

@@ -1,0 +1,47 @@
+"""KVStore server-role entry (python/mxnet/kvstore_server.py:58).
+
+The reference dispatches on DMLC_ROLE: "server"/"scheduler" processes run
+the ps-lite loop, "worker" returns to user code. The TPU-native stack has no
+server processes — every process is a worker participating in XLA
+collectives — so server/scheduler roles become no-op participants kept only
+so reference launch scripts (tools/launch.py -s N) still work: they join
+coordination and exit cleanly at shutdown.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer(object):
+    """Compatibility shim for the server role."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging()
+
+    def init_logging(self):
+        verbose = int(os.getenv("MXNET_KVSTORE_DEBUG", "0"))
+        if verbose > 0:
+            logging.basicConfig(level=logging.DEBUG)
+
+    def run(self):
+        logging.info("kvstore server role is a no-op under XLA collectives; "
+                     "idling until workers finish")
+        # Workers synchronize via jax.distributed; nothing to serve.
+
+
+def _init_kvstore_server_module():
+    """Called on import like the reference: if DMLC_ROLE is server or
+    scheduler, run the (no-op) server loop then exit."""
+    role = os.getenv("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        server = KVStoreServer(None)
+        server.run()
+        sys.exit(0)
+
+
+_init_kvstore_server_module()

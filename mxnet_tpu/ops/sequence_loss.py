@@ -1,0 +1,138 @@
+"""CTCLoss + Correlation — the remaining specialty layer ops.
+
+CTCLoss replaces the warpctc plugin (plugin/warpctc, src/operator/
+contrib/ctc_loss): log-space forward algorithm as a ``lax.scan`` over time;
+the gradient comes from differentiating the scan (XLA keeps it on-device),
+instead of warpctc's hand-written alpha-beta kernels.
+
+Correlation (src/operator/correlation-inl.h, FlowNet) is expressed as a
+displacement-enumerated elementwise product + channel reduction — a static
+shift loop XLA fuses, replacing the CUDA patch kernel.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _ctc_infer(attrs, in_shapes, aux):
+    data, label = in_shapes[0], in_shapes[1]
+    if data is None:
+        return in_shapes, None, aux
+    if label is None and in_shapes[1] is None:
+        return in_shapes, None, aux
+    return in_shapes, [(data[1],)], aux
+
+
+NEG_INF = -1e30
+
+
+def _ctc_loss_single(jnp, logprobs, labels, blank):
+    """CTC negative log likelihood for one sample.
+
+    logprobs: (T, C) log-softmax; labels: (L,) int32, 0 = padding
+    (blank_label='first' convention: class 0 is blank, valid labels >= 1).
+    """
+    import jax
+    T, C = logprobs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((S,), blank, dtype="int32")
+    ext = ext.at[1::2].set(labels)
+    valid_lab = labels > 0
+    num_valid = jnp.sum(valid_lab.astype("int32"))
+    S_valid = 2 * num_valid + 1
+
+    # can alpha skip from s-2 to s (different consecutive labels)?
+    skip_ok = jnp.zeros((S,), bool)
+    skip_ok = skip_ok.at[2::2].set(False)
+    lab_prev = jnp.concatenate([jnp.full((1,), -1, "int32"), labels[:-1]])
+    skip_ok = skip_ok.at[3::2].set(labels[1:] != labels[:-1]) \
+        if L > 1 else skip_ok
+
+    alpha0 = jnp.full((S,), NEG_INF)
+    alpha0 = alpha0.at[0].set(logprobs[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(L > 0, logprobs[0, ext[1]],
+                                        NEG_INF))
+
+    def step(alpha, lp):
+        prev1 = jnp.concatenate([jnp.full((1,), NEG_INF), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), NEG_INF), alpha[:-2]])
+        prev2 = jnp.where(skip_ok, prev2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new_alpha = merged + lp[ext]
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, logprobs[1:])
+    # final: last blank or last label of the VALID sequence
+    end1 = alpha[jnp.maximum(S_valid - 1, 0)]
+    end2 = jnp.where(S_valid >= 2, alpha[jnp.maximum(S_valid - 2, 0)],
+                     NEG_INF)
+    return -jnp.logaddexp(end1, end2)
+
+
+@register("CTCLoss", arg_names=("data", "label"),
+          attr_types={"use_data_lengths": bool, "use_label_lengths": bool,
+                      "blank_label": str},
+          infer_shape=_ctc_infer, num_outputs=1,
+          alias=("ctc_loss", "_contrib_CTCLoss", "WarpCTC"))
+def _ctc_loss(attrs, ins, octx):
+    """data (T, N, C) activations (softmax applied internally),
+    label (N, L) 1-indexed classes padded with 0; returns per-sample loss
+    (N,). blank_label='first' (class 0)."""
+    import jax
+    jnp = _jnp()
+    data, label = ins[0], ins[1]
+    lp = jax.nn.log_softmax(data, axis=-1)  # (T,N,C)
+    labels = label.astype("int32")          # (N,L)
+
+    def per_sample(lp_n, lab_n):
+        return _ctc_loss_single(jnp, lp_n, lab_n, 0)
+
+    losses = jax.vmap(per_sample, in_axes=(1, 0))(lp, labels)
+    return [losses]
+
+
+def _corr_infer(attrs, in_shapes, aux):
+    d1 = in_shapes[0]
+    if d1 is None:
+        return in_shapes, None, aux
+    md = int(attrs.get("max_displacement", 1))
+    s2 = int(attrs.get("stride2", 1))
+    d = 2 * (md // s2) + 1
+    return in_shapes, [(d1[0], d * d, d1[2], d1[3])], aux
+
+
+@register("Correlation", arg_names=("data1", "data2"),
+          attr_types={"kernel_size": int, "max_displacement": int,
+                      "stride1": int, "stride2": int, "pad_size": int,
+                      "is_multiply": bool})
+def _correlation(attrs, ins, octx):
+    """Displacement correlation (correlation-inl.h). kernel_size=1 path:
+    out[:, k, y, x] = mean_c d1[:, c, y, x] * d2[:, c, y+dy, x+dx]."""
+    jnp = _jnp()
+    d1, d2 = ins
+    N, C, H, W = d1.shape
+    md = int(attrs.get("max_displacement", 1))
+    s2 = int(attrs.get("stride2", 1))
+    multiply = attrs.get("is_multiply", True)
+    disp = range(-md, md + 1, s2)
+    pad = md
+    d2p = jnp.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    outs = []
+    for dy in disp:
+        for dx in disp:
+            shifted = d2p[:, :, pad + dy:pad + dy + H,
+                          pad + dx:pad + dx + W]
+            if multiply:
+                outs.append(jnp.mean(d1 * shifted, axis=1))
+            else:
+                outs.append(jnp.mean(jnp.abs(d1 - shifted), axis=1))
+    return [jnp.stack(outs, axis=1)]

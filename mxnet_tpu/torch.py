@@ -1,0 +1,37 @@
+"""Torch bridge (python/mxnet/torch.py / plugin/torch in the reference).
+
+The reference bridges Lua-torch modules/criterions into the graph. A
+CPU-only ``torch`` is present in this image, so the bridge maps torch
+callables into the graph via CustomOp semantics (host callback); there is
+no TPU-side torch execution.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["pytorch_function"]
+
+
+def pytorch_function(fn, name="torch_fn"):
+    """Wrap a (CPU) pytorch callable as an imperative NDArray function.
+
+    The callable receives/returns torch tensors; data round-trips through
+    host memory — use for preprocessing/losses, not hot-path compute.
+    """
+    try:
+        import torch as _torch
+    except ImportError:  # pragma: no cover
+        raise MXNetError("pytorch is not available in this environment")
+
+    from .ndarray import NDArray, array
+
+    def wrapped(*args):
+        t_args = [_torch.from_numpy(a.asnumpy()) if isinstance(a, NDArray)
+                  else a for a in args]
+        out = fn(*t_args)
+        if isinstance(out, (list, tuple)):
+            return [array(o.detach().cpu().numpy()) for o in out]
+        return array(out.detach().cpu().numpy())
+
+    wrapped.__name__ = name
+    return wrapped

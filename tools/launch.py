@@ -1,0 +1,79 @@
+"""Distributed job launcher (reference tools/launch.py → dmlc-tracker).
+
+Launches N worker processes for dist_sync/dist_async training. Instead of
+the ps-lite tracker's worker+server+scheduler topology, every process is a
+JAX-distributed worker (no server processes); the DMLC_* env contract is
+preserved so reference commands keep working:
+
+    python tools/launch.py -n 4 python train_mnist.py --kv-store dist_sync
+
+Local cluster = N forked processes (the reference's "local" launcher);
+multi-host via -H hostfile uses ssh like dmlc-tracker's ssh mode.
+"""
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+
+def launch_local(n, cmd, port):
+    procs = []
+    env_base = dict(os.environ)
+    env_base.update({
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_NUM_SERVER": "0",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+    })
+    for rank in range(n):
+        env = dict(env_base)
+        env["DMLC_WORKER_ID"] = str(rank)
+        env["DMLC_ROLE"] = "worker"
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def launch_ssh(hosts, n, cmd, port):
+    root = hosts[0]
+    procs = []
+    for rank in range(n):
+        host = hosts[rank % len(hosts)]
+        envs = ("DMLC_NUM_WORKER=%d DMLC_WORKER_ID=%d DMLC_ROLE=worker "
+                "DMLC_PS_ROOT_URI=%s DMLC_PS_ROOT_PORT=%d"
+                % (n, rank, root, port))
+        full = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                "cd %s; %s %s" % (os.getcwd(), envs, " ".join(cmd))]
+        procs.append(subprocess.Popen(full))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main():
+    parser = argparse.ArgumentParser(description="launch a dist job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="ignored: no server processes under XLA "
+                             "collectives (kept for compat)")
+    parser.add_argument("-H", "--hostfile", default=None)
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh"])
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    port = random.randint(9100, 9899)
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        sys.exit(launch_ssh(hosts, args.num_workers, args.command, port))
+    sys.exit(launch_local(args.num_workers, args.command, port))
+
+
+if __name__ == "__main__":
+    main()

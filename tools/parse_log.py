@@ -1,0 +1,46 @@
+"""Parse training logs into a table (reference tools/parse_log.py)."""
+import argparse
+import re
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Parse mxnet_tpu train logs")
+    parser.add_argument("logfile", help="log file path (or - for stdin)")
+    parser.add_argument("--format", default="markdown",
+                        choices=["markdown", "csv"])
+    args = parser.parse_args()
+    f = sys.stdin if args.logfile == "-" else open(args.logfile)
+    res = [re.compile(r".*Epoch\[(\d+)\] Train-([a-zA-Z_\-0-9]+)=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Validation-([a-zA-Z_\-0-9]+)=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Time cost=([.\d]+)")]
+    data = {}
+    for line in f:
+        for i, pat in enumerate(res):
+            m = pat.match(line)
+            if m is None:
+                continue
+            epoch = int(m.groups()[0])
+            if epoch not in data:
+                data[epoch] = [0.0, 0.0, 0.0, 0]
+            if i == 0:
+                data[epoch][0] = float(m.groups()[2])
+            elif i == 1:
+                data[epoch][1] = float(m.groups()[2])
+            else:
+                data[epoch][2] += float(m.groups()[1])
+                data[epoch][3] += 1
+            break
+    if args.format == "markdown":
+        print("| epoch | train | valid | time |")
+        print("| --- | --- | --- | --- |")
+        for k, v in sorted(data.items()):
+            print("| %2d | %f | %f | %.1f |" % (k, v[0], v[1], v[2]))
+    else:
+        print("epoch,train,valid,time")
+        for k, v in sorted(data.items()):
+            print("%d,%f,%f,%.1f" % (k, v[0], v[1], v[2]))
+
+
+if __name__ == "__main__":
+    main()
