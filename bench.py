@@ -59,11 +59,17 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     img = 224
 
+    # bfloat16 compute on TPU (MXU-native; params stay f32), f32 elsewhere
+    dtype_env = os.environ.get("BENCH_DTYPE",
+                               "bfloat16" if platform == "tpu" else "float32")
+    compute_dtype = None if dtype_env == "float32" else dtype_env
+
     net = models.get_symbol("resnet-50", num_classes=1000)
     mesh = pmesh.data_parallel_mesh(n_dev)
     step = dp.DataParallelTrainStep(
         net, mesh, dp.sgd_step_fn(momentum=0.9, wd=1e-4,
-                                  rescale_grad=1.0 / batch))
+                                  rescale_grad=1.0 / batch),
+        compute_dtype=compute_dtype)
     params, states, aux = step.init(Xavier(rnd_type="gaussian",
                                            factor_type="in", magnitude=2),
                                     {"data": (batch, 3, img, img)})
@@ -87,7 +93,8 @@ def main():
 
     img_per_sec = steps * batch / dt
     _emit(img_per_sec, {"platform": platform, "devices": n_dev,
-                        "batch": batch, "steps": steps})
+                        "batch": batch, "steps": steps,
+                        "dtype": dtype_env})
 
 
 if __name__ == "__main__":

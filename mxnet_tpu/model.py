@@ -71,7 +71,11 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
-    """aggregate via kvstore (or not), update locally (model.py:99-116)."""
+    """aggregate via kvstore (or not), update locally (model.py:99-116).
+
+    All per-(param, device) updates are batched into ONE jitted XLA call
+    (Updater.update_multi) — the reference pushes one engine op per param."""
+    triples = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list is None or grad_list[0] is None:
@@ -80,8 +84,13 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             kvstore.push(index, grad_list, priority=-index)
             kvstore.pull(index, grad_list, priority=-index)
         for k, p, g in zip(range(len(arg_list)), arg_list, grad_list):
-            # use a unique integer key per (param, device)
-            updater(index * num_device + k, g, p)
+            # unique integer key per (param, device)
+            triples.append((index * num_device + k, g, p))
+    if hasattr(updater, "update_multi"):
+        updater.update_multi(triples)
+    else:
+        for key, g, p in triples:
+            updater(key, g, p)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

@@ -216,8 +216,10 @@ def _pooling(attrs, ins, octx):
             hi = max(need, 0)
         pads.append((lo, hi))
     if ptype == "max":
-        init = -onp.inf if onp.issubdtype(onp.dtype(x.dtype), onp.floating) \
-            else onp.iinfo(onp.dtype(x.dtype)).min
+        # note: bfloat16 is a custom numpy dtype (kind 'V'), so test for
+        # integer-ness rather than float-ness
+        init = onp.iinfo(onp.dtype(x.dtype)).min \
+            if onp.issubdtype(onp.dtype(x.dtype), onp.integer) else -onp.inf
         y = lax.reduce_window(x, onp.asarray(init, x.dtype), lax.max, window,
                               strides, pads)
     else:

@@ -147,6 +147,18 @@ class SGD(Optimizer):
             return None
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
 
+    def _fused_apply(self, jnp, p, g, s, lr, wd):
+        """Pure single-param step for the whole-tree fused update
+        (Updater.update_multi). Must match update() numerics."""
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * p
+        if self.momentum == 0.0:
+            return p - lr * g, s
+        new_s = self.momentum * s - lr * g
+        return p + new_s, new_s
+
     def update(self, index, weight, grad, state):
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -256,6 +268,22 @@ class Adam(Optimizer):
     def create_state(self, index, weight):
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def _fused_lr(self, index):
+        t = self._index_update_count[index]
+        return self._get_lr(index) * math.sqrt(1.0 - self.beta2 ** t) / \
+            (1.0 - self.beta1 ** t)
+
+    def _fused_apply(self, jnp, p, g, s, lr, wd):
+        mean, var = s
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * p
+        new_mean = self.beta1 * mean + (1 - self.beta1) * g
+        new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+        new_p = p - lr * new_mean / (jnp.sqrt(new_var) + self.epsilon)
+        return new_p, (new_mean, new_var)
 
     def update(self, index, weight, grad, state):
         lr = self._get_lr(index)
@@ -423,11 +451,76 @@ class Updater(object):
     def __init__(self, optimizer):
         self.optimizer = optimizer
         self.states = {}
+        self._fused_fn = None
+        self._fused_key = None
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])
+
+    def update_multi(self, triples):
+        """One jitted XLA call updating EVERY parameter (the TPU-native
+        replacement for per-param engine pushes): ``triples`` is a list of
+        (index, grad NDArray, weight NDArray). Falls back to per-param
+        update() for optimizers without a pure ``_fused_apply``."""
+        opt = self.optimizer
+        fa = getattr(opt, "_fused_apply", None)
+        if fa is None:
+            for index, grad, weight in triples:
+                self(index, grad, weight)
+            return
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        for index, grad, weight in triples:
+            if index not in self.states:
+                self.states[index] = opt.create_state(index, weight)
+            opt._update_count(index)
+        get_lr = getattr(opt, "_fused_lr", opt._get_lr)
+        lrs = np.asarray([get_lr(i) for i, _, _ in triples], np.float32)
+        wds = np.asarray([opt._get_wd(i) for i, _, _ in triples],
+                         np.float32)
+
+        def tree_read(state):
+            if state is None:
+                return ()
+            if isinstance(state, (tuple, list)):
+                return tuple(tree_read(s) for s in state)
+            return state._read()
+
+        ws = [w._read() for _, _, w in triples]
+        gs = [g._read() for _, g, _ in triples]
+        ss = [tree_read(self.states[i]) for i, _, _ in triples]
+
+        key = tuple((tuple(w.shape), str(w.dtype)) for w in ws)
+        if self._fused_key != key:
+            def step(ws, gs, ss, lrs, wds):
+                new_ws, new_ss = [], []
+                for k in range(len(ws)):
+                    p, s = fa(jnp, ws[k], gs[k], ss[k], lrs[k], wds[k])
+                    new_ws.append(p)
+                    new_ss.append(s)
+                return new_ws, new_ss
+
+            self._fused_fn = jax.jit(step)
+            self._fused_key = key
+
+        new_ws, new_ss = self._fused_fn(ws, gs, ss, lrs, wds)
+
+        def tree_write(state, new):
+            if state is None:
+                return
+            if isinstance(state, (tuple, list)):
+                for s, n in zip(state, new):
+                    tree_write(s, n)
+                return
+            state._write(new)
+
+        for (i, _, w), nw, ns in zip(triples, new_ws, new_ss):
+            w._write(nw)
+            tree_write(self.states[i], ns)
 
     def set_states(self, states):
         self.states = pickle.loads(states)

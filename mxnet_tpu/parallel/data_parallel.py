@@ -81,7 +81,8 @@ class DataParallelTrainStep:
     """
 
     def __init__(self, symbol, mesh, step_fn, data_names=("data",),
-                 label_names=("softmax_label",), dtype=onp.float32):
+                 label_names=("softmax_label",), dtype=onp.float32,
+                 compute_dtype=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -90,21 +91,33 @@ class DataParallelTrainStep:
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.input_names = list(data_names) + list(label_names)
+        self.label_names = list(label_names)
         self.param_names = [n for n in self.arg_names
                             if n not in self.input_names]
         self._eval_fn, self._needs_rng = _build_eval(symbol)
         self._init_state, self._apply = step_fn
         self.dtype = dtype
+        # mixed precision: params kept f32 (master copies), compute in
+        # compute_dtype (bfloat16 on TPU — MXU native), grads cast back
+        self.compute_dtype = compute_dtype
 
         repl = NamedSharding(mesh, P())
         batch = NamedSharding(mesh, P("dp"))
         self._repl, self._batch = repl, batch
 
+        cdt = self.compute_dtype
+
         def train_step(params, states, aux, inputs, lr, rng):
             import jax.numpy as jnp
 
+            def maybe_cast(name, v):
+                if cdt is not None and name not in self.label_names:
+                    return v.astype(cdt)
+                return v
+
             def f(p):
-                vals = [p[n] if n in p else inputs[n]
+                vals = [maybe_cast(n, p[n]) if n in p
+                        else maybe_cast(n, inputs[n])
                         for n in self.arg_names]
                 auxv = [aux[n] for n in self.aux_names]
                 outs, new_aux = self._eval_fn(vals, auxv, rng, True)
@@ -115,8 +128,11 @@ class DataParallelTrainStep:
             (grads,) = vjp_fn(heads)
             new_params, new_states = {}, {}
             for n in self.param_names:
+                g = grads[n]
+                if cdt is not None:
+                    g = g.astype(params[n].dtype)
                 new_params[n], new_states[n] = self._apply(
-                    params[n], grads[n], states[n], lr)
+                    params[n], g, states[n], lr)
             new_aux_d = dict(zip(self.aux_names, new_aux))
             return new_params, new_states, new_aux_d, outs
 
