@@ -151,7 +151,7 @@ class SGD(Optimizer):
         """Pure single-param step for the whole-tree fused update
         (Updater.update_multi). Must match update() numerics."""
         g = g * self.rescale_grad
-        if self.clip_gradient is not None:
+        if self.clip_gradient:  # truthiness matches update()/_prep: 0 = off
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         g = g + wd * p
         if self.momentum == 0.0:
@@ -277,7 +277,7 @@ class Adam(Optimizer):
     def _fused_apply(self, jnp, p, g, s, lr, wd):
         mean, var = s
         g = g * self.rescale_grad
-        if self.clip_gradient is not None:
+        if self.clip_gradient:  # truthiness matches update()/_prep: 0 = off
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         g = g + wd * p
         new_mean = self.beta1 * mean + (1 - self.beta1) * g
@@ -451,8 +451,7 @@ class Updater(object):
     def __init__(self, optimizer):
         self.optimizer = optimizer
         self.states = {}
-        self._fused_fn = None
-        self._fused_key = None
+        self._fused_fns = {}  # (device, shapes/dtypes) -> jitted step
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
@@ -466,10 +465,33 @@ class Updater(object):
         update() for optimizers without a pure ``_fused_apply``."""
         opt = self.optimizer
         fa = getattr(opt, "_fused_apply", None)
+        if fa is not None:
+            # the fused fn is only valid if no subclass overrode update()
+            # below the class that defined _fused_apply (e.g. NAG overrides
+            # SGD.update but inherits SGD._fused_apply — wrong numerics)
+            def _defining(name):
+                for c in type(opt).__mro__:
+                    if name in c.__dict__:
+                        return c
+                return None
+
+            cf, cu = _defining("_fused_apply"), _defining("update")
+            if cf is None or cu is None or not issubclass(cf, cu):
+                fa = None
         if fa is None:
             for index, grad, weight in triples:
                 self(index, grad, weight)
             return
+        # jit can't mix devices in one call: split per weight placement
+        # (model.py's _update_params feeds per-(param, device) triples)
+        by_dev = {}
+        for t in triples:
+            by_dev.setdefault(str(t[2].context), []).append(t)
+        for dev, group in by_dev.items():
+            self._update_group(dev, group, fa)
+
+    def _update_group(self, dev, triples, fa):
+        opt = self.optimizer
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -494,8 +516,8 @@ class Updater(object):
         gs = [g._read() for _, g, _ in triples]
         ss = [tree_read(self.states[i]) for i, _, _ in triples]
 
-        key = tuple((tuple(w.shape), str(w.dtype)) for w in ws)
-        if self._fused_key != key:
+        key = (dev,) + tuple((tuple(w.shape), str(w.dtype)) for w in ws)
+        if key not in self._fused_fns:
             def step(ws, gs, ss, lrs, wds):
                 new_ws, new_ss = [], []
                 for k in range(len(ws)):
@@ -504,10 +526,9 @@ class Updater(object):
                     new_ss.append(s)
                 return new_ws, new_ss
 
-            self._fused_fn = jax.jit(step)
-            self._fused_key = key
+            self._fused_fns[key] = jax.jit(step)
 
-        new_ws, new_ss = self._fused_fn(ws, gs, ss, lrs, wds)
+        new_ws, new_ss = self._fused_fns[key](ws, gs, ss, lrs, wds)
 
         def tree_write(state, new):
             if state is None:

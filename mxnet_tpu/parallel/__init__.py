@@ -3,12 +3,18 @@
 The reference's parallelism is data-parallel executor groups + a parameter
 server; the TPU-native design is a device mesh with sharding annotations:
 
-* ``mesh``: Mesh construction helpers (dp/tp/pp/sp axes)
+* ``mesh``: Mesh construction helpers (dp/tp/pp/sp/ep axes)
 * ``data_parallel``: batch-sharded fused train step (shard_map + psum)
 * ``dist``: multi-host runtime (jax.distributed) behind the KVStore API
 * ``ring_attention``: sequence/context parallelism over ICI
+* ``tensor_parallel``: Megatron-style column/row sharded matmuls (1 psum)
+* ``pipeline_parallel``: GPipe microbatch schedule via lax.scan + ppermute
+* ``expert_parallel``: top-1 routed MoE with all_to_all dispatch
 """
 from . import dist  # noqa: F401
 from . import mesh  # noqa: F401
 from . import data_parallel  # noqa: F401
 from . import ring_attention  # noqa: F401
+from . import tensor_parallel  # noqa: F401
+from . import pipeline_parallel  # noqa: F401
+from . import expert_parallel  # noqa: F401

@@ -1,0 +1,155 @@
+"""Expert parallelism (Mixture-of-Experts) over a mesh axis — TPU-native.
+
+Absent from the reference (SURVEY.md §2.3 "TP/EP/CP/Ulysses: Absent —
+design fresh on top of shard_map"). Switch-Transformer-style top-1 routed
+MoE designed for the ICI fabric:
+
+* tokens live batch-sharded on the 'ep' axis; experts are sharded over the
+  same axis (each device owns E/n_ep experts);
+* routing builds a STATIC-shape capacity-bucketed dispatch tensor (no
+  dynamic shapes — XLA/MXU friendly), tokens over capacity are dropped and
+  routed around by the residual connection as in Switch;
+* dispatch and return are each ONE ``lax.all_to_all`` — the canonical MoE
+  collective pattern riding ICI;
+* expert FFNs run as a single batched einsum over the local expert dim so
+  the MXU sees one large matmul, not a per-expert loop.
+
+``moe_dispatch_combine`` is the shard_map-level core; ``MoELayer`` wraps
+param creation + jit.
+"""
+from __future__ import annotations
+
+__all__ = ["top1_routing", "moe_dispatch_combine", "moe_ffn_block",
+           "MoELayer"]
+
+
+def top1_routing(gate_logits, capacity):
+    """Top-1 router with static capacity buckets.
+
+    gate_logits: (T, E). Returns (dispatch (T, E, C) one-hot, combine
+    (T, E, C) prob-weighted, aux_loss scalar — the Switch load-balance loss).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # (T,)
+    mask = jax.nn.one_hot(expert, E, dtype=gate_logits.dtype)  # (T, E)
+    # position of each token within its expert's capacity bucket
+    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask            # (T, E)
+    keep = mask * (pos < capacity)
+    pos_idx = jnp.sum(pos * mask, axis=-1).astype(jnp.int32)  # (T,)
+    pos_hot = jax.nn.one_hot(pos_idx, capacity,
+                             dtype=gate_logits.dtype)        # (T, C)
+    dispatch = keep[:, :, None] * pos_hot[:, None, :]        # (T, E, C)
+    gate = jnp.sum(probs * mask, axis=-1)                    # (T,)
+    combine = dispatch * gate[:, None, None]
+    # load-balance aux loss: E * sum_e frac_tokens_e * mean_prob_e
+    frac = jnp.mean(mask, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    return dispatch, combine, aux
+
+
+def moe_dispatch_combine(x, wg, expert_fn, axis_name, capacity_factor=1.25):
+    """Full MoE layer body inside shard_map.
+
+    x: (T_local, d) local token shard; wg: (d, E) router weights
+    (replicated); expert_fn(expert_inputs (E_local, Cap_total, d)) ->
+    same-shape outputs using the LOCAL experts.
+    Returns (y (T_local, d), aux_loss).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_ep = lax.axis_size(axis_name)
+    T, d = x.shape
+    logits = x @ wg                                   # (T, E)
+    E = logits.shape[-1]
+    assert E % n_ep == 0, "n_experts must divide the ep axis"
+    cap = max(1, int(T * capacity_factor / E))
+    dispatch, combine, aux = top1_routing(logits, cap)
+
+    # (T,E,C) x (T,d) -> (E, C, d) expert-major send buffer
+    sendbuf = jnp.einsum("tec,td->ecd", dispatch, x)
+    # scatter expert dim over devices / gather capacity from all peers:
+    # (E, C, d) -> (E_local, n_ep*C, d)
+    recvbuf = lax.all_to_all(sendbuf, axis_name, split_axis=0,
+                             concat_axis=1, tiled=True)
+    expert_out = expert_fn(recvbuf)                   # (E_local, n_ep*C, d)
+    # inverse all_to_all: back to token owners, (E, C, d)
+    retbuf = lax.all_to_all(expert_out, axis_name, split_axis=1,
+                            concat_axis=0, tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine, retbuf)
+    aux = lax.pmean(aux, axis_name)
+    return y, aux
+
+
+def moe_ffn_block(expert_inputs, w1, b1, w2, b2):
+    """Batched two-layer FFN over the local expert dim: one big einsum per
+    matmul so every expert's tokens hit the MXU together.
+
+    expert_inputs: (E_local, Cap, d); w1: (E_local, d, ff); w2: (E_local,
+    ff, d)."""
+    import jax.numpy as jnp
+    h = jnp.einsum("ecd,edf->ecf", expert_inputs, w1) + b1[:, None, :]
+    h = jnp.maximum(h, 0)
+    return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+
+class MoELayer:
+    """Jitted MoE layer over ``mesh``'s ep axis.
+
+    Token batch (B, d) arrives sharded on 'ep'; expert weights (E, d, ff)
+    arrive sharded on their expert dim; router weights replicated.
+    """
+
+    def __init__(self, mesh, n_experts, d_model, d_ff, axis="ep",
+                 capacity_factor=1.25):
+        self.mesh = mesh
+        self.axis = axis
+        self.E = n_experts
+        self.d = d_model
+        self.ff = d_ff
+        self.capacity_factor = capacity_factor
+        self._fn = None
+
+    def init_params(self, rng):
+        import numpy as onp
+        r = onp.random.RandomState(rng)
+        s = 1.0 / onp.sqrt(self.d)
+        return {
+            "gate": (r.randn(self.d, self.E) * s).astype(onp.float32),
+            "w1": (r.randn(self.E, self.d, self.ff) * s).astype(onp.float32),
+            "b1": onp.zeros((self.E, self.ff), onp.float32),
+            "w2": (r.randn(self.E, self.ff, self.d) *
+                   (1.0 / onp.sqrt(self.ff))).astype(onp.float32),
+            "b2": onp.zeros((self.E, self.d), onp.float32),
+        }
+
+    def _build(self):
+        import jax
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        ax = self.axis
+
+        def body(x, p):
+            def expert_fn(inp):
+                return moe_ffn_block(inp, p["w1"], p["b1"], p["w2"],
+                                     p["b2"])
+            return moe_dispatch_combine(
+                x, p["gate"], expert_fn, ax,
+                capacity_factor=self.capacity_factor)
+
+        specs = {"gate": P(), "w1": P(ax), "b1": P(ax), "w2": P(ax),
+                 "b2": P(ax)}
+        self._fn = jax.jit(shard_map(
+            body, mesh=self.mesh, in_specs=(P(ax), specs),
+            out_specs=(P(ax), P()), check_vma=False))
+
+    def __call__(self, x, params):
+        """x: (B, d) global batch; returns (y, aux_loss)."""
+        if self._fn is None:
+            self._build()
+        return self._fn(x, params)

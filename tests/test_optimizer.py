@@ -143,3 +143,56 @@ def test_adagrad_adadelta_converge():
             g = w * 2  # grad of w^2
             o.update(0, w, g, state)
         assert np.abs(w.asnumpy()).max() < 5, name
+
+
+def test_update_multi_multi_device():
+    """Fused whole-tree update with weights on two cpu contexts (the
+    num_device>1 path of model._update_params) — one jit group per device."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    sgd = opt.SGD(learning_rate=0.1, rescale_grad=1.0)
+    up = opt.get_updater(sgd)
+    rng = np.random.RandomState(0)
+    triples, refs = [], []
+    for i, ctx in enumerate([mx.cpu(0), mx.cpu(1)]):
+        w = rng.randn(4, 3).astype(np.float32)
+        g = rng.randn(4, 3).astype(np.float32)
+        triples.append((i, nd.array(g, ctx=ctx), nd.array(w, ctx=ctx)))
+        refs.append(w - 0.1 * g)
+    up.update_multi(triples)
+    for (_, _, w), ref in zip(triples, refs):
+        np.testing.assert_allclose(w.asnumpy(), ref, rtol=1e-5)
+
+
+def test_update_multi_nag_matches_per_param():
+    """NAG overrides update() but inherits SGD._fused_apply: update_multi
+    must fall back to per-param NAG numerics, not silently run SGD."""
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(5).astype(np.float32)
+    g0 = rng.randn(5).astype(np.float32)
+
+    def run(batched):
+        nag = opt.NAG(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+        up = opt.get_updater(nag)
+        w = nd.array(w0)
+        for _ in range(3):
+            if batched:
+                up.update_multi([(0, nd.array(g0), w)])
+            else:
+                up(0, nd.array(g0), w)
+        return w.asnumpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+def test_update_multi_clip_zero_disables():
+    """clip_gradient=0.0 means 'no clipping' on the op path; the fused path
+    must agree instead of clamping every grad to zero."""
+    sgd = opt.SGD(learning_rate=0.1, rescale_grad=1.0, clip_gradient=0.0)
+    up = opt.get_updater(sgd)
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.ones(4, np.float32))
+    up.update_multi([(0, g, w)])
+    np.testing.assert_allclose(w.asnumpy(), np.full(4, 0.9, np.float32),
+                               rtol=1e-5)
