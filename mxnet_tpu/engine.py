@@ -1,18 +1,23 @@
-"""Engine shim — async semantics over the XLA runtime.
+"""Engine — async host scheduling over the XLA runtime.
 
-The reference's 2,001-LoC dependency engine (src/engine/, ThreadedEnginePer-
-Device) exists because HIP ops are eager and hazard-prone; it toposorts ops by
-NDArray Var read/write dependencies and runs them on per-device thread pools.
-On TPU, JAX's dispatch is already asynchronous (every eager op / jitted call
-returns immediately with a future-backed Array and XLA orders execution by
-data flow), so the engine survives only as this thin layer providing:
+The reference's 2,001-LoC dependency engine (src/engine/, ThreadedEngine-
+PerDevice) exists because HIP ops are eager and hazard-prone; it toposorts
+ops by NDArray Var read/write dependencies and runs them on per-device
+thread pools. On TPU, *device* ordering is XLA's job (every jitted call
+returns a future-backed Array ordered by dataflow), so the engine's
+remaining real work is HOST-side: input-pipeline stages, staging-buffer
+fills, checkpoint writes, python callbacks — overlapped with device compute
+but still hazard-ordered among themselves.
 
-* ``waitall`` / per-array ``wait_to_read`` sync points
-  (Engine::WaitForAll/WaitForVar, include/mxnet/engine.h:172-180);
-* a host-side bulk/async push for IO + callbacks (PushAsync's kAsync path);
-* engine-type selection compat (``MXNET_ENGINE_TYPE``): "NaiveEngine" makes
-  every op synchronous, the reference's standard race-bisection tool
-  (src/engine/naive_engine.cc); we honour it by blocking after each op.
+That host scheduler is native C++ (runtime/engine_core.cpp, bound in
+runtime/core.py): per-var FIFO hazard queues (reads run concurrently,
+writes serialize — threaded_engine.h ThreadedVar semantics), a priority
+worker pool, WaitForVar/WaitForAll sync points, and per-op profiler stamps
+(OprExecStat) dumped as Chrome trace JSON. This module keeps the python
+fallback for compiler-less environments and honours the reference's env
+contract: ``MXNET_ENGINE_TYPE=NaiveEngine`` makes every op synchronous (the
+standard race-bisection tool, src/engine/naive_engine.cc);
+``MXNET_CPU_WORKER_NTHREADS`` sizes the pool.
 """
 from __future__ import annotations
 
@@ -30,18 +35,34 @@ def is_naive():
 
 
 class Engine:
-    """Host-side async executor (bounded worker, FIFO per push order)."""
+    """Host-side async executor.
+
+    Native path: C++ dependency engine with var hazards. Fallback: single
+    FIFO worker thread (still async, no var tracking).
+    """
 
     _inst = None
 
-    def __init__(self, num_workers=1):
-        self._q = queue.Queue()
-        self._threads = []
-        for _ in range(num_workers):
-            t = threading.Thread(target=self._worker, daemon=True)
-            t.start()
-            self._threads.append(t)
+    def __init__(self, num_workers=None):
+        self._native = None
+        try:
+            from .runtime.core import NativeEngine
+            eng = NativeEngine(num_workers)
+            if eng.available:
+                self._native = eng
+        except Exception:  # pragma: no cover - build env without g++
+            self._native = None
+        self._q = None
+        if self._native is None:
+            self._q = queue.Queue()
+            if num_workers is None:
+                num_workers = int(os.environ.get(
+                    "MXNET_CPU_WORKER_NTHREADS", 1))
+            for _ in range(0 if _NAIVE else max(1, num_workers)):
+                t = threading.Thread(target=self._worker, daemon=True)
+                t.start()
 
+    # ------------------------------------------------------------- fallback
     def _worker(self):
         while True:
             fn, done = self._q.get()
@@ -51,18 +72,57 @@ class Engine:
                 done.set()
                 self._q.task_done()
 
-    def push_async(self, fn):
-        """Run ``fn`` on a host worker; returns an Event (the Var handle)."""
+    # ------------------------------------------------------------------ API
+    @property
+    def is_native(self):
+        return self._native is not None
+
+    def new_var(self):
+        """Engine::NewVariable — a dependency token for host buffers."""
+        if self._native is not None:
+            return self._native.new_var()
+        return None
+
+    def del_var(self, var):
+        if self._native is not None and var is not None:
+            self._native.del_var(var)
+
+    def push(self, fn, const_vars=(), mutate_vars=(), priority=0, name="op"):
+        """Engine::PushAsync — run fn() once all hazards clear."""
+        if self._native is not None:
+            self._native.push(fn, const_vars, mutate_vars, priority, name)
+            return
         done = threading.Event()
-        if _NAIVE:
+        if _NAIVE or not self._q:
             fn()
             done.set()
         else:
             self._q.put((fn, done))
         return done
 
+    def push_async(self, fn):
+        """Dependency-free host op; returns a waitable Event (fallback) or
+        None (native — use wait_for_all)."""
+        if self._native is not None:
+            self._native.push(fn)
+            return None
+        return self.push(fn)
+
+    def wait_for_var(self, var):
+        """Engine::WaitForVar — block until all pushed ops touching var ran."""
+        if self._native is not None:
+            if var is not None:
+                self._native.wait_for_var(var)
+        elif self._q is not None:
+            # fallback has no per-var tracking; a full drain is the only
+            # way to honor the WaitForVar contract
+            self._q.join()
+
     def wait_for_all(self):
-        self._q.join()
+        if self._native is not None:
+            self._native.wait_all()
+        elif self._q is not None:
+            self._q.join()
         import jax
         try:
             jax.effects_barrier()
@@ -73,6 +133,21 @@ class Engine:
             jax.device_put(0).block_until_ready()
         except Exception:  # pragma: no cover
             pass
+
+    # ------------------------------------------------------------- profiler
+    def profile_start(self):
+        if self._native is not None:
+            self._native.profile_start()
+
+    def profile_stop(self):
+        if self._native is not None:
+            self._native.profile_stop()
+
+    def profile_dump(self, path, clear=True):
+        """Dump native per-op stats as Chrome trace JSON; 0 if no native."""
+        if self._native is not None:
+            return self._native.profile_dump(path, clear)
+        return 0
 
 
 def get():

@@ -331,6 +331,14 @@ class ImageRecordIter(DataIter):
         self.pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         self.seq = list(range(len(self.rec)))
         self.cur = 0
+        # NOTE on staging: each batch gets a FRESH host buffer. A pooled
+        # double-buffer ring (iter_prefetcher.h pattern) was tried and
+        # reverted: jax.device_put zero-copies 64-byte-aligned host arrays
+        # onto the CPU jax device, so a recycled buffer would alias any
+        # still-live batch NDArray (and downstream TPU transfers read the
+        # alias asynchronously). runtime.core.HostPool remains available
+        # (and assemble_batch takes ``out=``) for callers that own the
+        # buffer lifetime end-to-end.
         self.provide_data = [DataDesc(data_name,
                                       (batch_size,) + self.data_shape)]
         self.provide_label = [DataDesc(label_name, (batch_size, label_width)

@@ -132,6 +132,7 @@ class PipelineRunner:
         import jax
         import jax.numpy as jnp
         p_spec, x_spec = self._specs()
+        cache_key = ("train", id(loss_fn), id(optimizer_update))
 
         def make():
             def whole(params, x, labels, lr):
@@ -156,7 +157,7 @@ class PipelineRunner:
                       in_specs=(p_spec, x_spec, x_spec, P()),
                       out_specs=(p_spec, P()), check_vma=False)
 
-        return self._build("train", make)
+        return self._build(cache_key, make)
 
     # ------------------------------------------------------------------
     @staticmethod

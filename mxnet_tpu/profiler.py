@@ -37,7 +37,9 @@ def profiler_set_state(state="stop"):
         return
     _state = state
     trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+    from . import engine as _engine
     if state == "run":
+        _engine.get().profile_start()  # native per-op host stamps
         try:
             import jax
             jax.profiler.start_trace(trace_dir)
@@ -45,6 +47,7 @@ def profiler_set_state(state="stop"):
         except Exception:
             _jax_tracing = False
     else:
+        _engine.get().profile_stop()
         if _jax_tracing:
             import jax
             try:
@@ -80,8 +83,24 @@ class Scope(object):
 
 
 def dump_profile():
-    """Write accumulated events as Chrome tracing JSON (MXDumpProfile)."""
+    """Write accumulated events as Chrome tracing JSON (MXDumpProfile),
+    merging the native engine's per-op stamps (OprExecStat equivalents)."""
+    native_events = []
+    from . import engine as _engine
+    eng = _engine.get()
+    if eng.is_native:
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as tmp:
+            path = tmp.name
+        try:
+            if eng.profile_dump(path) > 0:
+                with open(path) as f:
+                    native_events = json.load(f).get("traceEvents", [])
+        finally:
+            os.unlink(path)
     with _lock:
-        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        data = {"traceEvents": list(_events) + native_events,
+                "displayTimeUnit": "ms"}
         with open(_config["filename"], "w") as f:
             json.dump(data, f)

@@ -137,17 +137,23 @@ class RecordFile(object):
 
 
 def assemble_batch(images, mean=None, std=None, mirror=None, crop_yx=None,
-                   out_hw=None):
+                   out_hw=None, out=None):
     """uint8 (n,h,w,c) HWC images -> float32 (n,c,oh,ow) NCHW batch.
 
-    Native OpenMP path when available; numpy fallback otherwise.
+    Native OpenMP path when available; numpy fallback otherwise. ``out``
+    lets the caller supply a staging buffer (e.g. a pooled HostPool array,
+    the iter_prefetcher.h double-buffer pattern) instead of allocating.
     """
     images = onp.ascontiguousarray(images, dtype=onp.uint8)
     n, h, w, c = images.shape
     oh, ow = out_hw if out_hw is not None else (h, w)
+    if out is not None:
+        assert out.shape == (n, c, oh, ow) and out.dtype == onp.float32 \
+            and out.flags.c_contiguous, "bad staging buffer"
     lib = get_lib()
     if lib is not None:
-        out = onp.empty((n, c, oh, ow), dtype=onp.float32)
+        if out is None:
+            out = onp.empty((n, c, oh, ow), dtype=onp.float32)
         meanp = stdp = None
         if mean is not None:
             mean = onp.ascontiguousarray(mean, dtype=onp.float32)
@@ -171,7 +177,8 @@ def assemble_batch(images, mean=None, std=None, mirror=None, crop_yx=None,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         return out
     # numpy fallback
-    out = onp.empty((n, c, oh, ow), dtype=onp.float32)
+    if out is None:
+        out = onp.empty((n, c, oh, ow), dtype=onp.float32)
     for i in range(n):
         img = images[i]
         cy = int(crop_yx[0][i]) if crop_yx is not None else 0

@@ -1,0 +1,235 @@
+"""Native dependency engine + pooled storage tests — the python analog of
+the reference's tests/cpp/{threaded_engine_test.cc,storage_test.cc}:
+dependency-ordering invariants and pool recycling invariants."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import engine as eng_mod
+from mxnet_tpu.runtime.core import NativeEngine, HostPool, get_lib
+
+
+def _native():
+    e = NativeEngine(4)
+    if not e.available:
+        pytest.skip("no native engine (g++ unavailable)")
+    return e
+
+
+def test_write_ops_serialize_in_order():
+    e = _native()
+    v = e.new_var()
+    log = []
+    for i in range(100):
+        e.push(lambda i=i: log.append(i), mutate_vars=[v])
+    e.wait_all()
+    assert log == list(range(100))
+
+
+def test_reads_run_concurrently_writes_exclusive():
+    e = _native()
+    v = e.new_var()
+    lock = threading.Lock()
+    state = {"active": 0, "max_active": 0, "at_write": -1}
+
+    def reader():
+        with lock:
+            state["active"] += 1
+            state["max_active"] = max(state["max_active"], state["active"])
+        time.sleep(0.01)
+        with lock:
+            state["active"] -= 1
+
+    for _ in range(8):
+        e.push(reader, const_vars=[v])
+    e.push(lambda: state.__setitem__("at_write", state["active"]),
+           mutate_vars=[v])
+    e.wait_all()
+    assert state["max_active"] > 1, "readers should overlap"
+    assert state["at_write"] == 0, "write must wait for all readers"
+
+
+def test_independent_vars_overlap():
+    """Ops on disjoint vars run concurrently (the engine's whole point)."""
+    e = _native()
+    ev = threading.Event()
+    v1, v2 = e.new_var(), e.new_var()
+    e.push(lambda: ev.wait(5), mutate_vars=[v1])
+    e.push(ev.set, mutate_vars=[v2])  # must not queue behind v1's op
+    t0 = time.time()
+    e.wait_all()
+    assert time.time() - t0 < 4, "deadlock: independent ops serialized"
+
+
+def test_diamond_dependency():
+    """write A -> two reads of A writing B,C -> read B+C: runs as a DAG."""
+    e = _native()
+    a, b, c = e.new_var(), e.new_var(), e.new_var()
+    log = []
+    e.push(lambda: log.append("a"), mutate_vars=[a])
+    e.push(lambda: log.append("b"), const_vars=[a], mutate_vars=[b])
+    e.push(lambda: log.append("c"), const_vars=[a], mutate_vars=[c])
+    e.push(lambda: log.append("d"), const_vars=[b, c])
+    e.wait_all()
+    assert log[0] == "a" and log[-1] == "d"
+    assert set(log[1:3]) == {"b", "c"}
+
+
+def test_wait_for_var_blocks_until_writes_done():
+    e = _native()
+    v = e.new_var()
+    out = []
+    e.push(lambda: (time.sleep(0.05), out.append(1)), mutate_vars=[v])
+    e.wait_for_var(v)
+    assert out == [1]
+
+
+def test_push_error_surfaces_on_waitall():
+    e = _native()
+    v = e.new_var()
+    e.push(lambda: 1 / 0, mutate_vars=[v])
+    with pytest.raises(ZeroDivisionError):
+        e.wait_all()
+
+
+def test_dedup_overlapping_var_lists():
+    """Same var as const+mutate must not deadlock (DeduplicateVarHandle)."""
+    e = _native()
+    v = e.new_var()
+    log = []
+    e.push(lambda: log.append(1), const_vars=[v], mutate_vars=[v])
+    e.wait_all()
+    assert log == [1]
+
+
+def test_profiler_records_dump():
+    e = _native()
+    v = e.new_var()
+    e.profile_start()
+    e.push(lambda: time.sleep(0.001), mutate_vars=[v], name="op_x")
+    e.wait_all()
+    e.profile_stop()
+    import json
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".json") as f:
+        n = e.profile_dump(f.name)
+        assert n >= 1
+        trace = json.load(open(f.name))
+    names = [ev["name"] for ev in trace["traceEvents"]]
+    assert "op_x" in names
+    ev = [t for t in trace["traceEvents"] if t["name"] == "op_x"][0]
+    assert ev["ph"] == "X" and ev["dur"] >= 1000  # slept 1ms
+
+
+def test_engine_facade_uses_native():
+    e = eng_mod.Engine()
+    if not e.is_native:
+        pytest.skip("no native engine")
+    v = e.new_var()
+    log = []
+    for i in range(10):
+        e.push(lambda i=i: log.append(i), mutate_vars=[v])
+    e.wait_for_all()
+    assert log == list(range(10))
+    e.del_var(v)
+
+
+# ------------------------------------------------------------------ storage
+def test_pool_alloc_free_recycles():
+    p = HostPool()
+    if not p.available:
+        pytest.skip("no native pool")
+    a = p.alloc_array((64, 64), np.float32)
+    a[:] = 7.0
+    addr = a.ctypes.data
+    assert addr % 64 == 0, "64B alignment for DMA staging"
+    p.release(a)
+    b = p.alloc_array((60, 64), np.float32)  # same pow2 bucket
+    assert b.ctypes.data == addr, "free-list must recycle the buffer"
+
+
+def test_pool_stats_and_release_all():
+    p = HostPool()
+    if not p.available:
+        pytest.skip("no native pool")
+    arrs = [p.alloc_array((1024,), np.float32) for _ in range(4)]
+    assert p.used_bytes() >= 4 * 4096
+    for a in arrs:
+        p.release(a)
+    assert p.used_bytes() == 0
+    assert p.pooled_bytes() >= 4 * 4096
+    p.release_all()
+    assert p.pooled_bytes() == 0
+
+
+def test_pool_distinct_buffers_while_held():
+    p = HostPool()
+    if not p.available:
+        pytest.skip("no native pool")
+    a = p.alloc_array((256,), np.uint8)
+    b = p.alloc_array((256,), np.uint8)
+    assert a.ctypes.data != b.ctypes.data
+    a[:] = 1
+    b[:] = 2
+    assert int(a.sum()) == 256 and int(b.sum()) == 512
+
+
+def test_profiler_facade_merges_native(tmp_path):
+    from mxnet_tpu import profiler as prof
+    e = eng_mod.get()
+    if not e.is_native:
+        pytest.skip("no native engine")
+    out = tmp_path / "prof.json"
+    prof.profiler_set_config(mode="all", filename=str(out))
+    prof.profiler_set_state("run")
+    v = e.new_var()
+    e.push(lambda: time.sleep(0.001), mutate_vars=[v], name="host_stage")
+    e.wait_for_all()
+    prof.profiler_set_state("stop")
+    prof.dump_profile()
+    import json
+    trace = json.load(open(out))
+    assert any(ev["name"] == "host_stage" for ev in trace["traceEvents"])
+
+
+def test_engine_close_releases():
+    e = _native()
+    v = e.new_var()
+    e.push(lambda: None, mutate_vars=[v])
+    e.wait_all()
+    e.close()
+    e.close()  # idempotent
+    assert not e.available
+
+
+def test_profiler_escapes_op_names():
+    import json
+    import tempfile
+    e = _native()
+    v = e.new_var()
+    e.profile_start()
+    e.push(lambda: None, mutate_vars=[v], name='stage "decode"\\x')
+    e.wait_all()
+    e.profile_stop()
+    with tempfile.NamedTemporaryFile(suffix=".json") as f:
+        assert e.profile_dump(f.name) >= 1
+        trace = json.load(open(f.name))  # must parse despite quotes
+    assert any("decode" in ev["name"] for ev in trace["traceEvents"])
+
+
+def test_fallback_wait_for_var_drains():
+    """Python-fallback engine must not no-op wait_for_var (hazard API)."""
+    import mxnet_tpu.engine as em
+    e = em.Engine.__new__(em.Engine)
+    e._native = None
+    import queue as q
+    import threading
+    e._q = q.Queue()
+    t = threading.Thread(target=e._worker, daemon=True)
+    t.start()
+    out = []
+    e.push(lambda: (time.sleep(0.05), out.append(1)))
+    e.wait_for_var(None)
+    assert out == [1]
