@@ -88,24 +88,27 @@ class Engine:
             self._native.del_var(var)
 
     def push(self, fn, const_vars=(), mutate_vars=(), priority=0, name="op"):
-        """Engine::PushAsync — run fn() once all hazards clear."""
-        if self._native is not None:
-            self._native.push(fn, const_vars, mutate_vars, priority, name)
-            return
+        """Engine::PushAsync — run fn() once all hazards clear.
+
+        Returns a threading.Event set after fn completes (both paths)."""
         done = threading.Event()
-        if _NAIVE or not self._q:
-            fn()
-            done.set()
+
+        def run():
+            try:
+                fn()
+            finally:
+                done.set()
+
+        if self._native is not None:
+            self._native.push(run, const_vars, mutate_vars, priority, name)
+        elif _NAIVE or not self._q:
+            run()
         else:
-            self._q.put((fn, done))
+            self._q.put((run, done))
         return done
 
     def push_async(self, fn):
-        """Dependency-free host op; returns a waitable Event (fallback) or
-        None (native — use wait_for_all)."""
-        if self._native is not None:
-            self._native.push(fn)
-            return None
+        """Dependency-free host op; returns a waitable Event."""
         return self.push(fn)
 
     def wait_for_var(self, var):

@@ -581,6 +581,105 @@ def imdecode(str_img, **kwargs):
     return _imdecode(str_img, **kwargs)
 
 
+
+def _copyto(src, out):
+    """Legacy NDArray function (src/ndarray/ndarray.cc MXNET_REGISTER_NDARRAY_FUN
+    _copyto): copy ``src`` into ``out``, possibly across devices."""
+    return src.copyto(out)
+
+
+def _set_value(src_scalar, out):
+    """Fill ``out`` with a scalar (ndarray.cc _set_value)."""
+    jnp = _jnp()
+    out._write(jnp.full(out.shape, float(src_scalar), out.dtype))
+    return out
+
+
+def _onehot_encode(indices, out):
+    return onehot_encode(indices, out)
+
+
+def choose_element_0index(lhs, rhs, out=None):
+    """out[i] = lhs[i, rhs[i]] (ndarray.cc:765)."""
+    from .registry import get_op
+    return invoke(get_op("choose_element_0index"), [lhs, rhs], {}, out=out)
+
+
+def fill_element_0index(lhs, mhs, rhs, out=None):
+    """lhs with lhs[i, rhs[i]] = mhs[i] (ndarray.cc:771)."""
+    from .registry import get_op
+    return invoke(get_op("fill_element_0index"), [lhs, mhs, rhs], {}, out=out)
+
+
+def _broadcast(src, axis, size, out=None):
+    """Broadcast ``src`` along ``axis`` to ``size`` (ndarray.cc:860)."""
+    jnp = _jnp()
+    x = src._read()
+    res = jnp.broadcast_to(
+        x, x.shape[:int(axis)] + (int(size),) + x.shape[int(axis) + 1:])
+    if out is not None:
+        out._write(res)
+        return out
+    return NDArray(res, ctx=src.context)
+
+
+def _imdecode(mean, index, x0, y0, x1, y1, n_channels, size, str_img, out=None):
+    """Legacy positional imdecode (ndarray.cc _imdecode)."""
+    from .io_util import imdecode as _dec
+    return _dec(str_img, clip_rect=(x0, y0, x1, y1), out=out, index=index,
+                channels=n_channels, mean=mean)
+
+
+# ---------------------------------------------------------------------------
+# OpenCV-backed host image ops (plugin/opencv/cv_api.cc _cvimdecode/
+# _cvimresize/_cvcopyMakeBorder). Host-side work, imperative only.
+# ---------------------------------------------------------------------------
+def _cvimdecode(buf, flag=1, to_rgb=True):
+    """Decode a JPEG/PNG byte buffer into an HWC uint8 NDArray.
+    ``flag`` follows cv::imread: 0 = grayscale (h,w), nonzero = color."""
+    from .image import imdecode as _dec
+    import numpy as _np
+    img = _dec(buf if isinstance(buf, (bytes, bytearray)) else
+               buf.asnumpy().astype("uint8").tobytes(), to_rgb=to_rgb)
+    if flag == 0 and img.ndim == 3:
+        # ITU-R BT.601 luma — what cv::IMREAD_GRAYSCALE computes
+        w = _np.array([0.299, 0.587, 0.114] if to_rgb
+                      else [0.114, 0.587, 0.299], _np.float32)
+        img = (img.astype(_np.float32) @ w).round().astype(img.dtype)
+    return array(img, dtype=img.dtype)
+
+
+def _cvimresize(src, w, h, interp=1):
+    """Resize an HWC image NDArray (plugin/opencv cv_api.cc). ``interp``
+    follows cv2 enums (0=nearest, 1=linear, ...) when cv2 is present; the
+    PIL fallback maps 0 to nearest and anything else to bilinear."""
+    import numpy as _np
+    img = src.asnumpy()
+    try:
+        import cv2
+        out = cv2.resize(img, (int(w), int(h)), interpolation=int(interp))
+    except ImportError:
+        from PIL import Image
+        mode = Image.NEAREST if int(interp) == 0 else Image.BILINEAR
+        out = _np.asarray(Image.fromarray(img.astype(_np.uint8)).resize(
+            (int(w), int(h)), mode)).astype(img.dtype)
+    return array(out, dtype=out.dtype)
+
+
+def _cvcopyMakeBorder(src, top, bot, left, right, type=0, value=0.0):  # noqa: A002
+    """Pad an HWC image (plugin/opencv cv_api.cc). ``type`` follows cv2
+    border enums: 0 = constant fill; others fall back to edge replicate."""
+    import numpy as _np
+    img = src.asnumpy()
+    if int(type) == 0:
+        out = _np.full((img.shape[0] + top + bot, img.shape[1] + left + right)
+                       + img.shape[2:], value, dtype=img.dtype)
+        out[top:top + img.shape[0], left:left + img.shape[1]] = img
+    else:
+        pad = [(top, bot), (left, right)] + [(0, 0)] * (img.ndim - 2)
+        out = _np.pad(img, pad, mode="edge")
+    return array(out, dtype=out.dtype)
+
 # ---------------------------------------------------------------------------
 # serialization — NDArray::Save/Load (ndarray.h:360-371); we use the npz
 # container (documented own format, not binary-compatible with the reference)

@@ -153,3 +153,39 @@ def _argmax_channel(attrs, ins, octx):
     jnp = _jnp()
     x = ins[0]
     return [jnp.argmax(x, axis=-1).astype(x.dtype)]
+
+
+def _pick_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    axis = int(attrs.get("axis", -1))
+    keepdims = bool(attrs.get("keepdims", False))
+    if axis < 0:
+        axis += len(data)
+    idx_shape = tuple(d for i, d in enumerate(data) if i != axis)
+    if in_shapes[1] is None:
+        in_shapes[1] = idx_shape
+    out = tuple(1 if i == axis else d for i, d in enumerate(data)) \
+        if keepdims else idx_shape
+    return in_shapes, [out], aux
+
+
+@register("pick", arg_names=("data", "index"),
+          attr_types={"axis": int, "keepdims": bool},
+          infer_shape=_pick_infer)
+def _pick(attrs, ins, octx):
+    """Pick elements along ``axis`` by per-position indices, clip mode
+    (src/operator/tensor/broadcast_reduce_op_index.cc:92 ``pick``)."""
+    jnp = _jnp()
+    data, index = ins
+    axis = int(attrs.get("axis", -1))
+    keepdims = bool(attrs.get("keepdims", False))
+    if axis < 0:
+        axis += data.ndim
+    idx = jnp.clip(index.astype("int32"), 0, data.shape[axis] - 1)
+    idx = idx.reshape(data.shape[:axis] + (1,) + data.shape[axis + 1:])
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return [out]

@@ -102,3 +102,61 @@ def _multibox_prior(attrs, ins, octx):
     if attrs.get("clip", False):
         out = onp.clip(out, 0.0, 1.0)
     return [jnp.asarray(out[None])]
+
+
+def _quantize_infer(attrs, in_shapes, aux):
+    d = in_shapes[0]
+    if in_shapes[1] is None:
+        in_shapes[1] = (1,)
+    if in_shapes[2] is None:
+        in_shapes[2] = (1,)
+    if d is None:
+        return in_shapes, None, aux
+    return in_shapes, [tuple(d), (1,), (1,)], aux
+
+
+@register("_contrib_quantize", arg_names=("data", "min_range", "max_range"),
+          out_names=("output", "min_output", "max_output"),
+          attr_types={"out_type": str}, infer_shape=_quantize_infer,
+          alias=("quantize",))
+def _quantize(attrs, ins, octx):
+    """Affine quantization (src/operator/contrib/quantize-inl.h:29
+    ``quantize::Map``): out = (in - min) * (lim_max-lim_min)/(max-min) + .5,
+    carrying the range through. ``out_type`` picks the integer dtype
+    (reference enum admits uint8 only; int8 accepted as an extension)."""
+    jnp = _jnp()
+    data, mn, mx = ins
+    out_type = attrs.get("out_type", "uint8")
+    if out_type not in ("uint8", "int8"):
+        raise ValueError("unsupported quantize out_type %s" % out_type)
+    info = onp.iinfo(out_type)
+    scale = (float(info.max) - float(info.min)) / (mx - mn)
+    q = (data - mn.reshape((1,) * data.ndim)) * scale.reshape(
+        (1,) * data.ndim) + float(info.min) + 0.5
+    return [jnp.clip(q, info.min, info.max).astype(out_type), mn, mx]
+
+
+def _dequantize_infer(attrs, in_shapes, aux):
+    d = in_shapes[0]
+    if in_shapes[1] is None:
+        in_shapes[1] = (1,)
+    if in_shapes[2] is None:
+        in_shapes[2] = (1,)
+    if d is None:
+        return in_shapes, None, aux
+    return in_shapes, [tuple(d)], aux
+
+
+@register("_contrib_dequantize", arg_names=("data", "min_range", "max_range"),
+          attr_types={"out_type": str}, infer_shape=_dequantize_infer,
+          alias=("dequantize",))
+def _dequantize(attrs, ins, octx):
+    """Quantized int -> float32 (src/operator/contrib/dequantize-inl.h);
+    input dtype determines the integer limits."""
+    jnp = _jnp()
+    data, mn, mx = ins
+    info = onp.iinfo(onp.dtype(str(data.dtype)))
+    scale = (mx - mn) / (float(info.max) - float(info.min))
+    out = (data.astype(jnp.float32) - float(info.min)) \
+        * scale.reshape((1,) * data.ndim) + mn.reshape((1,) * data.ndim)
+    return [out]

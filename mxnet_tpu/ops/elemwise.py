@@ -191,3 +191,60 @@ def _add_n(attrs, ins, octx):
     for x in ins[1:]:
         out = out + x
     return [out]
+
+
+@register("_identity_with_attr_like_rhs", arg_names=("lhs", "rhs"))
+def _identity_like_rhs(attrs, ins, octx):
+    """Pass lhs through, shape/attrs taken from rhs — the grad-aggregation
+    helper (src/operator/tensor/elemwise_unary_op.cc)."""
+    return [ins[0]]
+
+
+@register("_NoGradient", arg_names=())
+def _no_gradient(attrs, ins, octx):
+    """Placeholder node meaning "no gradient flows here" (nnvm graph
+    construction). Materializes as a scalar zero; the executor's grad
+    aggregation treats it as absent."""
+    jnp = _jnp()
+    return [jnp.zeros((1,), jnp.float32)]
+
+
+@register("_CrossDeviceCopy")
+def _cross_device_copy(attrs, ins, octx):
+    """Device-boundary copy inserted by PlaceDevice in model-parallel graphs
+    (src/operator/cross_device_copy.cc). Under XLA/GSPMD, device placement is
+    expressed by shardings, so inside a jitted graph this is the identity;
+    the imperative NDArray.copyto path does the real device_put."""
+    return [ins[0]]
+
+
+@register("choose_element_0index", arg_names=("lhs", "rhs"))
+def _choose_element_0index(attrs, ins, octx):
+    """out[i] = lhs[i, rhs[i]] (src/ndarray/ndarray.cc:765
+    MatChooseRowElem)."""
+    jnp = _jnp()
+    lhs, rhs = ins
+    idx = jnp.clip(rhs.astype("int32"), 0, lhs.shape[1] - 1)
+    return [jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]]
+
+
+@register("fill_element_0index", arg_names=("lhs", "mhs", "rhs"))
+def _fill_element_0index(attrs, ins, octx):
+    """lhs with lhs[i, rhs[i]] = mhs[i] (src/ndarray/ndarray.cc:771
+    MatFillRowElem)."""
+    jnp = _jnp()
+    lhs, mhs, rhs = ins
+    idx = jnp.clip(rhs.astype("int32"), 0, lhs.shape[1] - 1)
+    rows = jnp.arange(lhs.shape[0])
+    return [lhs.at[rows, idx].set(mhs)]
+
+
+@register("_onehot_encode", arg_names=("indices", "out_like"))
+def _onehot_encode_op(attrs, ins, octx):
+    """One-hot rows sized like the second input (src/ndarray/ndarray.cc:765
+    OneHotEncode BinaryOp)."""
+    jnp = _jnp()
+    idx, out_like = ins
+    depth = out_like.shape[1]
+    return [(idx.astype("int32")[:, None] == jnp.arange(depth)[None, :])
+            .astype(out_like.dtype)]

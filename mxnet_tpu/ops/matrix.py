@@ -404,3 +404,50 @@ def _sequence_reverse(attrs, ins, octx):
     t = jnp.arange(T)[:, None]
     src = jnp.where(t < seq_len[None, :], seq_len[None, :] - 1 - t, t)
     return [x[src, jnp.arange(x.shape[1])[None, :]]]
+
+
+def _assign_region(x, attrs):
+    """Normalize SliceParam begin/end into per-dim slices."""
+    begin = attrs.get("begin", ())
+    end = attrs.get("end", ())
+    if isinstance(begin, int):
+        begin = (begin,)
+    if isinstance(end, int):
+        end = (end,)
+    idx = []
+    for i in range(x.ndim):
+        if i < len(begin):
+            b = begin[i] if begin[i] is not None else 0
+            e = end[i] if end[i] is not None else x.shape[i]
+            idx.append(slice(b, e))
+        else:
+            idx.append(slice(None))
+    return tuple(idx)
+
+
+def _slice_assign_infer(attrs, in_shapes, aux):
+    lhs = in_shapes[0]
+    if lhs is None:
+        return in_shapes, None, aux
+    return in_shapes, [tuple(lhs)], aux
+
+
+@register("_slice_assign", arg_names=("lhs", "rhs"),
+          attr_types={"begin": tuple, "end": tuple},
+          infer_shape=_slice_assign_infer, alias=("_crop_assign",))
+def _slice_assign_op(attrs, ins, octx):
+    """Functional out-of-place form of the reference's in-place
+    _slice_assign (src/operator/tensor/matrix_op.cc:258): output = lhs with
+    region [begin:end) replaced by rhs. The NDArray sliced-set path
+    (x[a:b] = y) routes here; XLA lowers it to dynamic-update-slice."""
+    lhs, rhs = ins
+    return [lhs.at[_assign_region(lhs, attrs)].set(rhs)]
+
+
+@register("_crop_assign_scalar",
+          attr_types={"begin": tuple, "end": tuple, "scalar": float},
+          infer_shape=_slice_assign_infer)
+def _crop_assign_scalar_op(attrs, ins, octx):
+    """Scalar variant (src/operator/tensor/matrix_op.cc:283)."""
+    x = ins[0]
+    return [x.at[_assign_region(x, attrs)].set(float(attrs.get("scalar", 0.0)))]

@@ -181,7 +181,7 @@ def _softmax_out_infer(attrs, in_shapes, aux):
                       "preserve_shape": bool, "normalization": str,
                       "out_grad": bool, "smooth_alpha": float},
           infer_shape=_softmax_out_infer,
-          backward_ignores_head_grads=True)
+          backward_ignores_head_grads=True, alias=("Softmax",))
 def _softmax_output(attrs, ins, octx):
     """Softmax forward; backward = (p - onehot(label)) * grad_scale
     (src/operator/softmax_output-inl.h). Gradient w.r.t. data only — the
@@ -496,3 +496,24 @@ def _identity_kl_sparse(attrs, ins, octx):
     # Forward identity; the sparse-reg penalty shapes gradients in the
     # reference — approximated as pure identity pending demand.
     return [ins[0]]
+
+
+def _sce_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is not None and in_shapes[1] is None:
+        in_shapes[1] = (data[0],)
+    return in_shapes, [(1,)], aux
+
+
+@register("softmax_cross_entropy", arg_names=("data", "label"),
+          infer_shape=_sce_infer)
+def _softmax_cross_entropy(attrs, ins, octx):
+    """Scalar -sum(log softmax(data)[i, label_i])
+    (src/operator/loss_binary_op.cc:11); gradient flows through jax.vjp."""
+    import jax
+    jnp = _jnp()
+    data, label = ins
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = jnp.clip(label.astype("int32"), 0, data.shape[-1] - 1)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return [-jnp.sum(picked).reshape((1,))]

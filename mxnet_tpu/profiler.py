@@ -82,10 +82,13 @@ class Scope(object):
         record_event(self.name, self.begin, time.time() * 1e6, self.pid)
 
 
+_native_events = []  # drained from the engine, kept so dumps stay cumulative
+
+
 def dump_profile():
     """Write accumulated events as Chrome tracing JSON (MXDumpProfile),
-    merging the native engine's per-op stamps (OprExecStat equivalents)."""
-    native_events = []
+    merging the native engine's per-op stamps (OprExecStat equivalents).
+    Callable repeatedly — both event sources accumulate across dumps."""
     from . import engine as _engine
     eng = _engine.get()
     if eng.is_native:
@@ -96,11 +99,13 @@ def dump_profile():
         try:
             if eng.profile_dump(path) > 0:
                 with open(path) as f:
-                    native_events = json.load(f).get("traceEvents", [])
+                    fresh = json.load(f).get("traceEvents", [])
+                with _lock:
+                    _native_events.extend(fresh)
         finally:
             os.unlink(path)
     with _lock:
-        data = {"traceEvents": list(_events) + native_events,
+        data = {"traceEvents": list(_events) + list(_native_events),
                 "displayTimeUnit": "ms"}
         with open(_config["filename"], "w") as f:
             json.dump(data, f)

@@ -21,26 +21,10 @@ import threading
 
 import numpy as onp
 
+from ._native_build import load_native
+
 _LIB = None
 _LOCK = threading.Lock()
-_DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "librecordio.so")
-_SRC = os.path.join(_DIR, "recordio.cpp")
-
-
-def _build():
-    cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
-           _SRC, "-o", _SO]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except Exception:
-        try:  # retry without -march=native (portability)
-            cmd.remove("-march=native")
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            return True
-        except Exception:
-            return False
 
 
 def get_lib():
@@ -49,15 +33,9 @@ def get_lib():
     with _LOCK:
         if _LIB is not None:
             return _LIB if _LIB is not False else None
-        if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            if not _build():
-                _LIB = False
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        lib = load_native("recordio.cpp", "librecordio.so",
+                          extra_flags=("-march=native", "-fopenmp"))
+        if lib is None:
             _LIB = False
             return None
         lib.ri_open.restype = ctypes.c_void_p

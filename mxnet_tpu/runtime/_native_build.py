@@ -1,0 +1,50 @@
+"""Shared build-and-load for the native runtime libs.
+
+Compiles C++ sources into ``runtime/_build/`` (gitignored — no binary
+artifacts in the tree, no in-place rewrites of package files) and loads them
+with ctypes. If compilation is impossible but an older build exists, the
+stale build is loaded rather than silently losing the native path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+
+
+def load_native(src_name, lib_name, extra_flags=()):
+    """Return a ctypes.CDLL for runtime/<src_name>, or None.
+
+    Builds to _build/<lib_name> when the source is newer than the cached
+    build (or none exists); on build failure falls back to the cached .so.
+    """
+    src = os.path.join(_DIR, src_name)
+    so = os.path.join(_BUILD_DIR, lib_name)
+    stale = (not os.path.exists(so)
+             or (os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(so)))
+    if stale and not _build(src, so, extra_flags) and not os.path.exists(so):
+        return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        return None
+
+
+def _build(src, so, extra_flags):
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = so + ".tmp"
+    cmd = ["g++", "-O3", "-std=c++14", "-shared", "-fPIC", "-pthread",
+           *extra_flags, src, "-o", tmp]
+    for attempt in (cmd, [f for f in cmd if f != "-march=native"]):
+        try:
+            subprocess.run(attempt, check=True, capture_output=True,
+                           timeout=180)
+            os.replace(tmp, so)  # atomic: never load a half-written .so
+            return True
+        except Exception:
+            continue
+    return False

@@ -21,9 +21,7 @@ import threading
 
 import numpy as onp
 
-_DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libengine_core.so")
-_SRC = os.path.join(_DIR, "engine_core.cpp")
+from ._native_build import load_native
 
 _LIB = None
 _LOCK = threading.Lock()
@@ -31,30 +29,13 @@ _LOCK = threading.Lock()
 _CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_int64)
 
 
-def _build():
-    cmd = ["g++", "-O3", "-std=c++14", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _SO]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-        return True
-    except Exception:
-        return False
-
-
 def get_lib():
     global _LIB
     with _LOCK:
         if _LIB is not None:
             return _LIB if _LIB is not False else None
-        if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            if not _build():
-                _LIB = False
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        lib = load_native("engine_core.cpp", "libengine_core.so")
+        if lib is None:
             _LIB = False
             return None
         lib.eng_create.restype = ctypes.c_void_p
@@ -205,6 +186,21 @@ class HostPool(object):
     def available(self):
         return self._h is not None
 
+    def close(self):
+        """Free the native pool and every buffer it caches (idempotent)."""
+        h, self._h = self._h, None
+        if h is not None and self._lib is not None:
+            try:
+                self._lib.sto_destroy(h)
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pragma: no cover
+            pass
+
     def alloc_array(self, shape, dtype=onp.float32):
         """numpy array over pooled 64B-aligned memory; release() recycles."""
         dtype = onp.dtype(dtype)
@@ -219,7 +215,10 @@ class HostPool(object):
 
     def release(self, arr):
         """Recycle the ORIGINAL array returned by alloc_array (its data
-        pointer is the pool key — don't pass slices/views)."""
+        pointer is the pool key — don't pass slices/views). The caller owns
+        the lifetime: jax.device_put zero-copies 64B-aligned host arrays on
+        the CPU backend (and TPU transfers are deferred), so only release
+        once no jax Array can still alias the buffer (block_until_ready)."""
         self._lib.sto_free(self._h,
                            ctypes.c_void_p(arr.ctypes.data))
 

@@ -55,6 +55,7 @@ struct Var {
   std::deque<VarEntry> q;
   int running_reads = 0;
   bool running_write = false;
+  bool to_delete = false;  // deferred deletion (Engine::DeleteVariable)
 };
 
 struct ProfRecord {
@@ -137,6 +138,16 @@ struct Engine {
     }
   }
 
+  // Erase a var whose deletion was requested once it fully drains
+  // (caller holds mu).
+  void MaybeErase(int64_t vid) {
+    auto it = vars.find(vid);
+    if (it != vars.end() && it->second.to_delete && it->second.q.empty() &&
+        it->second.running_reads == 0 && !it->second.running_write) {
+      vars.erase(it);
+    }
+  }
+
   void MakeReady(const std::vector<Op*>& runnable) {
     for (Op* op : runnable) ready.push(op);
     if (!runnable.empty()) ready_cv.notify_all();
@@ -177,11 +188,13 @@ struct Engine {
         Var& v = vars[vid];
         v.running_reads--;
         Schedule(vid, &runnable);
+        MaybeErase(vid);
       }
       for (int64_t vid : op->mutate_vars) {
         Var& v = vars[vid];
         v.running_write = false;
         Schedule(vid, &runnable);
+        MaybeErase(vid);
       }
       MakeReady(runnable);
       pending--;
@@ -339,9 +352,14 @@ void eng_del_var(void* h, int64_t vid) {
   Engine* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> lk(e->mu);
   auto it = e->vars.find(vid);
-  if (it != e->vars.end() && it->second.q.empty() &&
-      it->second.running_reads == 0 && !it->second.running_write) {
+  if (it == e->vars.end()) return;
+  if (it->second.q.empty() && it->second.running_reads == 0 &&
+      !it->second.running_write) {
     e->vars.erase(it);
+  } else {
+    // busy: defer — erased by MaybeErase when the last op drains
+    // (Engine::DeleteVariable contract, include/mxnet/engine.h)
+    it->second.to_delete = true;
   }
 }
 

@@ -437,3 +437,115 @@ def test_grad_req_add():
     e.forward(is_train=True)
     e.backward()
     assert_almost_equal(gbuf.asnumpy(), np.ones((3, 3)) + 2)
+
+
+# ---------------------------------------------------------------------------
+# ops added for registry parity: pick / softmax_cross_entropy / slice_assign /
+# quantize / legacy 0index + NDArray functions
+# ---------------------------------------------------------------------------
+def test_pick():
+    x = np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32)
+    # reference doc examples (broadcast_reduce_op_index.cc:112-124)
+    assert_almost_equal(
+        nd.pick(nd.array(x), nd.array(np.array([0., 1., 0.])), axis=1).asnumpy(),
+        np.array([1., 4., 5.]))
+    assert_almost_equal(
+        nd.pick(nd.array(x), nd.array(np.array([0., 1.])), axis=0).asnumpy(),
+        np.array([1., 4.]))
+    out = nd.pick(nd.array(x), nd.array(np.array([1., 0., 2.])), axis=1,
+                  keepdims=True)
+    assert out.shape == (3, 1)
+    # clip mode: out-of-range index clamps to last element
+    assert_almost_equal(out.asnumpy().ravel(), np.array([2., 3., 6.]))
+    # symbolic + gradient
+    d = sym.Variable("d")
+    i = sym.Variable("i")
+    s = sym.pick(d, i, axis=1)
+    ctx = mx.cpu()
+    gbuf = nd.zeros((3, 2), ctx=ctx)
+    e = s.bind(ctx, {"d": nd.array(x, ctx=ctx),
+                     "i": nd.array(np.array([0., 1., 0.]), ctx=ctx)},
+               args_grad={"d": gbuf})
+    e.forward(is_train=True)
+    e.backward(nd.ones((3,), ctx=ctx))
+    want = np.zeros((3, 2), np.float32)
+    want[[0, 1, 2], [0, 1, 0]] = 1.0
+    assert_almost_equal(gbuf.asnumpy(), want)
+
+
+def test_softmax_cross_entropy():
+    d = np.random.rand(4, 5).astype(np.float32)
+    l = np.array([0, 1, 2, 3], np.float32)
+    got = nd.softmax_cross_entropy(nd.array(d), nd.array(l)).asnumpy()
+    e = np.exp(d - d.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    want = -np.log(p[np.arange(4), l.astype(int)]).sum()
+    assert_almost_equal(got, np.array([want]), rtol=1e-5)
+
+
+def test_slice_assign():
+    from mxnet_tpu.registry import get_op
+    x = nd.zeros((4, 4))
+    y = nd.ones((2, 2))
+    out = nd.invoke(get_op("_slice_assign"), [x, y],
+                    {"begin": (1, 1), "end": (3, 3)})
+    want = np.zeros((4, 4), np.float32)
+    want[1:3, 1:3] = 1.0
+    assert_almost_equal(out.asnumpy(), want)
+    out2 = nd.invoke(get_op("_crop_assign_scalar"), [x],
+                     {"begin": (0, 0), "end": (2, 4), "scalar": 7.0})
+    want2 = np.zeros((4, 4), np.float32)
+    want2[0:2] = 7.0
+    assert_almost_equal(out2.asnumpy(), want2)
+
+
+def test_quantize_dequantize_roundtrip():
+    from mxnet_tpu.registry import get_op
+    d = nd.array(np.array([[0., 64.], [128., 255.]], np.float32))
+    mn = nd.array(np.array([0.], np.float32))
+    mx_ = nd.array(np.array([255.], np.float32))
+    q, qmn, qmx = nd.invoke(get_op("_contrib_quantize"), [d, mn, mx_], {})
+    assert q.asnumpy().dtype == np.uint8
+    back = nd.invoke(get_op("_contrib_dequantize"), [q, qmn, qmx], {})
+    assert_almost_equal(back.asnumpy(), d.asnumpy(), atol=1.0)
+
+
+def test_legacy_0index_functions():
+    x = nd.array(np.array([[1., 2.], [3., 4.], [5., 6.]]))
+    i = nd.array(np.array([1., 0., 1.]))
+    assert_almost_equal(nd.choose_element_0index(x, i).asnumpy(),
+                        np.array([2., 3., 6.]))
+    v = nd.array(np.array([9., 8., 7.]))
+    got = nd.fill_element_0index(x, v, i).asnumpy()
+    want = np.array([[1., 9.], [8., 4.], [5., 7.]], np.float32)
+    assert_almost_equal(got, want)
+
+
+def test_legacy_ndarray_functions():
+    out = nd.zeros((2, 3))
+    nd._set_value(2.5, out)
+    assert_almost_equal(out.asnumpy(), np.full((2, 3), 2.5, np.float32))
+    src = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    dst = nd.zeros((2, 3))
+    nd._copyto(src, dst)
+    assert_almost_equal(dst.asnumpy(), src.asnumpy())
+    b = nd._broadcast(nd.array(np.ones((2, 1, 3), np.float32)), 1, 4)
+    assert b.shape == (2, 4, 3)
+    oh = nd._onehot_encode(nd.array(np.array([0., 2.])), nd.zeros((2, 3)))
+    assert_almost_equal(oh.asnumpy(),
+                        np.array([[1., 0., 0.], [0., 0., 1.]], np.float32))
+
+
+def test_cv_image_functions():
+    img = np.random.randint(0, 255, (8, 10, 3), dtype=np.uint8)
+    r = nd._cvimresize(nd.array(img, dtype=np.uint8), 5, 4)
+    assert r.shape == (4, 5, 3)
+    b = nd._cvcopyMakeBorder(nd.array(img, dtype=np.uint8), 1, 1, 2, 2)
+    assert b.shape == (10, 14, 3)
+    assert_almost_equal(b.asnumpy()[1:9, 2:12], img)
+    import io as _io
+    from PIL import Image
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    d = nd._cvimdecode(buf.getvalue())
+    assert d.shape == (8, 10, 3)
