@@ -189,11 +189,16 @@ class FeedForward(object):
         eval_iter = self._as_iter(X, None, self.numpy_batch_size)
         data_names = [x[0] for x in eval_iter.provide_data]
         if self._module is None or not self._module.binded:
-            mod = self._make_module(data_names, [])
+            # loss label variables (…_label) are args of the symbol but not
+            # checkpoint params; declare them as labels so an unlabeled
+            # predict bind skips them (reference _init_predictor contract)
+            label_names = [n for n in self.symbol.list_arguments()
+                           if n.endswith("_label")]
+            mod = self._make_module(data_names, label_names)
             mod.bind(data_shapes=eval_iter.provide_data, label_shapes=None,
                      for_training=False)
             mod.init_params(arg_params=self.arg_params,
-                            aux_params=self.aux_params, allow_missing=False)
+                            aux_params=self.aux_params)
         out = self._module.predict(eval_iter, num_batch=num_batch,
                                    reset=reset)
         if isinstance(out, list):

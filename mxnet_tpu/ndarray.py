@@ -561,8 +561,17 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=_DEFAULT_DTYPE)
 def concatenate(arrays, axis=0, always_copy=True):
     if not arrays:
         raise ValueError("arrays must not be empty")
+    import jax
     jnp = _jnp()
-    res = jnp.concatenate([a._read() for a in arrays], axis=axis)
+    # inputs may live on different devices (multi-ctx executor outputs);
+    # stage onto the first array's device like the reference's CPU gather
+    parts = [a._read() for a in arrays]
+    dev = getattr(parts[0], "devices", lambda: None)()
+    if dev:
+        target = next(iter(dev))
+        parts = [p if getattr(p, "devices", lambda: {target})() == {target}
+                 else jax.device_put(p, target) for p in parts]
+    res = jnp.concatenate(parts, axis=axis)
     return NDArray(res, ctx=arrays[0].context)
 
 

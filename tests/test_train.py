@@ -1,0 +1,160 @@
+"""Training-integration tier (reference tests/python/train/): real small
+trainings with accuracy asserts. The reference trains on MNIST downloads;
+here the data is synthetic but genuinely learnable (clustered classes), so
+the asserts check actual optimization, not plumbing.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.random as mxrand
+
+
+def _clustered_data(rng, n, shape, num_classes=10, noise=0.3):
+    """Class-prototype + noise data every net here can separate. Features
+    are zero-centered — all-positive inputs make ReLU nets bimodally
+    trap-prone at momentum-SGD learning rates (seed-dependent dead layers),
+    which would turn these accuracy asserts flaky."""
+    protos = rng.rand(num_classes, *shape).astype(np.float32)
+    y = rng.randint(0, num_classes, n)
+    X = protos[y] + rng.rand(n, *shape).astype(np.float32) * noise
+    return X - X.mean(axis=0, keepdims=True), y.astype(np.float32)
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc3, name="sm")
+
+
+def test_mlp_feedforward():
+    """FeedForward.create end-to-end (reference test_mlp.py): multi-ctx
+    train, accuracy assert, checkpoint + reload predict consistency."""
+    mxrand.seed(11)
+    rng = np.random.RandomState(10)
+    X, y = _clustered_data(rng, 1200, (784,))
+    train = mx.io.NDArrayIter(X[:1000], y[:1000], batch_size=100,
+                              shuffle=True, label_name="sm_label")
+    val = mx.io.NDArrayIter(X[1000:], y[1000:], batch_size=100,
+                            label_name="sm_label")
+
+    def accuracy(label, pred):
+        return np.mean(np.argmax(pred, axis=1) == label)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "mlp")
+        model = mx.model.FeedForward.create(
+            _mlp_symbol(), X=train, eval_data=val,
+            eval_metric=mx.metric.np(accuracy),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix),
+            ctx=[mx.cpu(i) for i in range(2)],
+            num_epoch=8, learning_rate=0.1, wd=0.0004, momentum=0.9,
+            initializer=mx.init.Xavier())  # 80 updates total — the
+        # reference's Uniform(.01) default needs MNIST-scale step counts
+        prob = model.predict(val)
+        acc = accuracy(y[1000:], prob)
+        assert acc > 0.9, "FeedForward MLP accuracy %f" % acc
+
+        # checkpoint round trip: reloaded model predicts identically
+        reloaded = mx.model.FeedForward.load(prefix, 8)
+        val.reset()
+        prob2 = reloaded.predict(val)
+        np.testing.assert_allclose(prob, prob2, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_module_fit():
+    """LeNet-style conv net through Module.fit (reference test_conv.py)."""
+    mxrand.seed(12)
+    rng = np.random.RandomState(7)
+    X, y = _clustered_data(rng, 600, (1, 28, 28), noise=0.5)
+    train = mx.io.NDArrayIter(X[:500], y[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X[500:], y[500:], batch_size=50)
+
+    data = mx.sym.Variable("data")
+    conv1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8)
+    act1 = mx.sym.Activation(conv1, act_type="tanh")
+    pool1 = mx.sym.Pooling(act1, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+    conv2 = mx.sym.Convolution(pool1, kernel=(5, 5), num_filter=16)
+    act2 = mx.sym.Activation(conv2, act_type="tanh")
+    pool2 = mx.sym.Pooling(act2, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+    flat = mx.sym.Flatten(pool2)
+    fc = mx.sym.FullyConnected(flat, num_hidden=10)
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=6, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 0.00001})
+    val.reset()
+    acc = mod.score(val, mx.metric.Accuracy())[0][1]
+    assert acc > 0.9, "conv accuracy %f" % acc
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_dtype_training(dtype):
+    """Reduced-precision training (reference test_dtype.py trains with
+    float16 via Cast); bfloat16 is the TPU-native fast dtype."""
+    mxrand.seed(13)
+    rng = np.random.RandomState(3)
+    X, y = _clustered_data(rng, 600, (784,))
+    train = mx.io.NDArrayIter(X[:500], y[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X[500:], y[500:], batch_size=50)
+
+    data = mx.sym.Variable("data")
+    data = mx.sym.Cast(data, dtype=dtype)
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64)
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=10)
+    fc2 = mx.sym.Cast(fc2, dtype="float32")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=4, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    val.reset()
+    acc = mod.score(val, mx.metric.Accuracy())[0][1]
+    assert acc > 0.85, "%s accuracy %f" % (dtype, acc)
+
+
+def test_module_checkpoint_resume():
+    """save_checkpoint / load + fit(begin_epoch) resume path
+    (Module.save_checkpoint, module.py; reference fit resume contract)."""
+    mxrand.seed(14)
+    rng = np.random.RandomState(5)
+    X, y = _clustered_data(rng, 400, (64,))
+    train = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=32)
+    act = mx.sym.Activation(fc, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10)
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "model")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.fit(train, num_epoch=2, optimizer="sgd",
+                initializer=mx.init.Xavier(),
+                optimizer_params={"learning_rate": 0.1})
+        mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+
+        sym2, args2, auxs2 = mx.model.load_checkpoint(prefix, 2)
+        mod2 = mx.mod.Module(sym2, context=mx.cpu())
+        train.reset()
+        mod2.fit(train, num_epoch=4, begin_epoch=2,
+                 arg_params=args2, aux_params=auxs2,
+                 optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+        train.reset()
+        acc = mod2.score(train, mx.metric.Accuracy())[0][1]
+        assert acc > 0.9, "resumed accuracy %f" % acc
