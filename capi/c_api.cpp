@@ -1,0 +1,731 @@
+// C ABI boundary for mxnet_tpu (include/mxnet_tpu/c_api.h).
+//
+// Reference counterpart: src/c_api/c_api.cc — there, flat C functions over a
+// C++ core. Here the compute core is the JAX/XLA runtime driven by the
+// mxnet_tpu Python package, so this library EMBEDS a CPython interpreter and
+// fronts it with the same flat-C handle contract. Responsibilities that live
+// on this side of the boundary: interpreter lifecycle, GIL management,
+// opaque handle ownership (every handle is a strong PyObject ref), raw
+// buffer copies across the ABI, per-thread error strings, and C-lifetime
+// string/array marshalling (the MXAPIThreadLocalEntry pattern,
+// src/c_api/c_api_common.h).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_api.h"
+
+namespace {
+
+// ---------------------------------------------------------------- runtime
+std::once_flag g_init_flag;
+PyObject* g_bridge = nullptr;  // mxnet_tpu.capi_bridge module
+
+void InitRuntime() {
+  bool owns_interp = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    owns_interp = true;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  // make the package importable: MXNET_TPU_HOME, or the CWD fallback
+  PyRun_SimpleString(
+      "import sys, os\n"
+      "home = os.environ.get('MXNET_TPU_HOME')\n"
+      "for p in ([home] if home else []) + [os.getcwd()]:\n"
+      "    if p and os.path.isdir(os.path.join(p, 'mxnet_tpu')) "
+      "and p not in sys.path:\n"
+      "        sys.path.insert(0, p)\n");
+  g_bridge = PyImport_ImportModule("mxnet_tpu.capi_bridge");
+  if (g_bridge == nullptr) {
+    PyErr_Print();
+  }
+  PyGILState_Release(g);
+  if (owns_interp) {
+    // drop the GIL the init thread holds so any thread can Ensure() later
+    PyEval_SaveThread();
+  }
+}
+
+thread_local std::string g_last_error;
+
+// per-thread marshalling buffers whose lifetime spans until the next call
+// on the same thread (the reference's MXAPIThreadLocalEntry contract)
+struct ThreadLocalStore {
+  std::vector<std::string> strings;
+  std::vector<const char*> cptrs;
+  std::vector<mx_uint> shape;
+  std::vector<NDArrayHandle> handles;
+  std::string json;
+};
+thread_local ThreadLocalStore g_tls;
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+int HandleException() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_last_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+// Call bridge.<fn>(args...); returns new ref or nullptr (python error set).
+PyObject* Call(const char* fn, PyObject* args) {
+  if (g_bridge == nullptr) {
+    Py_XDECREF(args);
+    PyErr_SetString(PyExc_RuntimeError, "mxnet_tpu bridge failed to import");
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(g_bridge, fn);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+PyObject* StrList(const char** arr, mx_uint n) {
+  PyObject* l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SetItem(l, i, PyUnicode_FromString(arr[i] ? arr[i] : ""));
+  }
+  return l;
+}
+
+PyObject* HandleList(NDArrayHandle* arr, mx_uint n, bool none_ok = false) {
+  PyObject* l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(arr ? arr[i] : nullptr);
+    if (o == nullptr) {
+      if (!none_ok) {
+        Py_DECREF(l);
+        return nullptr;
+      }
+      o = Py_None;
+    }
+    Py_INCREF(o);
+    PyList_SetItem(l, i, o);
+  }
+  return l;
+}
+
+// copy a python list of str into TLS and expose as const char**
+int ReturnStrList(PyObject* list, mx_uint* out_size,
+                  const char*** out_array) {
+  Py_ssize_t n = PyList_Size(list);
+  g_tls.strings.clear();
+  g_tls.cptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_tls.strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(list, i)));
+  }
+  for (auto& s : g_tls.strings) g_tls.cptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = g_tls.cptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+#define API_BEGIN() \
+  std::call_once(g_init_flag, InitRuntime); \
+  Gil gil_; \
+  try {
+#define API_END()                                      \
+  }                                                    \
+  catch (...) { g_last_error = "c++ exception"; return -1; } \
+  if (PyErr_Occurred()) return HandleException();      \
+  return 0;
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// ------------------------------------------------------------------ global
+int MXRandomSeed(int seed) {
+  API_BEGIN();
+  PyObject* r = Call("random_seed", Py_BuildValue("(i)", seed));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXNotifyShutdown() {
+  API_BEGIN();
+  PyObject* r = Call("wait_all", PyTuple_New(0));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXSetProfilerConfig(int mode, const char* filename) {
+  API_BEGIN();
+  PyObject* r = Call("profiler_config", Py_BuildValue("(is)", mode, filename));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXSetProfilerState(int state) {
+  API_BEGIN();
+  PyObject* r = Call("profiler_state", Py_BuildValue("(i)", state));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXDumpProfile() {
+  API_BEGIN();
+  PyObject* r = Call("profiler_dump", PyTuple_New(0));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
+  API_BEGIN();
+  PyObject* r = Call("all_op_names", PyTuple_New(0));
+  if (r) {
+    ReturnStrList(r, out_size, out_array);
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+// ----------------------------------------------------------------- ndarray
+int MXNDArrayCreateNone(NDArrayHandle* out) {
+  API_BEGIN();
+  Py_INCREF(Py_None);
+  *out = Py_None;
+  API_END();
+}
+
+int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out) {
+  (void)delay_alloc;  // XLA owns allocation timing
+  API_BEGIN();
+  PyObject* shp = PyList_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyList_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject* r = Call("ndarray_create",
+                     Py_BuildValue("(Niii)", shp, dev_type, dev_id, dtype));
+  if (r) *out = r;  // strong ref IS the handle
+  API_END();
+}
+
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  API_END();
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata) {
+  API_BEGIN();
+  PyObject* r = Call("ndarray_shape",
+                     Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+  if (r) {
+    Py_ssize_t n = PyList_Size(r);
+    g_tls.shape.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      g_tls.shape.push_back(
+          static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(r, i))));
+    }
+    *out_dim = static_cast<mx_uint>(n);
+    *out_pdata = g_tls.shape.data();
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  API_BEGIN();
+  PyObject* r = Call("ndarray_dtype_code",
+                     Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+  if (r) {
+    *out_dtype = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id) {
+  API_BEGIN();
+  PyObject* r = Call("ndarray_context",
+                     Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+  if (r) {
+    *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+    *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size) {
+  API_BEGIN();
+  // size is in ELEMENTS (reference contract); wrap raw memory r/o
+  PyObject* dt = Call("ndarray_dtype_code",
+                      Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+  if (dt != nullptr) {
+    static const int kItem[] = {4, 8, 2, 1, 4, 1, 8};
+    int code = static_cast<int>(PyLong_AsLong(dt));
+    Py_DECREF(dt);
+    Py_ssize_t nbytes = static_cast<Py_ssize_t>(size) * kItem[code];
+    PyObject* mv = PyMemoryView_FromMemory(
+        const_cast<char*>(static_cast<const char*>(data)), nbytes,
+        PyBUF_READ);
+    PyObject* r = Call("ndarray_copy_from",
+                       Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                     mv));
+    Py_XDECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
+  API_BEGIN();
+  PyObject* r = Call("ndarray_copy_to",
+                     Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+  if (r) {
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    PyBytes_AsStringAndSize(r, &buf, &len);
+    PyObject* dt = Call("ndarray_dtype_code",
+                        Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+    static const int kItem[] = {4, 8, 2, 1, 4, 1, 8};
+    int code = dt ? static_cast<int>(PyLong_AsLong(dt)) : 0;
+    Py_XDECREF(dt);
+    Py_ssize_t want = static_cast<Py_ssize_t>(size) * kItem[code];
+    std::memcpy(data, buf, want < len ? want : len);
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                    "wait_to_read", nullptr);
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                    "wait_to_read", nullptr);
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitAll() {
+  API_BEGIN();
+  PyObject* r = Call("wait_all", PyTuple_New(0));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle), "slice",
+                                    "II", begin, end);
+  if (r) *out = r;
+  API_END();
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle), "at",
+                                    "I", idx);
+  if (r) *out = r;
+  API_END();
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                    "reshape", "N", shp);
+  if (r) *out = r;
+  API_END();
+}
+
+int MXNDArraySave(const char* fname, mx_uint num_args, NDArrayHandle* args,
+                  const char** keys) {
+  API_BEGIN();
+  PyObject* arrs = HandleList(args, num_args);
+  PyObject* ks = keys ? StrList(keys, num_args) : (Py_INCREF(Py_None),
+                                                   Py_None);
+  PyObject* r = Call("ndarray_save", Py_BuildValue("(sNN)", fname, arrs, ks));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names) {
+  API_BEGIN();
+  PyObject* r = Call("ndarray_load", Py_BuildValue("(s)", fname));
+  if (r) {
+    PyObject* arrs = PyTuple_GetItem(r, 0);
+    PyObject* names = PyTuple_GetItem(r, 1);
+    Py_ssize_t n = PyList_Size(arrs);
+    g_tls.handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* a = PyList_GetItem(arrs, i);
+      Py_INCREF(a);  // caller frees via MXNDArrayFree
+      g_tls.handles.push_back(a);
+    }
+    *out_size = static_cast<mx_uint>(n);
+    *out_arr = g_tls.handles.data();
+    ReturnStrList(names, out_name_size, out_names);
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+// ------------------------------------------------------- operator invoke
+int MXGetFunction(const char* name, FunctionHandle* out) {
+  API_BEGIN();
+  *out = ::strdup(name);  // interned op-name handle (leaked by design)
+  API_END();
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  API_BEGIN();
+  PyObject* ins = HandleList(inputs, num_inputs);
+  PyObject* ks = StrList(param_keys, num_params);
+  PyObject* vs = StrList(param_vals, num_params);
+  // reference contract: caller may pre-provide output arrays (in-place ops,
+  // e.g. fused optimizer updates writing back into the bound weight)
+  PyObject* outs_in = (*outputs != nullptr && *num_outputs > 0)
+      ? HandleList(*outputs, *num_outputs)
+      : (Py_INCREF(Py_None), Py_None);
+  PyObject* r = Call("imperative_invoke",
+                     Py_BuildValue("(sNNNN)",
+                                   static_cast<const char*>(creator), ins,
+                                   ks, vs, outs_in));
+  if (r) {
+    Py_ssize_t n = PyList_Size(r);
+    g_tls.handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* a = PyList_GetItem(r, i);
+      Py_INCREF(a);
+      g_tls.handles.push_back(a);
+    }
+    *num_outputs = static_cast<int>(n);
+    *outputs = g_tls.handles.data();
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+// ------------------------------------------------------------------ symbol
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* sym_mod = PyImport_ImportModule("mxnet_tpu.symbol");
+  PyObject* r = sym_mod ? PyObject_CallMethod(sym_mod, "load_json", "s", json)
+                        : nullptr;
+  Py_XDECREF(sym_mod);
+  if (r) *out = r;
+  API_END();
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* sym_mod = PyImport_ImportModule("mxnet_tpu.symbol");
+  PyObject* r = sym_mod ? PyObject_CallMethod(sym_mod, "load", "s", fname)
+                        : nullptr;
+  Py_XDECREF(sym_mod);
+  if (r) *out = r;
+  API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char** out_json) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(symbol), "tojson",
+                                    nullptr);
+  if (r) {
+    g_tls.json = PyUnicode_AsUTF8(r);
+    *out_json = g_tls.json.c_str();
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* sym_mod = PyImport_ImportModule("mxnet_tpu.symbol");
+  PyObject* r = sym_mod ? PyObject_CallMethod(sym_mod, "Variable", "s", name)
+                        : nullptr;
+  Py_XDECREF(sym_mod);
+  if (r) *out = r;
+  API_END();
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char** keys, const char** vals,
+                               SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* r = Call("symbol_create_atomic",
+                     Py_BuildValue("(sNN)",
+                                   static_cast<const char*>(creator),
+                                   StrList(keys, num_param),
+                                   StrList(vals, num_param)));
+  if (r) *out = r;
+  API_END();
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** keys, SymbolHandle* args) {
+  API_BEGIN();
+  PyObject* ks = keys ? StrList(keys, num_args) : (Py_INCREF(Py_None),
+                                                   Py_None);
+  PyObject* r = Call("symbol_compose",
+                     Py_BuildValue("(OsNN)", static_cast<PyObject*>(sym),
+                                   name ? name : "", ks,
+                                   HandleList(args, num_args)));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* copy_mod = PyImport_ImportModule("copy");
+  PyObject* r = copy_mod
+      ? PyObject_CallMethod(copy_mod, "deepcopy", "O",
+                            static_cast<PyObject*>(symbol))
+      : nullptr;
+  Py_XDECREF(copy_mod);
+  if (r) *out = r;
+  API_END();
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  API_BEGIN();
+  Py_XDECREF(static_cast<PyObject*>(symbol));
+  API_END();
+}
+
+static int SymbolList(SymbolHandle symbol, const char* which,
+                      mx_uint* out_size, const char*** out_str_array) {
+  API_BEGIN();
+  PyObject* r = Call("symbol_list",
+                     Py_BuildValue("(Os)", static_cast<PyObject*>(symbol),
+                                   which));
+  if (r) {
+    ReturnStrList(r, out_size, out_str_array);
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint* out_size,
+                          const char*** out_str_array) {
+  return SymbolList(symbol, "arguments", out_size, out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint* out_size,
+                        const char*** out_str_array) {
+  return SymbolList(symbol, "outputs", out_size, out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint* out_size,
+                                const char*** out_str_array) {
+  return SymbolList(symbol, "aux", out_size, out_str_array);
+}
+
+// ---------------------------------------------------------------- executor
+int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id, mx_uint len,
+                   NDArrayHandle* in_args, NDArrayHandle* arg_grad_store,
+                   mx_uint* grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle* aux_states, ExecutorHandle* out) {
+  API_BEGIN();
+  PyObject* reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyList_SetItem(reqs, i,
+                   PyLong_FromLong(grad_req_type ? grad_req_type[i] : 1));
+  }
+  PyObject* r = Call("executor_bind",
+                     Py_BuildValue("(OiiNNNN)",
+                                   static_cast<PyObject*>(symbol), dev_type,
+                                   dev_id, HandleList(in_args, len),
+                                   HandleList(arg_grad_store, len, true),
+                                   reqs,
+                                   HandleList(aux_states, aux_states_len)));
+  if (r) *out = r;
+  API_END();
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  API_BEGIN();
+  PyObject* r = Call("executor_forward",
+                     Py_BuildValue("(Oi)", static_cast<PyObject*>(handle),
+                                   is_train));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle* head_grads) {
+  API_BEGIN();
+  PyObject* grads = len ? HandleList(head_grads, len)
+                        : (Py_INCREF(Py_None), Py_None);
+  PyObject* r = Call("executor_backward",
+                     Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                   grads));
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
+                      NDArrayHandle** out) {
+  API_BEGIN();
+  PyObject* r = Call("executor_outputs",
+                     Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+  if (r) {
+    Py_ssize_t n = PyList_Size(r);
+    g_tls.handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* a = PyList_GetItem(r, i);
+      Py_INCREF(a);
+      g_tls.handles.push_back(a);
+    }
+    *out_size = static_cast<mx_uint>(n);
+    *out = g_tls.handles.data();
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  API_END();
+}
+
+// ------------------------------------------------------------ predict API
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out) {
+  API_BEGIN();
+  PyObject* names = StrList(input_keys, num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    mx_uint b = input_shape_indptr[i], e = input_shape_indptr[i + 1];
+    PyObject* s = PyList_New(e - b);
+    for (mx_uint j = b; j < e; ++j) {
+      PyList_SetItem(s, j - b, PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, s);
+  }
+  PyObject* blob = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* r = Call("pred_create",
+                     Py_BuildValue("(sNiiNN)", symbol_json_str, blob,
+                                   dev_type, dev_id, names, shapes));
+  if (r) *out = r;
+  API_END();
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const mx_float* data, mx_uint size) {
+  API_BEGIN();
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<mx_float*>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float), PyBUF_READ);
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                    "set_input", "sN", key, mv);
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXPredForward(PredictorHandle handle) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                    "forward", nullptr);
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                    "output_shape", "I", index);
+  if (r) {
+    Py_ssize_t n = PyList_Size(r);
+    g_tls.shape.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      g_tls.shape.push_back(
+          static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(r, i))));
+    }
+    *shape_ndim = static_cast<mx_uint>(n);
+    *shape_data = g_tls.shape.data();
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float* data,
+                    mx_uint size) {
+  API_BEGIN();
+  PyObject* r = PyObject_CallMethod(static_cast<PyObject*>(handle), "output",
+                                    "I", index);
+  if (r) {
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    PyBytes_AsStringAndSize(r, &buf, &len);
+    Py_ssize_t want = static_cast<Py_ssize_t>(size) * sizeof(mx_float);
+    std::memcpy(data, buf, want < len ? want : len);
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXPredFree(PredictorHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  API_END();
+}
+
+}  // extern "C"

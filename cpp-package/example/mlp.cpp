@@ -1,0 +1,108 @@
+// cpp-package example (reference cpp-package/example/mlp.cpp): build an MLP
+// symbolically, train it with manual SGD through the C++ API only, assert
+// the loss drops. Prints CPP_MLP_PASS on success.
+#include <mxnet_tpu.hpp>
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+using mxnet_tpu::cpp::Context;
+using mxnet_tpu::cpp::Executor;
+using mxnet_tpu::cpp::NDArray;
+using mxnet_tpu::cpp::Op;
+using mxnet_tpu::cpp::Symbol;
+
+int main() {
+  const int kBatch = 32, kIn = 16, kHidden = 32, kOut = 2;
+  Context ctx = Context::cpu();
+
+  Symbol x = Symbol::Variable("x");
+  Symbol label = Symbol::Variable("label");
+  Symbol fc1 = Symbol::Create("FullyConnected", {{"data", &x}},
+                              {{"num_hidden", "32"}}, "fc1");
+  Symbol act = Symbol::Create("Activation", {{"data", &fc1}},
+                              {{"act_type", "relu"}}, "relu1");
+  Symbol fc2 = Symbol::Create("FullyConnected", {{"data", &act}},
+                              {{"num_hidden", "2"}}, "fc2");
+  Symbol net = Symbol::Create("SoftmaxOutput",
+                              {{"data", &fc2}, {"label", &label}}, {}, "sm");
+
+  // args in list_arguments order: x, fc1_w, fc1_b, fc2_w, fc2_b, label
+  std::vector<std::string> arg_names = net.ListArguments();
+  std::vector<std::vector<mx_uint>> shapes = {
+      {kBatch, kIn}, {kHidden, kIn}, {kHidden},
+      {kOut, kHidden}, {kOut}, {kBatch}};
+  if (arg_names.size() != shapes.size()) {
+    std::fprintf(stderr, "unexpected arg count %zu\n", arg_names.size());
+    return 1;
+  }
+
+  std::vector<NDArray> args, grads;
+  std::vector<NDArrayHandle> arg_h, grad_h;
+  std::vector<mx_uint> reqs;
+  unsigned seed = 17;
+  auto frand = [&seed]() {
+    seed = seed * 1103515245u + 12345u;
+    return ((seed >> 16) % 1000) / 1000.0f - 0.5f;
+  };
+  std::vector<float> xdata(kBatch * kIn), ldata(kBatch);
+  for (int i = 0; i < kBatch; ++i) {
+    float s = 0;
+    for (int j = 0; j < kIn; ++j) {
+      xdata[i * kIn + j] = frand();
+      s += xdata[i * kIn + j];
+    }
+    ldata[i] = s > 0 ? 1.0f : 0.0f;  // learnable rule
+  }
+  for (size_t i = 0; i < shapes.size(); ++i) {
+    args.emplace_back(shapes[i], ctx);
+    grads.emplace_back(shapes[i], ctx);
+    size_t n = args[i].Size();
+    std::vector<float> init(n);
+    if (arg_names[i] == "x") {
+      init = xdata;
+    } else if (arg_names[i] == "label") {
+      init = ldata;
+    } else {
+      for (auto& v : init) v = frand() * 0.3f;
+    }
+    args[i].CopyFrom(init);
+    arg_h.push_back(args[i].handle());
+    grad_h.push_back(grads[i].handle());
+    reqs.push_back(arg_names[i] == "x" || arg_names[i] == "label" ? 0 : 1);
+  }
+
+  Executor exec(net, ctx, arg_h, grad_h, reqs);
+  float first_loss = -1, last_loss = -1;
+  for (int step = 0; step < 40; ++step) {
+    exec.Forward(true);
+    exec.Backward();
+    // cross-entropy from the softmax output
+    std::vector<float> probs = exec.Outputs()[0].CopyTo();
+    float loss = 0;
+    for (int i = 0; i < kBatch; ++i) {
+      float p = probs[i * kOut + static_cast<int>(ldata[i])];
+      loss += -std::log(p > 1e-9f ? p : 1e-9f);
+    }
+    loss /= kBatch;
+    if (step == 0) first_loss = loss;
+    last_loss = loss;
+    // manual SGD via the fused op (in-place write-back, through the C ABI)
+    for (size_t i = 0; i < args.size(); ++i) {
+      if (reqs[i] == 0) continue;
+      Op sgd("sgd_update");
+      sgd.SetParam("lr", "0.5");
+      sgd.InvokeInto({args[i].handle(), grads[i].handle()},
+                     {args[i].handle()});
+    }
+  }
+  std::printf("first loss %.4f last loss %.4f\n", first_loss, last_loss);
+  if (!(last_loss < first_loss * 0.7f)) {
+    std::fprintf(stderr, "loss did not drop\n");
+    return 1;
+  }
+  std::printf("CPP_MLP_PASS\n");
+  return 0;
+}

@@ -1,0 +1,229 @@
+// Header-only C++ API over the C ABI (reference: cpp-package/include/
+// mxnet-cpp, op wrappers over c_api.h). RAII handle owners + fluent
+// symbol/executor surface; link against capi/build/libmxnet_tpu.so.
+#ifndef MXNET_TPU_CPP_PACKAGE_HPP_
+#define MXNET_TPU_CPP_PACKAGE_HPP_
+
+#include <mxnet_tpu/c_api.h>
+
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu {
+namespace cpp {
+
+inline void Check(int ret) {
+  if (ret != 0) {
+    throw std::runtime_error(MXGetLastError());
+  }
+}
+
+class Context {
+ public:
+  Context(int dev_type, int dev_id) : type_(dev_type), id_(dev_id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context tpu(int id = 0) { return Context(2, id); }
+  int type() const { return type_; }
+  int id() const { return id_; }
+
+ private:
+  int type_, id_;
+};
+
+class NDArray {
+ public:
+  NDArray() : h_(nullptr) {}
+  NDArray(const std::vector<mx_uint>& shape, const Context& ctx) {
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<mx_uint>(shape.size()), ctx.type(),
+                          ctx.id(), 0, &h_));
+  }
+  explicit NDArray(NDArrayHandle h) : h_(h) {}
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  NDArray(NDArray&& o) : h_(o.h_) { o.h_ = nullptr; }
+  NDArray& operator=(NDArray&& o) {
+    Release();
+    h_ = o.h_;
+    o.h_ = nullptr;
+    return *this;
+  }
+  ~NDArray() { Release(); }
+
+  void CopyFrom(const std::vector<float>& data) {
+    Check(MXNDArraySyncCopyFromCPU(h_, data.data(), data.size()));
+  }
+  std::vector<float> CopyTo() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(h_, out.data(), out.size()));
+    return out;
+  }
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim;
+    const mx_uint* data;
+    Check(MXNDArrayGetShape(h_, &ndim, &data));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint s : Shape()) n *= s;
+    return n;
+  }
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(h_)); }
+  NDArrayHandle handle() const { return h_; }
+
+ private:
+  void Release() {
+    if (h_) MXNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  NDArrayHandle h_;
+};
+
+// invoke a registered op imperatively: outs = Op("elemwise_add")(a, b)
+class Op {
+ public:
+  explicit Op(const std::string& name) {
+    Check(MXGetFunction(name.c_str(), &fn_));
+  }
+  Op& SetParam(const std::string& k, const std::string& v) {
+    keys_.push_back(k);
+    vals_.push_back(v);
+    return *this;
+  }
+  std::vector<NDArray> operator()(const std::vector<NDArrayHandle>& ins) {
+    int n_out = 0;
+    NDArrayHandle* outs = nullptr;
+    Invoke(ins, &n_out, &outs);
+    std::vector<NDArray> result;
+    for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  // in-place form: results are written into caller-provided arrays
+  void InvokeInto(const std::vector<NDArrayHandle>& ins,
+                  std::vector<NDArrayHandle> outs) {
+    int n_out = static_cast<int>(outs.size());
+    NDArrayHandle* po = outs.data();
+    Invoke(ins, &n_out, &po);
+  }
+
+ private:
+  void Invoke(const std::vector<NDArrayHandle>& ins, int* n_out,
+              NDArrayHandle** outs) {
+    std::vector<const char*> ks, vs;
+    for (auto& k : keys_) ks.push_back(k.c_str());
+    for (auto& v : vals_) vs.push_back(v.c_str());
+    Check(MXImperativeInvoke(const_cast<void*>(fn_),
+                             static_cast<int>(ins.size()),
+                             const_cast<NDArrayHandle*>(ins.data()), n_out,
+                             outs, static_cast<int>(ks.size()), ks.data(),
+                             vs.data()));
+  }
+
+ public:
+
+ private:
+  FunctionHandle fn_;
+  std::vector<std::string> keys_, vals_;
+};
+
+class Symbol {
+ public:
+  Symbol() : h_(nullptr) {}
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle h;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  // atomic op symbol composed with named inputs
+  static Symbol Create(const std::string& op,
+                       const std::map<std::string, Symbol*>& inputs,
+                       const std::map<std::string, std::string>& params,
+                       const std::string& name) {
+    AtomicSymbolCreator creator;
+    Check(MXGetFunction(op.c_str(),
+                        const_cast<FunctionHandle*>(
+                            reinterpret_cast<const FunctionHandle*>(
+                                &creator))));
+    std::vector<const char*> pk, pv;
+    for (auto& kv : params) {
+      pk.push_back(kv.first.c_str());
+      pv.push_back(kv.second.c_str());
+    }
+    SymbolHandle h;
+    Check(MXSymbolCreateAtomicSymbol(creator,
+                                     static_cast<mx_uint>(pk.size()),
+                                     pk.data(), pv.data(), &h));
+    std::vector<const char*> ik;
+    std::vector<SymbolHandle> is;
+    for (auto& kv : inputs) {
+      ik.push_back(kv.first.c_str());
+      is.push_back(kv.second->h_);
+    }
+    Check(MXSymbolCompose(h, name.c_str(),
+                          static_cast<mx_uint>(ik.size()), ik.data(),
+                          is.data()));
+    return Symbol(h);
+  }
+  std::vector<std::string> ListArguments() const {
+    mx_uint n;
+    const char** arr;
+    Check(MXSymbolListArguments(h_, &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  std::string ToJSON() const {
+    const char* json;
+    Check(MXSymbolSaveToJSON(h_, &json));
+    return json;
+  }
+  SymbolHandle handle() const { return h_; }
+  ~Symbol() = default;  // symbols share handles freely; freed by runtime
+
+ private:
+  SymbolHandle h_;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, const Context& ctx,
+           const std::vector<NDArrayHandle>& args,
+           const std::vector<NDArrayHandle>& grads,
+           const std::vector<mx_uint>& reqs) {
+    Check(MXExecutorBind(sym.handle(), ctx.type(), ctx.id(),
+                         static_cast<mx_uint>(args.size()),
+                         const_cast<NDArrayHandle*>(args.data()),
+                         const_cast<NDArrayHandle*>(grads.data()),
+                         const_cast<mx_uint*>(reqs.data()), 0, nullptr,
+                         &h_));
+  }
+  ~Executor() {
+    if (h_) MXExecutorFree(h_);
+  }
+  void Forward(bool is_train) { Check(MXExecutorForward(h_, is_train)); }
+  void Backward() { Check(MXExecutorBackward(h_, 0, nullptr)); }
+  std::vector<NDArray> Outputs() {
+    mx_uint n;
+    NDArrayHandle* outs;
+    Check(MXExecutorOutputs(h_, &n, &outs));
+    std::vector<NDArray> result;
+    for (mx_uint i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  ExecutorHandle h_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PACKAGE_HPP_

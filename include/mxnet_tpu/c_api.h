@@ -1,0 +1,138 @@
+/*!
+ * C API for the mxnet_tpu framework — the ABI boundary for non-Python
+ * frontends (reference: include/mxnet/c_api.h, 119 MX* functions).
+ *
+ * Architectural note (vs the reference): in the reference the C API sits
+ * ABOVE a C++ core and Python calls DOWN through it. Here the compute core
+ * is JAX/XLA driven from Python, so the C API inverts: libmxnet_tpu.so
+ * EMBEDS a CPython interpreter hosting the mxnet_tpu runtime and exposes
+ * the same flat-C contract to C/C++/other-language clients (cpp-package/
+ * uses it). Handles are opaque pointers owned by the library; every
+ * function returns 0 on success, -1 on error (message via MXGetLastError).
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+#include <stddef.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef const void *FunctionHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *PredictorHandle;
+
+/*! \brief last error message from the library (thread-local). */
+const char *MXGetLastError();
+
+/* ------------------------------------------------------------------ global */
+int MXRandomSeed(int seed);
+int MXNotifyShutdown();
+int MXSetProfilerConfig(int mode, const char *filename);
+int MXSetProfilerState(int state);
+int MXDumpProfile();
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+
+/* ----------------------------------------------------------------- ndarray */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out);
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
+/* ------------------------------------------------------- operator invoke */
+/*! \brief op handle by name (MXGetFunction + AtomicSymbolCreator merged:
+ *  both are interned op names here). */
+int MXGetFunction(const char *name, FunctionHandle *out);
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+
+/* ------------------------------------------------------------------ symbol */
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolFree(SymbolHandle symbol);
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array);
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array);
+
+/* ---------------------------------------------------------------- executor */
+/*! \brief bind symbol + arrays into an executor (MXExecutorBindEX subset:
+ *  no group2ctx at this boundary; grad_req_type per arg:
+ *  0=null 1=write 3=add). */
+int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads);
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* ----------------------------------------------------------- predict API */
+/*! \brief standalone prediction (reference c_predict_api.h). param_bytes is
+ *  the framework's .params container (nd.save format). */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

@@ -1,0 +1,217 @@
+"""Python side of the C ABI boundary (capi/c_api.cpp embeds CPython and
+calls these). Each function takes/returns only simple types, NDArray/Symbol/
+Executor objects (opaque handles on the C side), lists, and memoryviews —
+the C++ layer owns handle lifetime, GIL transitions, buffer copies, and
+error propagation (reference: src/c_api/c_api.cc over the C++ core; here
+the "core" the C API fronts is the mxnet_tpu runtime itself).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import ndarray as nd
+from . import symbol as sym
+from .context import Context
+from .registry import get_op, list_ops
+
+_DTYPE_CODE = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+               4: "int32", 5: "int8", 6: "int64"}
+_CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
+
+
+def _ctx(dev_type, dev_id):
+    # dev_type codes: 1=cpu, 2=gpu(=tpu here), 3=cpu_pinned (base.h Context)
+    return Context({1: "cpu", 2: "tpu", 3: "cpu_pinned"}.get(dev_type, "cpu"),
+                   dev_id)
+
+
+# ------------------------------------------------------------------ ndarray
+def ndarray_create(shape, dev_type, dev_id, dtype_code=0):
+    return nd.zeros(tuple(int(s) for s in shape), ctx=_ctx(dev_type, dev_id),
+                    dtype=_DTYPE_CODE[dtype_code])
+
+
+def ndarray_shape(arr):
+    return [int(s) for s in arr.shape]
+
+
+def ndarray_dtype_code(arr):
+    return _CODE_DTYPE.get(str(onp.dtype(arr.dtype)), 0)
+
+
+def ndarray_context(arr):
+    code = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3}
+    return code.get(arr.context.device_type, 1), arr.context.device_id
+
+
+def ndarray_copy_from(arr, mv):
+    src = onp.frombuffer(mv, dtype=arr.dtype, count=int(arr.size))
+    arr._write(src.reshape(arr.shape))
+
+
+def ndarray_copy_to(arr):
+    return onp.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def ndarray_save(fname, arrs, keys):
+    nd.save(fname, dict(zip(keys, arrs)) if keys else list(arrs))
+
+
+def ndarray_load(fname):
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return [data[n] for n in names], names
+    return list(data), []
+
+
+# ------------------------------------------------------------------ invoke
+def imperative_invoke(op_name, inputs, keys, vals, out=None):
+    op = get_op(op_name)
+    res = nd.invoke(op, list(inputs), dict(zip(keys, vals)),
+                    out=list(out) if out else None)
+    return list(res) if isinstance(res, (list, tuple)) else [res]
+
+
+def all_op_names():
+    return list_ops()
+
+
+# ------------------------------------------------------------------ symbol
+def symbol_create_atomic(op_name, keys, vals):
+    fn = getattr(sym, op_name)
+    attrs = {k: v for k, v in zip(keys, vals)}
+    name = attrs.pop("name", None)
+    return fn(name=name, **attrs) if name else fn(**attrs)
+
+
+def symbol_compose(s, name, keys, args):
+    """nnvm Symbol::Compose semantics: for an atomic symbol, keyword names
+    are the op's ARGUMENT names (data/weight/...); translate them to the
+    implicit placeholder variables _create generated for the head node."""
+    if keys:
+        kwargs = dict(zip(keys, args))
+        head = s._heads[0][0]
+        if head.op is not None:
+            argnames = head.op.list_arguments(head.attrs)
+            trans = {}
+            for (src, _), nm in zip(head.inputs, argnames):
+                if src.op is None:
+                    trans[nm] = src.name
+            kwargs = {trans.get(k, k): v for k, v in kwargs.items()}
+        s._compose(name=name or None, **kwargs)
+    else:
+        s._compose(*args, name=name or None)
+    return s
+
+
+def symbol_list(s, which):
+    if which == "arguments":
+        return s.list_arguments()
+    if which == "outputs":
+        return s.list_outputs()
+    return s.list_auxiliary_states()
+
+
+# ---------------------------------------------------------------- executor
+def executor_bind(s, dev_type, dev_id, in_args, arg_grads, grad_reqs,
+                  aux_states):
+    ctx = _ctx(dev_type, dev_id)
+    req_map = {0: "null", 1: "write", 2: "write", 3: "add"}
+    arg_names = s.list_arguments()
+    args = dict(zip(arg_names, in_args))
+    grads = {n: g for n, g in zip(arg_names, arg_grads) if g is not None}
+    reqs = {n: req_map[int(r)] for n, r in zip(arg_names, grad_reqs)}
+    aux_names = s.list_auxiliary_states()
+    return s.bind(ctx, args, args_grad=grads or None, grad_req=reqs,
+                  aux_states=dict(zip(aux_names, aux_states)) or None)
+
+
+def executor_forward(e, is_train):
+    e.forward(is_train=bool(is_train))
+
+
+def executor_backward(e, head_grads):
+    e.backward(list(head_grads) if head_grads else None)
+
+
+def executor_outputs(e):
+    return list(e.outputs)
+
+
+# ------------------------------------------------------------ predict API
+class _Predictor(object):
+    def __init__(self, json_str, param_blob, dev_type, dev_id,
+                 input_names, input_shapes):
+        import os
+        import tempfile
+        net = sym.load_json(json_str)
+        params = {}
+        if param_blob:
+            fd, path = tempfile.mkstemp(suffix=".params")
+            os.close(fd)
+            try:
+                with open(path, "wb") as f:
+                    f.write(param_blob)
+                loaded = nd.load(path)
+            finally:
+                os.unlink(path)
+            for k, v in (loaded.items() if isinstance(loaded, dict) else []):
+                # strip the arg:/aux: prefixes of save_checkpoint
+                params[k.split(":", 1)[-1]] = v
+        ctx = _ctx(dev_type, dev_id)
+        shapes = dict(zip(input_names, [tuple(s) for s in input_shapes]))
+        self.exe = net.simple_bind(ctx, grad_req="null", **shapes)
+        for name, arr in self.exe.arg_dict.items():
+            if name in params:
+                params[name].copyto(arr)
+        for name, arr in self.exe.aux_dict.items():
+            if name in params:
+                params[name].copyto(arr)
+        self.input_names = list(input_names)
+
+    def set_input(self, key, mv):
+        arr = self.exe.arg_dict[key]
+        ndarray_copy_from(arr, mv)
+
+    def forward(self):
+        self.exe.forward(is_train=False)
+
+    def output_shape(self, index):
+        return [int(s) for s in self.exe.outputs[index].shape]
+
+    def output(self, index):
+        return ndarray_copy_to(self.exe.outputs[index])
+
+
+def pred_create(json_str, param_blob, dev_type, dev_id, input_names,
+                input_shapes):
+    return _Predictor(json_str, param_blob, dev_type, dev_id, input_names,
+                      input_shapes)
+
+
+# ------------------------------------------------------------------ global
+def random_seed(s):
+    from . import random as rnd
+    rnd.seed(int(s))
+
+
+def profiler_config(mode, filename):
+    from . import profiler
+    profiler.profiler_set_config(mode={0: "symbolic", 1: "all"}.get(mode,
+                                                                    "all"),
+                                 filename=filename)
+
+
+def profiler_state(state):
+    from . import profiler
+    profiler.profiler_set_state({0: "stop", 1: "run"}.get(state, "stop"))
+
+
+def profiler_dump():
+    from . import profiler
+    profiler.dump_profile()
+
+
+def wait_all():
+    nd.waitall()

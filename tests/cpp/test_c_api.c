@@ -1,0 +1,166 @@
+/* C API smoke test (reference tests/cpp + c_predict_api usage): drives the
+ * framework through the flat-C ABI only — no Python in this translation
+ * unit. Prints CAPI_TEST_PASS on success, exits nonzero on failure. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxnet_tpu/c_api.h>
+
+#define CHECK(call)                                                    \
+  do {                                                                 \
+    if ((call) != 0) {                                                 \
+      fprintf(stderr, "FAIL %s:%d %s: %s\n", __FILE__, __LINE__, #call, \
+              MXGetLastError());                                       \
+      exit(1);                                                         \
+    }                                                                  \
+  } while (0)
+
+#define ASSERT(cond)                                                 \
+  do {                                                               \
+    if (!(cond)) {                                                   \
+      fprintf(stderr, "ASSERT %s:%d %s\n", __FILE__, __LINE__, #cond); \
+      exit(1);                                                       \
+    }                                                                \
+  } while (0)
+
+int main(void) {
+  /* --- ndarray create / copy / read back ------------------------------- */
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a));
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &b));
+
+  float data_a[6] = {1, 2, 3, 4, 5, 6};
+  float data_b[6] = {10, 20, 30, 40, 50, 60};
+  CHECK(MXNDArraySyncCopyFromCPU(a, data_a, 6));
+  CHECK(MXNDArraySyncCopyFromCPU(b, data_b, 6));
+
+  mx_uint ndim;
+  const mx_uint *pshape;
+  CHECK(MXNDArrayGetShape(a, &ndim, &pshape));
+  ASSERT(ndim == 2 && pshape[0] == 2 && pshape[1] == 3);
+
+  int dev_type, dev_id;
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id));
+  ASSERT(dev_type == 1);
+
+  /* --- imperative invoke: elemwise_add --------------------------------- */
+  FunctionHandle add_op;
+  CHECK(MXGetFunction("elemwise_add", &add_op));
+  NDArrayHandle inputs[2];
+  inputs[0] = a;
+  inputs[1] = b;
+  int num_out = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK(MXImperativeInvoke((AtomicSymbolCreator)add_op, 2, inputs, &num_out,
+                           &outs, 0, NULL, NULL));
+  ASSERT(num_out == 1);
+  NDArrayHandle sum = outs[0];
+  float result[6];
+  CHECK(MXNDArrayWaitToRead(sum));
+  CHECK(MXNDArraySyncCopyToCPU(sum, result, 6));
+  ASSERT(result[0] == 11.0f && result[5] == 66.0f);
+
+  /* --- op registry ------------------------------------------------------ */
+  mx_uint n_ops;
+  const char **op_names;
+  CHECK(MXListAllOpNames(&n_ops, &op_names));
+  ASSERT(n_ops > 200);
+
+  /* --- symbol build + executor forward/backward ------------------------ */
+  SymbolHandle x, w, fc;
+  CHECK(MXSymbolCreateVariable("x", &x));
+  CHECK(MXSymbolCreateVariable("w", &w));
+  AtomicSymbolCreator fc_op;
+  CHECK(MXGetFunction("FullyConnected", (FunctionHandle *)&fc_op));
+  const char *fc_keys[2] = {"num_hidden", "no_bias"};
+  const char *fc_vals[2] = {"4", "True"};
+  CHECK(MXSymbolCreateAtomicSymbol(fc_op, 2, fc_keys, fc_vals, &fc));
+  const char *arg_keys[2] = {"data", "weight"};
+  SymbolHandle args[2];
+  args[0] = x;
+  args[1] = w;
+  CHECK(MXSymbolCompose(fc, "fc1", 2, arg_keys, args));
+
+  mx_uint n_args;
+  const char **arg_names;
+  CHECK(MXSymbolListArguments(fc, &n_args, &arg_names));
+  ASSERT(n_args == 2);
+  ASSERT(strcmp(arg_names[0], "x") == 0 && strcmp(arg_names[1], "w") == 0);
+
+  const char *json;
+  CHECK(MXSymbolSaveToJSON(fc, &json));
+  SymbolHandle fc2;
+  CHECK(MXSymbolCreateFromJSON(json, &fc2));
+
+  mx_uint xshape[2] = {2, 3}, wshape[2] = {4, 3};
+  NDArrayHandle xin, win, xgrad, wgrad;
+  CHECK(MXNDArrayCreate(xshape, 2, 1, 0, 0, &xin));
+  CHECK(MXNDArrayCreate(wshape, 2, 1, 0, 0, &win));
+  CHECK(MXNDArrayCreate(xshape, 2, 1, 0, 0, &xgrad));
+  CHECK(MXNDArrayCreate(wshape, 2, 1, 0, 0, &wgrad));
+  float xdata[6] = {1, 0, 0, 0, 1, 0};
+  float wdata[12] = {1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12};
+  CHECK(MXNDArraySyncCopyFromCPU(xin, xdata, 6));
+  CHECK(MXNDArraySyncCopyFromCPU(win, wdata, 12));
+
+  NDArrayHandle bind_args[2], bind_grads[2];
+  bind_args[0] = xin;
+  bind_args[1] = win;
+  bind_grads[0] = xgrad;
+  bind_grads[1] = wgrad;
+  mx_uint reqs[2] = {1, 1};
+  ExecutorHandle exec;
+  CHECK(MXExecutorBind(fc2, 1, 0, 2, bind_args, bind_grads, reqs, 0, NULL,
+                       &exec));
+  CHECK(MXExecutorForward(exec, 1));
+  mx_uint n_outs;
+  NDArrayHandle *exec_outs;
+  CHECK(MXExecutorOutputs(exec, &n_outs, &exec_outs));
+  ASSERT(n_outs == 1);
+  float fc_out[8];
+  CHECK(MXNDArraySyncCopyToCPU(exec_outs[0], fc_out, 8));
+  /* row0 = first column of w: [1,4,7,10]; row1 = second: [2,5,8,11] */
+  ASSERT(fc_out[0] == 1.0f && fc_out[1] == 4.0f && fc_out[4] == 2.0f);
+  CHECK(MXExecutorBackward(exec, 0, NULL));
+  float wg[12];
+  CHECK(MXNDArraySyncCopyToCPU(wgrad, wg, 12));
+  /* dL/dw with all-ones head grad = sum over batch of x: [1,1,0] per row */
+  ASSERT(wg[0] == 1.0f && wg[1] == 1.0f && wg[2] == 0.0f);
+
+  /* --- save / load round trip ------------------------------------------ */
+  const char *keys[1] = {"weight"};
+  CHECK(MXNDArraySave("/tmp/capi_test.params", 1, &win, keys));
+  mx_uint n_loaded, n_names;
+  NDArrayHandle *loaded;
+  const char **names;
+  CHECK(MXNDArrayLoad("/tmp/capi_test.params", &n_loaded, &loaded, &n_names,
+                      &names));
+  ASSERT(n_loaded == 1 && n_names == 1 && strcmp(names[0], "weight") == 0);
+  remove("/tmp/capi_test.params");
+
+  /* --- predict API ------------------------------------------------------ */
+  PredictorHandle pred;
+  const char *in_keys[1] = {"x"};
+  mx_uint indptr[2] = {0, 2};
+  mx_uint in_shape[2] = {2, 3};
+  CHECK(MXPredCreate(json, NULL, 0, 1, 0, 1, in_keys, indptr, in_shape,
+                     &pred));
+  CHECK(MXPredSetInput(pred, "x", xdata, 6));
+  CHECK(MXPredForward(pred));
+  mx_uint *oshape, ondim;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  ASSERT(ondim == 2 && oshape[0] == 2 && oshape[1] == 4);
+  CHECK(MXPredFree(pred));
+
+  CHECK(MXExecutorFree(exec));
+  CHECK(MXSymbolFree(fc));
+  CHECK(MXSymbolFree(fc2));
+  CHECK(MXNDArrayFree(a));
+  CHECK(MXNDArrayFree(b));
+  CHECK(MXNDArrayWaitAll());
+  CHECK(MXNotifyShutdown());
+  printf("CAPI_TEST_PASS\n");
+  return 0;
+}
