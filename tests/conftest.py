@@ -38,13 +38,9 @@ def pytest_configure(config):
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.suspend_global_capture(in_=True)
-    env = dict(os.environ)
+    from __graft_entry__ import virtual_cpu_env
+    env = virtual_cpu_env(8)
     env["MXNET_TPU_TEST_REEXEC"] = "1"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.pop("PYTHONPATH", None)  # drops the axon sitecustomize dir
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8").strip()
     os.execve(sys.executable,
               [sys.executable, "-m", "pytest"]
               + list(config.invocation_params.args), env)
