@@ -1,12 +1,19 @@
-"""Benchmark: ResNet-50 synthetic-data training throughput (images/sec).
+"""Benchmark: ResNet-50 training throughput through the Module API.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+The north-star path (BASELINE.md, reference module/base_module.py:368-519):
+``mx.mod.Module`` bound on every visible device, one batch per step through
+``forward_backward`` + ``update``. On this framework that runs the fused
+MeshExecutorGroup — forward+backward+psum as one mesh-sharded XLA program,
+optimizer as one donated whole-tree update (module/mesh_executor_group.py).
 
 Baseline: the reference's published ResNet-50 training throughput at batch 32
-on its best single GPU — 181.53 img/s on P100 (docs/how_to/perf.md:179-189,
-BASELINE.md). vs_baseline = ours / 181.53. The whole train step (fwd + bwd +
-SGD-momentum update) is one donated, jitted XLA program via
-mxnet_tpu.parallel.DataParallelTrainStep over every visible device.
+on its best single GPU — 181.53 img/s on P100 (docs/how_to/perf.md:179-189).
+vs_baseline = ours / 181.53.
+
+MFU accounting: ResNet-50 ≈ 3.8 GFLOPs/image forward at 224²; training
+(fwd + bwd) ≈ 3×. peak_tflops from the device kind (bf16 systolic peak).
 """
 from __future__ import annotations
 
@@ -17,6 +24,20 @@ import sys
 import time
 
 BASELINE_IMG_S = 181.53  # P100, reference perf.md
+FLOPS_PER_IMG_TRAIN = 3.8e9 * 3
+
+# bf16 peak TFLOP/s per chip by device kind substring
+_PEAK_TFLOPS = [("v6", 918.0), ("trillium", 918.0), ("v5p", 459.0),
+                ("v5e", 197.0), ("v5 lite", 197.0), ("v5lite", 197.0),
+                ("v4", 275.0), ("v3", 123.0), ("v2", 45.0)]
+
+
+def _peak_tflops(device_kind, n_dev):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak * n_dev
+    return None
 
 
 def _emit(value, extra=None):
@@ -47,11 +68,9 @@ def main():
     platform = devices[0].platform
     signal.alarm(0)
 
-    import mxnet_tpu  # noqa: F401
+    import mxnet_tpu as mx
     from mxnet_tpu import models
-    from mxnet_tpu.initializer import Xavier
-    from mxnet_tpu.parallel import mesh as pmesh
-    from mxnet_tpu.parallel import data_parallel as dp
+    from mxnet_tpu.io import DataBatch
 
     n_dev = len(devices)
     per_dev_batch = int(os.environ.get("BENCH_BATCH", "64"))
@@ -65,36 +84,67 @@ def main():
     compute_dtype = None if dtype_env == "float32" else dtype_env
 
     net = models.get_symbol("resnet-50", num_classes=1000)
-    mesh = pmesh.data_parallel_mesh(n_dev)
-    step = dp.DataParallelTrainStep(
-        net, mesh, dp.sgd_step_fn(momentum=0.9, wd=1e-4,
-                                  rescale_grad=1.0 / batch),
-        compute_dtype=compute_dtype)
-    params, states, aux = step.init(Xavier(rnd_type="gaussian",
-                                           factor_type="in", magnitude=2),
-                                    {"data": (batch, 3, img, img)})
+    ctxs = [mx.Context("tpu", i) for i in range(n_dev)]
+    mod = mx.mod.Module(net, context=ctxs, compute_dtype=compute_dtype)
+    mod.bind(data_shapes=[("data", (batch, 3, img, img))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9, "wd": 1e-4,
+                                         "rescale_grad": 1.0 / batch})
+    from mxnet_tpu.module.mesh_executor_group import MeshExecutorGroup
+    fused = isinstance(mod._exec_group, MeshExecutorGroup)
 
+    # device-resident synthetic batches (input-pipeline throughput is its own
+    # benchmark — bench_io.py), pre-sharded so staging is a no-op device_put
     rng = np.random.RandomState(0)
-    X = rng.rand(batch, 3, img, img).astype(np.float32)
-    y = rng.randint(0, 1000, batch).astype(np.float32)
-    inputs = step.shard_batch({"data": X, "softmax_label": y})
+    n_bufs = 2
+    batches = []
+    sharding = mod._exec_group._batch_sharding if fused else None
+    for _ in range(n_bufs):
+        X = rng.rand(batch, 3, img, img).astype(np.float32)
+        y = rng.randint(0, 1000, batch).astype(np.float32)
+        if sharding is not None:
+            Xd = mx.nd.NDArray(jax.device_put(X, sharding), ctx=ctxs[0])
+            yd = mx.nd.NDArray(jax.device_put(y, sharding), ctx=ctxs[0])
+        else:
+            Xd, yd = mx.nd.array(X, ctx=ctxs[0]), mx.nd.array(y, ctx=ctxs[0])
+        batches.append(DataBatch(data=[Xd], label=[yd]))
+
+    def step(i):
+        b = batches[i % n_bufs]
+        mod.forward_backward(b)
+        mod.update()
 
     # compile + warmup
-    for _ in range(3):
-        params, states, aux, outs = step(params, states, aux, inputs, 0.1)
-    jax.block_until_ready(outs)
+    for i in range(3):
+        step(i)
+    jax.block_until_ready([b._read() for b
+                           in mod._exec_group._param_dict.values()]
+                          if fused else mod.get_outputs()[0]._read())
 
     t0 = time.time()
-    for _ in range(steps):
-        params, states, aux, outs = step(params, states, aux, inputs, 0.1)
-    jax.block_until_ready(outs)
-    jax.block_until_ready(params)
+    for i in range(steps):
+        step(i)
+    jax.block_until_ready([b._read() for b
+                           in mod._exec_group._param_dict.values()]
+                          if fused else mod.get_outputs()[0]._read())
     dt = time.time() - t0
 
     img_per_sec = steps * batch / dt
-    _emit(img_per_sec, {"platform": platform, "devices": n_dev,
-                        "batch": batch, "steps": steps,
-                        "dtype": dtype_env})
+    achieved_tflops = img_per_sec * FLOPS_PER_IMG_TRAIN / 1e12
+    peak = _peak_tflops(devices[0].device_kind, n_dev)
+    extra = {"platform": platform, "devices": n_dev, "batch": batch,
+             "steps": steps, "dtype": dtype_env, "path": "module",
+             "fused_group": fused,
+             "achieved_tflops": round(achieved_tflops, 2),
+             "device_kind": devices[0].device_kind}
+    if peak:
+        extra["peak_tflops"] = peak
+        extra["mfu"] = round(achieved_tflops / peak, 4)
+    _emit(img_per_sec, extra)
 
 
 if __name__ == "__main__":

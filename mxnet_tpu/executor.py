@@ -43,7 +43,7 @@ def _build_eval(symbol):
     heads = symbol._heads
     needs_rng = any(n.op.needs_rng for n in op_nodes)
 
-    def eval_fn(arg_vals, aux_vals, rng, is_train):
+    def eval_fn(arg_vals, aux_vals, rng, is_train, tap=None):
         import jax
         env = {}
         for n, v in zip(arg_nodes, arg_vals):
@@ -60,6 +60,12 @@ def _build_eval(symbol):
             res = n.op.fcompute(n.attrs, ins, octx)
             n_out = n.op.num_outputs(n.attrs)
             env[id(n)] = tuple(res[:n_out])
+            if tap is not None:
+                if n_out == 1:
+                    tap("%s_output" % n.name, res[0])
+                else:
+                    for oi in range(n_out):
+                        tap("%s_output%d" % (n.name, oi), res[oi])
             if n.op.aux_names:
                 n_args = len(n.op.list_arguments(n.attrs))
                 for (src, _), newv in zip(n.inputs[n_args:], res[n_out:]):
@@ -181,10 +187,35 @@ class Executor:
             onp.zeros((2,), onp.uint32)
         self._pending = (bool(is_train), arg_vals, aux_vals, rng)
         self._last_run = self._pending
+        if self._monitor_active():
+            # execute-with-taps: run the per-node interpreter eagerly and
+            # feed every op output to the monitor callback — the reference
+            # copies each output to ExecuteMonCallback
+            # (graph_executor.cc:760-778)
+            self._pending = None
+            cb = self._monitor_callback
+            from . import ndarray as nd
+
+            def tap(name, val):
+                cb(name, nd.NDArray(val, ctx=self._ctx, writable=False))
+
+            outs, new_aux = self._eval_fn(arg_vals, aux_vals, rng,
+                                          bool(is_train), tap=tap)
+            self._write_results(outs, new_aux, bool(is_train))
+            return self.outputs
         force = self._materialize_forward
         for o in self._out_arrays:
             o._chunk.force = force
         return self.outputs
+
+    def _monitor_active(self):
+        cb = self._monitor_callback
+        if cb is None:
+            return False
+        owner = getattr(cb, "__self__", None)
+        # Monitor gates taps by interval via its ``activated`` flag; plain
+        # callables tap every batch
+        return getattr(owner, "activated", True) is not False
 
     def _materialize_forward(self):
         if self._pending is None:

@@ -70,11 +70,13 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
-                   kvstore=None):
+                   kvstore=None, donate=False):
     """aggregate via kvstore (or not), update locally (model.py:99-116).
 
     All per-(param, device) updates are batched into ONE jitted XLA call
-    (Updater.update_multi) — the reference pushes one engine op per param."""
+    (Updater.update_multi) — the reference pushes one engine op per param.
+    ``donate`` passes weight/state buffers to XLA for in-place HBM updates
+    (the fused Module path sets it on accelerators)."""
     triples = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
@@ -87,7 +89,7 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             # unique integer key per (param, device)
             triples.append((index * num_device + k, g, p))
     if hasattr(updater, "update_multi"):
-        updater.update_multi(triples)
+        updater.update_multi(triples, donate=donate)
     else:
         for key, g, p in triples:
             updater(key, g, p)

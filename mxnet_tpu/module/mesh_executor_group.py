@@ -1,0 +1,358 @@
+"""MeshExecutorGroup — the fused, mesh-sharded Module execution path.
+
+TPU-native replacement for the reference's DataParallelExecutorGroup
+(python/mxnet/module/executor_group.py:77-231): instead of slicing the batch
+across N per-device executors and reducing gradients through KVStore staging
+buffers (src/kvstore/comm.h), the whole forward+backward is ONE jitted XLA
+program over a ``jax.sharding.Mesh`` with a single 'dp' axis:
+
+* inputs are sharded on the batch axis (``PartitionSpec('dp')``);
+* parameters/aux are replicated; requesting *replicated* gradient outputs
+  makes the GSPMD partitioner insert the cross-device all-reduce (psum over
+  ICI) exactly where the reference staged through pinned merge buffers;
+* BatchNorm statistics are computed over the global batch (the partitioner
+  reduces across shards) — matching single-device numerics, which the
+  reference's per-device-slice BN does not;
+* the optimizer update stays in ``Module.update`` -> ``Updater.update_multi``
+  (one jitted whole-tree call, buffers donated on accelerators), preserving
+  every lr-scheduler/wd-mult semantic of optimizer.py.
+
+The group implements the same surface Module drives on
+DataParallelExecutorGroup, so ``Module.fit`` (base_module.py:368-519 in the
+reference) runs unchanged on top of it.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .. import ndarray as nd
+from .. import random as _random
+from ..base import MXNetError
+from ..executor import _build_eval
+
+__all__ = ["MeshExecutorGroup"]
+
+
+class MeshExecutorGroup(object):
+    """One donated, mesh-sharded program instead of N Python executors."""
+
+    fused = True
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", compute_dtype=None):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        assert shared_group is None or shared_group.fused
+        assert not inputs_need_grad
+        self.symbol = symbol
+        self.contexts = contexts
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.for_training = for_training
+        self.inputs_need_grad = False
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.compute_dtype = compute_dtype
+        self._grad_names = [n for n in param_names
+                            if n not in self.fixed_param_names] \
+            if for_training and grad_req == "write" else []
+
+        devices = [c.jax_device() for c in contexts]
+        self.mesh = Mesh(onp.array(devices), ("dp",))
+        self._repl = NamedSharding(self.mesh, P())
+        self._batch_sharding = NamedSharding(self.mesh, P("dp"))
+        self._platform = devices[0].platform
+
+        self._eval_fn, self._needs_rng = _build_eval(symbol)
+        self._jits = {}
+        self._pending = None     # (inputs dict of device arrays, is_train)
+        self._outputs_from = None  # "fwd" | "bwd"
+
+        self.bind_exec(data_shapes, label_shapes)
+
+        # parameter / grad / aux buffers: replicated global jax arrays
+        # wrapped as NDArrays so Module + Updater.update_multi drive them
+        # unchanged.  ctx is display-only; placement is the mesh sharding.
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**self._input_shapes)
+        shape_of = dict(zip(self.arg_names, arg_shapes))
+        self._shape_of = shape_of
+        # non-param args the batch may not provide (e.g. labels at predict
+        # time) are bound as zeros, like the classic group's pre-allocated
+        # input arrays
+        self._nonparam_names = [n for n in self.arg_names
+                                if n not in param_names]
+        ctx0 = contexts[0]
+
+        def repl_zeros(shape):
+            arr = jax.device_put(onp.zeros(shape, onp.float32), self._repl)
+            return nd.NDArray(arr, ctx=ctx0)
+
+        if shared_group is not None:
+            # shared_module semantics (executor_group.py:560-585): share the
+            # parameter/grad/aux buffers with the parent module — trivially
+            # memory-shared here since params are name-keyed device dicts
+            for n in param_names:
+                src = shared_group._param_dict[n]
+                assert tuple(src.shape) == tuple(shape_of[n]), n
+            self.param_arrays = [[shared_group._param_dict[n]]
+                                 for n in param_names]
+            self._param_dict = shared_group._param_dict
+            self.grad_arrays = [[shared_group._grad_dict[n]]
+                                if n in self._grad_names
+                                and n in shared_group._grad_dict else
+                                ([repl_zeros(shape_of[n])]
+                                 if n in self._grad_names else None)
+                                for n in param_names]
+            self._grad_dict = {n: b[0] for n, b in zip(param_names,
+                                                       self.grad_arrays)
+                               if b is not None}
+            self.aux_arrays = shared_group.aux_arrays
+            self._aux_dict = shared_group._aux_dict
+        else:
+            self.param_arrays = [[repl_zeros(shape_of[n])]
+                                 for n in param_names]
+            self._param_dict = {n: b[0] for n, b in zip(param_names,
+                                                        self.param_arrays)}
+            self.grad_arrays = [[repl_zeros(shape_of[n])]
+                                if n in self._grad_names else None
+                                for n in param_names]
+            self._grad_dict = {n: b[0] for n, b in zip(param_names,
+                                                       self.grad_arrays)
+                               if b is not None}
+            self.aux_arrays = [[repl_zeros(s)] for s in aux_shapes]
+            self._aux_dict = {n: b[0] for n, b in zip(self.aux_names,
+                                                      self.aux_arrays)}
+
+        # persistent output NDArrays (lazy force thunk, like Executor)
+        out_structs = self._out_structs()
+        self._out_arrays = [nd.zeros(s.shape, ctx=ctx0, dtype=s.dtype)
+                            for s in out_structs]
+
+    # ------------------------------------------------------------------
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        assert shared_group is None
+        self.batch_size = data_shapes[0][1][0]
+        n_dev = len(self.contexts)
+        if self.batch_size % n_dev:
+            raise MXNetError(
+                "fused mesh path needs batch_size %% n_devices == 0 "
+                "(got %d %% %d)" % (self.batch_size, n_dev))
+        self.data_shapes = [(x[0], tuple(x[1])) for x in data_shapes]
+        self.label_shapes = [(x[0], tuple(x[1])) for x in label_shapes] \
+            if label_shapes else None
+        self._input_shapes = dict(self.data_shapes)
+        if self.label_shapes:
+            self._input_shapes.update(dict(self.label_shapes))
+        self.input_names = list(self._input_shapes)
+        self._label_names = [x[0] for x in (self.label_shapes or [])]
+
+    def _out_structs(self):
+        import jax
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(
+            **self._input_shapes)
+        args = [jax.ShapeDtypeStruct(tuple(s), onp.float32)
+                for s in arg_shapes]
+        auxs = [jax.ShapeDtypeStruct(tuple(s), onp.float32)
+                for s in aux_shapes]
+        rng = jax.ShapeDtypeStruct((2,), onp.uint32)
+        outs, _ = jax.eval_shape(
+            lambda a, x, r: self._eval_fn(a, x, r, False), args, auxs, rng)
+        return outs
+
+    # ------------------------------------------------------------------
+    # jitted programs (cached per (kind, input-shape) — recompiles on a
+    # batch-size change exactly like simple_bind reshaping)
+    def _get_jit(self, kind):
+        key = kind
+        if key in self._jits:
+            return self._jits[key]
+        import jax
+
+        cdt = self.compute_dtype
+        label_names = set(self._label_names)
+        grad_names = list(self._grad_names)
+
+        def cast(name, v):
+            if cdt is not None and name not in label_names:
+                return v.astype(cdt)
+            return v
+
+        def run_fwd(params, aux, inputs, rng, is_train):
+            vals = [cast(n, params[n]) if n in params else
+                    cast(n, inputs[n]) for n in self.arg_names]
+            auxv = [aux[n] for n in self.aux_names]
+            outs, new_aux = self._eval_fn(vals, auxv, rng, is_train)
+            return outs, dict(zip(self.aux_names, new_aux))
+
+        repl, batch = self._repl, self._batch_sharding
+
+        if kind in ("fwd_train", "fwd_eval"):
+            is_train = kind == "fwd_train"
+
+            def fwd(params, aux, inputs, rng):
+                outs, new_aux = run_fwd(params, aux, inputs, rng, is_train)
+                outs = tuple(o.astype(onp.float32) for o in outs)
+                return outs, new_aux
+
+            fn = jax.jit(fwd, in_shardings=(repl, repl, batch, None),
+                         out_shardings=(batch, repl))
+        else:  # fused forward+backward, grads all-reduced to replicated
+            with_heads = kind == "fwd_bwd_heads"
+
+            def fwd_bwd(params, aux, inputs, rng, heads=None):
+                def f(p):
+                    outs, new_aux = run_fwd(p, aux, inputs, rng, True)
+                    return tuple(outs), new_aux
+
+                outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+                import jax.numpy as jnp
+                hs = tuple(h.astype(o.dtype) for h, o in zip(heads, outs)) \
+                    if with_heads else tuple(jnp.ones_like(o) for o in outs)
+                (grads,) = vjp_fn(hs)
+                grads = {n: grads[n].astype(params[n].dtype)
+                         for n in grad_names}
+                outs = tuple(o.astype(onp.float32) for o in outs)
+                return outs, new_aux, grads
+
+            in_sh = (repl, repl, batch, None) + ((batch,) if with_heads
+                                                 else ())
+            fn = jax.jit(fwd_bwd, in_shardings=in_sh,
+                         out_shardings=(batch, repl, repl))
+        self._jits[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def set_params(self, arg_params, aux_params):
+        import jax
+        for n, buf in self._param_dict.items():
+            if n in arg_params:
+                buf._write(jax.device_put(arg_params[n].asnumpy(),
+                                          self._repl))
+        for n, buf in self._aux_dict.items():
+            if aux_params and n in aux_params:
+                buf._write(jax.device_put(aux_params[n].asnumpy(),
+                                          self._repl))
+
+    def get_params(self, arg_params, aux_params):
+        for n, buf in self._param_dict.items():
+            arg_params[n]._write(onp.asarray(buf._read(),
+                                             arg_params[n].dtype))
+        for n, buf in self._aux_dict.items():
+            aux_params[n]._write(onp.asarray(buf._read(),
+                                             aux_params[n].dtype))
+
+    # ------------------------------------------------------------------
+    def _stage(self, batch):
+        """Shard the host batch onto the mesh ('dp' on axis 0)."""
+        import jax
+        inputs = {}
+        data_names = [x[0] for x in self.data_shapes]
+        for name, arr in zip(data_names, batch.data):
+            inputs[name] = jax.device_put(arr._read(), self._batch_sharding)
+        if self.label_shapes and batch.label:
+            for name, arr in zip(self._label_names, batch.label):
+                if arr is not None:
+                    inputs[name] = jax.device_put(arr._read(),
+                                                  self._batch_sharding)
+        bs = next(iter(inputs.values())).shape[0]
+        for name in self._nonparam_names:
+            if name not in inputs:
+                inputs[name] = jax.device_put(
+                    onp.zeros((bs,) + tuple(self._shape_of[name][1:]),
+                              onp.float32), self._batch_sharding)
+        return inputs
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        inputs = self._stage(data_batch)
+        rng = _random.next_key() if self._needs_rng else \
+            onp.zeros((2,), onp.uint32)
+        self._pending = (inputs, bool(is_train), rng)
+        self._last = self._pending
+        self._last_aux = None
+        self._outputs_from = None
+        force = self._materialize_forward
+        for o in self._out_arrays:
+            o._chunk.force = force
+
+    def _materialize_forward(self):
+        if self._pending is None:
+            return
+        inputs, is_train, rng = self._pending
+        self._pending = None
+        fn = self._get_jit("fwd_train" if is_train else "fwd_eval")
+        params = {n: b._read() for n, b in self._param_dict.items()}
+        aux = {n: b._read() for n, b in self._aux_dict.items()}
+        # snapshot pre-forward aux so a later backward() re-runs from the
+        # same moving statistics (no double BN-EMA update)
+        self._last_aux = aux
+        outs, new_aux = fn(params, aux, inputs, rng)
+        self._write_outs(outs)
+        if is_train:
+            self._write_aux(new_aux)
+        self._outputs_from = "fwd"
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True"
+        if self._outputs_from == "bwd":
+            return  # fused fwd+bwd already ran for this forward
+        if getattr(self, "_last", None) is None:
+            raise MXNetError("backward() called before forward()")
+        inputs, _, rng = self._last
+        self._pending = None
+        params = {n: b._read() for n, b in self._param_dict.items()}
+        aux = self._last_aux if getattr(self, "_last_aux", None) is not None \
+            else {n: b._read() for n, b in self._aux_dict.items()}
+        if out_grads is None:
+            fn = self._get_jit("fwd_bwd")
+            outs, new_aux, grads = fn(params, aux, inputs, rng)
+        else:
+            import jax
+            if isinstance(out_grads, nd.NDArray):
+                out_grads = [out_grads]
+            heads = tuple(jax.device_put(
+                g._read() if isinstance(g, nd.NDArray) else onp.asarray(g),
+                self._batch_sharding) for g in out_grads)
+            fn = self._get_jit("fwd_bwd_heads")
+            outs, new_aux, grads = fn(params, aux, inputs, rng, heads)
+        self._write_outs(outs)
+        self._write_aux(new_aux)
+        for n, g in grads.items():
+            self._grad_dict[n]._write(g)
+        self._outputs_from = "bwd"
+
+    def _write_outs(self, outs):
+        for o, v in zip(self._out_arrays, outs):
+            o._chunk.force = None
+            o._chunk.arr = v
+
+    def _write_aux(self, new_aux):
+        for n, v in new_aux.items():
+            self._aux_dict[n]._write(v)
+
+    # ------------------------------------------------------------------
+    def get_outputs(self, merge_multi_context=True):
+        for o in self._out_arrays:
+            o._read()  # materialize any pending forward
+        if merge_multi_context:
+            return list(self._out_arrays)
+        return [[o] for o in self._out_arrays]
+
+    def get_input_grads(self, merge_multi_context=True):
+        raise MXNetError("inputs_need_grad is not supported on the fused "
+                         "mesh path; set MXNET_MODULE_FUSED=0")
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        raise MXNetError("monitor requires the per-executor path; "
+                         "Module re-binds automatically")

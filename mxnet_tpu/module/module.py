@@ -13,17 +13,29 @@ from ..model import _create_kvstore, _initialize_kvstore, _update_params, \
     _update_params_on_kvstore, load_checkpoint, save_checkpoint
 from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
+from .mesh_executor_group import MeshExecutorGroup
 
 __all__ = ["Module"]
 
 
 class Module(BaseModule):
-    """Trainable module over a Symbol (module.py Module)."""
+    """Trainable module over a Symbol (module.py Module).
+
+    When the bound contexts form one device mesh (and no feature forces the
+    per-executor path), ``bind`` builds a fused :class:`MeshExecutorGroup` —
+    one mesh-sharded XLA program per step — instead of N Python executors.
+    ``compute_dtype`` selects mixed precision there (bfloat16 on TPU; params
+    stay float32 master copies). ``MXNET_MODULE_FUSED=0`` forces the classic
+    per-executor group.
+    """
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
-                 context=None, work_load_list=None, fixed_param_names=None):
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 compute_dtype=None, _allow_fused=True):
         super().__init__(logger=logger)
+        self._compute_dtype = compute_dtype
+        self._allow_fused = _allow_fused
         if context is None:
             context = ctx_mod.current_context()
         if isinstance(context, ctx_mod.Context):
@@ -195,11 +207,26 @@ class Module(BaseModule):
                 shared_module.binded and shared_module.params_initialized
             shared_group = shared_module._exec_group
 
-        self._exec_group = DataParallelExecutorGroup(
-            self._symbol, self._context, self._work_load_list,
-            self._data_shapes, self._label_shapes, self._param_names,
-            for_training, inputs_need_grad, shared_group, self.logger,
-            self._fixed_param_names, grad_req)
+        shared_is_fused = shared_group is not None and \
+            getattr(shared_group, "fused", False)
+        if self._fused_eligible(shared_group, inputs_need_grad, grad_req):
+            self._exec_group = MeshExecutorGroup(
+                self._symbol, self._context, self._work_load_list,
+                self._data_shapes, self._label_shapes, self._param_names,
+                for_training, inputs_need_grad, shared_group, self.logger,
+                self._fixed_param_names, grad_req,
+                compute_dtype=self._compute_dtype)
+        elif shared_is_fused:
+            raise ValueError(
+                "shared_module uses the fused mesh group but this bind is "
+                "not fused-eligible; bind the shared module with "
+                "MXNET_MODULE_FUSED=0 to share classic executors")
+        else:
+            self._exec_group = DataParallelExecutorGroup(
+                self._symbol, self._context, self._work_load_list,
+                self._data_shapes, self._label_shapes, self._param_names,
+                for_training, inputs_need_grad, shared_group, self.logger,
+                self._fixed_param_names, grad_req)
         self._total_exec_bytes = 0
 
         if shared_module is not None:
@@ -211,6 +238,41 @@ class Module(BaseModule):
 
         if shared_module is not None and shared_module.optimizer_initialized:
             self.borrow_optimizer(shared_module)
+
+    def _fused_eligible(self, shared_group, inputs_need_grad, grad_req):
+        """Use the mesh-fused group when the bind maps onto one device mesh
+        and nothing requires per-executor machinery."""
+        import os
+        if not self._allow_fused or \
+                os.environ.get("MXNET_MODULE_FUSED", "1") == "0":
+            return False
+        if shared_group is not None and \
+                not getattr(shared_group, "fused", False):
+            return False
+        if inputs_need_grad:
+            return False
+        if grad_req != "write":
+            return False
+        if self._data_shapes[0][1][0] % len(self._context):
+            return False
+        # the fused mesh shards the batch evenly; a deliberate non-uniform
+        # workload split needs the classic sliced group
+        if len(set(self._work_load_list)) != 1:
+            return False
+        try:
+            devs = [c.jax_device() for c in self._context]
+        except Exception:
+            return False
+        return (len(set(devs)) == len(devs)
+                and len({d.platform for d in devs}) == 1)
+
+    @property
+    def _num_update_blocks(self):
+        """Per-param device-block count seen by the optimizer machinery:
+        the fused group exposes ONE replicated block regardless of mesh
+        size; the classic group one block per context."""
+        return 1 if getattr(self._exec_group, "fused", False) \
+            else len(self._context)
 
     def _reset_bind(self):
         self.binded = False
@@ -226,8 +288,20 @@ class Module(BaseModule):
             self._label_shapes = [(x[0], tuple(x[1])) for x in label_shapes]
         else:
             self._label_shapes = None
-        self._exec_group.bind_exec(self._data_shapes, self._label_shapes,
-                                   reshape=True)
+        if getattr(self._exec_group, "fused", False) and \
+                self._data_shapes[0][1][0] % len(self._context):
+            # new batch doesn't divide the mesh: fall back to the classic
+            # sliced group, keeping parameters
+            if self._params_dirty:
+                self._sync_params_from_devices()
+            self._exec_group = DataParallelExecutorGroup(
+                self._symbol, self._context, self._work_load_list,
+                self._data_shapes, self._label_shapes, self._param_names,
+                self.for_training, self.inputs_need_grad, None, self.logger,
+                self._fixed_param_names, "write")
+        else:
+            self._exec_group.bind_exec(self._data_shapes, self._label_shapes,
+                                       reshape=True)
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
@@ -239,9 +313,10 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+        self._kvstore_arg = kvstore
 
         (kvstore, update_on_kvstore) = _create_kvstore(
-            kvstore, len(self._context), self._arg_params)
+            kvstore, self._num_update_blocks, self._arg_params)
 
         batch_size = self._exec_group.batch_size
         if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
@@ -253,9 +328,10 @@ class Module(BaseModule):
             if update_on_kvstore:
                 idx2name.update(enumerate(self._exec_group.param_names))
             else:
-                for k in range(len(self._context)):
+                n_blocks = self._num_update_blocks
+                for k in range(n_blocks):
                     idx2name.update(
-                        {i * len(self._context) + k: n for i, n in
+                        {i * n_blocks + k: n for i, n in
                          enumerate(self._exec_group.param_names)})
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
@@ -315,11 +391,14 @@ class Module(BaseModule):
                                       self._exec_group.grad_arrays,
                                       self._kvstore)
         else:
+            fused = getattr(self._exec_group, "fused", False)
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,
-                           num_device=len(self._context),
-                           kvstore=self._kvstore)
+                           num_device=self._num_update_blocks,
+                           kvstore=self._kvstore,
+                           donate=fused and
+                           self._exec_group._platform != "cpu")
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -354,5 +433,28 @@ class Module(BaseModule):
                 self._updater.set_states(fin.read())
 
     def install_monitor(self, mon):
+        """Install a Monitor; the fused mesh group has no per-op boundaries
+        (the whole step is one XLA program), so re-bind onto the classic
+        per-executor group where the tapped interpreter runs."""
         assert self.binded
+        if getattr(self._exec_group, "fused", False):
+            if self._params_dirty:
+                self._sync_params_from_devices()
+            self._exec_group = DataParallelExecutorGroup(
+                self._symbol, self._context, self._work_load_list,
+                self._data_shapes, self._label_shapes, self._param_names,
+                self.for_training, self.inputs_need_grad, None, self.logger,
+                self._fixed_param_names, "write")
+            if self.params_initialized:
+                self._exec_group.set_params(self._arg_params,
+                                            self._aux_params)
+            if self.optimizer_initialized:
+                # per-param update keys change from 1 block to N blocks;
+                # rebuild the optimizer wiring (momentum state restarts)
+                self.logger.warning(
+                    "install_monitor re-bound the module onto per-executor "
+                    "groups; optimizer state was reset")
+                self.optimizer_initialized = False
+                self.init_optimizer(self._kvstore_arg, self._optimizer,
+                                    force_init=True)
         self._exec_group.install_monitor(mon)

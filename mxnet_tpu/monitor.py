@@ -1,10 +1,13 @@
-"""Monitor — inspect internal outputs/weights during training
+"""Monitor — inspect every op's outputs (and weights/aux) during training
 (python/mxnet/monitor.py:16 + MXExecutorSetMonitorCallback).
 
-The reference copies every op output via a C callback
-(graph_executor.cc:760-778); here ``install`` binds a side executor over
-``symbol.get_internals()`` sharing the main executor's arrays, evaluated on
-``toc`` — same observability, one extra XLA program only while monitoring.
+The reference's GraphExecutor copies each op output to a registered C
+callback (ExecuteMonCallback, graph_executor.cc:760-778). Here ``install``
+registers ``stat_helper`` as the executor's monitor callback; while a
+monitored batch is active the executor runs its per-node interpreter with
+taps (executor.py forward) and feeds every op output through ``stat_func``.
+``tic``/``toc`` gate taps to every ``interval``-th batch, so non-monitored
+batches keep the fused jit fast path.
 """
 from __future__ import annotations
 
@@ -31,7 +34,14 @@ class Monitor(object):
         self.re_prog = re.compile(pattern)
         self.sort = sort
 
+    def stat_helper(self, name, array):
+        """Per-op-output callback fed by the executor's tapped run."""
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(array)))
+
     def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 
     def tic(self):
@@ -50,10 +60,6 @@ class Monitor(object):
                     self.queue.append((self.step, name,
                                        self.stat_func(array)))
             for name, array in exe.aux_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
-            for name, array in zip(exe._symbol.list_outputs(), exe.outputs):
                 if self.re_prog.match(name):
                     self.queue.append((self.step, name,
                                        self.stat_func(array)))

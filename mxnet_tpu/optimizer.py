@@ -458,11 +458,15 @@ class Updater(object):
             self.states[index] = self.optimizer.create_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])
 
-    def update_multi(self, triples):
+    def update_multi(self, triples, donate=False):
         """One jitted XLA call updating EVERY parameter (the TPU-native
         replacement for per-param engine pushes): ``triples`` is a list of
         (index, grad NDArray, weight NDArray). Falls back to per-param
-        update() for optimizers without a pure ``_fused_apply``."""
+        update() for optimizers without a pure ``_fused_apply``.
+
+        ``donate=True`` donates weight/state buffers to XLA so the update is
+        in-place in HBM — only safe when no live reference to the old buffers
+        remains (the fused Module path guarantees this)."""
         opt = self.optimizer
         fa = getattr(opt, "_fused_apply", None)
         if fa is not None:
@@ -488,9 +492,9 @@ class Updater(object):
         for t in triples:
             by_dev.setdefault(str(t[2].context), []).append(t)
         for dev, group in by_dev.items():
-            self._update_group(dev, group, fa)
+            self._update_group(dev, group, fa, donate)
 
-    def _update_group(self, dev, triples, fa):
+    def _update_group(self, dev, triples, fa, donate=False):
         opt = self.optimizer
         import jax
         import jax.numpy as jnp
@@ -505,18 +509,26 @@ class Updater(object):
         wds = np.asarray([opt._get_wd(i) for i, _, _ in triples],
                          np.float32)
 
-        def tree_read(state):
+        def tree_read(state, like=None):
             if state is None:
                 return ()
             if isinstance(state, (tuple, list)):
-                return tuple(tree_read(s) for s in state)
-            return state._read()
+                return tuple(tree_read(s, like) for s in state)
+            v = state._read()
+            # optimizer state must live on the weight's sharding (the fused
+            # Module path keeps weights mesh-replicated; create_state made a
+            # single-device array)
+            if like is not None and v.sharding != like.sharding:
+                v = jax.device_put(v, like.sharding)
+            return v
 
         ws = [w._read() for _, _, w in triples]
         gs = [g._read() for _, g, _ in triples]
-        ss = [tree_read(self.states[i]) for i, _, _ in triples]
+        ss = [tree_read(self.states[i], w) for (i, _, _), w
+              in zip(triples, ws)]
 
-        key = (dev,) + tuple((tuple(w.shape), str(w.dtype)) for w in ws)
+        key = (dev, donate) + tuple((tuple(w.shape), str(w.dtype))
+                                    for w in ws)
         if key not in self._fused_fns:
             def step(ws, gs, ss, lrs, wds):
                 new_ws, new_ss = [], []
@@ -526,7 +538,8 @@ class Updater(object):
                     new_ss.append(s)
                 return new_ws, new_ss
 
-            self._fused_fns[key] = jax.jit(step)
+            self._fused_fns[key] = jax.jit(
+                step, donate_argnums=(0, 2) if donate else ())
 
         new_ws, new_ss = self._fused_fns[key](ws, gs, ss, lrs, wds)
 

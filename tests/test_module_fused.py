@@ -1,0 +1,211 @@
+"""Fused mesh Module path: Module.fit on an 8-device mesh must match
+single-device training numerically (VERDICT r1 #2).
+
+The conftest provisions 8 virtual CPU devices, so ``[mx.cpu(i) for i in
+range(8)]`` binds one 8-way 'dp' mesh. BatchNorm statistics are computed
+over the global batch on the fused path (GSPMD reduces across shards), so
+the 8-device run reproduces the single-device numbers — something the
+reference's per-device-slice BN cannot do (executor_group.py:77-231).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module.mesh_executor_group import MeshExecutorGroup
+from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+
+
+def _conv_bn_net():
+    net = sym.Variable("data")
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=10, name="fc1")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _mlp_net():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(batch=32, shape=(1, 8, 8), nclass=10, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(batch * 4, *shape).astype(np.float32)
+    y = rng.randint(0, nclass, batch * 4).astype(np.float32)
+    return X, y
+
+
+def _train(net, contexts, X, y, batch, steps=8, seed_params=None):
+    mod = mx.mod.Module(net, context=contexts)
+    mod.bind(data_shapes=[("data", (batch,) + X.shape[1:])],
+             label_shapes=[("softmax_label", (batch,))])
+    if seed_params is None:
+        mx.random.seed(42)
+        mod.init_params(mx.initializer.Xavier())
+    else:
+        mod.init_params(arg_params=seed_params[0], aux_params=seed_params[1])
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9,
+                                         "rescale_grad": 1.0 / batch})
+    it = NDArrayIter(X, y, batch_size=batch, shuffle=False)
+    done = 0
+    while done < steps:
+        it.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+            done += 1
+            if done >= steps:
+                break
+    return mod.get_params()
+
+
+def test_fused_group_selected():
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(_mlp_net(), context=ctxs)
+    mod.bind(data_shapes=[("data", (32, 64))],
+             label_shapes=[("softmax_label", (32,))])
+    assert isinstance(mod._exec_group, MeshExecutorGroup)
+
+    os.environ["MXNET_MODULE_FUSED"] = "0"
+    try:
+        mod2 = mx.mod.Module(_mlp_net(), context=ctxs)
+        mod2.bind(data_shapes=[("data", (32, 64))],
+                  label_shapes=[("softmax_label", (32,))])
+        assert isinstance(mod2._exec_group, DataParallelExecutorGroup)
+    finally:
+        del os.environ["MXNET_MODULE_FUSED"]
+
+    # indivisible batch falls back
+    mod3 = mx.mod.Module(_mlp_net(), context=ctxs)
+    mod3.bind(data_shapes=[("data", (30, 64))],
+              label_shapes=[("softmax_label", (30,))])
+    assert isinstance(mod3._exec_group, DataParallelExecutorGroup)
+
+
+def test_fit_8dev_matches_single_device():
+    """Global-batch BN + psum grads: 8-device fused == 1-device fused."""
+    net = _conv_bn_net()
+    X, y = _data(batch=32)
+    mod = mx.mod.Module(net, context=[mx.cpu(0)])
+    mod.bind(data_shapes=[("data", (32, 1, 8, 8))],
+             label_shapes=[("softmax_label", (32,))])
+    mx.random.seed(42)
+    mod.init_params(mx.initializer.Xavier())
+    p0, a0 = mod.get_params()
+    seed = ({k: v for k, v in p0.items()}, {k: v for k, v in a0.items()})
+
+    args1, auxs1 = _train(net, [mx.cpu(0)], X, y, 32, seed_params=seed)
+    args8, auxs8 = _train(net, [mx.cpu(i) for i in range(8)], X, y, 32,
+                          seed_params=seed)
+    for k in args1:
+        np.testing.assert_allclose(args1[k].asnumpy(), args8[k].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+    for k in auxs1:
+        np.testing.assert_allclose(auxs1[k].asnumpy(), auxs8[k].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_fused_matches_classic():
+    """On a BN-free net the fused mesh path reproduces the classic sliced
+    per-executor path (same grad sums, same updates)."""
+    net = _mlp_net()
+    rng = np.random.RandomState(3)
+    X = rng.rand(128, 64).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    ctxs = [mx.cpu(i) for i in range(4)]
+
+    mod = mx.mod.Module(net, context=[mx.cpu(0)])
+    mod.bind(data_shapes=[("data", (32, 64))],
+             label_shapes=[("softmax_label", (32,))])
+    mx.random.seed(0)
+    mod.init_params(mx.initializer.Xavier())
+    p0, a0 = mod.get_params()
+    seed = (dict(p0), dict(a0))
+
+    fused = _train(net, ctxs, X, y, 32, seed_params=seed)
+    os.environ["MXNET_MODULE_FUSED"] = "0"
+    try:
+        classic = _train(net, ctxs, X, y, 32, seed_params=seed)
+    finally:
+        del os.environ["MXNET_MODULE_FUSED"]
+    for k in fused[0]:
+        np.testing.assert_allclose(fused[0][k].asnumpy(),
+                                   classic[0][k].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_shared_module_fused():
+    """bind(shared_module=...) on a fused module shares parameter buffers."""
+    net = _mlp_net()
+    ctxs = [mx.cpu(i) for i in range(4)]
+    train = mx.mod.Module(net, context=ctxs)
+    train.bind(data_shapes=[("data", (32, 64))],
+               label_shapes=[("softmax_label", (32,))])
+    train.init_params(mx.initializer.Xavier())
+    assert isinstance(train._exec_group, MeshExecutorGroup)
+
+    val = mx.mod.Module(net, context=ctxs)
+    val.bind(data_shapes=[("data", (32, 64))],
+             label_shapes=[("softmax_label", (32,))],
+             for_training=False, shared_module=train)
+    assert isinstance(val._exec_group, MeshExecutorGroup)
+    assert val._exec_group._param_dict is train._exec_group._param_dict
+
+    rng = np.random.RandomState(0)
+    X = mx.nd.array(rng.rand(32, 64).astype(np.float32))
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch(data=[X], label=None)
+    val.forward(batch, is_train=False)
+    out1 = val.get_outputs()[0].asnumpy()
+
+    # perturb the shared params through the train module; val must see it
+    p, a = train.get_params()
+    p2 = {k: v * 0 for k, v in p.items()}
+    train.init_params(arg_params=p2, aux_params=a, force_init=True)
+    val.forward(batch, is_train=False)
+    out2 = val.get_outputs()[0].asnumpy()
+    assert not np.allclose(out1, out2)
+    np.testing.assert_allclose(out2, np.full_like(out2, 1.0 / 10), atol=1e-6)
+
+
+def test_fused_fit_and_predict():
+    """End-to-end Module.fit on the 8-device mesh learns; predict agrees
+    with score."""
+    net = _mlp_net()
+    rng = np.random.RandomState(0)
+    n, nclass = 256, 4
+    y = rng.randint(0, nclass, n).astype(np.float32)
+    centers = rng.randn(nclass, 64).astype(np.float32) * 2
+    X = centers[y.astype(int)] + 0.3 * rng.randn(n, 64).astype(np.float32)
+
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(net, context=ctxs)
+    train = NDArrayIter(X, y, batch_size=32, shuffle=False)
+    mod.fit(train, num_epoch=6,
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc",
+            initializer=mx.initializer.Xavier())
+    assert isinstance(mod._exec_group, MeshExecutorGroup)
+
+    train.reset()
+    score = mod.score(train, "acc")
+    acc = dict(score)["accuracy"] if isinstance(score, list) else score
+    assert acc > 0.9, acc
+
+    train.reset()
+    preds = mod.predict(train).asnumpy()
+    assert preds.shape == (n, 10)
+    assert (preds.argmax(axis=1) == y).mean() > 0.9
