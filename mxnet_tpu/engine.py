@@ -54,11 +54,22 @@ class Engine:
             self._native = None
         self._q = None
         if self._native is None:
-            self._q = queue.Queue()
+            # the fallback has no per-var hazard tracking, so correctness
+            # requires ONE worker: FIFO push order then serializes all
+            # mutations (threaded_engine.h ThreadedVar semantics degrade to
+            # a total order). MXNET_CPU_WORKER_NTHREADS>1 only takes effect
+            # on the native engine.
             if num_workers is None:
                 num_workers = int(os.environ.get(
                     "MXNET_CPU_WORKER_NTHREADS", 1))
-            for _ in range(0 if _NAIVE else max(1, num_workers)):
+            if num_workers > 1:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "python fallback engine runs a single worker to keep "
+                    "var-hazard ordering; MXNET_CPU_WORKER_NTHREADS=%d "
+                    "needs the native engine", num_workers)
+            self._q = queue.Queue()
+            if not _NAIVE:
                 t = threading.Thread(target=self._worker, daemon=True)
                 t.start()
 

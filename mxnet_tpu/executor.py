@@ -278,31 +278,55 @@ class Executor:
 
     # ------------------------------------------------------------------
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
-        """Return a new executor bound to resized arrays (executor.py:287)."""
+        """Return a new executor bound to resized arrays (executor.py:287).
+
+        Matches the reference's flag semantics: an arg whose shape changes
+        without being named in kwargs requires ``partial_shaping``; growing
+        an array beyond its current element count requires
+        ``allow_up_sizing`` (same-or-smaller reshapes share memory)."""
         from . import ndarray as nd
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
-        new_args = {}
+        if arg_shapes is None:
+            raise MXNetError("Insufficient argument shapes provided.")
+
+        def _resize(name, new_shape, arr, specified):
+            new_shape = tuple(new_shape)
+            if tuple(arr.shape) == new_shape:
+                return arr
+            if not (partial_shaping or specified):
+                raise MXNetError(
+                    "Shape of unspecified array %s changed. This can cause "
+                    "the new executor to not share parameters with the old "
+                    "one. Set partial_shaping=True if intended." % name)
+            if int(onp.prod(new_shape)) > arr.size:
+                if not allow_up_sizing:
+                    raise MXNetError(
+                        "New shape of %s larger than original; set "
+                        "allow_up_sizing=True to allocate a new array."
+                        % name)
+                return nd.empty(new_shape, ctx=arr.context, dtype=arr.dtype)
+            if int(onp.prod(new_shape)) == arr.size:
+                return arr.reshape(new_shape)
+            # shrink: the reference keeps a prefix view of the old buffer
+            # (executor.py:287 arr.reshape); values are preserved here via a
+            # prefix copy (jax arrays are immutable, so no aliased view)
+            prefix = arr._read().ravel()[:int(onp.prod(new_shape))]
+            return nd.NDArray(prefix.reshape(new_shape), ctx=arr.context)
+
+        new_args, grads = {}, None
+        if any(g is not None for g in self.grad_arrays):
+            grads = {}
         for name, new_shape, arr in zip(self.arg_names, arg_shapes,
                                         self.arg_arrays):
-            if tuple(new_shape) == tuple(arr.shape):
-                new_args[name] = arr
-            else:
-                new_args[name] = nd.zeros(new_shape, ctx=arr.context,
-                                          dtype=arr.dtype)
+            new_args[name] = _resize(name, new_shape, arr, name in kwargs)
+            g = self.grad_dict.get(name)
+            if g is not None:
+                grads[name] = _resize("grad of " + name, new_shape, g,
+                                      name in kwargs)
         new_aux = {}
         for name, new_shape, arr in zip(self.aux_names, aux_shapes,
                                         self.aux_arrays):
-            new_aux[name] = arr if tuple(new_shape) == tuple(arr.shape) else \
-                nd.zeros(new_shape, ctx=arr.context, dtype=arr.dtype)
-        grads = None
-        if any(g is not None for g in self.grad_arrays):
-            grads = {}
-            for name, new_shape in zip(self.arg_names, arg_shapes):
-                g = self.grad_dict.get(name)
-                if g is None:
-                    continue
-                grads[name] = g if tuple(new_shape) == tuple(g.shape) else \
-                    nd.zeros(new_shape, ctx=g.context, dtype=g.dtype)
+            new_aux[name] = _resize(name, new_shape, arr, True)
         return Executor(self._symbol, self._ctx, new_args, grads,
                         self._grad_req, new_aux)
 

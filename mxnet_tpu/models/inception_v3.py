@@ -1,4 +1,12 @@
-"""Inception-v3 (example/image-classification/symbols/inception-v3.py)."""
+"""Inception-v3 (example/image-classification/symbols/inception-v3.py).
+
+Provenance: this file is DERIVED from the reference's model-zoo symbol
+script — the layer wiring, filter counts, and layer names are transcribed
+so that checkpoints and per-layer comparisons line up 1:1 with the
+reference architecture. Model-zoo topology files are the one place where
+such derivation is intentional; all execution machinery underneath
+(symbol composition, executor, ops) is original TPU-native code.
+"""
 from .. import symbol as sym
 
 

@@ -2,6 +2,12 @@
 
 Pre-activation residual units (BN→ReLU→Conv), bottleneck for depth>=50.
 This is the headline benchmark network (BASELINE.md ResNet-50).
+
+Provenance: the unit structure, filter schedules, and layer names are
+partially derived from the reference's model-zoo symbol script so that
+checkpoints and per-layer comparisons line up 1:1 with the reference
+architecture. Model-zoo topology files are the one place where such
+derivation is intentional.
 """
 from .. import symbol as sym
 

@@ -97,6 +97,7 @@ class MeshExecutorGroup(object):
             # shared_module semantics (executor_group.py:560-585): share the
             # parameter/grad/aux buffers with the parent module — trivially
             # memory-shared here since params are name-keyed device dicts
+            shared_group._shared_out = True  # parent must not rebind away
             for n in param_names:
                 src = shared_group._param_dict[n]
                 assert tuple(src.shape) == tuple(shape_of[n]), n

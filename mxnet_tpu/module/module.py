@@ -65,9 +65,11 @@ class Module(BaseModule):
 
         self._optimizer = None
         self._kvstore = None
+        self._kvstore_arg = None
         self._update_on_kvstore = None
         self._updater = None
         self._preload_opt_states = None
+        self._shared_from_fused = False
 
         self._exec_group = None
         self._data_shapes = None
@@ -209,6 +211,7 @@ class Module(BaseModule):
 
         shared_is_fused = shared_group is not None and \
             getattr(shared_group, "fused", False)
+        self._shared_from_fused = shared_is_fused
         if self._fused_eligible(shared_group, inputs_need_grad, grad_req):
             self._exec_group = MeshExecutorGroup(
                 self._symbol, self._context, self._work_load_list,
@@ -292,18 +295,55 @@ class Module(BaseModule):
                 self._data_shapes[0][1][0] % len(self._context):
             # new batch doesn't divide the mesh: fall back to the classic
             # sliced group, keeping parameters
-            if self._params_dirty:
-                self._sync_params_from_devices()
-            self._exec_group = DataParallelExecutorGroup(
-                self._symbol, self._context, self._work_load_list,
-                self._data_shapes, self._label_shapes, self._param_names,
-                self.for_training, self.inputs_need_grad, None, self.logger,
-                self._fixed_param_names, "write")
+            self._fallback_to_classic("reshape to a batch size that does "
+                                      "not divide the device mesh")
         else:
             self._exec_group.bind_exec(self._data_shapes, self._label_shapes,
                                        reshape=True)
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    def _fallback_to_classic(self, reason):
+        """Swap the fused mesh group for the classic per-executor group,
+        keeping parameters and re-wiring the optimizer for per-device
+        update blocks."""
+        from ..base import MXNetError
+        if getattr(self._exec_group, "_shared_out", False) or \
+                getattr(self, "_shared_from_fused", False):
+            raise MXNetError(
+                "cannot fall back from the fused mesh group (%s) while "
+                "parameters are shared with another module; bind all "
+                "modules with MXNET_MODULE_FUSED=0 instead" % reason)
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        if self._compute_dtype is not None:
+            self.logger.warning(
+                "%s: falling back to per-executor groups; compute_dtype=%s "
+                "only applies on the fused path, execution continues in "
+                "float32", reason, self._compute_dtype)
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list,
+            self._data_shapes, self._label_shapes, self._param_names,
+            self.for_training, self.inputs_need_grad, None, self.logger,
+            self._fixed_param_names, "write")
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+        if self.optimizer_initialized:
+            # per-param update keys change from 1 block to N; re-wire the
+            # optimizer (momentum state restarts) and fix idx2name so
+            # lr_mult/wd_mult attribute lookups keep resolving
+            self.logger.warning(
+                "%s: optimizer re-initialized for per-executor update "
+                "blocks; optimizer state was reset", reason)
+            n_blocks = len(self._context)
+            if not self._update_on_kvstore and self._optimizer is not None:
+                self._optimizer.idx2name = {
+                    i * n_blocks + k: n
+                    for i, n in enumerate(self._param_names)
+                    for k in range(n_blocks)}
+            self.optimizer_initialized = False
+            self.init_optimizer(self._kvstore_arg, self._optimizer,
+                                force_init=True)
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -367,6 +407,7 @@ class Module(BaseModule):
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer
+        self._kvstore_arg = shared_module._kvstore_arg
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
@@ -438,23 +479,5 @@ class Module(BaseModule):
         per-executor group where the tapped interpreter runs."""
         assert self.binded
         if getattr(self._exec_group, "fused", False):
-            if self._params_dirty:
-                self._sync_params_from_devices()
-            self._exec_group = DataParallelExecutorGroup(
-                self._symbol, self._context, self._work_load_list,
-                self._data_shapes, self._label_shapes, self._param_names,
-                self.for_training, self.inputs_need_grad, None, self.logger,
-                self._fixed_param_names, "write")
-            if self.params_initialized:
-                self._exec_group.set_params(self._arg_params,
-                                            self._aux_params)
-            if self.optimizer_initialized:
-                # per-param update keys change from 1 block to N blocks;
-                # rebuild the optimizer wiring (momentum state restarts)
-                self.logger.warning(
-                    "install_monitor re-bound the module onto per-executor "
-                    "groups; optimizer state was reset")
-                self.optimizer_initialized = False
-                self.init_optimizer(self._kvstore_arg, self._optimizer,
-                                    force_init=True)
+            self._fallback_to_classic("install_monitor needs per-op taps")
         self._exec_group.install_monitor(mon)

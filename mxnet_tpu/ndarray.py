@@ -510,6 +510,15 @@ def _init_ndarray_module():
 # creation
 # ---------------------------------------------------------------------------
 def empty(shape, ctx=None, dtype=_DEFAULT_DTYPE):
+    """Allocate an NDArray without defined contents (mx.nd.empty).
+
+    Contract note: XLA's functional buffer model has no "uninitialized
+    allocation" — every device buffer is produced by a computation, and
+    jnp.empty is itself zeros. The zero-fill executes on device at HBM
+    bandwidth and typically fuses away when the buffer is first written,
+    so unlike the reference (ndarray.cc empty alloc) there is no separate
+    fill pass to save.
+    """
     return zeros(shape, ctx=ctx, dtype=dtype)
 
 

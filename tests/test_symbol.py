@@ -126,3 +126,40 @@ def test_name_manager_uniqueness():
     a = sym.FullyConnected(sym.Variable("d1"), num_hidden=2)
     b = sym.FullyConnected(sym.Variable("d2"), num_hidden=2)
     assert a.name != b.name
+
+
+def test_executor_reshape_flags():
+    """Reference executor.py:287 reshape semantics: partial_shaping and
+    allow_up_sizing gate which shape changes are permitted."""
+    import numpy as np
+    import pytest
+    from mxnet_tpu.base import MXNetError
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(8, 16))
+
+    # batch-size change, data named in kwargs: shares weights
+    exe2 = exe.reshape(data=(4, 16))
+    assert exe2.arg_dict["data"].shape == (4, 16)
+    assert exe2.arg_dict["fc_weight"] is exe.arg_dict["fc_weight"]
+
+    # up-sizing requires allow_up_sizing
+    with pytest.raises(MXNetError):
+        exe.reshape(data=(16, 16))
+    exe3 = exe.reshape(data=(16, 16), allow_up_sizing=True)
+    assert exe3.arg_dict["data"].shape == (16, 16)
+
+    # changing an unspecified array's shape requires partial_shaping
+    net2 = sym.FullyConnected(data, num_hidden=4, name="fc",
+                              no_bias=False)
+    exe4 = net2.simple_bind(mx.cpu(), data=(8, 16))
+    with pytest.raises(MXNetError):
+        exe4.reshape(data=(8, 32))  # fc_weight (4,32) != (4,16), unspecified
+    exe5 = exe4.reshape(data=(8, 32), partial_shaping=True,
+                        allow_up_sizing=True)
+    assert exe5.arg_dict["fc_weight"].shape == (4, 32)
+
+    exe2.forward(is_train=False,
+                 data=np.zeros((4, 16), np.float32))
+    assert exe2.outputs[0].shape == (4, 4)
