@@ -26,8 +26,11 @@ int main() {
                               {{"act_type", "relu"}}, "relu1");
   Symbol fc2 = Symbol::Create("FullyConnected", {{"data", &act}},
                               {{"num_hidden", "2"}}, "fc2");
+  // normalization=batch: grads averaged over the batch so a fixed lr is
+  // batch-size independent (src/operator/softmax_output-inl.h semantics)
   Symbol net = Symbol::Create("SoftmaxOutput",
-                              {{"data", &fc2}, {"label", &label}}, {}, "sm");
+                              {{"data", &fc2}, {"label", &label}},
+                              {{"normalization", "batch"}}, "sm");
 
   // args in list_arguments order: x, fc1_w, fc1_b, fc2_w, fc2_b, label
   std::vector<std::string> arg_names = net.ListArguments();

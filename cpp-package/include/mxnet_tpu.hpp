@@ -223,6 +223,131 @@ class Executor {
   ExecutorHandle h_;
 };
 
+// key-value store over the C ABI (reference cpp-package kvstore.h)
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    Check(MXKVStoreCreate(type.c_str(), &h_));
+  }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+  ~KVStore() {
+    if (h_) MXKVStoreFree(h_);
+  }
+  void Init(int key, const NDArray& val) {
+    NDArrayHandle vh = val.handle();
+    Check(MXKVStoreInit(h_, 1, &key, &vh));
+  }
+  void Push(int key, const NDArray& val, int priority = 0) {
+    NDArrayHandle vh = val.handle();
+    Check(MXKVStorePush(h_, 1, &key, &vh, priority));
+  }
+  void Pull(int key, NDArray* out, int priority = 0) {
+    NDArrayHandle oh = out->handle();
+    Check(MXKVStorePull(h_, 1, &key, &oh, priority));
+  }
+  void SetUpdater(MXKVStoreUpdater* updater, void* handle) {
+    Check(MXKVStoreSetUpdater(h_, updater, handle));
+  }
+  int Rank() const {
+    int r;
+    Check(MXKVStoreGetRank(h_, &r));
+    return r;
+  }
+  int NumWorkers() const {
+    int n;
+    Check(MXKVStoreGetGroupSize(h_, &n));
+    return n;
+  }
+  std::string Type() const {
+    const char* t;
+    Check(MXKVStoreGetType(h_, &t));
+    return t;
+  }
+  void Barrier() { Check(MXKVStoreBarrier(h_)); }
+
+ private:
+  KVStoreHandle h_;
+};
+
+// data iterator over the C ABI (reference cpp-package io.h MXDataIter)
+class DataIter {
+ public:
+  DataIter(const std::string& name,
+           const std::map<std::string, std::string>& params) {
+    mx_uint n;
+    DataIterCreator* creators;
+    Check(MXListDataIters(&n, &creators));
+    DataIterCreator found = nullptr;
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *nm, *desc;
+      mx_uint na;
+      const char **an, **at, **ad;
+      Check(MXDataIterGetIterInfo(creators[i], &nm, &desc, &na, &an, &at,
+                                  &ad));
+      if (name == nm) found = creators[i];
+    }
+    if (!found) throw std::runtime_error("no such iterator: " + name);
+    std::vector<const char*> ks, vs;
+    for (auto& kv : params) {
+      ks.push_back(kv.first.c_str());
+      vs.push_back(kv.second.c_str());
+    }
+    Check(MXDataIterCreateIter(found, static_cast<mx_uint>(ks.size()),
+                               ks.data(), vs.data(), &h_));
+  }
+  DataIter(const DataIter&) = delete;
+  DataIter& operator=(const DataIter&) = delete;
+  ~DataIter() {
+    if (h_) MXDataIterFree(h_);
+  }
+  bool Next() {
+    int has;
+    Check(MXDataIterNext(h_, &has));
+    return has != 0;
+  }
+  void BeforeFirst() { Check(MXDataIterBeforeFirst(h_)); }
+  NDArray GetData() {
+    NDArrayHandle d;
+    Check(MXDataIterGetData(h_, &d));
+    return NDArray(d);
+  }
+  NDArray GetLabel() {
+    NDArrayHandle d;
+    Check(MXDataIterGetLabel(h_, &d));
+    return NDArray(d);
+  }
+  int GetPadNum() {
+    int pad;
+    Check(MXDataIterGetPadNum(h_, &pad));
+    return pad;
+  }
+
+ private:
+  DataIterHandle h_;
+};
+
+// SGD over the fused update ops (reference cpp-package optimizer.h; the
+// update math itself is the framework's registered optimizer op, so the
+// C++ layer stays a thin dispatcher)
+class Optimizer {
+ public:
+  explicit Optimizer(const std::string& type = "sgd", float lr = 0.01f,
+                     float wd = 0.0f)
+      : op_(type == "sgd" ? "sgd_update" : type) {
+    op_.SetParam("lr", std::to_string(lr));
+    op_.SetParam("wd", std::to_string(wd));
+  }
+  // weight <- update(weight, grad)
+  void Update(NDArray* weight, const NDArray& grad) {
+    NDArrayHandle w = weight->handle();
+    op_.InvokeInto({w, grad.handle()}, {w});
+  }
+
+ private:
+  Op op_;
+};
+
 }  // namespace cpp
 }  // namespace mxnet_tpu
 

@@ -28,6 +28,65 @@ typedef void *AtomicSymbolCreator;
 typedef void *SymbolHandle;
 typedef void *ExecutorHandle;
 typedef void *PredictorHandle;
+typedef void *DataIterCreator;
+typedef void *DataIterHandle;
+typedef void *KVStoreHandle;
+typedef void *RecordIOHandle;
+typedef void *RtcHandle;
+typedef void *NDListHandle;
+
+/*! \brief callback fired once per op output during monitored executor runs
+ *  (reference: include/mxnet/c_api.h ExecutorMonitorCallback). */
+typedef void (*ExecutorMonitorCallback)(const char *name, NDArrayHandle arr,
+                                        void *callback_handle);
+/*! \brief aggregation callback applied at each push (reference
+ *  MXKVStoreUpdater, c_api.h:1264). */
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void *handle);
+/*! \brief server-side command controller (reference MXKVStoreServerController). */
+typedef void(MXKVStoreServerController)(int head, const char *body,
+                                        void *controller_handle);
+
+/* ------------------------------------------------- custom-op callback ABI
+ * Mirrors the reference's C custom-op protocol (c_api.h:110-145): the
+ * client's CustomOpPropCreator fills an MXCallbackList whose slots are
+ * indexed by the enums below. */
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+
+enum CustomOpCallbacks { kCustomOpDelete, kCustomOpForward, kCustomOpBackward };
+
+enum CustomOpPropCallbacks {
+  kCustomOpPropDelete,
+  kCustomOpPropListArguments,
+  kCustomOpPropListOutputs,
+  kCustomOpPropListAuxiliaryStates,
+  kCustomOpPropInferShape,
+  kCustomOpPropDeclareBackwardDependency,
+  kCustomOpPropCreateOperator,
+  kCustomOpPropInferType
+};
+
+typedef int (*CustomOpFBFunc)(int size, void **ptrs, int *tags,
+                              const int *reqs, const int is_train,
+                              void *state);
+typedef int (*CustomOpDelFunc)(void *state);
+typedef int (*CustomOpListFunc)(char ***args, void *state);
+typedef int (*CustomOpInferShapeFunc)(int num_input, int *ndims,
+                                      unsigned **shapes, void *state);
+typedef int (*CustomOpInferTypeFunc)(int num_input, int *types, void *state);
+typedef int (*CustomOpBwdDepFunc)(const int *out_grad, const int *in_data,
+                                  const int *out_data, int *num_deps,
+                                  int **rdeps, void *state);
+typedef int (*CustomOpCreateFunc)(const char *ctx, int num_inputs,
+                                  unsigned **shapes, int *ndims, int *dtypes,
+                                  struct MXCallbackList *ret, void *state);
+typedef int (*CustomOpPropCreator)(const char *op_type, const int num_kwargs,
+                                   const char **keys, const char **values,
+                                   struct MXCallbackList *ret);
 
 /*! \brief last error message from the library (thread-local). */
 const char *MXGetLastError();
@@ -69,6 +128,24 @@ int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
 int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                   NDArrayHandle **out_arr, mx_uint *out_name_size,
                   const char ***out_names);
+/*! \brief serialize one array (shape+dtype+data) to an opaque blob; the
+ *  returned buffer lives until the handle is freed (c_api.h:385). */
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+/*! \brief host pointer to the array contents. The buffer is a host mirror
+ *  synced at call time (device arrays are XLA buffers, there is no stable
+ *  raw device pointer); it stays valid until the handle is freed or the
+ *  next MXNDArrayGetData on the same handle. */
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+
+/* ---------------------------------------------------------------- autograd */
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array, NDArrayHandle *grad_handles);
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles);
 
 /* ------------------------------------------------------- operator invoke */
 /*! \brief op handle by name (MXGetFunction + AtomicSymbolCreator merged:
@@ -78,6 +155,27 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle *inputs, int *num_outputs,
                        NDArrayHandle **outputs, int num_params,
                        const char **param_keys, const char **param_vals);
+/*! \brief legacy NDArray-function registry view over the op registry
+ *  (reference c_api.cc:366-445). Handles are interned op names. The legacy
+ *  calling convention maps as: use_vars = op inputs, scalars = none (all
+ *  params are string kwargs here), mutate_vars = op outputs. */
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions, const char **return_type);
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask);
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars);
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals);
+/*! \brief register a C custom op (reference c_api.h:1493). The prop creator
+ *  and every callback it returns are invoked from Python via ctypes
+ *  trampolines; handles passed to CustomOpFBFunc are NDArrayHandles. */
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator);
 
 /* ------------------------------------------------------------------ symbol */
 int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
@@ -97,6 +195,70 @@ int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
                         const char ***out_str_array);
 int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
                                 const char ***out_str_array);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value);
+/*! \brief recursive attr dict, flattened as k,v,k,v (out_size = #pairs). */
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out);
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out);
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out);
+/*! \brief symbolic gradient graph — unimplemented in the reference too
+ *  (c_api_symbolic.cc:545 LOG(FATAL)); gradients come from XLA autodiff at
+ *  bind time here. Always returns -1. */
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
+/*! \brief shape inference. Args keyed by name (keys) or positional
+ *  (keys=NULL); CSR-encoded shapes in via arg_ind_ptr/arg_shape_data;
+ *  per-array shapes out via TLS-backed ndim/data arrays. */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete);
+/*! \brief op registry reflection (AtomicSymbolCreator = interned op name). */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type);
 
 /* ---------------------------------------------------------------- executor */
 /*! \brief bind symbol + arrays into an executor (MXExecutorBindEX subset:
@@ -113,6 +275,103 @@ int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
 int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
                       NDArrayHandle **out);
 int MXExecutorFree(ExecutorHandle handle);
+/*! \brief bind with per-argument device-group placement maps
+ *  (reference c_api.h MXExecutorBindX/EX; group2ctx = map_keys→devices). */
+int MXExecutorBindX(SymbolHandle symbol, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+int MXExecutorBindEX(SymbolHandle symbol, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out);
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
+
+/* -------------------------------------------------------------- data iters */
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+int MXDataIterCreateIter(DataIterCreator handle, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* ----------------------------------------------------------------- kvstore */
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit);
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int *number, const int timeout_sec);
+
+/* ---------------------------------------------------------------- recordio */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/*! \brief read next record; *buf=NULL, *size=0 at end of file. Buffer valid
+ *  until the next read on the same handle. */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+
+/* --------------------------------------------------------------------- rtc */
+/*! \brief runtime-compiled kernels. The reference compiles CUDA-C via NVRTC;
+ *  here the kernel source is a Pallas/JAX python body compiled by XLA
+ *  (mxnet_tpu/rtc.py). Grid/block dims are accepted for API parity and
+ *  ignored — XLA owns the schedule. */
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out);
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs, mx_uint gridDimX,
+              mx_uint gridDimY, mx_uint gridDimZ, mx_uint blockDimX,
+              mx_uint blockDimY, mx_uint blockDimZ);
+int MXRtcFree(RtcHandle handle);
 
 /* ----------------------------------------------------------- predict API */
 /*! \brief standalone prediction (reference c_predict_api.h). param_bytes is
@@ -130,6 +389,24 @@ int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
 int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
                     mx_uint size);
 int MXPredFree(PredictorHandle handle);
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out);
+/*! \brief whole-graph-jit note: the graph executes as ONE fused XLA program,
+ *  so partial forward runs the full program on the first step and reports
+ *  step_left=0 after (reference c_predict_api.h:151 runs op-by-op). */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+int MXNDListFree(NDListHandle handle);
 
 #ifdef __cplusplus
 }

@@ -124,8 +124,13 @@ class KVStore(object):
     def _set_updater(self, updater):
         self._updater = updater
 
-    def _send_command_to_servers(self, head, body):  # compat no-op
-        pass
+    def _send_command_to_servers(self, head, body):
+        """With no server processes, commands loop back to a controller
+        registered in-process via MXKVStoreRunServer (reference
+        kvstore_dist.h SendCommandToServers -> server controller)."""
+        ctrl = getattr(self, "_server_controller", None)
+        if ctrl is not None:
+            ctrl(int(head), str(body))
 
     # -------------------------------------------------------- dist compat
     def barrier(self):

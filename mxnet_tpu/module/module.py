@@ -297,11 +297,13 @@ class Module(BaseModule):
             # sliced group, keeping parameters
             self._fallback_to_classic("reshape to a batch size that does "
                                       "not divide the device mesh")
+            # _fallback_to_classic already re-set the parameters
         else:
             self._exec_group.bind_exec(self._data_shapes, self._label_shapes,
                                        reshape=True)
-        if self.params_initialized:
-            self._exec_group.set_params(self._arg_params, self._aux_params)
+            if self.params_initialized:
+                self._exec_group.set_params(self._arg_params,
+                                            self._aux_params)
 
     def _fallback_to_classic(self, reason):
         """Swap the fused mesh group for the classic per-executor group,
@@ -335,15 +337,23 @@ class Module(BaseModule):
             self.logger.warning(
                 "%s: optimizer re-initialized for per-executor update "
                 "blocks; optimizer state was reset", reason)
-            n_blocks = len(self._context)
-            if not self._update_on_kvstore and self._optimizer is not None:
-                self._optimizer.idx2name = {
-                    i * n_blocks + k: n
-                    for i, n in enumerate(self._param_names)
-                    for k in range(n_blocks)}
             self.optimizer_initialized = False
             self.init_optimizer(self._kvstore_arg, self._optimizer,
                                 force_init=True)
+            # re-key idx2name from the FINAL update placement decision
+            # (init_optimizer may flip update_on_kvstore now that the
+            # block count changed): kvstore updates use plain param
+            # indices, local updates stripe index*n_blocks+block
+            if self._optimizer is not None:
+                if self._update_on_kvstore:
+                    idx2name = dict(enumerate(self._param_names))
+                else:
+                    n_blocks = self._num_update_blocks
+                    idx2name = {
+                        i * n_blocks + k: n
+                        for i, n in enumerate(self._param_names)
+                        for k in range(n_blocks)}
+                self._optimizer.idx2name = idx2name
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

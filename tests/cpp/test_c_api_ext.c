@@ -1,0 +1,681 @@
+/* Extended C API coverage: the families added beyond the round-1 core —
+ * raw-bytes NDArray, autograd, legacy Func registry, symbol reflection +
+ * shape/type inference, executor print/monitor/BindX, DataIter-over-C,
+ * KVStore (incl. C updater + server-command loopback), RecordIO, Rtc, the
+ * C custom-op protocol, and the predict partial/NDList API.
+ * Prints CAPI_EXT_TEST_PASS on success. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxnet_tpu/c_api.h>
+
+#define CHECK(call)                                                     \
+  do {                                                                  \
+    if ((call) != 0) {                                                  \
+      fprintf(stderr, "FAIL %s:%d %s: %s\n", __FILE__, __LINE__, #call, \
+              MXGetLastError());                                        \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+#define ASSERT(cond)                                                   \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "ASSERT %s:%d %s\n", __FILE__, __LINE__, #cond); \
+      exit(1);                                                         \
+    }                                                                  \
+  } while (0)
+
+/* ------------------------------------------------------ monitor callback */
+static int g_monitor_calls = 0;
+static void monitor_cb(const char *name, NDArrayHandle arr, void *ctx) {
+  (void)name;
+  ASSERT(ctx == (void *)0x1234);
+  g_monitor_calls++;
+  MXNDArrayFree(arr); /* monitor receives a strong ref */
+}
+
+/* ------------------------------------------------------- kvstore updater */
+static int g_updater_calls = 0;
+static void updater_cb(int key, NDArrayHandle recv, NDArrayHandle local,
+                       void *handle) {
+  /* local += recv (the canonical aggregation updater) */
+  mx_uint ndim;
+  const mx_uint *shape;
+  (void)key;
+  ASSERT(handle == (void *)0x77);
+  CHECK(MXNDArrayGetShape(local, &ndim, &shape));
+  {
+    mx_uint total = 1, i;
+    float lbuf[64], rbuf[64];
+    for (i = 0; i < ndim; ++i) total *= shape[i];
+    ASSERT(total <= 64);
+    CHECK(MXNDArraySyncCopyToCPU(local, lbuf, total));
+    CHECK(MXNDArraySyncCopyToCPU(recv, rbuf, total));
+    for (i = 0; i < total; ++i) lbuf[i] += rbuf[i];
+    CHECK(MXNDArraySyncCopyFromCPU(local, lbuf, total));
+  }
+  g_updater_calls++;
+}
+
+/* ------------------------------------------------ kvstore server command */
+static int g_cmd_head = -1;
+static char g_cmd_body[64];
+static void server_controller(int head, const char *body, void *handle) {
+  ASSERT(handle == (void *)0x55);
+  g_cmd_head = head;
+  strncpy(g_cmd_body, body, sizeof(g_cmd_body) - 1);
+}
+
+/* --------------------------------------------------- C custom op (csqr) */
+static int csqr_list_arguments(char ***args, void *state) {
+  static char *names[] = {(char *)"data", NULL};
+  (void)state;
+  *args = names;
+  return 1;
+}
+
+static int csqr_list_outputs(char ***args, void *state) {
+  static char *names[] = {(char *)"output", NULL};
+  (void)state;
+  *args = names;
+  return 1;
+}
+
+static unsigned g_csqr_shape[8];
+static int csqr_infer_shape(int num_input, int *ndims, unsigned **shapes,
+                            void *state) {
+  int j;
+  (void)state;
+  ASSERT(num_input == 2); /* 1 in + 1 out */
+  for (j = 0; j < ndims[0]; ++j) g_csqr_shape[j] = shapes[0][j];
+  ndims[1] = ndims[0];
+  shapes[1] = g_csqr_shape;
+  return 1;
+}
+
+static int csqr_forward(int size, void **ptrs, int *tags, const int *reqs,
+                        const int is_train, void *state) {
+  NDArrayHandle in = NULL, out = NULL;
+  int i;
+  (void)reqs;
+  (void)is_train;
+  (void)state;
+  for (i = 0; i < size; ++i) {
+    if (tags[i] == 0) in = ptrs[i];
+    if (tags[i] == 1) out = ptrs[i];
+  }
+  ASSERT(in != NULL && out != NULL);
+  {
+    mx_uint ndim;
+    const mx_uint *shape;
+    mx_uint total = 1, k;
+    float buf[64];
+    CHECK(MXNDArrayGetShape(in, &ndim, &shape));
+    for (k = 0; k < ndim; ++k) total *= shape[k];
+    ASSERT(total <= 64);
+    CHECK(MXNDArraySyncCopyToCPU(in, buf, total));
+    for (k = 0; k < total; ++k) buf[k] *= buf[k];
+    CHECK(MXNDArraySyncCopyFromCPU(out, buf, total));
+  }
+  return 1;
+}
+
+static int csqr_create_operator(const char *ctx, int num_inputs,
+                                unsigned **shapes, int *ndims, int *dtypes,
+                                struct MXCallbackList *ret, void *state) {
+  static int (*cbs[3])(void);
+  static void *ctxs[3] = {NULL, NULL, NULL};
+  (void)ctx;
+  (void)num_inputs;
+  (void)shapes;
+  (void)ndims;
+  (void)dtypes;
+  (void)state;
+  cbs[kCustomOpDelete] = NULL;
+  cbs[kCustomOpForward] = (int (*)(void))csqr_forward;
+  cbs[kCustomOpBackward] = NULL;
+  ret->num_callbacks = 3;
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 1;
+}
+
+static int csqr_creator_full(const char *op_type, const int num_kwargs,
+                             const char **keys, const char **values,
+                             struct MXCallbackList *ret) {
+  static int (*cbs[7])(void);
+  static void *ctxs[7] = {NULL, NULL, NULL, NULL, NULL, NULL, NULL};
+  (void)op_type;
+  (void)num_kwargs;
+  (void)keys;
+  (void)values;
+  cbs[kCustomOpPropDelete] = NULL;
+  cbs[kCustomOpPropListArguments] = (int (*)(void))csqr_list_arguments;
+  cbs[kCustomOpPropListOutputs] = (int (*)(void))csqr_list_outputs;
+  cbs[kCustomOpPropListAuxiliaryStates] = NULL;
+  cbs[kCustomOpPropInferShape] = (int (*)(void))csqr_infer_shape;
+  cbs[kCustomOpPropDeclareBackwardDependency] = NULL;
+  cbs[kCustomOpPropCreateOperator] = (int (*)(void))csqr_create_operator;
+  ret->num_callbacks = 7;
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 1;
+}
+
+int main(void) {
+  /* ---------------------------------------------- raw bytes + GetData */
+  mx_uint shape[2] = {2, 2};
+  NDArrayHandle a;
+  float av[4] = {1, 2, 3, 4};
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a));
+  CHECK(MXNDArraySyncCopyFromCPU(a, av, 4));
+
+  size_t raw_size;
+  const char *raw_buf;
+  CHECK(MXNDArraySaveRawBytes(a, &raw_size, &raw_buf));
+  ASSERT(raw_size > 16);
+  {
+    NDArrayHandle a2;
+    float back[4];
+    CHECK(MXNDArrayLoadFromRawBytes(raw_buf, raw_size, &a2));
+    CHECK(MXNDArraySyncCopyToCPU(a2, back, 4));
+    ASSERT(back[0] == 1.0f && back[3] == 4.0f);
+    CHECK(MXNDArrayFree(a2));
+  }
+  {
+    void *pdata;
+    CHECK(MXNDArrayGetData(a, &pdata));
+    ASSERT(((float *)pdata)[2] == 3.0f);
+  }
+
+  /* --------------------------------------------------------- autograd */
+  {
+    NDArrayHandle x, g;
+    mx_uint req = 1;
+    float xv[4] = {2, 3, 4, 5}, gv[4];
+    int prev;
+    CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &x));
+    CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &g));
+    CHECK(MXNDArraySyncCopyFromCPU(x, xv, 4));
+    CHECK(MXAutogradSetIsTraining(1, &prev));
+    CHECK(MXAutogradMarkVariables(1, &x, &req, &g));
+    {
+      FunctionHandle mul;
+      NDArrayHandle ins[2];
+      int n_out = 0;
+      NDArrayHandle *outs = NULL;
+      CHECK(MXGetFunction("elemwise_mul", &mul));
+      ins[0] = x;
+      ins[1] = x;
+      CHECK(MXImperativeInvoke((AtomicSymbolCreator)mul, 2, ins, &n_out,
+                               &outs, 0, NULL, NULL));
+      ASSERT(n_out == 1);
+      CHECK(MXAutogradComputeGradient(1, outs));
+    }
+    CHECK(MXNDArraySyncCopyToCPU(g, gv, 4));
+    ASSERT(gv[0] == 4.0f && gv[3] == 10.0f); /* d(x*x)/dx = 2x */
+    CHECK(MXAutogradSetIsTraining(prev, NULL));
+    CHECK(MXNDArrayFree(x));
+    CHECK(MXNDArrayFree(g));
+  }
+
+  /* ------------------------------------------------- func registry */
+  {
+    mx_uint n_funcs;
+    FunctionHandle *funcs;
+    FunctionHandle addf;
+    const char *fname, *fdesc, *ret_type;
+    mx_uint n_args;
+    const char **arg_names, **arg_types, **arg_descs;
+    mx_uint n_use, n_scalar, n_mut;
+    int mask;
+    CHECK(MXListFunctions(&n_funcs, &funcs));
+    ASSERT(n_funcs > 200);
+    CHECK(MXGetFunction("elemwise_add", &addf));
+    CHECK(MXFuncGetInfo(addf, &fname, &fdesc, &n_args, &arg_names,
+                        &arg_types, &arg_descs, &ret_type));
+    ASSERT(strcmp(fname, "elemwise_add") == 0);
+    ASSERT(n_args == 2);
+    CHECK(MXFuncDescribe(addf, &n_use, &n_scalar, &n_mut, &mask));
+    ASSERT(n_use == 2 && n_mut == 1);
+    {
+      NDArrayHandle b, out;
+      float bv[4] = {10, 20, 30, 40}, res[4];
+      CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &b));
+      CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &out));
+      CHECK(MXNDArraySyncCopyFromCPU(b, bv, 4));
+      {
+        NDArrayHandle use[2];
+        use[0] = a;
+        use[1] = b;
+        CHECK(MXFuncInvoke(addf, use, NULL, &out));
+      }
+      CHECK(MXNDArraySyncCopyToCPU(out, res, 4));
+      ASSERT(res[0] == 11.0f && res[3] == 44.0f);
+      CHECK(MXNDArrayFree(b));
+      CHECK(MXNDArrayFree(out));
+    }
+  }
+
+  /* ------------------------------------------- symbol reflection */
+  {
+    SymbolHandle x, y, grp, fc, out0, internals, children;
+    const char *nm;
+    int ok;
+    CHECK(MXSymbolCreateVariable("sx", &x));
+    CHECK(MXSymbolCreateVariable("sy", &y));
+    {
+      SymbolHandle pair[2];
+      pair[0] = x;
+      pair[1] = y;
+      CHECK(MXSymbolCreateGroup(2, pair, &grp));
+    }
+    {
+      mx_uint n_out;
+      const char **onames;
+      CHECK(MXSymbolListOutputs(grp, &n_out, &onames));
+      ASSERT(n_out == 2);
+    }
+    CHECK(MXSymbolGetOutput(grp, 1, &out0));
+    CHECK(MXSymbolGetName(out0, &nm, &ok));
+    ASSERT(ok == 1 && strcmp(nm, "sy") == 0);
+
+    /* attrs */
+    CHECK(MXSymbolSetAttr(x, "lr_mult", "2.0"));
+    CHECK(MXSymbolGetAttr(x, "lr_mult", &nm, &ok));
+    ASSERT(ok == 1 && strcmp(nm, "2.0") == 0);
+    {
+      mx_uint n_attr;
+      const char **attrs;
+      CHECK(MXSymbolListAttrShallow(x, &n_attr, &attrs));
+      ASSERT(n_attr >= 1);
+    }
+
+    /* atomic symbol reflection */
+    {
+      mx_uint n_creators;
+      AtomicSymbolCreator *creators;
+      CHECK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+      ASSERT(n_creators > 200);
+      CHECK(MXSymbolGetAtomicSymbolName(creators[0], &nm));
+      ASSERT(nm != NULL && strlen(nm) > 0);
+    }
+
+    /* build fc for infer shape/type + internals */
+    {
+      AtomicSymbolCreator fc_op;
+      const char *fc_keys[1] = {"num_hidden"};
+      const char *fc_vals[1] = {"4"};
+      const char *arg_keys[1] = {"data"};
+      SymbolHandle args[1];
+      CHECK(MXGetFunction("FullyConnected", (FunctionHandle *)&fc_op));
+      CHECK(MXSymbolCreateAtomicSymbol(fc_op, 1, fc_keys, fc_vals, &fc));
+      args[0] = x;
+      CHECK(MXSymbolCompose(fc, "fc_ext", 1, arg_keys, args));
+    }
+    CHECK(MXSymbolGetInternals(fc, &internals));
+    CHECK(MXSymbolGetChildren(fc, &children));
+    {
+      const char *dbg;
+      CHECK(MXSymbolPrint(fc, &dbg));
+      ASSERT(strlen(dbg) > 0);
+    }
+    {
+      /* infer shape keyed on the data arg */
+      const char *keys[1] = {"sx"};
+      mx_uint indptr[2] = {0, 2};
+      mx_uint sdata[2] = {5, 3};
+      mx_uint in_sz, out_sz, aux_sz;
+      const mx_uint *in_nd, *out_nd, *aux_nd;
+      const mx_uint **in_sh, **out_sh, **aux_sh;
+      int complete;
+      CHECK(MXSymbolInferShape(fc, 1, keys, indptr, sdata, &in_sz, &in_nd,
+                               &in_sh, &out_sz, &out_nd, &out_sh, &aux_sz,
+                               &aux_nd, &aux_sh, &complete));
+      ASSERT(complete == 1);
+      ASSERT(out_sz == 1 && out_nd[0] == 2);
+      ASSERT(out_sh[0][0] == 5 && out_sh[0][1] == 4);
+    }
+    {
+      const char *keys[1] = {"sx"};
+      int tdata[1] = {0}; /* float32 */
+      mx_uint in_sz, out_sz, aux_sz;
+      const int *in_t, *out_t, *aux_t;
+      int complete;
+      CHECK(MXSymbolInferType(fc, 1, keys, tdata, &in_sz, &in_t, &out_sz,
+                              &out_t, &aux_sz, &aux_t, &complete));
+      ASSERT(complete == 1 && out_t[0] == 0);
+    }
+    {
+      /* MXSymbolGrad matches the reference: unimplemented, returns -1 */
+      SymbolHandle gout;
+      const char *wrt[1] = {"sx"};
+      ASSERT(MXSymbolGrad(fc, 1, wrt, &gout) == -1);
+    }
+    CHECK(MXSymbolSaveToFile(fc, "/tmp/capi_ext_sym.json"));
+    {
+      SymbolHandle fc2;
+      CHECK(MXSymbolCreateFromFile("/tmp/capi_ext_sym.json", &fc2));
+      CHECK(MXSymbolFree(fc2));
+    }
+    remove("/tmp/capi_ext_sym.json");
+
+    /* -------------------------- executor BindX + print + monitor */
+    {
+      mx_uint xshape[2] = {5, 3}, wshape[2] = {4, 3}, bshape[1] = {4};
+      NDArrayHandle xin, win, bin;
+      NDArrayHandle bind_args[3];
+      mx_uint reqs[3] = {0, 0, 0};
+      ExecutorHandle exec;
+      float ones[15];
+      int i;
+      for (i = 0; i < 15; ++i) ones[i] = 1.0f;
+      CHECK(MXNDArrayCreate(xshape, 2, 1, 0, 0, &xin));
+      CHECK(MXNDArrayCreate(wshape, 2, 1, 0, 0, &win));
+      CHECK(MXNDArrayCreate(bshape, 1, 1, 0, 0, &bin));
+      CHECK(MXNDArraySyncCopyFromCPU(xin, ones, 15));
+      CHECK(MXNDArraySyncCopyFromCPU(win, ones, 12));
+      bind_args[0] = xin;
+      bind_args[1] = win;
+      bind_args[2] = bin;
+      CHECK(MXExecutorBindX(fc, 1, 0, 0, NULL, NULL, NULL, 3, bind_args,
+                            NULL, reqs, 0, NULL, &exec));
+      CHECK(MXExecutorSetMonitorCallback(exec, monitor_cb, (void *)0x1234));
+      CHECK(MXExecutorForward(exec, 0));
+      {
+        mx_uint n_outs;
+        NDArrayHandle *outs;
+        float res[20];
+        CHECK(MXExecutorOutputs(exec, &n_outs, &outs));
+        CHECK(MXNDArraySyncCopyToCPU(outs[0], res, 20));
+        ASSERT(res[0] == 3.0f); /* ones(3) . ones(3) */
+      }
+      ASSERT(g_monitor_calls > 0);
+      {
+        const char *dbg;
+        CHECK(MXExecutorPrint(exec, &dbg));
+        ASSERT(strlen(dbg) > 0);
+      }
+      CHECK(MXExecutorFree(exec));
+      CHECK(MXNDArrayFree(xin));
+      CHECK(MXNDArrayFree(win));
+      CHECK(MXNDArrayFree(bin));
+    }
+    CHECK(MXSymbolFree(grp));
+    CHECK(MXSymbolFree(fc));
+  }
+
+  /* ------------------------------------------------------ data iters */
+  {
+    mx_uint n_iters;
+    DataIterCreator *iters;
+    DataIterCreator csv = NULL;
+    mx_uint i;
+    CHECK(MXListDataIters(&n_iters, &iters));
+    ASSERT(n_iters >= 3);
+    for (i = 0; i < n_iters; ++i) {
+      const char *nm;
+      const char *desc;
+      mx_uint na;
+      const char **an, **at, **ad;
+      CHECK(MXDataIterGetIterInfo(iters[i], &nm, &desc, &na, &an, &at,
+                                  &ad));
+      if (strcmp(nm, "CSVIter") == 0) csv = iters[i];
+    }
+    ASSERT(csv != NULL);
+    {
+      FILE *f = fopen("/tmp/capi_ext.csv", "w");
+      ASSERT(f != NULL);
+      fprintf(f, "1,2,3\n4,5,6\n7,8,9\n10,11,12\n");
+      fclose(f);
+    }
+    {
+      const char *keys[3] = {"data_csv", "data_shape", "batch_size"};
+      const char *vals[3] = {"/tmp/capi_ext.csv", "(3,)", "2"};
+      DataIterHandle it;
+      int has_next, pad;
+      int batches = 0;
+      CHECK(MXDataIterCreateIter(csv, 3, keys, vals, &it));
+      CHECK(MXDataIterBeforeFirst(it));
+      for (;;) {
+        CHECK(MXDataIterNext(it, &has_next));
+        if (!has_next) break;
+        batches++;
+        {
+          NDArrayHandle data;
+          mx_uint nd2;
+          const mx_uint *shp;
+          CHECK(MXDataIterGetData(it, &data));
+          CHECK(MXNDArrayGetShape(data, &nd2, &shp));
+          ASSERT(nd2 == 2 && shp[0] == 2 && shp[1] == 3);
+          CHECK(MXNDArrayFree(data));
+        }
+        CHECK(MXDataIterGetPadNum(it, &pad));
+        ASSERT(pad == 0);
+      }
+      ASSERT(batches == 2);
+      {
+        uint64_t *idx;
+        uint64_t idx_n;
+        CHECK(MXDataIterBeforeFirst(it));
+        CHECK(MXDataIterNext(it, &has_next));
+        CHECK(MXDataIterGetIndex(it, &idx, &idx_n));
+        ASSERT(idx_n == 2);
+        {
+          NDArrayHandle lab;
+          CHECK(MXDataIterGetLabel(it, &lab));
+          if (lab != NULL) CHECK(MXNDArrayFree(lab));
+        }
+      }
+      CHECK(MXDataIterFree(it));
+      remove("/tmp/capi_ext.csv");
+    }
+  }
+
+  /* --------------------------------------------------------- kvstore */
+  {
+    KVStoreHandle kv;
+    const char *kvtype;
+    int rank, size, is_worker;
+    CHECK(MXKVStoreCreate("local", &kv));
+    CHECK(MXKVStoreGetType(kv, &kvtype));
+    ASSERT(strstr(kvtype, "local") != NULL);
+    CHECK(MXKVStoreGetRank(kv, &rank));
+    CHECK(MXKVStoreGetGroupSize(kv, &size));
+    ASSERT(rank == 0 && size == 1);
+    CHECK(MXKVStoreIsWorkerNode(&is_worker));
+    ASSERT(is_worker == 1);
+    {
+      int dead;
+      CHECK(MXKVStoreGetNumDeadNode(kv, -1, &dead, 1));
+      ASSERT(dead == 0);
+    }
+    {
+      int kkeys[1] = {3};
+      NDArrayHandle v0, v1;
+      float init[4] = {1, 1, 1, 1}, delta[4] = {2, 2, 2, 2}, res[4];
+      CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &v0));
+      CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &v1));
+      CHECK(MXNDArraySyncCopyFromCPU(v0, init, 4));
+      CHECK(MXNDArraySyncCopyFromCPU(v1, delta, 4));
+      CHECK(MXKVStoreInit(kv, 1, kkeys, &v0));
+      CHECK(MXKVStoreSetUpdater(kv, updater_cb, (void *)0x77));
+      CHECK(MXKVStorePush(kv, 1, kkeys, &v1, 0));
+      ASSERT(g_updater_calls == 1);
+      {
+        NDArrayHandle outv;
+        CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &outv));
+        CHECK(MXKVStorePull(kv, 1, kkeys, &outv, 0));
+        CHECK(MXNDArraySyncCopyToCPU(outv, res, 4));
+        ASSERT(res[0] == 3.0f); /* 1 + 2 via C updater */
+        CHECK(MXNDArrayFree(outv));
+      }
+      CHECK(MXNDArrayFree(v0));
+      CHECK(MXNDArrayFree(v1));
+    }
+    CHECK(MXKVStoreBarrier(kv));
+    CHECK(MXKVStoreSetBarrierBeforeExit(kv, 0));
+    CHECK(MXKVStoreRunServer(kv, server_controller, (void *)0x55));
+    CHECK(MXKVStoreSendCommmandToServers(kv, 9, "hello"));
+    ASSERT(g_cmd_head == 9 && strcmp(g_cmd_body, "hello") == 0);
+    CHECK(MXKVStoreFree(kv));
+    {
+      const char *env_keys[1] = {"MXNET_TPU_TEST_PSENV"};
+      const char *env_vals[1] = {"42"};
+      CHECK(MXInitPSEnv(1, env_keys, env_vals));
+    }
+  }
+
+  /* -------------------------------------------------------- recordio */
+  {
+    RecordIOHandle w, r;
+    const char *rec;
+    size_t rec_size, pos;
+    CHECK(MXRecordIOWriterCreate("/tmp/capi_ext.rec", &w));
+    CHECK(MXRecordIOWriterWriteRecord(w, "hello-record", 12));
+    CHECK(MXRecordIOWriterWriteRecord(w, "second", 6));
+    CHECK(MXRecordIOWriterTell(w, &pos));
+    ASSERT(pos > 0);
+    CHECK(MXRecordIOWriterFree(w));
+    CHECK(MXRecordIOReaderCreate("/tmp/capi_ext.rec", &r));
+    CHECK(MXRecordIOReaderReadRecord(r, &rec, &rec_size));
+    ASSERT(rec_size == 12 && memcmp(rec, "hello-record", 12) == 0);
+    CHECK(MXRecordIOReaderReadRecord(r, &rec, &rec_size));
+    ASSERT(rec_size == 6 && memcmp(rec, "second", 6) == 0);
+    CHECK(MXRecordIOReaderReadRecord(r, &rec, &rec_size));
+    ASSERT(rec == NULL && rec_size == 0); /* EOF */
+    CHECK(MXRecordIOReaderSeek(r, 0));
+    CHECK(MXRecordIOReaderReadRecord(r, &rec, &rec_size));
+    ASSERT(rec_size == 12);
+    CHECK(MXRecordIOReaderFree(r));
+    remove("/tmp/capi_ext.rec");
+  }
+
+  /* ------------------------------------------------------------- rtc */
+  {
+    NDArrayHandle xs, ys, zs;
+    float xv[4] = {1, 2, 3, 4}, yv[4] = {10, 20, 30, 40}, zv[4];
+    char *in_names[2] = {(char *)"x", (char *)"y"};
+    char *out_names[1] = {(char *)"z"};
+    NDArrayHandle ins[2], outs[1];
+    RtcHandle rtc;
+    CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &xs));
+    CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &ys));
+    CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &zs));
+    CHECK(MXNDArraySyncCopyFromCPU(xs, xv, 4));
+    CHECK(MXNDArraySyncCopyFromCPU(ys, yv, 4));
+    ins[0] = xs;
+    ins[1] = ys;
+    outs[0] = zs;
+    CHECK(MXRtcCreate((char *)"axpy", 2, 1, in_names, out_names, ins, outs,
+                      (char *)"z_ref[...] = x_ref[...] * 2.0 + y_ref[...]",
+                      &rtc));
+    CHECK(MXRtcPush(rtc, 2, 1, ins, outs, 1, 1, 1, 1, 1, 1));
+    CHECK(MXNDArraySyncCopyToCPU(zs, zv, 4));
+    ASSERT(zv[0] == 12.0f && zv[3] == 48.0f);
+    CHECK(MXRtcFree(rtc));
+    CHECK(MXNDArrayFree(xs));
+    CHECK(MXNDArrayFree(ys));
+    CHECK(MXNDArrayFree(zs));
+  }
+
+  /* ------------------------------------------------- C custom op */
+  {
+    FunctionHandle custom;
+    NDArrayHandle ins[1];
+    int n_out = 0;
+    NDArrayHandle *outs = NULL;
+    const char *pkeys[1] = {"op_type"};
+    const char *pvals[1] = {"csqr"};
+    float res[4];
+    CHECK(MXCustomOpRegister("csqr", csqr_creator_full));
+    CHECK(MXGetFunction("Custom", &custom));
+    ins[0] = a; /* [1,2,3,4] */
+    CHECK(MXImperativeInvoke((AtomicSymbolCreator)custom, 1, ins, &n_out,
+                             &outs, 1, pkeys, pvals));
+    ASSERT(n_out == 1);
+    CHECK(MXNDArraySyncCopyToCPU(outs[0], res, 4));
+    ASSERT(res[0] == 1.0f && res[1] == 4.0f && res[3] == 16.0f);
+  }
+
+  /* ----------------------------------- predict partial-out + NDList */
+  {
+    /* two-layer net; slice output at the first fc */
+    SymbolHandle xv2, fc1, fc2;
+    AtomicSymbolCreator fc_op;
+    const char *k1[1] = {"num_hidden"};
+    const char *v1[1] = {"4"};
+    const char *v2[1] = {"2"};
+    const char *ak[1] = {"data"};
+    SymbolHandle args[1];
+    const char *json;
+    CHECK(MXSymbolCreateVariable("px", &xv2));
+    CHECK(MXGetFunction("FullyConnected", (FunctionHandle *)&fc_op));
+    CHECK(MXSymbolCreateAtomicSymbol(fc_op, 1, k1, v1, &fc1));
+    args[0] = xv2;
+    CHECK(MXSymbolCompose(fc1, "pfc1", 1, ak, args));
+    CHECK(MXSymbolCreateAtomicSymbol(fc_op, 1, k1, v2, &fc2));
+    args[0] = fc1;
+    CHECK(MXSymbolCompose(fc2, "pfc2", 1, ak, args));
+    CHECK(MXSymbolSaveToJSON(fc2, &json));
+    {
+      PredictorHandle pred;
+      const char *in_keys[1] = {"px"};
+      mx_uint indptr[2] = {0, 2};
+      mx_uint in_shape[2] = {3, 5};
+      const char *out_keys[1] = {"pfc1"};
+      mx_uint *oshape, ondim;
+      int step_left;
+      CHECK(MXPredCreatePartialOut(json, NULL, 0, 1, 0, 1, in_keys, indptr,
+                                   in_shape, 1, out_keys, &pred));
+      CHECK(MXPredPartialForward(pred, 0, &step_left));
+      ASSERT(step_left == 0);
+      CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+      ASSERT(ondim == 2 && oshape[0] == 3 && oshape[1] == 4);
+      CHECK(MXPredFree(pred));
+    }
+    CHECK(MXSymbolFree(fc2));
+  }
+  {
+    /* NDList round-trips through an .nd file blob */
+    NDArrayHandle arr;
+    const char *keys[1] = {"weight"};
+    float wv[4] = {9, 8, 7, 6};
+    CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &arr));
+    CHECK(MXNDArraySyncCopyFromCPU(arr, wv, 4));
+    CHECK(MXNDArraySave("/tmp/capi_ext.nd", 1, &arr, keys));
+    {
+      FILE *f = fopen("/tmp/capi_ext.nd", "rb");
+      char blob[65536];
+      size_t blen;
+      NDListHandle ndl;
+      mx_uint len;
+      ASSERT(f != NULL);
+      blen = fread(blob, 1, sizeof(blob), f);
+      fclose(f);
+      CHECK(MXNDListCreate(blob, (int)blen, &ndl, &len));
+      ASSERT(len == 1);
+      {
+        const char *key;
+        const mx_float *data;
+        const mx_uint *shp;
+        mx_uint nd2;
+        CHECK(MXNDListGet(ndl, 0, &key, &data, &shp, &nd2));
+        ASSERT(strcmp(key, "weight") == 0);
+        ASSERT(nd2 == 2 && shp[0] == 2 && shp[1] == 2);
+        ASSERT(data[0] == 9.0f && data[3] == 6.0f);
+      }
+      CHECK(MXNDListFree(ndl));
+    }
+    CHECK(MXNDArrayFree(arr));
+    remove("/tmp/capi_ext.nd");
+  }
+
+  CHECK(MXNDArrayFree(a));
+  CHECK(MXNDArrayWaitAll());
+  CHECK(MXNotifyShutdown());
+  printf("CAPI_EXT_TEST_PASS\n");
+  return 0;
+}

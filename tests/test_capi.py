@@ -1,0 +1,77 @@
+"""Build + run the C ABI tests (tests/cpp/*.c) against libmxnet_tpu.so.
+
+The reference exercises its C API from C++ unit tests and the amalgamation
+builds; here the two C translation units drive the embedded-interpreter
+library end to end (ndarray, symbol, executor, dataiter, kvstore, recordio,
+rtc, custom-op, predict families) with no Python in the client.
+"""
+import os
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI = os.path.join(ROOT, "capi")
+BUILD = os.path.join(CAPI, "build")
+
+
+def _build_lib():
+    subprocess.run(["make", "-C", CAPI], check=True, capture_output=True)
+    return os.path.join(BUILD, "libmxnet_tpu.so")
+
+
+def _compile_and_run(src_name, expect):
+    lib = _build_lib()
+    src = os.path.join(ROOT, "tests", "cpp", src_name)
+    exe = os.path.join(BUILD, src_name.replace(".c", ""))
+    subprocess.run(
+        ["gcc", "-O1", src, "-I", os.path.join(ROOT, "include"),
+         "-o", exe, "-L", BUILD, "-lmxnet_tpu", "-Wl,-rpath," + BUILD],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    proc = subprocess.run([exe], env=env, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, (
+        "C test failed:\nstdout:%s\nstderr:%s" % (proc.stdout, proc.stderr))
+    assert expect in proc.stdout
+
+
+def test_c_api_core():
+    _compile_and_run("test_c_api.c", "CAPI_TEST_PASS")
+
+
+def test_c_api_ext():
+    _compile_and_run("test_c_api_ext.c", "CAPI_EXT_TEST_PASS")
+
+
+def _compile_and_run_cpp(src_path, expect):
+    lib = _build_lib()
+    exe = os.path.join(BUILD, os.path.basename(src_path).replace(".cpp", ""))
+    subprocess.run(
+        ["g++", "-O1", "-std=c++14", src_path,
+         "-I", os.path.join(ROOT, "include"),
+         "-I", os.path.join(ROOT, "cpp-package", "include"),
+         "-o", exe, "-L", BUILD, "-lmxnet_tpu", "-Wl,-rpath," + BUILD],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    proc = subprocess.run([exe], env=env, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, (
+        "cpp example failed:\nstdout:%s\nstderr:%s"
+        % (proc.stdout, proc.stderr))
+    assert expect in proc.stdout
+
+
+def test_cpp_package_mlp():
+    _compile_and_run_cpp(
+        os.path.join(ROOT, "cpp-package", "example", "mlp.cpp"),
+        "CPP_MLP_PASS")
+
+
+def test_cpp_package_train_csv():
+    """Generated op wrappers + DataIter + KVStore + Optimizer end to end."""
+    _compile_and_run_cpp(
+        os.path.join(ROOT, "cpp-package", "example", "train_csv.cpp"),
+        "CPP_TRAIN_CSV_PASS")
