@@ -107,12 +107,33 @@ def synth_batch(rng, n, size=32):
     return imgs, labels
 
 
+def write_det_recordio(path, imgs, labels):
+    """Pack the synthetic set as a detection RecordIO: label wire format
+    [header_width=2, object_width=5, id, x0, y0, x1, y1] per object
+    (src/io/image_det_aug_default.cc:238)."""
+    try:  # pack_img's cv2 encoder expects BGR; the npy fallback is as-is
+        import cv2  # noqa: F401
+        to_wire = lambda a: a[:, :, ::-1]  # noqa: E731
+    except ImportError:
+        to_wire = lambda a: a  # noqa: E731
+    writer = mx.recordio.MXRecordIO(path, "w")
+    for i in range(len(imgs)):
+        hwc = to_wire((imgs[i].transpose(1, 2, 0) * 255).astype(np.uint8))
+        det = np.concatenate([[2, 5], labels[i].ravel()]).astype(np.float32)
+        header = mx.recordio.IRHeader(0, det, i, 0)
+        writer.write(mx.recordio.pack_img(header, hwc, img_fmt=".png"))
+    writer.close()
+
+
 def main():
     parser = argparse.ArgumentParser(description="train toy ssd")
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--num-epochs", type=int, default=3)
     parser.add_argument("--num-examples", type=int, default=512)
     parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--use-recordio", action="store_true",
+                        help="feed through ImageDetRecordIter (box-aware "
+                        "augmentation pipeline) instead of NDArrayIter")
     parser.add_argument("--tpus", default=None,
                         help="comma list of tpu ids; default cpu/first device")
     args = parser.parse_args()
@@ -120,17 +141,30 @@ def main():
 
     rng = np.random.RandomState(0)
     imgs, labels = synth_batch(rng, args.num_examples)
-    train = mx.io.NDArrayIter(imgs, label=labels.reshape(len(labels), -1),
-                              batch_size=args.batch_size, shuffle=True,
-                              label_name="label")
+    if args.use_recordio:
+        import tempfile
+        rec_path = os.path.join(tempfile.gettempdir(), "ssd_train.rec")
+        write_det_recordio(rec_path, imgs, labels)
+        train = mx.image.ImageDetRecordIter(
+            rec_path, data_shape=(3, 32, 32), batch_size=args.batch_size,
+            shuffle=True, scale=1.0 / 255,
+            rand_mirror_prob=0.5, rand_crop_prob=0.5,
+            min_crop_scales=0.7, max_crop_scales=1.0,
+            min_crop_object_coverages=0.75, label_name="label")
+    else:
+        train = mx.io.NDArrayIter(imgs, label=labels.reshape(len(labels),
+                                                             -1),
+                                  batch_size=args.batch_size, shuffle=True,
+                                  label_name="label")
 
     ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
         else [mx.cpu()]
     net, _, _, _ = build_ssd()
     mod = mx.mod.Module(net, data_names=["data"], label_names=["label"],
                         context=ctx)
-    mod.bind(data_shapes=train.provide_data,
-             label_shapes=[("label", (args.batch_size, 1, 5))])
+    label_shapes = train.provide_label if args.use_recordio \
+        else [("label", (args.batch_size, 1, 5))]
+    mod.bind(data_shapes=train.provide_data, label_shapes=label_shapes)
     mod.init_params(mx.init.Xavier())
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": args.lr,
@@ -140,7 +174,8 @@ def main():
         train.reset()
         metric.reset()
         for batch in train:
-            batch.label = [batch.label[0].reshape((-1, 1, 5))]
+            if not args.use_recordio:
+                batch.label = [batch.label[0].reshape((-1, 1, 5))]
             mod.forward_backward(batch)
             mod.update()
             metric.update(None, [mod.get_outputs()[1]])

@@ -394,3 +394,10 @@ class ImageRecordIter(DataIter):
                                        mirror=mirror)
         label_out = labels if self.label_width > 1 else labels[:, 0]
         return DataBatch([nd.array(batch)], [nd.array(label_out)], pad=pad)
+
+
+# detection pipeline lives in its own module; re-exported here so the
+# reference surface (mx.image / the C-API iterator registry) finds it
+from .image_det import DetAugmenter, DetLabel, ImageDetRecordIter  # noqa: E402,F401
+
+__all__ += ["DetLabel", "DetAugmenter", "ImageDetRecordIter"]

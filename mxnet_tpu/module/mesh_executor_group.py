@@ -153,6 +153,16 @@ class MeshExecutorGroup(object):
             self._input_shapes.update(dict(self.label_shapes))
         self.input_names = list(self._input_shapes)
         self._label_names = [x[0] for x in (self.label_shapes or [])]
+        # per-output shardings: only outputs that actually carry the batch
+        # dimension shard on 'dp'; scalars (losses) and batch-free outputs
+        # (e.g. MultiBoxPrior anchors, batch dim 1) stay replicated.
+        # Recomputed on every (re)bind since it depends on batch size.
+        _, out_shapes, _ = self.symbol.infer_shape(**self._input_shapes)
+        self._out_shardings = tuple(
+            self._batch_sharding
+            if len(s) >= 1 and s[0] == self.batch_size else self._repl
+            for s in out_shapes)
+        self._jits = {}  # shardings changed; recompile
 
     def _out_structs(self):
         import jax
@@ -203,7 +213,7 @@ class MeshExecutorGroup(object):
                 return outs, new_aux
 
             fn = jax.jit(fwd, in_shardings=(repl, repl, batch, None),
-                         out_shardings=(batch, repl))
+                         out_shardings=(self._out_shardings, repl))
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
 
@@ -222,10 +232,10 @@ class MeshExecutorGroup(object):
                 outs = tuple(o.astype(onp.float32) for o in outs)
                 return outs, new_aux, grads
 
-            in_sh = (repl, repl, batch, None) + ((batch,) if with_heads
-                                                 else ())
+            in_sh = (repl, repl, batch, None) + (
+                (self._out_shardings,) if with_heads else ())
             fn = jax.jit(fwd_bwd, in_shardings=in_sh,
-                         out_shardings=(batch, repl, repl))
+                         out_shardings=(self._out_shardings, repl, repl))
         self._jits[key] = fn
         return fn
 
@@ -319,9 +329,11 @@ class MeshExecutorGroup(object):
             import jax
             if isinstance(out_grads, nd.NDArray):
                 out_grads = [out_grads]
+            # each head is placed with ITS output's sharding (replicated
+            # outputs, e.g. anchors/losses, can't take the batch spec)
             heads = tuple(jax.device_put(
                 g._read() if isinstance(g, nd.NDArray) else onp.asarray(g),
-                self._batch_sharding) for g in out_grads)
+                sh) for g, sh in zip(out_grads, self._out_shardings))
             fn = self._get_jit("fwd_bwd_heads")
             outs, new_aux, grads = fn(params, aux, inputs, rng, heads)
         self._write_outs(outs)

@@ -1,0 +1,216 @@
+"""Detection data pipeline: box-aware augmentation + ImageDetRecordIter
+(VERDICT r1 #7; reference src/io/image_det_aug_default.cc +
+iter_image_det_recordio.cc)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.image_det import DetAugmenter, DetLabel, ImageDetRecordIter
+
+
+def _label(objects, header=(2, 5)):
+    return np.concatenate([np.asarray(header, np.float32),
+                           np.asarray(objects, np.float32).ravel()])
+
+
+def test_det_label_roundtrip():
+    raw = _label([[1, 0.1, 0.2, 0.5, 0.6], [3, 0.3, 0.3, 0.9, 0.8]])
+    lab = DetLabel(raw)
+    assert lab.object_width == 5
+    assert lab.objects.shape == (2, 5)
+    np.testing.assert_allclose(lab.to_array(), raw)
+
+
+def test_det_label_extra_fields_roundtrip():
+    # object_width 6: one extra float per object (difficult flag etc.)
+    raw = _label([[1, 0.1, 0.2, 0.5, 0.6, 0.7]], header=(2, 6))
+    lab = DetLabel(raw)
+    assert lab.object_width == 6
+    np.testing.assert_allclose(lab.to_array(), raw)
+
+
+def test_det_mirror_flips_coords():
+    lab = DetLabel(_label([[1, 0.1, 0.2, 0.5, 0.6]]))
+    lab.mirror()
+    np.testing.assert_allclose(lab.objects[0, 1:5], [0.5, 0.2, 0.9, 0.6],
+                               atol=1e-6)
+    # involution
+    lab.mirror()
+    np.testing.assert_allclose(lab.objects[0, 1:5], [0.1, 0.2, 0.5, 0.6],
+                               atol=1e-6)
+
+
+def test_det_crop_projects_and_clips():
+    lab = DetLabel(_label([[1, 0.2, 0.2, 0.6, 0.6]]))
+    # crop the left-top quadrant-ish region; box center (0.4,0.4) inside
+    ok = lab.try_crop((0.1, 0.1, 0.5, 0.5))
+    assert ok
+    # projected: (0.2-0.1)/0.5=0.2 ... right clipped to 1.0
+    np.testing.assert_allclose(lab.objects[0, 1:5], [0.2, 0.2, 1.0, 1.0],
+                               atol=1e-6)
+
+
+def test_det_crop_drops_outside_boxes():
+    lab = DetLabel(_label([[0, 0.05, 0.05, 0.15, 0.15],
+                           [1, 0.6, 0.6, 0.9, 0.9]]))
+    # crop right-bottom: first box's center (0.1,0.1) outside -> dropped
+    ok = lab.try_crop((0.5, 0.5, 0.5, 0.5), emit_mode="center")
+    assert ok
+    assert len(lab.objects) == 1
+    assert lab.objects[0, 0] == 1
+
+
+def test_det_crop_rejects_when_no_box_survives():
+    lab = DetLabel(_label([[0, 0.05, 0.05, 0.15, 0.15]]))
+    before = lab.objects.copy()
+    ok = lab.try_crop((0.5, 0.5, 0.5, 0.5), emit_mode="center")
+    assert not ok
+    np.testing.assert_allclose(lab.objects, before)  # unmodified on fail
+
+
+def test_det_crop_object_coverage_constraint():
+    lab = DetLabel(_label([[0, 0.0, 0.0, 0.4, 0.4]]))
+    # crop keeps only ~25% of the object: below min coverage -> reject
+    ok = lab.try_crop((0.2, 0.2, 0.8, 0.8), min_object_coverage=0.5,
+                      emit_mode="overlap", emit_overlap_thresh=0.1)
+    assert not ok
+    # same crop with lax coverage passes
+    ok = lab.try_crop((0.2, 0.2, 0.8, 0.8), min_object_coverage=0.1,
+                      emit_mode="overlap", emit_overlap_thresh=0.1)
+    assert ok
+
+
+def test_det_pad_projects_boxes():
+    lab = DetLabel(_label([[1, 0.0, 0.0, 1.0, 1.0]]))
+    # canvas 2x size with the image at offset (-0.5,-0.5) => centered
+    lab.try_pad((-0.5, -0.5, 2.0, 2.0))
+    np.testing.assert_allclose(lab.objects[0, 1:5],
+                               [0.25, 0.25, 0.75, 0.75], atol=1e-6)
+
+
+def test_det_augmenter_mirror_consistency():
+    """Pixels and boxes must transform together: a bright square's box
+    still covers the bright pixels after augmentation."""
+    rng = np.random.RandomState(0)
+    img = np.zeros((40, 40, 3), np.uint8)
+    img[8:20, 4:16] = 255  # y 8:20, x 4:16
+    lab = DetLabel(_label([[0, 4 / 40, 8 / 40, 16 / 40, 20 / 40]]))
+    aug = DetAugmenter((3, 40, 40), rand_mirror_prob=1.0, seed=1)
+    out = aug(img, lab)
+    x0, y0, x1, y1 = (lab.objects[0, 1:5] * 40).astype(int)
+    # the box region in the augmented image is the bright square
+    assert out[y0:y1, x0:x1].mean() > 250
+    assert out.mean() < 100  # rest dark
+
+
+def _to_wire(img):
+    """pack_img's cv2 encoder expects BGR; the npy fallback stores as-is."""
+    try:
+        import cv2  # noqa: F401
+        return img[:, :, ::-1]
+    except ImportError:
+        return img
+
+
+def _write_synth_rec(path, n=32, size=32, fmt=".png"):
+    rng = np.random.RandomState(3)
+    writer = mx.recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 40).astype(np.uint8)
+        w = rng.randint(8, 16)
+        x0, y0 = rng.randint(0, size - w, 2)
+        img[y0:y0 + w, x0:x0 + w] = 255
+        img = _to_wire(img)
+        det = _label([[0, x0 / size, y0 / size, (x0 + w) / size,
+                       (y0 + w) / size]])
+        header = mx.recordio.IRHeader(0, det, i, 0)
+        writer.write(mx.recordio.pack_img(header, img, img_fmt=fmt))
+    writer.close()
+
+
+def test_image_det_record_iter_end_to_end():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = os.path.join(tmp, "det.rec")
+        _write_synth_rec(rec, n=20)
+        it = ImageDetRecordIter(rec, data_shape=(3, 32, 32), batch_size=8,
+                                shuffle=True, rand_mirror_prob=0.5,
+                                rand_crop_prob=0.5, min_crop_scales=0.7,
+                                max_crop_scales=1.0,
+                                min_crop_object_coverages=0.7, seed=7)
+        assert it.provide_label[0].shape == (8, 1, 5)
+        n_batches = 0
+        for batch in it:
+            n_batches += 1
+            data = batch.data[0].asnumpy()
+            lab = batch.label[0].asnumpy()
+            assert data.shape == (8, 3, 32, 32)
+            assert lab.shape == (8, 1, 5)
+            # every (non-padded) box covers bright pixels
+            for b in range(8):
+                cls, x0, y0, x1, y1 = lab[b, 0]
+                assert cls == 0
+                assert x1 > x0 and y1 > y0
+                xi0, yi0 = int(x0 * 32), int(y0 * 32)
+                xi1, yi1 = max(int(x1 * 32), xi0 + 1), max(int(y1 * 32),
+                                                           yi0 + 1)
+                assert data[b, :, yi0:yi1, xi0:xi1].mean() > 150
+        assert n_batches == 3  # 20 rows @ bs 8, round_batch
+        it.reset()
+        assert next(it) is not None
+
+
+def test_image_det_record_iter_varying_object_count():
+    """Samples with different object counts pad with -1 rows (BatchLoader
+    padding; MultiBoxTarget treats id<0 as padding)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = os.path.join(tmp, "det.rec")
+        writer = mx.recordio.MXRecordIO(rec, "w")
+        img = np.full((16, 16, 3), 80, np.uint8)
+        one = _label([[0, 0.1, 0.1, 0.4, 0.4]])
+        three = _label([[0, 0.1, 0.1, 0.4, 0.4],
+                        [1, 0.5, 0.5, 0.9, 0.9],
+                        [2, 0.2, 0.6, 0.5, 0.95]])
+        for i, det in enumerate([one, three, one, one]):
+            writer.write(mx.recordio.pack_img(
+                mx.recordio.IRHeader(0, det, i, 0), img, img_fmt=".png"))
+        writer.close()
+        it = ImageDetRecordIter(rec, data_shape=(3, 16, 16), batch_size=2,
+                                shuffle=False)
+        assert it.max_objects == 3
+        batch = next(it)
+        lab = batch.label[0].asnumpy()
+        assert lab.shape == (2, 3, 5)
+        assert (lab[0, 1:] == -1).all()   # one-object sample padded
+        assert (lab[1, :, 0] >= 0).all()  # three-object sample full
+
+
+def test_image_det_record_iter_label_pad_width():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = os.path.join(tmp, "det.rec")
+        _write_synth_rec(rec, n=4)
+        it = ImageDetRecordIter(rec, data_shape=(3, 32, 32), batch_size=2,
+                                label_pad_width=30)
+        assert it.max_objects == 6  # 30 // 5
+        assert it.provide_label[0].shape == (2, 6, 5)
+        with pytest.raises(ValueError):
+            ImageDetRecordIter(rec, data_shape=(3, 32, 32), batch_size=2,
+                               label_pad_width=3)
+
+
+def test_ssd_trains_through_det_record_iter():
+    """SSD smoke-train consuming the detection iterator (VERDICT r1 #7
+    'done' condition)."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "example", "ssd", "train_ssd.py"),
+         "--use-recordio", "--num-epochs", "1", "--num-examples", "64",
+         "--batch-size", "16"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr
+    assert "loc-loss" in (proc.stdout + proc.stderr)

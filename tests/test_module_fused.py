@@ -209,3 +209,36 @@ def test_fused_fit_and_predict():
     preds = mod.predict(train).asnumpy()
     assert preds.shape == (n, 10)
     assert (preds.argmax(axis=1) == y).mean() > 0.9
+
+
+def test_fused_replicated_outputs_and_scalar_heads():
+    """Outputs without a batch dimension (anchors, scalar losses) must get
+    replicated shardings on the fused path, including the explicit
+    out_grads backward (SSD-shaped graphs; code-review r2 finding)."""
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    scalar_loss = sym.sum(fc, name="tot")          # rank-0 output
+    net = sym.Group([fc, scalar_loss])
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(net, data_names=["data"], label_names=None,
+                        context=ctxs)
+    mod.bind(data_shapes=[("data", (16, 6))], for_training=True)
+    assert getattr(mod._exec_group, "fused", False)
+    mod.init_params(mx.init.One())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+
+    batch = mx.io.DataBatch([mx.nd.array(np.ones((16, 6), np.float32))], [])
+    mod.forward(batch, is_train=True)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (16, 4)
+    assert outs[1].shape == ()
+    np.testing.assert_allclose(outs[1].asnumpy(), 16 * 4 * 6, rtol=1e-5)
+
+    # explicit head grads: batch-shaped for fc, scalar for the loss
+    mod.forward(batch, is_train=True)
+    mod.backward(out_grads=[mx.nd.zeros((16, 4)), mx.nd.array(1.0)])
+    grads = {n: g[0].asnumpy() for n, g in
+             zip(mod._exec_group.param_names, mod._exec_group.grad_arrays)}
+    # d(sum(x W^T + b))/db = batch size
+    np.testing.assert_allclose(grads["fc_bias"], 16.0, rtol=1e-5)
