@@ -143,7 +143,8 @@ def main():
     imgs, labels = synth_batch(rng, args.num_examples)
     if args.use_recordio:
         import tempfile
-        rec_path = os.path.join(tempfile.gettempdir(), "ssd_train.rec")
+        fd, rec_path = tempfile.mkstemp(suffix=".rec", prefix="ssd_train_")
+        os.close(fd)
         write_det_recordio(rec_path, imgs, labels)
         train = mx.image.ImageDetRecordIter(
             rec_path, data_shape=(3, 32, 32), batch_size=args.batch_size,

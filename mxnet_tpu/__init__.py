@@ -7,6 +7,13 @@ HIP/mshadow/NNVM/ps-lite stack.
 """
 from __future__ import annotations
 
+# Multi-process bootstrap MUST precede anything that can initialize the
+# XLA backend (jax.distributed.initialize rejects a live backend), the way
+# the reference dispatches DMLC_ROLE at import (kvstore_server.py). Cheap
+# no-op unless DMLC_NUM_WORKER > 1.
+from .parallel import dist as _dist_bootstrap
+_dist_bootstrap.init_from_env()
+
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context
 from . import base

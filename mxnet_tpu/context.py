@@ -72,13 +72,20 @@ class Context:
         """
         import jax
 
+        # multi-process: a Context addresses THIS process's devices (the
+        # reference's Context is process-local too); global jax.devices()
+        # would hand out peers' unaddressable devices
+        local = jax.process_count() > 1
+
         if self.device_type in ("cpu", "cpu_pinned"):
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu") if local \
+                    else jax.devices("cpu")
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices() if local else jax.devices()
             return devs[min(self.device_id, len(devs) - 1)]
-        devs = jax.devices()  # default backend: TPU when present, else CPU
+        # default backend: TPU when present, else CPU
+        devs = jax.local_devices() if local else jax.devices()
         if self.device_id >= len(devs):
             raise ValueError(
                 "Context %s out of range: only %d device(s) visible to JAX"

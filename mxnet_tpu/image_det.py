@@ -204,9 +204,9 @@ class DetAugmenter(object):
         self.rng = random.Random(seed)
 
     # ------------------------------------------------------------- pieces
-    def _generate_crop_box(self, idx, img_aspect):
+    def _generate_crop_box(self, idx, img_aspect, r=None):
         """GenerateCropBox (image_det_aug_default.cc:459)."""
-        r = self.rng
+        r = r if r is not None else self.rng
         scale = r.uniform(self.min_crop_scales[idx],
                          self.max_crop_scales[idx]) + 1e-12
         min_ratio = max(self.min_crop_aspect_ratios[idx] / img_aspect,
@@ -222,20 +222,23 @@ class DetAugmenter(object):
         y0 = r.uniform(0.0, 1.0 - h)
         return (x0, y0, w, h)
 
-    def _generate_pad_box(self, threshold=1.05):
+    def _generate_pad_box(self, threshold=1.05, r=None):
         """GeneratePadBox (image_det_aug_default.cc:479)."""
-        scale = self.rng.uniform(1.0, self.max_pad_scale)
+        r = r if r is not None else self.rng
+        scale = r.uniform(1.0, self.max_pad_scale)
         if scale < threshold:
             return None
-        x0 = self.rng.uniform(0.0, scale - 1.0)
-        y0 = self.rng.uniform(0.0, scale - 1.0)
+        x0 = r.uniform(0.0, scale - 1.0)
+        y0 = r.uniform(0.0, scale - 1.0)
         return (-x0, -y0, scale, scale)
 
     # -------------------------------------------------------------- apply
-    def __call__(self, img, label):
+    def __call__(self, img, label, rng=None):
         """img: HWC uint8; label: DetLabel (modified in place). Returns the
-        augmented image (reference Process, same op order)."""
-        r = self.rng
+        augmented image (reference Process, same op order). ``rng`` lets
+        callers pass a per-sample engine (the reference keeps per-thread
+        prnds_[tid]) so threaded decode stays deterministic."""
+        r = rng if rng is not None else self.rng
         if self.resize > 0:
             h, w = img.shape[:2]
             if h > w:
@@ -264,7 +267,7 @@ class DetAugmenter(object):
         # pad out to a larger canvas, boxes projected into it
         if self.rand_pad_prob > 0 and self.max_pad_scale > 1.0:
             if r.random() < self.rand_pad_prob:
-                box = self._generate_pad_box()
+                box = self._generate_pad_box(r=r)
                 if box is not None:
                     label.try_pad(box)
                     x, y, s = box[0], box[1], box[2]
@@ -286,7 +289,7 @@ class DetAugmenter(object):
                         break
                     for _ in range(self.max_crop_trials[idx]):
                         h, w = img.shape[:2]
-                        box = self._generate_crop_box(idx, w / h)
+                        box = self._generate_crop_box(idx, w / h, r=r)
                         if box is None:
                             continue
                         x, y, bw, bh = box
@@ -362,6 +365,8 @@ class ImageDetRecordIter(DataIter):
         self.std = onp.array([std_r, std_g, std_b], onp.float32)
         self.scale = scale
         self.rng = random.Random(seed)
+        self._base_seed = seed
+        self._epoch = -1  # reset() below brings it to 0
         self.aug = DetAugmenter(data_shape, seed=seed, **aug_kwargs)
 
         # scan for max label width (iter_image_det_recordio.cc:270
@@ -401,6 +406,7 @@ class ImageDetRecordIter(DataIter):
         if self.shuffle:
             self.rng.shuffle(self.seq)
         self.cur = 0
+        self._epoch += 1
 
     def _load_one(self, idx):
         header, payload = recordio.unpack(self.rec.read(idx))
@@ -413,7 +419,11 @@ class ImageDetRecordIter(DataIter):
         if img.ndim == 2:
             img = onp.stack([img] * 3, axis=-1)
         label = DetLabel(onp.asarray(header.label))
-        img = self.aug(img, label)
+        # per-sample engine keyed on (iterator seed, sample, epoch):
+        # deterministic regardless of decode-thread scheduling (the
+        # reference keeps per-thread prnds_[tid])
+        rng = random.Random(hash((self._base_seed, idx, self._epoch)))
+        img = self.aug(img, label, rng=rng)
         out = onp.full((self.max_objects, self.object_width), -1.0,
                        onp.float32)
         n = min(len(label.objects), self.max_objects)
@@ -426,8 +436,13 @@ class ImageDetRecordIter(DataIter):
         idxs = self.seq[self.cur:self.cur + self.batch_size]
         self.cur += self.batch_size
         pad = self.batch_size - len(idxs)
-        if pad > 0 and self.round_batch:
-            idxs = idxs + self.seq[:pad]
+        if pad > 0:
+            # the batch is ALWAYS full-size (provide_data contract); pad
+            # says how many tail entries are filler. round_batch wraps to
+            # the head (reference BatchLoader round_batch_), otherwise the
+            # last real sample repeats.
+            idxs = idxs + (self.seq[:pad] if self.round_batch
+                           else [idxs[-1]] * pad)
         samples = list(self.pool.map(self._load_one, idxs))
         imgs = onp.stack([s[0] for s in samples]).astype(onp.float32)
         imgs = (imgs - self.mean) / (self.std / self.scale)

@@ -50,34 +50,89 @@ class DistRuntime:
         def _sum(x):
             return jnp.sum(x, axis=0)
 
+        out = _sum(arr)  # global array, replicated across processes
+        # hand back a PROCESS-LOCAL array (the kvstore mixes it with
+        # local weights in updaters); our shard of the replicated result
+        # is the full value
+        import numpy as onp
+        local = jax.device_put(onp.asarray(out.addressable_shards[0].data),
+                               ndarray.context.jax_device())
         from ..ndarray import NDArray
-        return NDArray(_sum(arr), ctx=ndarray.context)
+        return NDArray(local, ctx=ndarray.context)
 
-    def barrier(self):
+    @property
+    def _client(self):
+        """The JAX coordination-service client (None single-process)."""
+        from jax._src import distributed
+        return distributed.global_state.client
+
+    def barrier(self, timeout=300):
+        """Real rendezvous through the coordination service
+        (kvstore_dist.h Barrier -> scheduler; here the JAX coordination
+        server plays the scheduler role)."""
         if self.size == 1:
             return
-        import jax
-        # all-reduce of a scalar is a barrier
-        x = jax.numpy.zeros(())
-        x.block_until_ready()
+        client = self._client
+        if client is not None:
+            self._barrier_n = getattr(self, "_barrier_n", 0) + 1
+            client.wait_at_barrier("mxtpu_barrier_%d" % self._barrier_n,
+                                   int(timeout * 1000))
+        else:  # pragma: no cover - client always exists when size > 1
+            import jax
+            jax.numpy.zeros(()).block_until_ready()
 
     def num_dead_nodes(self, timeout=60):
-        # The JAX coordination service fails fast on dead peers rather than
-        # exposing a heartbeat count; surviving processes see an error.
-        return 0
+        """Count peers the coordination service no longer sees as live
+        (kvstore_dist.h:159-168 GetNumDeadNode; the reference asks the
+        ps-lite scheduler, we ask the coordination server's heartbeat
+        tracker). ``timeout`` is accepted for API parity; detection
+        latency is governed by MXNET_KVSTORE_HEARTBEAT_TIMEOUT, the probe
+        itself does not block."""
+        del timeout
+        if self.size == 1:
+            return 0
+        client = self._client
+        if client is None:
+            return 0
+        try:
+            live = client.get_live_nodes(list(range(self.size)))
+        except RuntimeError:
+            # the coordination RPC failing means the coordinator (or our
+            # link to it) is gone — everyone else is unreachable from
+            # here. Other exception types (API misuse) propagate.
+            return self.size - 1
+        return self.size - len(live)
 
 
 def init_from_env():
-    """Initialize jax.distributed from DMLC_*/JAX env (launch.py contract)."""
-    import jax
+    """Initialize jax.distributed from DMLC_*/JAX env (launch.py contract).
+
+    MXNET_KVSTORE_HEARTBEAT_TIMEOUT (seconds) tunes how quickly dead
+    peers are detected (ps-lite PS_HEARTBEAT_TIMEOUT equivalent)."""
     n_worker = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    if n_worker > 1 and jax.process_count() == 1:
+    if n_worker <= 1:
+        return
+    import jax
+    # elastic mode: survivors keep running when a peer dies (so
+    # get_num_dead_node can report it) instead of the coordination
+    # client's default die-together policy. Maps the reference's
+    # ps-lite elastic training knob onto jax recoverability. Set via
+    # jax.config (an env var would be ignored if jax imported first).
+    if os.environ.get("MXNET_KVSTORE_ELASTIC", "0") == "1":
+        jax.config.update("jax_enable_recoverability", True)
+    from jax._src import distributed as _dstate
+    # NOTE: probe the coordination client, NOT jax.process_count() — the
+    # latter initializes the XLA backend, after which initialize() is
+    # rejected
+    if _dstate.global_state.client is None:
         coord = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
         rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        hb = int(os.environ.get("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "100"))
         jax.distributed.initialize(
             coordinator_address="%s:%s" % (coord, port),
-            num_processes=n_worker, process_id=rank)
+            num_processes=n_worker, process_id=rank,
+            heartbeat_timeout_seconds=hb)
 
 
 def get_runtime():

@@ -1,0 +1,105 @@
+"""Multi-process dist_sync worker (driven by test_dist_multiprocess.py).
+
+Mirrors /root/reference/tests/nightly/dist_sync_kvstore.py: every worker
+pushes rank-dependent values into shared keys (including a big key) and
+asserts the pulled aggregate is BITWISE exact — XLA psum has a fixed
+reduction order, so dist_sync is deterministic across repeats and ranks.
+
+Modes (argv[1]):
+  sync   - push/pull determinism incl. big key + barrier
+  crash  - rank DIST_CRASH_RANK dies (os._exit, no goodbye); survivors
+           must observe it via kv.get_num_dead_node (coordination-service
+           liveness, parallel/dist.py num_dead_nodes)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import optimizer as opt  # noqa: E402
+
+
+def check_exact(arr, x):
+    a = arr.asnumpy()
+    assert onp.sum(onp.abs(a - x)) == 0.0, (a.ravel()[:4], x)
+
+
+def run_sync(kv):
+    rank, nworker = kv.rank, kv.num_workers
+    shape, big_shape = (2, 2), (600, 600)
+    rate, nrepeat = 2, 3
+
+    kv.init([3, 5, 7], [mx.nd.ones(shape)] * 3)
+    kv.init(99, mx.nd.ones(big_shape))
+    # server-side updater: stored += rate * merged (reference 'test'
+    # optimizer with rate; Test here is w += -lr * rescale * g)
+    kv.set_optimizer(opt.Test(learning_rate=-float(rate),
+                              rescale_grad=1.0))
+
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(shape) * (rank + 1))
+        kv.push(99, mx.nd.ones(big_shape) * (rank + 1))
+
+    num = (nworker + 1) * nworker * rate / 2 * nrepeat + 1
+    val = mx.nd.zeros(shape)
+    kv.pull(3, out=val)
+    check_exact(val, num)
+    val2 = mx.nd.zeros(big_shape)
+    kv.pull(99, out=val2)
+    check_exact(val2, num)
+
+    # untouched key still the init value on every rank
+    val3 = mx.nd.zeros(shape)
+    kv.pull(5, out=val3)
+    check_exact(val3, 1.0)
+
+    # two more pulls are bitwise identical (determinism across repeats)
+    a = mx.nd.zeros(big_shape)
+    b = mx.nd.zeros(big_shape)
+    kv.pull(99, out=a)
+    kv.pull(99, out=b)
+    assert (a.asnumpy() == b.asnumpy()).all()
+
+    kv.barrier()
+    print("DIST_WORKER_OK rank=%d nworker=%d" % (rank, nworker), flush=True)
+
+
+def run_crash(kv):
+    rank = kv.rank
+    victim = int(os.environ["DIST_CRASH_RANK"])
+    assert kv.get_num_dead_node(-1, timeout=5) == 0
+    kv.barrier()  # everyone connected before the crash
+    if rank == victim:
+        os._exit(0)  # die without telling the coordinator
+    deadline = time.time() + 60
+    dead = 0
+    while time.time() < deadline:
+        dead = kv.get_num_dead_node(-1, timeout=5)
+        if dead >= 1:
+            break
+        time.sleep(1)
+    assert dead >= 1, "dead peer not detected within 60s"
+    print("DIST_DEAD_DETECTED rank=%d dead=%d" % (rank, dead), flush=True)
+    # skip the atexit coordination shutdown: with a peer dead there is no
+    # full-job shutdown barrier to complete (and the coordinator may exit
+    # first, racing the ShutdownTask RPC)
+    os._exit(0)
+
+
+def main():
+    mode = sys.argv[1]
+    kv = mx.kv.create("dist_sync")
+    if mode == "sync":
+        run_sync(kv)
+    elif mode == "crash":
+        run_crash(kv)
+    else:
+        raise SystemExit("unknown mode %s" % mode)
+
+
+if __name__ == "__main__":
+    main()

