@@ -352,7 +352,14 @@ class ImageRecordIter(DataIter):
 
     def _decode_one(self, idx):
         header, img_bytes = recordio.unpack(self.rec.read(idx))
-        img = imdecode(img_bytes)
+        if img_bytes[:6] == b"\x93NUMPY":
+            # raw (uncompressed) payload from pack_img's npy fallback /
+            # im2rec --encoding .npy: decode is a buffer view, the mode
+            # for hosts where JPEG decode can't keep up with the chip
+            img = onp.load(_pyio.BytesIO(bytes(img_bytes)),
+                           allow_pickle=False)
+        else:
+            img = imdecode(img_bytes)
         c, th, tw = self.data_shape
         if self.resize > 0:
             img = resize_short(img, self.resize)

@@ -198,6 +198,9 @@ class MeshExecutorGroup(object):
         def run_fwd(params, aux, inputs, rng, is_train):
             vals = [cast(n, params[n]) if n in params else
                     cast(n, inputs[n]) for n in self.arg_names]
+            # aux (BN moving stats) stay f32: BatchNorm's fcompute runs its
+            # statistics math in f32 and casts its output to the activation
+            # dtype, so mixed-precision dtype agreement is the op's job
             auxv = [aux[n] for n in self.aux_names]
             outs, new_aux = self._eval_fn(vals, auxv, rng, is_train)
             return outs, dict(zip(self.aux_names, new_aux))
@@ -241,14 +244,17 @@ class MeshExecutorGroup(object):
 
     # ------------------------------------------------------------------
     def set_params(self, arg_params, aux_params):
+        # device_put straight from the source buffer (host OR device):
+        # an .asnumpy() here would be a device->host readback per param —
+        # ~260 blocking D2H round trips per init on remote-attached TPUs
         import jax
         for n, buf in self._param_dict.items():
             if n in arg_params:
-                buf._write(jax.device_put(arg_params[n].asnumpy(),
+                buf._write(jax.device_put(arg_params[n]._read(),
                                           self._repl))
         for n, buf in self._aux_dict.items():
             if aux_params and n in aux_params:
-                buf._write(jax.device_put(aux_params[n].asnumpy(),
+                buf._write(jax.device_put(aux_params[n]._read(),
                                           self._repl))
 
     def get_params(self, arg_params, aux_params):

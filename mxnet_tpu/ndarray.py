@@ -439,7 +439,24 @@ def invoke(op, inputs, raw_attrs, out=None, ctx=None):
     octx = _registry.OpContext(
         is_train=_autograd.is_training(),
         rng=_random.next_key() if op.needs_rng else None)
-    results = op.fcompute(attrs, vals, octx)
+    # pin input-free ops (zeros/full/random fills) to the op's context:
+    # they would otherwise land on the process default device — silently
+    # migrating "cpu" arrays onto the accelerator (and, on remote-attached
+    # TPUs, turning every host-side fill into tunnel traffic). Ops WITH
+    # inputs follow their committed inputs already; skip the config
+    # context manager on that hot path.
+    out_first = (next((o for o in out if o is not None), None)
+                 if isinstance(out, (list, tuple))
+                 else out)
+    in_ctx = ctx or (inputs[0].context if inputs
+                     else out_first.context if out_first is not None
+                     else current_context())
+    if inputs:
+        results = op.fcompute(attrs, vals, octx)
+    else:
+        import jax
+        with jax.default_device(in_ctx.jax_device()):
+            results = op.fcompute(attrs, vals, octx)
     n_out = op.num_outputs(attrs)
     outs, aux_updates = list(results[:n_out]), list(results[n_out:])
 
@@ -448,7 +465,6 @@ def invoke(op, inputs, raw_attrs, out=None, ctx=None):
         for nda, new in zip(inputs[-n_aux:], aux_updates):
             nda._write(new)
 
-    in_ctx = ctx or (inputs[0].context if inputs else current_context())
     out_list = out if isinstance(out, (list, tuple)) else (
         [out] if out is not None else None)
     wrapped = []

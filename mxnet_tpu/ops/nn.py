@@ -417,20 +417,34 @@ def _batch_norm(attrs, ins, octx):
     fix_gamma = attrs.get("fix_gamma", True)
     use_global = attrs.get("use_global_stats", False)
 
+    # mixed-precision contract (AMP standard): statistics + normalization
+    # math run in f32 even for bf16 activations — the moving-stat EMA
+    # increment (1-mom)*x is at bf16's quantization floor, so bf16 stats
+    # would random-walk instead of converge — and the output is cast back
+    # to the activation dtype so dtype-strict consumers (lax.conv) are
+    # happy in both train (batch-stat) and eval (moving-stat) modes.
+    xdt = x.dtype
+    f32 = jnp.float32
+    xf = x.astype(f32)
     axes = tuple(i for i in range(x.ndim) if i != 1)
     bshape = (1, -1) + (1,) * (x.ndim - 2)
     if octx.is_train and not use_global:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=axes)
-        new_mmean = mmean * mom + jax.lax.stop_gradient(mean) * (1 - mom)
-        new_mvar = mvar * mom + jax.lax.stop_gradient(var) * (1 - mom)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf - mean.reshape(bshape)), axis=axes)
+        new_mmean = (mmean * mom +
+                     jax.lax.stop_gradient(mean).astype(mmean.dtype) *
+                     (1 - mom))
+        new_mvar = (mvar * mom +
+                    jax.lax.stop_gradient(var).astype(mvar.dtype) *
+                    (1 - mom))
     else:
-        mean, var = mmean, mvar
+        mean, var = mmean.astype(f32), mvar.astype(f32)
         new_mmean, new_mvar = mmean, mvar
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    out = (x - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + eps)
-    out = out * g.reshape(bshape) + beta.reshape(bshape)
-    return [out, new_mmean, new_mvar]
+    out = (xf - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + eps)
+    out = (out * g.astype(f32).reshape(bshape) +
+           beta.astype(f32).reshape(bshape))
+    return [out.astype(xdt), new_mmean, new_mvar]
 
 
 @register("InstanceNorm", arg_names=("data", "gamma", "beta"),

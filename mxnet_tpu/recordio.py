@@ -170,9 +170,20 @@ def unpack_img(s, iscolor=-1):
     return header, img
 
 
+def _pack_npy(header, img):
+    import io as _io
+    bio = _io.BytesIO()
+    onp.save(bio, onp.asarray(img), allow_pickle=False)
+    return pack(header, bio.getvalue())
+
+
 def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Pack an image into a record; uses cv2 JPEG/PNG encode when available,
-    else raw .npy bytes (decode with unpack_img)."""
+    """Pack an image into a record; encodes via cv2, else PIL, else raw
+    .npy bytes (decode with unpack_img). ``img_fmt=".npy"`` forces the raw
+    uncompressed payload — zero decode cost at read time, for hosts whose
+    image-decode throughput can't feed the chip."""
+    if img_fmt == ".npy":
+        return _pack_npy(header, img)
     try:
         import cv2
         encode_params = None
@@ -184,7 +195,17 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         assert ret, "failed to encode image"
         return pack(header, buf.tobytes())
     except ImportError:
+        pass
+    try:
         import io as _io
-        bio = _io.BytesIO()
-        onp.save(bio, onp.asarray(img), allow_pickle=False)
-        return pack(header, bio.getvalue())
+        from PIL import Image
+        fmt = {"jpg": "JPEG", "jpeg": "JPEG", "png": "PNG"}.get(
+            img_fmt.lstrip("."), None)
+        if fmt is not None:
+            bio = _io.BytesIO()
+            Image.fromarray(onp.asarray(img)).save(bio, format=fmt,
+                                                   quality=quality)
+            return pack(header, bio.getvalue())
+    except ImportError:
+        pass
+    return _pack_npy(header, img)
