@@ -1,0 +1,146 @@
+# coding: utf-8
+"""Lightweight ctypes prediction frontend over the amalgamated library.
+
+Reference counterpart: amalgamation/python/mxnet_predict.py — a
+dependency-free Predictor for deployment targets that only need inference.
+This binds libmxnet_tpu_predict.so (or the full libmxnet_tpu.so) through
+the C predict API (include/mxnet_tpu/c_api.h: MXPred* / MXNDList*); the
+full mxnet_tpu package is NOT imported into the caller's process — the
+library hosts its own embedded runtime.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+_mx_uint = ctypes.c_uint
+_mx_float = ctypes.c_float
+
+
+def _find_lib_path():
+    here = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    cands = [os.path.join(here, "..", n) for n in
+             ("libmxnet_tpu_predict.so", "mxnet_tpu_predict-all.so")]
+    cands += [os.path.join(here, "..", "..", "capi", "build",
+                           "libmxnet_tpu.so")]
+    env = os.environ.get("MXNET_TPU_PREDICT_LIB")
+    if env:
+        cands.insert(0, env)
+    for p in cands:
+        if os.path.isfile(p):
+            return os.path.abspath(p)
+    raise RuntimeError("cannot find libmxnet_tpu_predict.so; build it with "
+                       "`make -C amalgamation` (candidates: %s)" % cands)
+
+
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(_find_lib_path(), ctypes.RTLD_GLOBAL)
+        _lib.MXGetLastError.restype = ctypes.c_char_p
+    return _lib
+
+
+def _check(rc):
+    if rc != 0:
+        raise RuntimeError(_load_lib().MXGetLastError().decode("utf-8"))
+
+
+def _c_str(s):
+    return ctypes.c_char_p(s.encode("utf-8"))
+
+
+class Predictor(object):
+    """Forward-only model runner.
+
+    Parameters
+    ----------
+    symbol_json_str : str — symbol graph (``sym.tojson()``)
+    param_raw_bytes : bytes — serialized params (``mx.nd.save`` file bytes)
+    input_shapes : dict of name -> tuple
+    dev_type : "cpu" or "tpu"; dev_id : int
+    """
+
+    def __init__(self, symbol_json_str, param_raw_bytes, input_shapes,
+                 dev_type="cpu", dev_id=0):
+        lib = _load_lib()
+        dev = {"cpu": 1, "gpu": 2, "tpu": 2}.get(dev_type, 1)
+        keys = list(input_shapes)
+        indptr, shapes = [0], []
+        for k in keys:
+            shapes.extend(int(d) for d in input_shapes[k])
+            indptr.append(len(shapes))
+        c_keys = (ctypes.c_char_p * len(keys))(
+            *[k.encode("utf-8") for k in keys])
+        handle = ctypes.c_void_p()
+        param_raw_bytes = bytes(param_raw_bytes)
+        _check(lib.MXPredCreate(
+            _c_str(symbol_json_str), param_raw_bytes,
+            ctypes.c_int(len(param_raw_bytes)), ctypes.c_int(dev),
+            ctypes.c_int(dev_id), _mx_uint(len(keys)), c_keys,
+            (_mx_uint * len(indptr))(*indptr),
+            (_mx_uint * len(shapes))(*shapes),
+            ctypes.byref(handle)))
+        self.handle = handle
+        self._lib = lib
+
+    def __del__(self):
+        if getattr(self, "handle", None):
+            self._lib.MXPredFree(self.handle)
+            self.handle = None
+
+    def forward(self, **kwargs):
+        for k, v in kwargs.items():
+            v = np.ascontiguousarray(v, dtype=np.float32)
+            _check(self._lib.MXPredSetInput(
+                self.handle, _c_str(k),
+                v.ctypes.data_as(ctypes.POINTER(_mx_float)),
+                _mx_uint(v.size)))
+        _check(self._lib.MXPredForward(self.handle))
+
+    def get_output(self, index):
+        pdata = ctypes.POINTER(_mx_uint)()
+        ndim = _mx_uint()
+        _check(self._lib.MXPredGetOutputShape(
+            self.handle, _mx_uint(index), ctypes.byref(pdata),
+            ctypes.byref(ndim)))
+        shape = tuple(pdata[i] for i in range(ndim.value))
+        out = np.empty(shape, dtype=np.float32)
+        _check(self._lib.MXPredGetOutput(
+            self.handle, _mx_uint(index),
+            out.ctypes.data_as(ctypes.POINTER(_mx_float)),
+            _mx_uint(out.size)))
+        return out
+
+
+def load_ndarray_file(nd_bytes):
+    """Load a ``mx.nd.save`` file's bytes into {name: np.ndarray}."""
+    lib = _load_lib()
+    handle = ctypes.c_void_p()
+    length = _mx_uint()
+    nd_bytes = bytes(nd_bytes)
+    _check(lib.MXNDListCreate(nd_bytes, ctypes.c_int(len(nd_bytes)),
+                              ctypes.byref(handle), ctypes.byref(length)))
+    out = {}
+    for i in range(length.value):
+        key = ctypes.c_char_p()
+        pdata = ctypes.POINTER(_mx_float)()
+        pshape = ctypes.POINTER(_mx_uint)()
+        ndim = _mx_uint()
+        _check(lib.MXNDListGet(handle, _mx_uint(i), ctypes.byref(key),
+                               ctypes.byref(pdata), ctypes.byref(pshape),
+                               ctypes.byref(ndim)))
+        shape = tuple(pshape[j] for j in range(ndim.value))
+        size = int(np.prod(shape)) if shape else 1
+        arr = np.ctypeslib.as_array(pdata, shape=(size,)).copy()
+        name = key.value.decode("utf-8") if key.value else str(i)
+        out[name] = arr.reshape(shape)
+    _check(lib.MXNDListFree(handle))
+    return out

@@ -1,0 +1,105 @@
+"""Amalgamation: single-file predict-only library (reference amalgamation/).
+
+Builds mxnet_tpu_predict-all.cc via the section extractor, compiles
+libmxnet_tpu_predict.so, and drives it from a clean subprocess through the
+ctypes frontend (amalgamation/python/mxnet_tpu_predict.py) — the client
+process never imports mxnet_tpu, proving the deployment story.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AMALG = os.path.join(ROOT, "amalgamation")
+
+
+def _build():
+    subprocess.run(["make", "-C", AMALG], check=True, capture_output=True)
+    return os.path.join(AMALG, "libmxnet_tpu_predict.so")
+
+
+def test_generator_sections():
+    out = subprocess.run(
+        [sys.executable, os.path.join(AMALG, "amalgamation.py"),
+         "-o", os.path.join(AMALG, "mxnet_tpu_predict-all.cc")],
+        check=True, capture_output=True, text=True)
+    assert "predict API" in out.stdout
+    src = open(os.path.join(AMALG, "mxnet_tpu_predict-all.cc")).read()
+    assert "MXNET_TPU_PREDICT_ONLY" in src
+    assert src.count('}  // extern "C"') == 1
+    assert "MXPredCreate" in src and "MXNDListCreate" in src
+
+
+def test_training_families_stripped():
+    """The predict-only .so must not export training/dist entry points."""
+    lib = _build()
+    out = subprocess.run(["nm", "-D", "--defined-only", lib],
+                         check=True, capture_output=True, text=True).stdout
+    assert "MXPredCreate" in out and "MXNDListGet" in out
+    for sym in ("MXExecutorBackward", "MXKVStoreCreate", "MXDataIterNext",
+                "MXRecordIOWriterCreate", "MXImperativeInvoke"):
+        assert sym not in out, "%s leaked into predict-only build" % sym
+
+
+def test_predict_via_amalgamated_lib(tmp_path):
+    lib = _build()
+
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc1")
+    net = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+
+    w = np.arange(12, dtype=np.float32).reshape(3, 4) * 0.1
+    b = np.array([0.1, -0.2, 0.3], np.float32)
+    params = {"arg:fc1_weight": mx.nd.array(w), "arg:fc1_bias": mx.nd.array(b)}
+    param_path = str(tmp_path / "model.params")
+    mx.nd.save(param_path, params)
+    json_path = str(tmp_path / "model.json")
+    with open(json_path, "w") as f:
+        f.write(net.tojson())
+
+    x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    x_path = str(tmp_path / "x.npy")
+    np.save(x_path, x)
+
+    # expected softmax(x @ w.T + b)
+    logits = x @ w.T + b
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    expected = e / e.sum(axis=1, keepdims=True)
+
+    client = tmp_path / "client.py"
+    client.write_text(
+        "import sys, json\n"
+        "import numpy as np\n"
+        "sys.path.insert(0, %r)\n"
+        "assert 'mxnet_tpu' not in sys.modules\n"
+        "from mxnet_tpu_predict import Predictor, load_ndarray_file\n"
+        "assert 'mxnet_tpu' not in sys.modules  # deployment: no framework\n"
+        "sym = open(%r).read()\n"
+        "params = open(%r, 'rb').read()\n"
+        "x = np.load(%r)\n"
+        "p = Predictor(sym, params, {'data': x.shape})\n"
+        "p.forward(data=x)\n"
+        "out = p.get_output(0)\n"
+        "nd = load_ndarray_file(params)\n"
+        "print(json.dumps({'out': out.tolist(),\n"
+        "                  'keys': sorted(nd.keys()),\n"
+        "                  'wsum': float(nd['arg:fc1_weight'].sum())}))\n"
+        % (os.path.join(AMALG, "python"), json_path, param_path, x_path))
+
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env["MXNET_TPU_PREDICT_LIB"] = lib
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run([sys.executable, str(client)], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        "client failed:\nstdout:%s\nstderr:%s" % (proc.stdout, proc.stderr))
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    np.testing.assert_allclose(np.array(res["out"]), expected,
+                               rtol=1e-4, atol=1e-5)
+    assert res["keys"] == ["arg:fc1_bias", "arg:fc1_weight"]
+    np.testing.assert_allclose(res["wsum"], w.sum(), rtol=1e-5)
