@@ -14,6 +14,7 @@ are serialized as strings like nnvm does, and ``load`` accepts both the
 """
 from __future__ import annotations
 
+import ast as _ast
 import json
 import sys
 
@@ -287,7 +288,12 @@ class Symbol:
         shapes = {}  # id(node) -> list of out shapes (or None)
         for n in order:
             if n.op is None:
-                shapes[id(n)] = [known.get(n.name)]
+                s = known.get(n.name)
+                if s is None and "__shape__" in n._attr_dict:
+                    # Variable(shape=...) hint seeds inference, matching
+                    # reference python/mxnet/symbol.py Variable semantics
+                    s = tuple(_ast.literal_eval(n._attr_dict["__shape__"]))
+                shapes[id(n)] = [s]
             else:
                 shapes[id(n)] = [None] * n.num_outputs()
 

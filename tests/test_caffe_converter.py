@@ -1,0 +1,256 @@
+"""caffe_converter: prototxt -> symbol, caffemodel -> params
+(reference tools/caffe_converter; its test_converter.py downloads model
+zoos — here a synthetic conv/bn/scale/fc net is generated with the same
+protobuf schema and the converted network's output is checked against a
+numpy reference computation)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from caffe_converter import caffe_parser  # noqa: E402
+from caffe_converter.convert_model import convert_model  # noqa: E402
+from caffe_converter.convert_symbol import convert_symbol  # noqa: E402
+
+import shutil
+
+if (shutil.which("protoc") is None
+        and not os.path.exists(os.path.join(
+            ROOT, "tools", "caffe_converter", "_gen",
+            "caffe_subset_pb2.py"))):  # pragma: no cover
+    pytest.skip("protoc unavailable and no pre-generated module",
+                allow_module_level=True)
+
+
+def _build_net(tmp_path):
+    """Emit deploy.prototxt + net.caffemodel for a small conv net."""
+    from google.protobuf import text_format
+    pb2 = caffe_parser._pb2()
+    rng = np.random.RandomState(3)
+
+    def layer(net, name, ltype, bottoms, tops):
+        lay = net.layer.add()
+        lay.name, lay.type = name, ltype
+        lay.bottom.extend(bottoms)
+        lay.top.extend(tops)
+        return lay
+
+    def fill(lay, *arrs):
+        for a in arrs:
+            b = lay.blobs.add()
+            b.shape.dim.extend(a.shape)
+            b.data.extend(a.astype(np.float32).reshape(-1))
+
+    net = pb2.NetParameter()
+    net.name = "tiny"
+    inp = layer(net, "input", "Input", [], ["data"])
+    inp.input_param.shape.add().dim.extend([2, 3, 8, 8])
+
+    conv = layer(net, "conv1", "Convolution", ["data"], ["conv1"])
+    conv.convolution_param.num_output = 4
+    conv.convolution_param.kernel_size.append(3)
+    conv.convolution_param.pad.append(1)
+    conv.convolution_param.stride.append(1)
+
+    bn = layer(net, "bn1", "BatchNorm", ["conv1"], ["bn1"])
+    bn.batch_norm_param.use_global_stats = True
+    bn.batch_norm_param.eps = 1e-5
+    sc = layer(net, "scale1", "Scale", ["bn1"], ["scale1"])
+    sc.scale_param.bias_term = True
+
+    layer(net, "relu1", "ReLU", ["scale1"], ["relu1"])
+    pool = layer(net, "pool1", "Pooling", ["relu1"], ["pool1"])
+    pool.pooling_param.pool = pb2.PoolingParameter.AVE
+    pool.pooling_param.global_pooling = True
+
+    fc = layer(net, "fc1", "InnerProduct", ["pool1"], ["fc1"])
+    fc.inner_product_param.num_output = 5
+    layer(net, "prob", "Softmax", ["fc1"], ["prob"])
+
+    proto_path = str(tmp_path / "deploy.prototxt")
+    with open(proto_path, "w") as f:
+        f.write(text_format.MessageToString(net))
+
+    # weights (BN blobs stored Caffe-style: sums + scale factor 0.5)
+    W = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    bconv = rng.randn(4).astype(np.float32) * 0.1
+    mean, var = rng.randn(4).astype(np.float32) * 0.05, \
+        (rng.rand(4).astype(np.float32) + 0.5)
+    sf = 0.5
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32) * 0.1
+    Wfc = rng.randn(5, 4).astype(np.float32) * 0.3
+    bfc = rng.randn(5).astype(np.float32) * 0.1
+
+    weights = pb2.NetParameter()
+    weights.name = "tiny"
+    fill(layer(weights, "conv1", "Convolution", ["data"], ["conv1"]),
+         W, bconv)
+    fill(layer(weights, "bn1", "BatchNorm", ["conv1"], ["bn1"]),
+         mean / sf, var / sf, np.array([1.0 / sf]))
+    fill(layer(weights, "scale1", "Scale", ["bn1"], ["scale1"]),
+         gamma, beta)
+    fill(layer(weights, "fc1", "InnerProduct", ["pool1"], ["fc1"]),
+         Wfc, bfc)
+    model_path = str(tmp_path / "net.caffemodel")
+    with open(model_path, "wb") as f:
+        f.write(weights.SerializeToString())
+
+    ref = dict(W=W, bconv=bconv, mean=mean, var=var, gamma=gamma,
+               beta=beta, Wfc=Wfc, bfc=bfc)
+    return proto_path, model_path, ref
+
+
+def _conv2d(x, W, b):
+    n, c, h, w = x.shape
+    o = W.shape[0]
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    out = np.zeros((n, o, h, w), np.float32)
+    for i in range(h):
+        for j in range(w):
+            patch = xp[:, :, i:i + 3, j:j + 3].reshape(n, -1)
+            out[:, :, i, j] = patch @ W.reshape(o, -1).T + b
+    return out
+
+
+def test_symbol_conversion(tmp_path):
+    proto_path, _, _ = _build_net(tmp_path)
+    sym, in_name, dims = convert_symbol(proto_path)
+    assert in_name == "data" and tuple(dims) == (2, 3, 8, 8)
+    args = set(sym.list_arguments())
+    assert {"conv1_weight", "conv1_bias", "bn1_gamma", "bn1_beta",
+            "fc1_weight", "fc1_bias"} <= args
+
+
+def test_model_conversion_end_to_end(tmp_path):
+    import mxnet_tpu as mx
+    proto_path, model_path, ref = _build_net(tmp_path)
+    sym, arg_params, aux_params, in_name, dims = convert_model(
+        proto_path, model_path)
+
+    mod = mx.mod.Module(sym, data_names=[in_name],
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=[(in_name, tuple(dims))], label_shapes=None,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    x = np.random.RandomState(0).rand(*dims).astype(np.float32)
+    from mxnet_tpu.io import DataBatch
+    mod.forward(DataBatch([mx.nd.array(x)], []), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    # numpy reference: conv -> BN(global stats) -> scale -> relu ->
+    # global avg pool -> fc -> softmax
+    y = _conv2d(x, ref["W"], ref["bconv"])
+    y = (y - ref["mean"].reshape(1, -1, 1, 1)) / np.sqrt(
+        ref["var"].reshape(1, -1, 1, 1) + 1e-5)
+    y = y * ref["gamma"].reshape(1, -1, 1, 1) + \
+        ref["beta"].reshape(1, -1, 1, 1)
+    y = np.maximum(y, 0)
+    y = y.mean(axis=(2, 3))
+    y = y @ ref["Wfc"].T + ref["bfc"]
+    e = np.exp(y - y.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+def test_edge_layers(tmp_path):
+    """Asymmetric *_h/*_w geometry, Eltwise coeff, Reshape, Scale w/o
+    bias — the silent-mistranslation traps."""
+    from google.protobuf import text_format
+    import mxnet_tpu as mx
+    pb2 = caffe_parser._pb2()
+    net = pb2.NetParameter()
+
+    def layer(name, ltype, bottoms, tops):
+        lay = net.layer.add()
+        lay.name, lay.type = name, ltype
+        lay.bottom.extend(bottoms)
+        lay.top.extend(tops)
+        return lay
+
+    inp = layer("input", "Input", [], ["data"])
+    inp.input_param.shape.add().dim.extend([1, 2, 6, 6])
+    c = layer("conv_asym", "Convolution", ["data"], ["c"])
+    c.convolution_param.num_output = 2
+    c.convolution_param.kernel_h = 1
+    c.convolution_param.kernel_w = 3
+    c.convolution_param.pad_h = 0
+    c.convolution_param.pad_w = 1
+    sc = layer("scale_nb", "Scale", ["c"], ["s"])
+    sc.scale_param.bias_term = False
+    e = layer("sub", "Eltwise", ["c", "s"], ["e"])
+    e.eltwise_param.operation = pb2.EltwiseParameter.SUM
+    e.eltwise_param.coeff.extend([1.0, -1.0])
+    r = layer("resh", "Reshape", ["e"], ["r"])
+    r.reshape_param.shape.dim.extend([0, -1])
+    layer("prob", "Softmax", ["r"], ["prob"])
+
+    path = str(tmp_path / "edge.prototxt")
+    with open(path, "w") as f:
+        f.write(text_format.MessageToString(net))
+    sym, in_name, dims = convert_symbol(path)
+    args = set(sym.list_arguments())
+    assert "scale_nb_gamma" in args and "scale_nb_beta" not in args
+
+    mod = mx.mod.Module(sym, data_names=[in_name],
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=[(in_name, tuple(dims))], label_shapes=None,
+             for_training=False)
+    rng = np.random.RandomState(5)
+    W = rng.randn(2, 2, 1, 3).astype(np.float32)
+    g = rng.rand(2).astype(np.float32) + 0.5
+    mod.set_params({"conv_asym_weight": __import__("mxnet_tpu").nd.array(W),
+                    "conv_asym_bias": __import__("mxnet_tpu").nd.zeros((2,)),
+                    "scale_nb_gamma": __import__("mxnet_tpu").nd.array(g)},
+                   {})
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    from mxnet_tpu.io import DataBatch
+    mod.forward(DataBatch([__import__("mxnet_tpu").nd.array(x)], []),
+                is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    # numpy ref: conv(1x3, pad (0,1)) -> c - gamma*c -> flatten -> softmax
+    xp = np.pad(x, ((0, 0), (0, 0), (0, 0), (1, 1)))
+    conv = np.zeros((1, 2, 6, 6), np.float32)
+    for i in range(6):
+        for j in range(6):
+            patch = xp[:, :, i, j:j + 3].reshape(1, -1)
+            conv[:, :, i, j] = patch @ W.reshape(2, -1).T
+    y = conv - g.reshape(1, -1, 1, 1) * conv
+    y = y.reshape(1, -1)
+    ex = np.exp(y - y.max(axis=1, keepdims=True))
+    want = ex / ex.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+def test_convert_mean(tmp_path):
+    from caffe_converter.convert_mean import convert_mean
+    pb2 = caffe_parser._pb2()
+    mean = np.random.RandomState(1).rand(3, 4, 4).astype(np.float32)
+    blob = pb2.BlobProto()
+    blob.shape.dim.extend(mean.shape)
+    blob.data.extend(mean.reshape(-1))
+    path = str(tmp_path / "mean.binaryproto")
+    with open(path, "wb") as f:
+        f.write(blob.SerializeToString())
+    nd = convert_mean(path, str(tmp_path / "mean.nd"))
+    np.testing.assert_allclose(nd.asnumpy(), mean, rtol=1e-6)
+    import mxnet_tpu as mx
+    loaded = mx.nd.load(str(tmp_path / "mean.nd"))
+    np.testing.assert_allclose(loaded["mean_img"].asnumpy(), mean,
+                               rtol=1e-6)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import mxnet_tpu as mx
+    proto_path, model_path, _ = _build_net(tmp_path)
+    sym, arg_params, aux_params, _, _ = convert_model(proto_path, model_path)
+    prefix = str(tmp_path / "converted")
+    mx.model.save_checkpoint(prefix, 0, sym, arg_params, aux_params)
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 0)
+    assert set(args2) == set(arg_params)
+    assert set(aux2) == set(aux_params)

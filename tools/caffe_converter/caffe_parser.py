@@ -1,0 +1,88 @@
+"""Parse Caffe deploy prototxt / caffemodel files.
+
+Counterpart of the reference's tools/caffe_converter/caffe_parser.py —
+there it imports the caffe python package or a pre-generated caffe_pb2;
+here the minimal schema subset (caffe_subset.proto) is compiled on first
+use with the system protoc, so no Caffe installation is needed.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_GEN = os.path.join(_HERE, "_gen")
+
+
+def _pb2():
+    """Compile caffe_subset.proto once and import the generated module.
+    Falls back to an already-generated module when protoc is unavailable
+    (checkout mtimes are arbitrary; a stale-looking module still works)."""
+    import shutil
+    mod_path = os.path.join(_GEN, "caffe_subset_pb2.py")
+    proto = os.path.join(_HERE, "caffe_subset.proto")
+    stale = (not os.path.exists(mod_path)
+             or os.path.getmtime(mod_path) < os.path.getmtime(proto))
+    if stale:
+        if shutil.which("protoc"):
+            os.makedirs(_GEN, exist_ok=True)
+            subprocess.run(
+                ["protoc", "--proto_path", _HERE, "--python_out", _GEN,
+                 proto], check=True)
+        elif not os.path.exists(mod_path):
+            raise RuntimeError(
+                "protoc not found and no pre-generated caffe_subset_pb2 "
+                "module exists — install protoc to use the converter")
+    if _GEN not in sys.path:
+        sys.path.insert(0, _GEN)
+    import caffe_subset_pb2
+    return caffe_subset_pb2
+
+
+def read_prototxt(path):
+    """Parse a network prototxt (text format) into a NetParameter."""
+    from google.protobuf import text_format
+    pb2 = _pb2()
+    net = pb2.NetParameter()
+    with open(path) as f:
+        try:
+            text_format.Parse(f.read(), net, allow_unknown_field=True)
+        except TypeError:  # older protobuf without the kwarg
+            f.seek(0)
+            text_format.Parse(f.read(), net)
+    return net
+
+
+def read_caffemodel(path):
+    """Parse binary .caffemodel weights into a NetParameter
+    (unknown/legacy fields are skipped by protobuf)."""
+    pb2 = _pb2()
+    net = pb2.NetParameter()
+    with open(path, "rb") as f:
+        net.ParseFromString(f.read())
+    return net
+
+
+def get_layers(net):
+    """Layer list of a NetParameter (the V2 'layer' field; legacy V1
+    'layers' graphs must be upgraded with Caffe's own tool first)."""
+    if len(net.layer) == 0:
+        raise ValueError(
+            "prototxt has no V2 'layer' entries; legacy V1 'layers' nets "
+            "are not supported — upgrade with caffe's upgrade_net_proto_*")
+    return list(net.layer)
+
+
+def blob_array(blob):
+    """BlobProto -> numpy array with its declared shape."""
+    import numpy as np
+    if len(blob.double_data):
+        arr = np.array(blob.double_data, dtype=np.float64)
+    else:
+        arr = np.array(blob.data, dtype=np.float32)
+    if blob.HasField("shape") and len(blob.shape.dim):
+        return arr.reshape(tuple(int(d) for d in blob.shape.dim))
+    dims = [blob.num, blob.channels, blob.height, blob.width]
+    dims = [d for d in dims if d > 0]
+    return arr.reshape(tuple(dims)) if dims else arr

@@ -1,0 +1,45 @@
+"""Convert a Caffe mean.binaryproto file to an mxnet_tpu .nd file.
+
+Counterpart of the reference's tools/caffe_converter/convert_mean.py:
+the mean image ships as a serialized BlobProto; save it under the key
+"mean_img" so ImageIter/feedforward mean subtraction can load it.
+"""
+from __future__ import annotations
+
+import argparse
+
+try:
+    from . import caffe_parser
+except ImportError:
+    import caffe_parser
+
+
+def convert_mean(binaryproto_path, output_path=None):
+    import numpy as np
+    import mxnet_tpu as mx
+
+    pb2 = caffe_parser._pb2()
+    blob = pb2.BlobProto()
+    with open(binaryproto_path, "rb") as f:
+        blob.ParseFromString(f.read())
+    img = caffe_parser.blob_array(blob).astype(np.float32)
+    if img.ndim == 4:  # (1, C, H, W) -> (C, H, W)
+        img = img[0]
+    nd = mx.nd.array(img)
+    if output_path:
+        mx.nd.save(output_path, {"mean_img": nd})
+    return nd
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Convert mean.binaryproto to a .nd file")
+    ap.add_argument("binaryproto")
+    ap.add_argument("output_nd")
+    args = ap.parse_args()
+    nd = convert_mean(args.binaryproto, args.output_nd)
+    print("wrote %s (mean_img %s)" % (args.output_nd, nd.shape))
+
+
+if __name__ == "__main__":
+    main()

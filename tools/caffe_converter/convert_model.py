@@ -1,0 +1,112 @@
+"""Convert Caffe weights (.caffemodel) to mxnet_tpu checkpoint files.
+
+Counterpart of the reference's tools/caffe_converter/convert_model.py:
+maps layer blobs onto this framework's parameter naming —
+  Convolution/Deconvolution: blobs[0] -> <name>_weight, blobs[1] -> _bias
+  InnerProduct:              blobs[0] (num_output x in) -> <name>_weight
+  BatchNorm: blobs[0]/sf -> moving_mean, blobs[1]/sf -> moving_var where
+             sf = blobs[2] scale factor (Caffe stores unnormalized sums)
+  Scale after BatchNorm:     blobs[0] -> <bn>_gamma, blobs[1] -> <bn>_beta
+Saves a `<prefix>-symbol.json` + `<prefix>-0000.params` checkpoint pair
+loadable by Module / FeedForward.load.
+"""
+from __future__ import annotations
+
+import argparse
+
+try:
+    from . import caffe_parser
+    from .convert_symbol import convert_symbol
+except ImportError:
+    import caffe_parser
+    from convert_symbol import convert_symbol
+
+
+def convert_model(prototxt_path, caffemodel_path):
+    """Returns (symbol, arg_params, aux_params, input_name, input_dims)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    sym, input_name, input_dims = convert_symbol(prototxt_path)
+    model = caffe_parser.read_caffemodel(caffemodel_path)
+    layers = {lay.name: lay for lay in caffe_parser.get_layers(model)}
+    proto_layers = caffe_parser.get_layers(
+        caffe_parser.read_prototxt(prototxt_path))
+
+    arg_params, aux_params = {}, {}
+    # map Scale layers to the BatchNorm they follow (top-blob chaining)
+    bn_by_top = {}
+    for lay in proto_layers:
+        if lay.type == "BatchNorm":
+            bn_by_top[lay.top[0]] = lay.name
+
+    def blobs_of(name):
+        lay = layers.get(name)
+        return [caffe_parser.blob_array(b) for b in lay.blobs] if lay else []
+
+    for lay in proto_layers:
+        blobs = blobs_of(lay.name)
+        if not blobs:
+            continue
+        t, name = lay.type, lay.name
+        if t in ("Convolution", "Deconvolution", "InnerProduct"):
+            w = blobs[0].astype(np.float32)
+            if t == "InnerProduct" and w.ndim > 2:
+                w = w.reshape(w.shape[0], -1)
+            arg_params[name + "_weight"] = mx.nd.array(w)
+            if len(blobs) > 1:
+                arg_params[name + "_bias"] = mx.nd.array(
+                    blobs[1].astype(np.float32).reshape(-1))
+        elif t == "BatchNorm":
+            sf = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 else 1.0
+            sf = 1.0 / sf if sf != 0 else 0.0
+            aux_params[name + "_moving_mean"] = mx.nd.array(
+                blobs[0].astype(np.float32).reshape(-1) * sf)
+            aux_params[name + "_moving_var"] = mx.nd.array(
+                blobs[1].astype(np.float32).reshape(-1) * sf)
+        elif t == "Scale":
+            bn = bn_by_top.get(lay.bottom[0])
+            prefix = (bn if bn is not None else name)
+            gamma = blobs[0].astype(np.float32).reshape(-1)
+            arg_params[prefix + "_gamma"] = mx.nd.array(gamma)
+            if len(blobs) > 1:
+                arg_params[prefix + "_beta"] = mx.nd.array(
+                    blobs[1].astype(np.float32).reshape(-1))
+            elif bn is not None:
+                # Scale without bias fused into BatchNorm: the BN symbol
+                # always carries a beta argument — zero it
+                arg_params[prefix + "_beta"] = mx.nd.zeros(gamma.shape)
+
+    # BN layers converted with fix_gamma=True (no Scale pair) still need
+    # gamma/beta entries so bind() finds every argument
+    needed = set(sym.list_arguments())
+    for bn_name in bn_by_top.values():
+        g, b = bn_name + "_gamma", bn_name + "_beta"
+        mm = bn_name + "_moving_mean"
+        if g in needed and g not in arg_params and mm in aux_params:
+            n = aux_params[mm].shape[0]
+            arg_params[g] = mx.nd.ones((n,))
+            arg_params[b] = mx.nd.zeros((n,))
+    return sym, arg_params, aux_params, input_name, input_dims
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Convert a Caffe model to an mxnet_tpu checkpoint")
+    ap.add_argument("prototxt")
+    ap.add_argument("caffemodel")
+    ap.add_argument("save_prefix")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    sym, arg_params, aux_params, in_name, dims = convert_model(
+        args.prototxt, args.caffemodel)
+    mx.model.save_checkpoint(args.save_prefix, 0, sym, arg_params,
+                             aux_params)
+    print("saved %s-symbol.json / %s-0000.params (input %s %s; %d args, "
+          "%d aux)" % (args.save_prefix, args.save_prefix, in_name, dims,
+                       len(arg_params), len(aux_params)))
+
+
+if __name__ == "__main__":
+    main()

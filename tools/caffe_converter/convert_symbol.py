@@ -1,0 +1,260 @@
+"""Convert a Caffe deploy prototxt to an mxnet_tpu Symbol.
+
+Counterpart of the reference's tools/caffe_converter/convert_symbol.py.
+Design differs: the reference emits python source text for each layer and
+exec()s it; here symbols are composed directly from the parsed proto, and
+BatchNorm+Scale pairs are fused into one BatchNorm symbol (Caffe splits
+affine BN across two layers; this framework's BatchNorm carries gamma/beta
+itself).
+"""
+from __future__ import annotations
+
+import argparse
+
+try:
+    from . import caffe_parser
+except ImportError:  # run as a script from this directory
+    import caffe_parser
+
+
+def _pair(param, field, default, hw_field=None):
+    """Caffe geometry field -> (h, w). Handles the three schema styles:
+    repeated (Convolution), scalar (Pooling), and explicit *_h/*_w.
+    Presence (HasField), not truthiness: `pad_h: 0 pad_w: 3` is a
+    legitimate asymmetric setting."""
+    hw = hw_field or field
+    has_h = param.HasField(hw + "_h") if hw + "_h" in (
+        f.name for f in param.DESCRIPTOR.fields) else False
+    has_w = param.HasField(hw + "_w") if hw + "_w" in (
+        f.name for f in param.DESCRIPTOR.fields) else False
+    if has_h or has_w:
+        return (int(getattr(param, hw + "_h")),
+                int(getattr(param, hw + "_w")))
+    val = getattr(param, field)
+    try:
+        rep = list(val)
+    except TypeError:  # scalar field (PoolingParameter)
+        if param.HasField(field):
+            return (int(val), int(val))
+        return (default, default)
+    if len(rep) == 1:
+        return (int(rep[0]), int(rep[0]))
+    if len(rep) >= 2:
+        return (int(rep[0]), int(rep[1]))
+    return (default, default)
+
+
+def _input_of(net):
+    layers = caffe_parser.get_layers(net)
+    if len(net.input):  # deprecated top-level input declaration
+        name = net.input[0]
+        if len(net.input_shape):
+            dims = tuple(int(d) for d in net.input_shape[0].dim)
+        elif len(net.input_dim):
+            dims = tuple(int(d) for d in net.input_dim)
+        else:
+            dims = None
+        return name, dims, layers
+    if layers and layers[0].type == "Input":
+        lay = layers[0]
+        dims = (tuple(int(d) for d in lay.input_param.shape[0].dim)
+                if len(lay.input_param.shape) else None)
+        return lay.top[0], dims, layers[1:]
+    raise ValueError("cannot find the network input "
+                     "(no net.input and no Input layer)")
+
+
+def convert_symbol(prototxt_path):
+    """Returns (symbol, input_name, input_dims or None).
+
+    Layer coverage: Input, Convolution, Pooling, InnerProduct, ReLU,
+    Sigmoid, TanH, LRN, Dropout, BatchNorm(+Scale fused), Concat,
+    Eltwise(SUM/PROD/MAX), Flatten, Softmax, SoftmaxWithLoss, Accuracy
+    (skipped), Silence (skipped).
+    """
+    import mxnet_tpu as mx
+
+    net = caffe_parser.read_prototxt(prototxt_path)
+    input_name, input_dims, layers = _input_of(net)
+
+    tops = {input_name: mx.sym.Variable(input_name)}
+    # Scale layers directly after BatchNorm are folded into the BN symbol;
+    # remember BN tops so the Scale pass-through can be detected
+    bn_tops = {}
+
+    def get(name):
+        if name not in tops:
+            raise ValueError("bottom blob %r not produced by any layer"
+                             % name)
+        return tops[name]
+
+    for lay in layers:
+        t = lay.type
+        name = lay.name
+        bottoms = list(lay.bottom)
+        out = None
+        if t == "Convolution":
+            p = lay.convolution_param
+            out = mx.sym.Convolution(
+                data=get(bottoms[0]), name=name,
+                num_filter=int(p.num_output),
+                kernel=_pair(p, "kernel_size", 1, "kernel"),
+                stride=_pair(p, "stride", 1),
+                pad=_pair(p, "pad", 0), dilate=_pair(p, "dilation", 1),
+                num_group=int(p.group), no_bias=not p.bias_term)
+        elif t == "Deconvolution":
+            p = lay.convolution_param
+            out = mx.sym.Deconvolution(
+                data=get(bottoms[0]), name=name,
+                num_filter=int(p.num_output),
+                kernel=_pair(p, "kernel_size", 1, "kernel"),
+                stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                num_group=int(p.group), no_bias=not p.bias_term)
+        elif t == "Pooling":
+            p = lay.pooling_param
+            if int(p.pool) == 2:
+                raise ValueError("STOCHASTIC pooling (layer %r) has no "
+                                 "equivalent here" % name)
+            ptype = {0: "max", 1: "avg"}[int(p.pool)]
+            kwargs = dict(pool_type=ptype,
+                          pooling_convention="full",
+                          name=name)
+            if p.global_pooling:
+                kwargs.update(global_pool=True, kernel=(1, 1))
+            else:
+                kwargs.update(kernel=_pair(p, "kernel_size", 1, "kernel"),
+                              stride=_pair(p, "stride", 1),
+                              pad=_pair(p, "pad", 0))
+            out = mx.sym.Pooling(data=get(bottoms[0]), **kwargs)
+        elif t == "InnerProduct":
+            p = lay.inner_product_param
+            out = mx.sym.FullyConnected(
+                data=get(bottoms[0]), name=name,
+                num_hidden=int(p.num_output), no_bias=not p.bias_term)
+        elif t == "ReLU":
+            out = mx.sym.Activation(data=get(bottoms[0]), act_type="relu",
+                                    name=name)
+        elif t == "Sigmoid":
+            out = mx.sym.Activation(data=get(bottoms[0]),
+                                    act_type="sigmoid", name=name)
+        elif t == "TanH":
+            out = mx.sym.Activation(data=get(bottoms[0]), act_type="tanh",
+                                    name=name)
+        elif t == "LRN":
+            p = lay.lrn_param
+            out = mx.sym.LRN(data=get(bottoms[0]), name=name,
+                             alpha=float(p.alpha), beta=float(p.beta),
+                             knorm=float(p.k), nsize=int(p.local_size))
+        elif t == "Dropout":
+            p = lay.dropout_param
+            out = mx.sym.Dropout(data=get(bottoms[0]), name=name,
+                                 p=float(p.dropout_ratio))
+        elif t == "BatchNorm":
+            p = lay.batch_norm_param
+            bn_kwargs = dict(name=name, eps=max(float(p.eps), 1e-5),
+                             momentum=float(p.moving_average_fraction),
+                             use_global_stats=bool(p.use_global_stats))
+            out = mx.sym.BatchNorm(data=get(bottoms[0]), fix_gamma=True,
+                                   **bn_kwargs)
+            bn_tops[lay.top[0]] = (get(bottoms[0]), bn_kwargs)
+        elif t == "Scale":
+            # Caffe idiom: Scale right after BatchNorm supplies gamma/beta.
+            # The BN symbol was created with fix_gamma=True; rebuild it with
+            # learnable gamma so the Scale weights land in <bn>_gamma/_beta.
+            src = bottoms[0]
+            if src in bn_tops:
+                data_sym, bn_kwargs = bn_tops[src]
+                out = mx.sym.BatchNorm(data=data_sym, fix_gamma=False,
+                                       **bn_kwargs)
+            else:  # standalone scale: per-channel affine via broadcast
+                x = get(src)
+                # pin gamma/beta to the channel count so shape inference
+                # has no ambiguity through the (1,-1,1,1) reshape
+                ch = None
+                if input_dims is not None:
+                    try:
+                        _, outs_sh, _ = x.infer_shape(
+                            **{input_name: tuple(input_dims)})
+                        ch = int(outs_sh[0][1])
+                    except Exception:
+                        pass
+                shp = (ch,) if ch else None
+                gamma = mx.sym.Variable(name + "_gamma", shape=shp)
+                out = mx.sym.broadcast_mul(
+                    x, mx.sym.reshape(gamma, shape=(1, -1, 1, 1)))
+                if lay.scale_param.bias_term:
+                    beta = mx.sym.Variable(name + "_beta", shape=shp)
+                    out = mx.sym.broadcast_add(
+                        out, mx.sym.reshape(beta, shape=(1, -1, 1, 1)))
+        elif t == "Concat":
+            p = lay.concat_param
+            out = mx.sym.Concat(*[get(b) for b in bottoms], name=name,
+                                dim=int(p.axis))
+        elif t == "Eltwise":
+            p = lay.eltwise_param
+            op = int(p.operation)
+            coeff = list(p.coeff)
+            syms = [get(b) for b in bottoms]
+            if coeff and op != 1:
+                raise ValueError("Eltwise coeff only applies to SUM "
+                                 "(layer %r)" % name)
+            if coeff and len(coeff) != len(syms):
+                raise ValueError("Eltwise %r: %d coeffs for %d bottoms"
+                                 % (name, len(coeff), len(syms)))
+            if op == 1 and coeff:
+                syms = [s if c == 1.0 else s * float(c)
+                        for s, c in zip(syms, coeff)]
+            acc = syms[0]
+            for s in syms[1:]:
+                if op == 0:
+                    acc = acc * s
+                elif op == 1:
+                    acc = acc + s
+                else:
+                    acc = mx.sym.maximum(acc, s)
+            out = acc
+        elif t == "Flatten":
+            out = mx.sym.Flatten(data=get(bottoms[0]), name=name)
+        elif t == "Reshape":
+            p = lay.reshape_param
+            if int(p.axis) != 0 or int(p.num_axes) != -1:
+                raise ValueError("Reshape axis/num_axes not supported "
+                                 "(layer %r)" % name)
+            dims = tuple(int(d) for d in p.shape.dim)
+            # Caffe dim semantics match this framework's Reshape: 0 copies
+            # the input dimension, -1 infers from the remaining size
+            out = mx.sym.Reshape(data=get(bottoms[0]), shape=dims,
+                                 name=name)
+        elif t in ("Softmax", "SoftmaxWithLoss"):
+            # single-head nets keep the conventional "softmax"/"softmax_label"
+            # naming; multi-head nets get per-layer names to avoid collisions
+            n_soft = sum(1 for l2 in layers
+                         if l2.type in ("Softmax", "SoftmaxWithLoss"))
+            out = mx.sym.SoftmaxOutput(
+                data=get(bottoms[0]),
+                name="softmax" if n_soft == 1 else name)
+        elif t in ("Accuracy", "Silence", "Data", "ImageData", "HDF5Data"):
+            continue
+        else:
+            raise ValueError("unsupported Caffe layer type %r (layer %r)"
+                             % (t, name))
+        for top in lay.top:
+            tops[top] = out
+
+    return out, input_name, input_dims
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Convert Caffe deploy prototxt to mxnet_tpu symbol")
+    ap.add_argument("prototxt")
+    ap.add_argument("output_json")
+    args = ap.parse_args()
+    sym, in_name, dims = convert_symbol(args.prototxt)
+    with open(args.output_json, "w") as f:
+        f.write(sym.tojson())
+    print("wrote %s (input %s %s)" % (args.output_json, in_name, dims))
+
+
+if __name__ == "__main__":
+    main()

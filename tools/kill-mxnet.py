@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Kill a distributed training job on every host of a hostfile.
+
+Counterpart of the reference's tools/kill-mxnet.py: for each host in the
+hostfile (one ``host[:port]`` per line) ssh in and kill all of ``user``'s
+processes whose command line matches ``prog``, then do the same locally.
+
+Usage: kill-mxnet.py <hostfile> <user> <prog>
+"""
+import os
+import subprocess
+import sys
+
+
+def _kill_cmd(user, prog):
+    # pgrep -f matches full command lines; exclude whatever shell/python
+    # is running this very command (its argv also contains the pattern)
+    import shlex
+    q = shlex.quote(prog)
+    return ("for p in $(pgrep -u %s -f %s); do "
+            "[ \"$p\" != \"$$\" ] && [ \"$p\" != \"$PPID\" ] && "
+            "kill -9 \"$p\"; done; true" % (shlex.quote(user), q))
+
+
+def main():
+    if len(sys.argv) != 4:
+        sys.stderr.write("usage: %s <hostfile> <user> <prog>\n" % sys.argv[0])
+        return 1
+    hostfile, user, prog = sys.argv[1:4]
+    cmd = _kill_cmd(user, prog)
+    print(cmd)
+
+    procs = []
+    with open(hostfile) as f:
+        for line in f:
+            host = line.strip()
+            if not host or host.startswith("#"):
+                continue
+            host = host.split(":")[0]
+            print("killing on %s" % host)
+            try:
+                procs.append(subprocess.Popen(
+                    ["ssh", "-o", "StrictHostKeyChecking=no", host, cmd],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            except FileNotFoundError:
+                sys.stderr.write("ssh not available; skipping %s\n" % host)
+    for p in procs:
+        p.wait()
+    os.system(cmd)
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
