@@ -54,6 +54,7 @@ from . import test_utils
 from . import operator
 from . import rtc
 from . import torch
+from . import plugin
 from . import parallel
 
 from .attribute import AttrScope

@@ -82,7 +82,7 @@ def _ctc_loss_single(jnp, logprobs, labels, blank):
           attr_types={"use_data_lengths": bool, "use_label_lengths": bool,
                       "blank_label": str},
           infer_shape=_ctc_infer, num_outputs=1,
-          alias=("ctc_loss", "_contrib_CTCLoss", "WarpCTC"))
+          alias=("ctc_loss", "_contrib_CTCLoss"))
 def _ctc_loss(attrs, ins, octx):
     """data (T, N, C) activations (softmax applied internally),
     label (N, L) 1-indexed classes padded with 0; returns per-sample loss

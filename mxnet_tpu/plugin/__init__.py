@@ -1,0 +1,28 @@
+"""Plugin namespace (reference plugin/ directory).
+
+- ``warpctc`` — WarpCTC op with the Baidu-plugin contract, lowered onto
+  the native lax.scan CTC (imported eagerly: registers ``mx.sym.WarpCTC``)
+- ``caffe``  — CaffeOp/CaffeLoss: Caffe layer prototxts lowered to
+  native symbols via tools/caffe_converter (no libcaffe)
+- ``opencv`` — cv-style imdecode/resize/copyMakeBorder + ImageListIter
+  over the framework's native/PIL image kernels
+
+The reference's ``sframe`` plugin (SFrame database iterator) has no
+counterpart: it binds the proprietary SFrame C++ SDK; use ImageRecordIter
+or CSVIter.
+"""
+from . import warpctc  # noqa: F401  (registers the WarpCTC op)
+from . import opencv  # noqa: F401
+from .caffe import CaffeLoss, CaffeOp  # noqa: F401
+
+# ops registered at plugin-import time need re-exposure on the sym/nd
+# namespaces (they were populated at package import)
+from .. import ndarray as _nd
+from .. import symbol as _sym
+_sym._init_symbol_module()
+_nd._init_ndarray_module()
+
+# reference scripts call mx.sym.CaffeOp / mx.sym.CaffeLoss (plugin/caffe
+# registers them as symbols when built in)
+_sym.CaffeOp = CaffeOp
+_sym.CaffeLoss = CaffeLoss

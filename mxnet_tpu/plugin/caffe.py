@@ -1,0 +1,121 @@
+"""Caffe plugin: run Caffe-described layers as native symbols.
+
+Reference counterpart: plugin/caffe/caffe_op.cc — there, CaffeOp embeds
+libcaffe and executes the layer with Caffe's own kernels. Binding Caffe
+is neither possible nor desirable here; instead the ``prototxt`` layer
+string is parsed with the converter's schema (tools/caffe_converter) and
+lowered to the equivalent native operator, so models scripted against
+``mx.sym.CaffeOp`` keep working on TPU with XLA kernels.
+
+    fc = mx.sym.CaffeOp(data, num_weight=2,
+                        prototxt="layer{type:\\"InnerProduct\\" "
+                                 "inner_product_param{num_output: 10}}")
+
+Supported layer types: those of tools/caffe_converter/convert_symbol.py
+minus the cross-layer BatchNorm+Scale fusion. CaffeLoss supports
+SoftmaxWithLoss. CaffeDataIter is NOT provided — it reads LMDB/LevelDB
+databases through libcaffe; use ImageRecordIter instead.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _converter():
+    """Import tools/caffe_converter from the repo layout."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    tools = os.path.join(root, "tools")
+    if not os.path.isdir(os.path.join(tools, "caffe_converter")):
+        raise ImportError(
+            "tools/caffe_converter not found next to the mxnet_tpu "
+            "package — the caffe plugin needs the converter's schema")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    from caffe_converter import caffe_parser, convert_symbol
+    return caffe_parser, convert_symbol
+
+
+def _parse_layer(prototxt):
+    from google.protobuf import text_format
+    caffe_parser, _ = _converter()
+    pb2 = caffe_parser._pb2()
+    lay = pb2.LayerParameter()
+    txt = prototxt.strip()
+    # accept both "layer { ... }" wrappers and bare LayerParameter bodies
+    if txt.startswith("layer"):
+        txt = txt[txt.index("{") + 1:txt.rindex("}")]
+    try:
+        text_format.Parse(txt, lay, allow_unknown_field=True)
+    except TypeError:
+        text_format.Parse(txt, lay)
+    return lay
+
+
+# weight-blob counts by layer type, where knowable (reference CaffeOp's
+# num_weight declares how many trailing inputs are parameters)
+_KNOWN_NUM_WEIGHT = {
+    "Convolution": lambda lay: 2 if lay.convolution_param.bias_term else 1,
+    "Deconvolution": lambda lay: 2 if lay.convolution_param.bias_term
+    else 1,
+    "InnerProduct": lambda lay: 2 if lay.inner_product_param.bias_term
+    else 1,
+    "ReLU": lambda lay: 0, "Sigmoid": lambda lay: 0,
+    "TanH": lambda lay: 0, "Pooling": lambda lay: 0,
+    "LRN": lambda lay: 0, "Dropout": lambda lay: 0,
+    "Concat": lambda lay: 0, "Eltwise": lambda lay: 0,
+    "Flatten": lambda lay: 0, "Reshape": lambda lay: 0,
+    "Softmax": lambda lay: 0,
+}
+
+
+def CaffeOp(*data, prototxt="layer{}", num_data=1, num_weight=0,
+            num_out=1, name=None, **kwargs):
+    """Build the native symbol for a Caffe layer prototxt.
+
+    ``data`` (positional or data_0..data_N kwargs): input symbols.
+    num_weight/num_out are reference-API parameters; num_weight is
+    checked against the layer type's actual parameter count when known.
+    """
+    import mxnet_tpu as mx
+
+    _, convert_symbol_mod = _converter()
+    lay = _parse_layer(prototxt)
+    inputs = list(data)
+    for i in range(num_data):
+        key = "data_%d" % i
+        if key in kwargs:
+            inputs.append(kwargs.pop(key))
+    if not inputs:
+        raise ValueError("CaffeOp needs at least one input symbol")
+    if num_out != 1:
+        raise ValueError("only single-output Caffe layers are supported")
+
+    t = lay.type
+    if not t:
+        raise ValueError("prototxt must set layer type")
+    want = _KNOWN_NUM_WEIGHT.get(t)
+    if want is not None and num_weight not in (0, want(lay)):
+        raise ValueError(
+            "num_weight=%d but a %s layer with this prototxt has %d "
+            "parameter blobs" % (num_weight, t, want(lay)))
+    if not lay.name:
+        lay.name = name or t.lower()
+
+    return convert_symbol_mod.build_layer(mx, lay, inputs,
+                                          name=name or lay.name)
+
+
+def CaffeLoss(data, label, prototxt='layer{type:"SoftmaxWithLoss"}',
+              num_data=2, num_out=1, grad_scale=1.0, name=None):
+    """Caffe loss layer -> native loss symbol (SoftmaxWithLoss only)."""
+    import mxnet_tpu as mx
+
+    lay = _parse_layer(prototxt)
+    t = lay.type or "SoftmaxWithLoss"
+    if t != "SoftmaxWithLoss":
+        raise ValueError("CaffeLoss supports SoftmaxWithLoss, got %r" % t)
+    return mx.sym.SoftmaxOutput(data=data, label=label,
+                                grad_scale=grad_scale,
+                                name=name or "softmax")

@@ -1,0 +1,72 @@
+"""WarpCTC plugin op (reference plugin/warpctc/warpctc-inl.h).
+
+The reference binds Baidu's warp-ctc library; here the op is the native
+lax.scan CTC recursion (ops/sequence_loss.py) wrapped in the plugin's
+exact contract, which differs from CTCLoss:
+
+- data: 2D ``(input_length * minibatch, alphabet_size)`` — time-major
+  flattened activations (warpctc-inl.h InferShape requires ndim==2)
+- label: ``(minibatch * label_length,)`` 0-padded, blank = 0
+- output: softmax(data), same shape as data; the backward pass ignores
+  the head gradient and injects d(sum CTC loss)/d(logits), the
+  SoftmaxOutput pattern.
+"""
+from __future__ import annotations
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _warpctc_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    out = [data] if data is not None else None
+    if data is not None and in_shapes[1] is None:
+        T = int(attrs["input_length"])
+        L = int(attrs["label_length"])
+        n = data[0] // T
+        in_shapes = [data, (n * L,)]
+    return in_shapes, out, aux
+
+
+@register("WarpCTC", arg_names=("data", "label"),
+          attr_types={"label_length": int, "input_length": int},
+          infer_shape=_warpctc_infer, num_outputs=1,
+          backward_ignores_head_grads=True)
+def _warpctc(attrs, ins, octx):
+    import jax
+    jnp = _jnp()
+    from ..ops.sequence_loss import _ctc_loss_single
+
+    T = int(attrs["input_length"])
+    L = int(attrs["label_length"])
+    data, label = ins
+
+    @jax.custom_vjp
+    def f(data, label):
+        return jax.nn.softmax(data, axis=-1)
+
+    def f_fwd(data, label):
+        return jax.nn.softmax(data, axis=-1), (data, label)
+
+    def f_bwd(res, g):
+        data, label = res
+        n = data.shape[0] // T
+        logits = data.reshape(T, n, data.shape[-1])
+        labels = label.reshape(n, L).astype("int32")
+
+        def total_loss(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            losses = jax.vmap(
+                lambda lp_n, lab_n: _ctc_loss_single(jnp, lp_n, lab_n, 0),
+                in_axes=(1, 0))(lp, labels)
+            return jnp.sum(losses)
+
+        grad = jax.grad(total_loss)(logits).reshape(data.shape)
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(data, label)]

@@ -84,9 +84,22 @@ def run_crash(kv):
         time.sleep(1)
     assert dead >= 1, "dead peer not detected within 60s"
     print("DIST_DEAD_DETECTED rank=%d dead=%d" % (rank, dead), flush=True)
+    # Exit ordering: rank 0 HOSTS the coordination service. If it exits
+    # first, the other survivors' error-polling threads see the service
+    # socket close and abort the process (absl FATAL) before they can
+    # finish. Survivors publish their detection through the service's KV
+    # store; the leader leaves only after every expected survivor did.
+    from mxnet_tpu.parallel import dist as _dist
+    client = _dist.get_runtime()._client
+    nworker = kv.num_workers
+    survivors = [r for r in range(nworker) if r != victim and r != 0]
+    if rank != 0:
+        client.key_value_set("crash_detected_r%d" % rank, "1")
+    else:
+        for r in survivors:
+            client.blocking_key_value_get("crash_detected_r%d" % r, 60000)
     # skip the atexit coordination shutdown: with a peer dead there is no
-    # full-job shutdown barrier to complete (and the coordinator may exit
-    # first, racing the ShutdownTask RPC)
+    # full-job shutdown barrier to complete
     os._exit(0)
 
 

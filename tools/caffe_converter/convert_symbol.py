@@ -93,67 +93,8 @@ def convert_symbol(prototxt_path):
         name = lay.name
         bottoms = list(lay.bottom)
         out = None
-        if t == "Convolution":
-            p = lay.convolution_param
-            out = mx.sym.Convolution(
-                data=get(bottoms[0]), name=name,
-                num_filter=int(p.num_output),
-                kernel=_pair(p, "kernel_size", 1, "kernel"),
-                stride=_pair(p, "stride", 1),
-                pad=_pair(p, "pad", 0), dilate=_pair(p, "dilation", 1),
-                num_group=int(p.group), no_bias=not p.bias_term)
-        elif t == "Deconvolution":
-            p = lay.convolution_param
-            out = mx.sym.Deconvolution(
-                data=get(bottoms[0]), name=name,
-                num_filter=int(p.num_output),
-                kernel=_pair(p, "kernel_size", 1, "kernel"),
-                stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
-                num_group=int(p.group), no_bias=not p.bias_term)
-        elif t == "Pooling":
-            p = lay.pooling_param
-            if int(p.pool) == 2:
-                raise ValueError("STOCHASTIC pooling (layer %r) has no "
-                                 "equivalent here" % name)
-            ptype = {0: "max", 1: "avg"}[int(p.pool)]
-            kwargs = dict(pool_type=ptype,
-                          pooling_convention="full",
-                          name=name)
-            if p.global_pooling:
-                kwargs.update(global_pool=True, kernel=(1, 1))
-            else:
-                kwargs.update(kernel=_pair(p, "kernel_size", 1, "kernel"),
-                              stride=_pair(p, "stride", 1),
-                              pad=_pair(p, "pad", 0))
-            out = mx.sym.Pooling(data=get(bottoms[0]), **kwargs)
-        elif t == "InnerProduct":
-            p = lay.inner_product_param
-            out = mx.sym.FullyConnected(
-                data=get(bottoms[0]), name=name,
-                num_hidden=int(p.num_output), no_bias=not p.bias_term)
-        elif t == "ReLU":
-            out = mx.sym.Activation(data=get(bottoms[0]), act_type="relu",
-                                    name=name)
-        elif t == "Sigmoid":
-            out = mx.sym.Activation(data=get(bottoms[0]),
-                                    act_type="sigmoid", name=name)
-        elif t == "TanH":
-            out = mx.sym.Activation(data=get(bottoms[0]), act_type="tanh",
-                                    name=name)
-        elif t == "LRN":
-            p = lay.lrn_param
-            out = mx.sym.LRN(data=get(bottoms[0]), name=name,
-                             alpha=float(p.alpha), beta=float(p.beta),
-                             knorm=float(p.k), nsize=int(p.local_size))
-        elif t == "Dropout":
-            p = lay.dropout_param
-            out = mx.sym.Dropout(data=get(bottoms[0]), name=name,
-                                 p=float(p.dropout_ratio))
-        elif t == "BatchNorm":
-            p = lay.batch_norm_param
-            bn_kwargs = dict(name=name, eps=max(float(p.eps), 1e-5),
-                             momentum=float(p.moving_average_fraction),
-                             use_global_stats=bool(p.use_global_stats))
+        if t == "BatchNorm":
+            bn_kwargs = _bn_kwargs(lay)
             out = mx.sym.BatchNorm(data=get(bottoms[0]), fix_gamma=True,
                                    **bn_kwargs)
             bn_tops[lay.top[0]] = (get(bottoms[0]), bn_kwargs)
@@ -186,48 +127,12 @@ def convert_symbol(prototxt_path):
                     beta = mx.sym.Variable(name + "_beta", shape=shp)
                     out = mx.sym.broadcast_add(
                         out, mx.sym.reshape(beta, shape=(1, -1, 1, 1)))
-        elif t == "Concat":
-            p = lay.concat_param
-            out = mx.sym.Concat(*[get(b) for b in bottoms], name=name,
-                                dim=int(p.axis))
-        elif t == "Eltwise":
-            p = lay.eltwise_param
-            op = int(p.operation)
-            coeff = list(p.coeff)
-            syms = [get(b) for b in bottoms]
-            if coeff and op != 1:
-                raise ValueError("Eltwise coeff only applies to SUM "
-                                 "(layer %r)" % name)
-            if coeff and len(coeff) != len(syms):
-                raise ValueError("Eltwise %r: %d coeffs for %d bottoms"
-                                 % (name, len(coeff), len(syms)))
-            if op == 1 and coeff:
-                syms = [s if c == 1.0 else s * float(c)
-                        for s, c in zip(syms, coeff)]
-            acc = syms[0]
-            for s in syms[1:]:
-                if op == 0:
-                    acc = acc * s
-                elif op == 1:
-                    acc = acc + s
-                else:
-                    acc = mx.sym.maximum(acc, s)
-            out = acc
-        elif t == "Flatten":
-            out = mx.sym.Flatten(data=get(bottoms[0]), name=name)
-        elif t == "Reshape":
-            p = lay.reshape_param
-            if int(p.axis) != 0 or int(p.num_axes) != -1:
-                raise ValueError("Reshape axis/num_axes not supported "
-                                 "(layer %r)" % name)
-            dims = tuple(int(d) for d in p.shape.dim)
-            # Caffe dim semantics match this framework's Reshape: 0 copies
-            # the input dimension, -1 infers from the remaining size
-            out = mx.sym.Reshape(data=get(bottoms[0]), shape=dims,
-                                 name=name)
         elif t in ("Softmax", "SoftmaxWithLoss"):
-            # single-head nets keep the conventional "softmax"/"softmax_label"
-            # naming; multi-head nets get per-layer names to avoid collisions
+            # a TERMINAL Softmax in a deploy prototxt is the prediction
+            # head -> SoftmaxOutput (build_layer's mid-graph Softmax maps
+            # to the activation instead). Single-head nets keep the
+            # conventional "softmax"/"softmax_label" naming; multi-head
+            # nets get per-layer names to avoid collisions.
             n_soft = sum(1 for l2 in layers
                          if l2.type in ("Softmax", "SoftmaxWithLoss"))
             out = mx.sym.SoftmaxOutput(
@@ -236,12 +141,133 @@ def convert_symbol(prototxt_path):
         elif t in ("Accuracy", "Silence", "Data", "ImageData", "HDF5Data"):
             continue
         else:
-            raise ValueError("unsupported Caffe layer type %r (layer %r)"
-                             % (t, name))
+            out = build_layer(mx, lay, [get(b) for b in bottoms])
         for top in lay.top:
             tops[top] = out
 
     return out, input_name, input_dims
+
+
+def _bn_kwargs(lay):
+    p = lay.batch_norm_param
+    return dict(name=lay.name, eps=max(float(p.eps), 1e-5),
+                momentum=float(p.moving_average_fraction),
+                use_global_stats=bool(p.use_global_stats))
+
+
+def build_layer(mx, lay, inputs, name=None):
+    """Single Caffe LayerParameter + input symbols -> native symbol.
+
+    The per-layer mapping shared by convert_symbol() and the CaffeOp
+    plugin (mxnet_tpu/plugin/caffe.py). Cross-layer behaviors — the
+    BatchNorm+Scale fusion, in-place top bookkeeping — stay with the
+    graph-level converter.
+    """
+    t = lay.type
+    name = name or lay.name or t.lower()
+    if t == "Convolution":
+        p = lay.convolution_param
+        return mx.sym.Convolution(
+            data=inputs[0], name=name, num_filter=int(p.num_output),
+            kernel=_pair(p, "kernel_size", 1, "kernel"),
+            stride=_pair(p, "stride", 1),
+            pad=_pair(p, "pad", 0), dilate=_pair(p, "dilation", 1),
+            num_group=int(p.group), no_bias=not p.bias_term)
+    if t == "Deconvolution":
+        p = lay.convolution_param
+        return mx.sym.Deconvolution(
+            data=inputs[0], name=name, num_filter=int(p.num_output),
+            kernel=_pair(p, "kernel_size", 1, "kernel"),
+            stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+            num_group=int(p.group), no_bias=not p.bias_term)
+    if t == "Pooling":
+        p = lay.pooling_param
+        if int(p.pool) == 2:
+            raise ValueError("STOCHASTIC pooling (layer %r) has no "
+                             "equivalent here" % name)
+        ptype = {0: "max", 1: "avg"}[int(p.pool)]
+        kwargs = dict(pool_type=ptype, pooling_convention="full",
+                      name=name)
+        if p.global_pooling:
+            kwargs.update(global_pool=True, kernel=(1, 1))
+        else:
+            kwargs.update(kernel=_pair(p, "kernel_size", 1, "kernel"),
+                          stride=_pair(p, "stride", 1),
+                          pad=_pair(p, "pad", 0))
+        return mx.sym.Pooling(data=inputs[0], **kwargs)
+    if t == "InnerProduct":
+        p = lay.inner_product_param
+        return mx.sym.FullyConnected(
+            data=inputs[0], name=name,
+            num_hidden=int(p.num_output), no_bias=not p.bias_term)
+    if t == "ReLU":
+        return mx.sym.Activation(data=inputs[0], act_type="relu",
+                                 name=name)
+    if t == "Sigmoid":
+        return mx.sym.Activation(data=inputs[0], act_type="sigmoid",
+                                 name=name)
+    if t == "TanH":
+        return mx.sym.Activation(data=inputs[0], act_type="tanh",
+                                 name=name)
+    if t == "LRN":
+        p = lay.lrn_param
+        return mx.sym.LRN(data=inputs[0], name=name,
+                          alpha=float(p.alpha), beta=float(p.beta),
+                          knorm=float(p.k), nsize=int(p.local_size))
+    if t == "Dropout":
+        p = lay.dropout_param
+        return mx.sym.Dropout(data=inputs[0], name=name,
+                              p=float(p.dropout_ratio))
+    if t == "BatchNorm":
+        kw = _bn_kwargs(lay)
+        kw["name"] = name
+        return mx.sym.BatchNorm(data=inputs[0], fix_gamma=True, **kw)
+    if t == "Concat":
+        return mx.sym.Concat(*inputs, name=name,
+                             dim=int(lay.concat_param.axis))
+    if t == "Eltwise":
+        p = lay.eltwise_param
+        op = int(p.operation)
+        coeff = list(p.coeff)
+        syms = list(inputs)
+        if coeff and op != 1:
+            raise ValueError("Eltwise coeff only applies to SUM "
+                             "(layer %r)" % name)
+        if coeff and len(coeff) != len(syms):
+            raise ValueError("Eltwise %r: %d coeffs for %d bottoms"
+                             % (name, len(coeff), len(syms)))
+        if op == 1 and coeff:
+            syms = [s if c == 1.0 else s * float(c)
+                    for s, c in zip(syms, coeff)]
+        acc = syms[0]
+        for s in syms[1:]:
+            if op == 0:
+                acc = acc * s
+            elif op == 1:
+                acc = acc + s
+            else:
+                acc = mx.sym.maximum(acc, s)
+        return acc
+    if t == "Flatten":
+        return mx.sym.Flatten(data=inputs[0], name=name)
+    if t == "Reshape":
+        p = lay.reshape_param
+        if int(p.axis) != 0 or int(p.num_axes) != -1:
+            raise ValueError("Reshape axis/num_axes not supported "
+                             "(layer %r)" % name)
+        dims = tuple(int(d) for d in p.shape.dim)
+        # Caffe dim semantics match this framework's Reshape: 0 copies
+        # the input dimension, -1 infers from the remaining size
+        return mx.sym.Reshape(data=inputs[0], shape=dims, name=name)
+    if t == "Softmax":
+        # mid-graph Softmax is an ACTIVATION (proper softmax Jacobian in
+        # backward); the terminal-loss interpretation lives in
+        # convert_symbol, which maps deploy heads to SoftmaxOutput
+        return mx.sym.SoftmaxActivation(data=inputs[0], name=name)
+    if t == "SoftmaxWithLoss":
+        return mx.sym.SoftmaxOutput(data=inputs[0], name=name)
+    raise ValueError("unsupported Caffe layer type %r (layer %r)"
+                     % (t, name))
 
 
 def main():
