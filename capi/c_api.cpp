@@ -11,6 +11,10 @@
 // src/c_api/c_api_common.h).
 #include <Python.h>
 
+#ifndef _WIN32
+#include <dlfcn.h>
+#endif
+
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -29,6 +33,22 @@ PyObject* g_bridge = nullptr;  // mxnet_tpu.capi_bridge module
 void InitRuntime() {
   bool owns_interp = false;
   if (!Py_IsInitialized()) {
+#ifndef _WIN32
+    // Hosts that dlopen this library WITHOUT RTLD_GLOBAL (perl XSLoader,
+    // R dyn.load, MATLAB loadlibrary) leave libpython's symbols local to
+    // this .so; numpy & friends' C extensions rely on process-global
+    // libpython symbols and fail with "undefined symbol: PyObject_...".
+    // Promote the already-mapped libpython to global scope.
+    {
+      char soname[64];
+      snprintf(soname, sizeof(soname), "libpython%d.%d.so.1.0",
+               PY_MAJOR_VERSION, PY_MINOR_VERSION);
+      if (dlopen(soname, RTLD_LAZY | RTLD_GLOBAL | RTLD_NOLOAD) ==
+          nullptr) {
+        dlopen(soname, RTLD_LAZY | RTLD_GLOBAL);  // not yet mapped
+      }
+    }
+#endif
     Py_InitializeEx(0);
     owns_interp = true;
   }

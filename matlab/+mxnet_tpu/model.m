@@ -1,0 +1,155 @@
+classdef model < handle
+%MODEL mxnet_tpu model: load a checkpoint and run forward.
+%
+% Counterpart of the reference matlab/+mxnet/model.m — predict-only over
+% the C predict API (include/mxnet_tpu/c_api.h MXPred*), bound with
+% MATLAB's loadlibrary: no MEX compilation needed, the header is parsed
+% directly. Build capi first (`make -C capi`) or the amalgamation
+% (`make -C amalgamation`).
+%
+%   m = mxnet_tpu.model;
+%   m.load('model-prefix', 0);          % prefix-symbol.json + -0000.params
+%   out = m.forward(img, 'data_shape', [1 3 224 224]);
+
+properties
+  symbol   % symbol json text
+  params   % raw bytes of the .params file
+  verbose
+end
+
+properties (Access = private)
+  predictor
+  prev_shape
+  prev_dev
+end
+
+methods
+  function obj = model()
+    obj.predictor = libpointer('voidPtr', 0);
+    obj.prev_shape = [];
+    obj.verbose = 1;
+    mxnet_tpu.model.load_library();
+  end
+
+  function delete(obj)
+    obj.free_predictor();
+  end
+
+  function load(obj, prefix, epoch)
+  %LOAD load prefix-symbol.json and prefix-%04d.params
+    sym_file = [prefix, '-symbol.json'];
+    param_file = sprintf('%s-%04d.params', prefix, epoch);
+    fid = fopen(sym_file, 'r');
+    assert(fid >= 0, ['cannot open ', sym_file]);
+    obj.symbol = fread(fid, inf, '*char')';
+    fclose(fid);
+    fid = fopen(param_file, 'rb');
+    assert(fid >= 0, ['cannot open ', param_file]);
+    obj.params = fread(fid, inf, '*uint8');
+    fclose(fid);
+    obj.free_predictor();
+  end
+
+  function out = forward(obj, img, varargin)
+  %FORWARD run the model on img (HWC or NCHW single/double array)
+    p = inputParser;
+    addParameter(p, 'data_shape', []);
+    addParameter(p, 'dev_type', 'cpu');
+    addParameter(p, 'dev_id', 0);
+    parse(p, varargin{:});
+    shape = p.Results.data_shape;
+    if isempty(shape)
+      shape = size(img);
+      if numel(shape) == 3  % HWC -> 1CHW
+        shape = [1, shape(3), shape(1), shape(2)];
+        img = permute(img, [3, 1, 2]);
+      end
+    end
+    assert(numel(img) == prod(shape), 'img does not match data_shape');
+    dev = 1;
+    if ~strcmp(p.Results.dev_type, 'cpu'), dev = 2; end
+    devkey = [dev, p.Results.dev_id];
+
+    if isempty(obj.prev_shape) || ~isequal(obj.prev_shape, shape) ...
+        || ~isequal(obj.prev_dev, devkey)
+      obj.free_predictor();
+      keys = libpointer('stringPtrPtr', {'data'});
+      indptr = uint32([0, numel(shape)]);
+      sdata = uint32(shape);
+      h = libpointer('voidPtr', 0);
+      rc = calllib('libmxnet_tpu', 'MXPredCreate', obj.symbol, ...
+                   obj.params, int32(numel(obj.params)), int32(dev), ...
+                   int32(p.Results.dev_id), uint32(1), keys, indptr, ...
+                   sdata, h);
+      mxnet_tpu.model.check(rc, 'MXPredCreate');
+      obj.predictor = h;
+      obj.prev_shape = shape;
+      obj.prev_dev = devkey;
+    end
+
+    % MATLAB stores column-major; the C API wants row-major (last dim
+    % fastest). Reverse-permute so the column-major flatten emits
+    % row-major order — the inverse of the output conversion below.
+    a = reshape(img, shape);
+    a = permute(a, numel(shape):-1:1);
+    data = single(reshape(a, 1, []));
+    rc = calllib('libmxnet_tpu', 'MXPredSetInput', obj.predictor, ...
+                 'data', data, uint32(numel(data)));
+    mxnet_tpu.model.check(rc, 'MXPredSetInput');
+    rc = calllib('libmxnet_tpu', 'MXPredForward', obj.predictor);
+    mxnet_tpu.model.check(rc, 'MXPredForward');
+
+    sdptr = libpointer('uint32PtrPtr', uint32(0));
+    ndim = libpointer('uint32Ptr', uint32(0));
+    rc = calllib('libmxnet_tpu', 'MXPredGetOutputShape', obj.predictor, ...
+                 uint32(0), sdptr, ndim);
+    mxnet_tpu.model.check(rc, 'MXPredGetOutputShape');
+    setdatatype(sdptr.Value, 'uint32Ptr', 1, double(ndim.Value));
+    oshape = double(sdptr.Value.Value');
+    osize = prod(oshape);
+
+    buf = libpointer('singlePtr', zeros(1, osize, 'single'));
+    rc = calllib('libmxnet_tpu', 'MXPredGetOutput', obj.predictor, ...
+                 uint32(0), buf, uint32(osize));
+    mxnet_tpu.model.check(rc, 'MXPredGetOutput');
+    out = reshape(buf.Value, fliplr(oshape));
+    out = permute(out, numel(oshape):-1:1);
+  end
+
+  function free_predictor(obj)
+    if ~isempty(obj.predictor) && obj.predictor.Value ~= 0
+      calllib('libmxnet_tpu', 'MXPredFree', obj.predictor);
+      obj.predictor = libpointer('voidPtr', 0);
+      obj.prev_shape = [];
+      obj.prev_dev = [];
+    end
+  end
+end
+
+methods (Static)
+  function load_library()
+    if ~libisloaded('libmxnet_tpu')
+      here = fileparts(fileparts(mfilename('fullpath')));
+      root = fileparts(here);
+      candidates = { ...
+        fullfile(root, 'capi', 'build', 'libmxnet_tpu.so'), ...
+        fullfile(root, 'amalgamation', 'libmxnet_tpu_predict.so')};
+      header = fullfile(root, 'include', 'mxnet_tpu', 'c_api.h');
+      for i = 1:numel(candidates)
+        if exist(candidates{i}, 'file')
+          loadlibrary(candidates{i}, header, 'alias', 'libmxnet_tpu');
+          return
+        end
+      end
+      error('libmxnet_tpu.so not found; run `make -C capi` first');
+    end
+  end
+
+  function check(rc, what)
+    if rc ~= 0
+      err = calllib('libmxnet_tpu', 'MXGetLastError');
+      error('%s failed: %s', what, err);
+    end
+  end
+end
+end

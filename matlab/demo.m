@@ -1,0 +1,18 @@
+%% mxnet_tpu MATLAB demo (reference matlab/demo.m)
+% Loads a checkpoint pair (<prefix>-symbol.json / <prefix>-0000.params)
+% and classifies a random image. Produce a checkpoint with e.g.
+%   python tools/caffe_converter/convert_model.py deploy.prototxt ...
+% or mx.model.save_checkpoint from the Python frontend.
+
+clear model
+model = mxnet_tpu.model;
+model.load('data/model', 0);
+
+img = single(rand(224, 224, 3)) * 255;
+out = model.forward(img, 'data_shape', [1 3 224 224]);
+
+[prob, idx] = sort(out(:), 'descend');
+fprintf('top-5 classes:\n');
+for i = 1:5
+  fprintf('  class %d  p=%.4f\n', idx(i), prob(i));
+end

@@ -1,0 +1,184 @@
+/* XS glue for AI::MXNetTPU — binds the flat C ABI (include/mxnet_tpu/
+ * c_api.h) into Perl. Reference counterpart: perl-package/AI-MXNetCAPI
+ * (SWIG-generated, 16.9k LoC incl. the full trainer surface); here the
+ * bindings are hand-written for the predict + NDList families, the
+ * deployment surface, with handles passed as IVs. */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxnet_tpu/c_api.h"
+
+static void croak_on_fail(pTHX_ int rc, const char *what) {
+  if (rc != 0) {
+    croak("%s failed: %s", what, MXGetLastError());
+  }
+}
+
+MODULE = AI::MXNetTPU    PACKAGE = AI::MXNetTPU   PREFIX = mxtpu_
+
+PROTOTYPES: DISABLE
+
+const char *
+mxtpu_last_error()
+  CODE:
+    RETVAL = MXGetLastError();
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_pred_create(const char *symbol_json, SV *param_sv, int dev_type, int dev_id, SV *names_ref, SV *shapes_ref)
+  PREINIT:
+    AV *names_av;
+    AV *shapes_av;
+    mx_uint n, i, j, total;
+    const char **keys;
+    mx_uint *indptr;
+    mx_uint *shape_data;
+    STRLEN param_len;
+    const char *param_bytes;
+    PredictorHandle handle;
+    int rc;
+  CODE:
+    names_av = (AV *)SvRV(names_ref);
+    shapes_av = (AV *)SvRV(shapes_ref);
+    n = (mx_uint)(av_len(names_av) + 1);
+    keys = (const char **)malloc(n * sizeof(char *));
+    indptr = (mx_uint *)malloc((n + 1) * sizeof(mx_uint));
+    total = 0;
+    for (i = 0; i < n; ++i) {
+      AV *shape = (AV *)SvRV(*av_fetch(shapes_av, i, 0));
+      total += (mx_uint)(av_len(shape) + 1);
+    }
+    shape_data = (mx_uint *)malloc(total * sizeof(mx_uint));
+    indptr[0] = 0;
+    total = 0;
+    for (i = 0; i < n; ++i) {
+      AV *shape = (AV *)SvRV(*av_fetch(shapes_av, i, 0));
+      mx_uint ndim = (mx_uint)(av_len(shape) + 1);
+      keys[i] = SvPV_nolen(*av_fetch(names_av, i, 0));
+      for (j = 0; j < ndim; ++j) {
+        shape_data[total + j] = (mx_uint)SvUV(*av_fetch(shape, j, 0));
+      }
+      total += ndim;
+      indptr[i + 1] = total;
+    }
+    param_bytes = SvPV(param_sv, param_len);
+    rc = MXPredCreate(symbol_json, param_bytes, (int)param_len, dev_type,
+                      dev_id, n, keys, indptr, shape_data, &handle);
+    free(shape_data);
+    free(indptr);
+    free(keys);
+    croak_on_fail(aTHX_ rc, "MXPredCreate");
+    RETVAL = PTR2IV(handle);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_pred_set_input(IV handle, const char *key, SV *data_ref)
+  PREINIT:
+    AV *data_av;
+    mx_uint n, i;
+    mx_float *buf;
+    int rc;
+  CODE:
+    data_av = (AV *)SvRV(data_ref);
+    n = (mx_uint)(av_len(data_av) + 1);
+    buf = (mx_float *)malloc(n * sizeof(mx_float));
+    for (i = 0; i < n; ++i) {
+      buf[i] = (mx_float)SvNV(*av_fetch(data_av, i, 0));
+    }
+    rc = MXPredSetInput(INT2PTR(PredictorHandle, handle), key, buf, n);
+    free(buf);
+    croak_on_fail(aTHX_ rc, "MXPredSetInput");
+
+void
+mxtpu_pred_forward(IV handle)
+  CODE:
+    croak_on_fail(aTHX_ MXPredForward(INT2PTR(PredictorHandle, handle)),
+                  "MXPredForward");
+
+void
+mxtpu_pred_output_shape(IV handle, unsigned index)
+  PREINIT:
+    mx_uint *shape_data;
+    mx_uint ndim, i;
+  PPCODE:
+    croak_on_fail(aTHX_ MXPredGetOutputShape(
+        INT2PTR(PredictorHandle, handle), (mx_uint)index, &shape_data,
+        &ndim), "MXPredGetOutputShape");
+    EXTEND(SP, ndim);
+    for (i = 0; i < ndim; ++i) {
+      mPUSHu(shape_data[i]);
+    }
+
+void
+mxtpu_pred_get_output(IV handle, unsigned index, unsigned size)
+  PREINIT:
+    mx_float *buf;
+    mx_uint i;
+  PPCODE:
+    buf = (mx_float *)malloc(size * sizeof(mx_float));
+    {
+      int rc = MXPredGetOutput(INT2PTR(PredictorHandle, handle),
+                               (mx_uint)index, buf, (mx_uint)size);
+      if (rc != 0) {
+        free(buf);
+        croak("MXPredGetOutput failed: %s", MXGetLastError());
+      }
+    }
+    EXTEND(SP, size);
+    for (i = 0; i < size; ++i) {
+      mPUSHn((double)buf[i]);
+    }
+    free(buf);
+
+void
+mxtpu_pred_free(IV handle)
+  CODE:
+    MXPredFree(INT2PTR(PredictorHandle, handle));
+
+void
+mxtpu_ndlist_load(SV *bytes_sv)
+  PREINIT:
+    STRLEN len;
+    const char *bytes;
+    NDListHandle handle;
+    mx_uint n, i, j;
+    int rc;
+  PPCODE:
+    bytes = SvPV(bytes_sv, len);
+    croak_on_fail(aTHX_ MXNDListCreate(bytes, (int)len, &handle, &n),
+                  "MXNDListCreate");
+    for (i = 0; i < n; ++i) {
+      const char *key;
+      const mx_float *data;
+      const mx_uint *shape;
+      mx_uint ndim, size;
+      AV *shape_av;
+      HV *entry;
+      rc = MXNDListGet(handle, i, &key, &data, &shape, &ndim);
+      if (rc != 0) {
+        /* free the handle BEFORE croak longjmps out of this frame */
+        MXNDListFree(handle);
+        croak("MXNDListGet failed: %s", MXGetLastError());
+      }
+      size = 1;
+      shape_av = newAV();
+      for (j = 0; j < ndim; ++j) {
+        av_push(shape_av, newSVuv(shape[j]));
+        size *= shape[j];
+      }
+      entry = newHV();
+      (void)hv_stores(entry, "name", newSVpv(key, 0));
+      (void)hv_stores(entry, "shape", newRV_noinc((SV *)shape_av));
+      /* tensor payload as one packed native-float32 string — a 25M-param
+       * checkpoint would otherwise cost 25M individual NV SVs; callers
+       * unpack('f*') the slices they actually want */
+      (void)hv_stores(entry, "data",
+                      newSVpvn((const char *)data,
+                               (STRLEN)size * sizeof(mx_float)));
+      mXPUSHs(newRV_noinc((SV *)entry));
+    }
+    MXNDListFree(handle);

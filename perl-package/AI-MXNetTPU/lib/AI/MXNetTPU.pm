@@ -1,0 +1,104 @@
+package AI::MXNetTPU;
+# AI::MXNetTPU — Perl frontend over the mxnet_tpu C ABI.
+#
+# Reference counterpart: perl-package/AI-MXNet (full trainer API over a
+# SWIG-generated CAPI layer). This package binds the deployment surface
+# — Predictor + parameter loading — through hand-written XS
+# (MXNetTPU.xs) against libmxnet_tpu.so; training lives in the Python
+# frontend, which the reference's Perl users also ultimately drive
+# through the same flat C API.
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+package AI::MXNetTPU::Predictor;
+use strict;
+use warnings;
+
+# new(symbol_json => $json, params => $bytes, input_shapes => {name=>[dims]},
+#     dev_type => 'cpu'|'tpu', dev_id => 0)
+sub new {
+    my ($class, %args) = @_;
+    my %dev = (cpu => 1, gpu => 2, tpu => 2);
+    my @names = sort keys %{ $args{input_shapes} };
+    my @shapes = map { $args{input_shapes}{$_} } @names;
+    my $handle = AI::MXNetTPU::pred_create(
+        $args{symbol_json}, $args{params},
+        $dev{ $args{dev_type} // 'cpu' } // 1, $args{dev_id} // 0,
+        \@names, \@shapes);
+    return bless { handle => $handle }, $class;
+}
+
+sub set_input {
+    my ($self, $key, $data) = @_;
+    AI::MXNetTPU::pred_set_input($self->{handle}, $key, $data);
+    return $self;
+}
+
+sub forward {
+    my ($self) = @_;
+    AI::MXNetTPU::pred_forward($self->{handle});
+    return $self;
+}
+
+sub output_shape {
+    my ($self, $index) = @_;
+    return [AI::MXNetTPU::pred_output_shape($self->{handle}, $index // 0)];
+}
+
+sub get_output {
+    my ($self, $index) = @_;
+    $index //= 0;
+    my $shape = $self->output_shape($index);
+    my $size = 1;
+    $size *= $_ for @$shape;
+    my @out = AI::MXNetTPU::pred_get_output($self->{handle}, $index, $size);
+    return { shape => $shape, data => \@out };
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::pred_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+package AI::MXNetTPU::NDList;
+use strict;
+use warnings;
+
+# load($bytes) -> { name => { shape => [...], packed => $f32_string } }
+# `packed` is native float32 bytes; unpack('f*', $packed) materializes a
+# Perl list only for the tensors you actually read.
+sub load {
+    my ($class, $bytes) = @_;
+    my @entries = AI::MXNetTPU::ndlist_load($bytes);
+    my %out;
+    for my $e (@entries) {
+        $out{ $e->{name} } = { shape => $e->{shape},
+                               packed => $e->{data} };
+    }
+    return \%out;
+}
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl prediction frontend for the mxnet_tpu framework
+
+=head1 SYNOPSIS
+
+  use AI::MXNetTPU;
+  my $pred = AI::MXNetTPU::Predictor->new(
+      symbol_json  => $json,
+      params       => $param_bytes,
+      input_shapes => { data => [1, 3, 224, 224] });
+  $pred->set_input(data => \@pixels)->forward;
+  my $out = $pred->get_output(0);   # { shape => [...], data => [...] }
+
+=cut

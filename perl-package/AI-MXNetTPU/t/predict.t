@@ -1,0 +1,53 @@
+#!/usr/bin/env perl
+# End-to-end predict through the Perl frontend. The harness
+# (tests/test_perl_package.py) generates model.json / model.params /
+# expected.txt with the Python frontend first; this script must
+# reproduce the expected softmax outputs through AI::MXNetTPU alone.
+use strict;
+use warnings;
+use Test::More;
+
+my $dir = $ENV{MXTPU_PERL_TEST_DIR} or plan skip_all => 'no test dir';
+
+open my $jf, '<', "$dir/model.json" or die $!;
+my $json = do { local $/; <$jf> };
+close $jf;
+open my $pf, '<:raw', "$dir/model.params" or die $!;
+my $params = do { local $/; <$pf> };
+close $pf;
+open my $xf, '<', "$dir/input.txt" or die $!;
+my @x = map { 0 + $_ } split ' ', do { local $/; <$xf> };
+close $xf;
+open my $ef, '<', "$dir/expected.txt" or die $!;
+my @expected = map { 0 + $_ } split ' ', do { local $/; <$ef> };
+close $ef;
+
+use_ok('AI::MXNetTPU');
+
+my $pred = AI::MXNetTPU::Predictor->new(
+    symbol_json  => $json,
+    params       => $params,
+    input_shapes => { data => [2, 4] });
+ok($pred, 'predictor created');
+
+$pred->set_input(data => \@x)->forward;
+my $out = $pred->get_output(0);
+is_deeply($out->{shape}, [2, 3], 'output shape');
+
+my $data = $out->{data};
+is(scalar @$data, scalar @expected, 'output length');
+my $maxdiff = 0;
+for my $i (0 .. $#expected) {
+    my $d = abs($data->[$i] - $expected[$i]);
+    $maxdiff = $d if $d > $maxdiff;
+}
+cmp_ok($maxdiff, '<', 1e-4, "outputs match python frontend (max |d| $maxdiff)");
+
+# params load through NDList (packed float32 payloads)
+my $nd = AI::MXNetTPU::NDList->load($params);
+ok(exists $nd->{'arg:fc1_weight'}, 'ndlist has weight');
+is_deeply($nd->{'arg:fc1_weight'}{shape}, [3, 4], 'weight shape');
+my @w = unpack 'f*', $nd->{'arg:fc1_weight'}{packed};
+is(scalar @w, 12, 'weight payload unpacks to 12 floats');
+
+done_testing();

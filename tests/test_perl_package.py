@@ -1,0 +1,74 @@
+"""Perl frontend (perl-package/AI-MXNetTPU): XS bindings over the C ABI
+(reference perl-package/ AI::MXNet + AI::MXNetCAPI, 16.9k LoC trainer;
+here the deployment surface — Predictor + NDList — built with
+ExtUtils::MakeMaker and driven end to end from prove)."""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "perl-package", "AI-MXNetTPU")
+
+if shutil.which("perl") is None:  # pragma: no cover
+    pytest.skip("perl unavailable", allow_module_level=True)
+
+
+def _build_capi():
+    subprocess.run(["make", "-C", os.path.join(ROOT, "capi")], check=True,
+                   capture_output=True)
+
+
+def _build_perl():
+    env = dict(os.environ)
+    subprocess.run(["perl", "Makefile.PL"], cwd=PKG, check=True,
+                   capture_output=True, env=env)
+    proc = subprocess.run(["make"], cwd=PKG, capture_output=True,
+                          text=True, env=env)
+    assert proc.returncode == 0, (
+        "perl make failed:\n%s\n%s" % (proc.stdout, proc.stderr))
+
+
+def test_perl_predict_end_to_end(tmp_path):
+    _build_capi()
+    _build_perl()
+
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc1")
+    net = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    rng = np.random.RandomState(11)
+    w = rng.randn(3, 4).astype(np.float32) * 0.4
+    b = rng.randn(3).astype(np.float32) * 0.1
+    params = {"arg:fc1_weight": mx.nd.array(w), "arg:fc1_bias": mx.nd.array(b)}
+    mx.nd.save(str(tmp_path / "model.params"), params)
+    (tmp_path / "model.json").write_text(net.tojson())
+
+    x = rng.rand(2, 4).astype(np.float32)
+    logits = x @ w.T + b
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    expected = (e / e.sum(axis=1, keepdims=True)).reshape(-1)
+    (tmp_path / "input.txt").write_text(
+        " ".join("%.8f" % v for v in x.reshape(-1)))
+    (tmp_path / "expected.txt").write_text(
+        " ".join("%.8f" % v for v in expected))
+
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env["MXTPU_PERL_TEST_DIR"] = str(tmp_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        ["perl", "-Mblib=%s" % os.path.join(PKG, "blib"),
+         os.path.join(PKG, "t", "predict.t")],
+        cwd=ROOT, capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, (
+        "perl test failed:\nstdout:%s\nstderr:%s"
+        % (proc.stdout, proc.stderr))
+    assert "outputs match python frontend" in proc.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
