@@ -14,6 +14,16 @@ vs_baseline = ours / 181.53.
 
 MFU accounting: ResNet-50 ≈ 3.8 GFLOPs/image forward at 224²; training
 (fwd + bwd) ≈ 3×. peak_tflops from the device kind (bf16 systolic peak).
+xla_* metrics come from the compiled program's own cost analysis; ResNet
+training is HBM-bound on single chips (see PERF.md), so
+hbm_util (= xla bytes-accessed / time vs peak HBM BW) is the roofline
+figure of merit, not MFU.
+
+Timing barrier: on remote-attached devices `jax.block_until_ready` can
+return at enqueue time rather than completion (observed on the axon
+tunnel — it yielded physically impossible >100% MFU). The barrier here
+is a data-dependent 4-byte fetch: a tiny jitted sum of a post-step
+parameter, converted to a Python float.
 """
 from __future__ import annotations
 
@@ -26,18 +36,20 @@ import time
 BASELINE_IMG_S = 181.53  # P100, reference perf.md
 FLOPS_PER_IMG_TRAIN = 3.8e9 * 3
 
-# bf16 peak TFLOP/s per chip by device kind substring
-_PEAK_TFLOPS = [("v6", 918.0), ("trillium", 918.0), ("v5p", 459.0),
-                ("v5e", 197.0), ("v5 lite", 197.0), ("v5lite", 197.0),
-                ("v4", 275.0), ("v3", 123.0), ("v2", 45.0)]
+# per-chip peaks by device kind substring: (bf16 TFLOP/s, HBM GB/s)
+_PEAKS = [("v6", 918.0, 1640.0), ("trillium", 918.0, 1640.0),
+          ("v5p", 459.0, 2765.0),
+          ("v5e", 197.0, 819.0), ("v5 lite", 197.0, 819.0),
+          ("v5lite", 197.0, 819.0),
+          ("v4", 275.0, 1228.0), ("v3", 123.0, 900.0), ("v2", 45.0, 700.0)]
 
 
-def _peak_tflops(device_kind, n_dev):
+def _peaks(device_kind, n_dev):
     kind = device_kind.lower()
-    for sub, peak in _PEAK_TFLOPS:
+    for sub, tf, bw in _PEAKS:
         if sub in kind:
-            return peak * n_dev
-    return None
+            return tf * n_dev, bw * n_dev
+    return None, None
 
 
 def _emit(value, extra=None):
@@ -73,7 +85,8 @@ def main():
     from mxnet_tpu.io import DataBatch
 
     n_dev = len(devices)
-    per_dev_batch = int(os.environ.get("BENCH_BATCH", "64"))
+    # bs128/chip: best measured true throughput (PERF.md batch sweep)
+    per_dev_batch = int(os.environ.get("BENCH_BATCH", "128"))
     batch = per_dev_batch * n_dev
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     img = 224
@@ -118,40 +131,107 @@ def main():
         mod.forward_backward(b)
         mod.update()
 
-    # compile + warmup
+    barrier = _make_barrier(mod, fused)
+
+    # compile + warmup (incl. the barrier program itself)
     for i in range(3):
         step(i)
-    jax.block_until_ready([b._read() for b
-                           in mod._exec_group._param_dict.values()]
-                          if fused else mod.get_outputs()[0]._read())
+    barrier()
 
     t0 = time.time()
     for i in range(steps):
         step(i)
-    jax.block_until_ready([b._read() for b
-                           in mod._exec_group._param_dict.values()]
-                          if fused else mod.get_outputs()[0]._read())
+    barrier()
     dt = time.time() - t0
 
     img_per_sec = steps * batch / dt
     achieved_tflops = img_per_sec * FLOPS_PER_IMG_TRAIN / 1e12
-    peak = _peak_tflops(devices[0].device_kind, n_dev)
+    peak_tf, peak_bw = _peaks(devices[0].device_kind, n_dev)
     extra = {"platform": platform, "devices": n_dev, "batch": batch,
              "steps": steps, "dtype": dtype_env, "path": "module",
-             "fused_group": fused,
+             "fused_group": fused, "ms_per_step": round(dt * 1000 / steps, 2),
              "achieved_tflops": round(achieved_tflops, 2),
              "device_kind": devices[0].device_kind}
-    if peak:
-        extra["peak_tflops"] = peak
-        extra["mfu"] = round(achieved_tflops / peak, 4)
+    if peak_tf:
+        extra["peak_tflops"] = peak_tf
+        extra["mfu"] = round(achieved_tflops / peak_tf, 4)
+    extra.update(_xla_cost(mod, fused, dt / steps, peak_bw, n_dev))
 
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
         extra.update(_bench_pipeline(mx, mod, step_batch=batch, steps=steps,
-                                     img=img, synthetic_img_s=img_per_sec))
+                                     img=img, synthetic_img_s=img_per_sec,
+                                     barrier=barrier))
     _emit(img_per_sec, extra)
 
 
-def _bench_pipeline(mx, mod, step_batch, steps, img, synthetic_img_s):
+def _make_barrier(mod, fused):
+    """Data-dependent completion barrier: jitted 4-byte reduction of a
+    post-step parameter fetched to host. See module docstring — plain
+    block_until_ready is NOT a reliable completion barrier on
+    remote-attached device transports."""
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))
+    if fused:
+        eg = mod._exec_group
+        name = sorted(eg._param_dict)[0]
+
+        def barrier():
+            return float(tiny(eg._param_dict[name]._read()))
+    else:
+        def barrier():
+            return float(tiny(mod.get_outputs()[0]._read()))
+    return barrier
+
+
+def _xla_cost(mod, fused, sec_per_step, peak_bw, n_dev):
+    """XLA's own cost analysis of the train-step programs: true flops and
+    bytes-accessed, plus the HBM roofline utilization they imply.
+
+    cost_analysis() reports the PER-DEVICE partitioned module; scale by
+    n_dev to compare against the n_dev-scaled peaks. The optimizer-update
+    program's traffic (read w/g/m + write w/m on f32 for sgd-momentum) is
+    added analytically — it's a separate jit keyed deep in the optimizer.
+    """
+    out = {}
+    if not fused:
+        return out
+    try:
+        eg = mod._exec_group
+        fn = eg._jits.get("fwd_bwd")
+        if fn is None:
+            return out
+        # jit caches compilations; lower().compile() here is a cache hit
+        params = {n: b._read() for n, b in eg._param_dict.items()}
+        aux = {n: b._read() for n, b in eg._aux_dict.items()}
+        import numpy as np
+        rngk = np.zeros((2,), np.uint32)
+        comp = fn.lower(params, aux, eg._last[0], rngk).compile()
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        fl = float(ca.get("flops", 0.0)) * n_dev
+        by = float(ca.get("bytes accessed", 0.0)) * n_dev
+        n_par = sum(int(np.prod(b.shape)) for b in eg._param_dict.values())
+        upd_by = 5.0 * 4 * n_par   # w,g,m reads + w,m writes, f32
+        upd_fl = 4.0 * n_par
+        out["xla_flops_per_step_tf"] = round((fl + upd_fl) / 1e12, 3)
+        out["xla_bytes_per_step_gb"] = round((by + upd_by) / 1e9, 3)
+        if sec_per_step > 0:
+            out["xla_achieved_tflops"] = round(
+                (fl + upd_fl) / sec_per_step / 1e12, 2)
+            if peak_bw:
+                out["hbm_util"] = round(
+                    (by + upd_by) / sec_per_step / 1e9 / peak_bw, 4)
+                out["bound_by"] = ("hbm" if out.get("hbm_util", 0) > 0.5
+                                   else "other")
+    except Exception as e:  # cost analysis is best-effort diagnostics
+        out["xla_cost_error"] = str(e)[:120]
+    return out
+
+
+def _bench_pipeline(mx, mod, step_batch, steps, img, synthetic_img_s,
+                    barrier):
     """Input-pipeline throughput (SURVEY §7 hard part f; VERDICT r1 #8):
     the SAME Module.fit-style step fed from ImageRecordIter with threaded
     decode + PrefetchingIter double-buffering, vs the synthetic number.
@@ -230,34 +310,21 @@ def _bench_pipeline(mx, mod, step_batch, steps, img, synthetic_img_s):
             out["iter_only_%s_img_per_sec" % fmt] = round(
                 io_batches * step_batch / (time.time() - t0), 2)
 
-            import jax
-
-            def sync():
-                jax.block_until_ready(
-                    [p._read()
-                     for p in mod._exec_group._param_dict.values()]
-                    if getattr(mod._exec_group, "fused", False)
-                    else mod.get_outputs()[0]._read())
-
             for _ in range(2):  # warmup (staging path)
                 b = next_batch()
                 mod.forward_backward(b)
                 mod.update()
-            sync()
-            # median per-step time: single-step samples so one transfer
-            # hiccup (remote-attached TPU tunnels stall for seconds at a
-            # time) doesn't swing the whole 20-step window
-            samples = []
+            barrier()
+            # ONE barrier for the whole window: a per-step barrier would
+            # be a device->host readback per step, and readbacks degrade
+            # remote-attached transports (PERF.md trap #2)
+            t0 = time.time()
             for _ in range(steps):
-                t0 = time.time()
                 b = next_batch()
                 mod.forward_backward(b)
                 mod.update()
-                sync()
-                samples.append(time.time() - t0)
-            samples.sort()
-            med = samples[len(samples) // 2]
-            out[key] = round(step_batch / med, 2)
+            barrier()
+            out[key] = round(steps * step_batch / (time.time() - t0), 2)
             it.pool.shutdown(wait=False)
 
         out["pipeline_vs_synthetic"] = round(

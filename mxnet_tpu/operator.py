@@ -22,6 +22,7 @@ from .base import MXNetError
 from . import registry as _registry
 
 __all__ = ["CustomOp", "CustomOpProp", "register", "NumpyOp", "NDArrayOp",
+           "NativeOp",
            "get_prop"]
 
 _CUSTOM_REGISTRY = {}
@@ -230,3 +231,6 @@ def _custom_fcompute(attrs, ins, octx):
 # passes numpy-backed views, so the base class is shared.
 NumpyOp = CustomOp
 NDArrayOp = CustomOp
+# NativeOp (reference python/mxnet/operator.py:24, the v0.9 C-callback
+# python-op bridge registered as the _Native op) — same modern surface
+NativeOp = CustomOp

@@ -127,7 +127,10 @@ def _binary(name, fn, alias=()):
 
 
 _BINARY_TABLE = {
-    "_plus": (lambda jnp, a, b: a + b, ("elemwise_add", "_add")),
+    "_plus": (lambda jnp, a, b: a + b,
+              # _grad_add: the reference's gradient-accumulation add
+              # (elemwise_binary_op.cc) — same math, kept for parity
+              ("elemwise_add", "_add", "_grad_add")),
     "_minus": (lambda jnp, a, b: a - b, ("elemwise_sub", "_sub")),
     "_mul": (lambda jnp, a, b: a * b, ("elemwise_mul",)),
     "_div": (lambda jnp, a, b: a / b, ("elemwise_div",)),

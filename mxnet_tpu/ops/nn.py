@@ -404,7 +404,11 @@ def _bn_infer(attrs, in_shapes, aux):
           aux_names=("moving_mean", "moving_var"),
           attr_types={"eps": float, "momentum": float, "fix_gamma": bool,
                       "use_global_stats": bool, "output_mean_var": bool},
-          infer_shape=_bn_infer)
+          infer_shape=_bn_infer,
+          # CuDNNBatchNorm: the reference's cudnn-path registration
+          # (cudnn_batch_norm.cc) — same semantics, kept so its
+          # checkpoints/symbols load
+          alias=("CuDNNBatchNorm",))
 def _batch_norm(attrs, ins, octx):
     """Normalize over all axes but channel (axis 1). In training, use batch
     stats and update moving stats (returned as aux updates; the executor
