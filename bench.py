@@ -198,23 +198,33 @@ def _xla_cost(mod, fused, sec_per_step, peak_bw, n_dev):
     if not fused:
         return out
     try:
-        eg = mod._exec_group
-        fn = eg._jits.get("fwd_bwd")
-        if fn is None:
-            return out
-        # jit caches compilations; lower().compile() here is a cache hit
-        params = {n: b._read() for n, b in eg._param_dict.items()}
-        aux = {n: b._read() for n, b in eg._aux_dict.items()}
         import numpy as np
-        rngk = np.zeros((2,), np.uint32)
-        comp = fn.lower(params, aux, eg._last[0], rngk).compile()
+        eg = mod._exec_group
+        upd_fl = upd_by = 0.0
+        step = getattr(eg, "_last_step", None)
+        if step is not None:
+            # one-program path: fwd+bwd+optimizer in a single program —
+            # its cost analysis covers the whole step
+            fn, structs = step
+            comp = fn.lower(*structs).compile()
+        else:
+            fn = eg._jits.get("fwd_bwd")
+            if fn is None:
+                return out
+            # separate optimizer-update program: account its traffic
+            # analytically (read w/g/m + write w/m on f32 sgd-momentum)
+            n_par = sum(int(np.prod(b.shape))
+                        for b in eg._param_dict.values())
+            upd_by = 5.0 * 4 * n_par
+            upd_fl = 4.0 * n_par
+            params = {n: b._read() for n, b in eg._param_dict.items()}
+            aux = {n: b._read() for n, b in eg._aux_dict.items()}
+            rngk = np.zeros((2,), np.uint32)
+            comp = fn.lower(params, aux, eg._last[0], rngk).compile()
         ca = comp.cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca
         fl = float(ca.get("flops", 0.0)) * n_dev
         by = float(ca.get("bytes accessed", 0.0)) * n_dev
-        n_par = sum(int(np.prod(b.shape)) for b in eg._param_dict.values())
-        upd_by = 5.0 * 4 * n_par   # w,g,m reads + w,m writes, f32
-        upd_fl = 4.0 * n_par
         out["xla_flops_per_step_tf"] = round((fl + upd_fl) / 1e12, 3)
         out["xla_bytes_per_step_gb"] = round((by + upd_by) / 1e9, 3)
         if sec_per_step > 0:

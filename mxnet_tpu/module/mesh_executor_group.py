@@ -27,10 +27,15 @@ import logging
 
 import numpy as onp
 
+import itertools
+
 from .. import ndarray as nd
 from .. import random as _random
 from ..base import MXNetError
 from ..executor import _build_eval
+
+# monotonic tokens for optimizer instances (train_step jit cache keys)
+_STEP_TOKENS = itertools.count()
 
 __all__ = ["MeshExecutorGroup"]
 
@@ -207,6 +212,22 @@ class MeshExecutorGroup(object):
 
         repl, batch = self._repl, self._batch_sharding
 
+        def fwd_bwd_math(params, aux, inputs, rng, heads=None):
+            def f(p):
+                outs, new_aux = run_fwd(p, aux, inputs, rng, True)
+                return tuple(outs), new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+            import jax.numpy as jnp
+            hs = tuple(h.astype(o.dtype) for h, o in zip(heads, outs)) \
+                if heads is not None else \
+                tuple(jnp.ones_like(o) for o in outs)
+            (grads,) = vjp_fn(hs)
+            grads = {n: grads[n].astype(params[n].dtype)
+                     for n in grad_names}
+            outs = tuple(o.astype(onp.float32) for o in outs)
+            return outs, new_aux, grads
+
         if kind in ("fwd_train", "fwd_eval"):
             is_train = kind == "fwd_train"
 
@@ -217,23 +238,42 @@ class MeshExecutorGroup(object):
 
             fn = jax.jit(fwd, in_shardings=(repl, repl, batch, None),
                          out_shardings=(self._out_shardings, repl))
+        elif kind.startswith("train_step:"):
+            # whole train step — fwd+bwd+optimizer — as ONE XLA program:
+            # one launch per step and the update fuses into the
+            # bandwidth-bound backward (PERF.md: per-launch overhead is
+            # ~5 ms on remote-attached chips). fa is the optimizer's pure
+            # per-param apply; params/states donate for in-place HBM.
+            fa = self._step_fa
+
+            def train_step(params, aux, states, inputs, rng, lrs, wds):
+                import jax.numpy as jnp
+                outs, new_aux, grads = fwd_bwd_math(params, aux, inputs,
+                                                    rng)
+                new_params = dict(params)
+                new_states = []
+                for k, n in enumerate(grad_names):
+                    p, s = fa(jnp, params[n], grads[n], states[k],
+                              lrs[k], wds[k])
+                    new_params[n] = p
+                    new_states.append(s)
+                return outs, new_aux, grads, new_params, tuple(new_states)
+
+            # no donation on cpu: device_put is zero-copy there, so user-
+            # visible host arrays can alias the param buffers (the classic
+            # update path gates donation the same way)
+            fn = jax.jit(
+                train_step,
+                in_shardings=(repl, repl, repl, batch, None, None, None),
+                out_shardings=(self._out_shardings, repl, repl, repl,
+                               repl),
+                donate_argnums=(0, 2) if self._platform != "cpu" else ())
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
 
             def fwd_bwd(params, aux, inputs, rng, heads=None):
-                def f(p):
-                    outs, new_aux = run_fwd(p, aux, inputs, rng, True)
-                    return tuple(outs), new_aux
-
-                outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
-                import jax.numpy as jnp
-                hs = tuple(h.astype(o.dtype) for h, o in zip(heads, outs)) \
-                    if with_heads else tuple(jnp.ones_like(o) for o in outs)
-                (grads,) = vjp_fn(hs)
-                grads = {n: grads[n].astype(params[n].dtype)
-                         for n in grad_names}
-                outs = tuple(o.astype(onp.float32) for o in outs)
-                return outs, new_aux, grads
+                return fwd_bwd_math(params, aux, inputs, rng,
+                                    heads if with_heads else None)
 
             in_sh = (repl, repl, batch, None) + (
                 (self._out_shardings,) if with_heads else ())
@@ -289,6 +329,10 @@ class MeshExecutorGroup(object):
     def forward(self, data_batch, is_train=None):
         if is_train is None:
             is_train = self.for_training
+        # a still-deferred backward (one-program step awaiting update())
+        # must run before its inputs are superseded — dropping it would
+        # lose that batch's grads and BN-EMA side effects
+        self._materialize_backward()
         inputs = self._stage(data_batch)
         rng = _random.next_key() if self._needs_rng else \
             onp.zeros((2,), onp.uint32)
@@ -325,6 +369,21 @@ class MeshExecutorGroup(object):
             raise MXNetError("backward() called before forward()")
         inputs, _, rng = self._last
         self._pending = None
+        if out_grads is None and getattr(self, "_step_enabled", False):
+            # defer: if update() follows (the fit loop), the whole step —
+            # fwd+bwd+optimizer — runs as ONE XLA program (step_update).
+            # Reading outputs or grads first falls back to plain fwd_bwd.
+            self._pending_bwd = (inputs, rng)
+            force = self._materialize_backward
+            for o in self._out_arrays:
+                o._chunk.force = force
+            for g in self._grad_dict.values():
+                g._chunk.force = force
+            self._outputs_from = "bwd"
+            return
+        self._run_fwd_bwd(inputs, rng, out_grads)
+
+    def _run_fwd_bwd(self, inputs, rng, out_grads=None):
         params = {n: b._read() for n, b in self._param_dict.items()}
         aux = self._last_aux if getattr(self, "_last_aux", None) is not None \
             else {n: b._read() for n, b in self._aux_dict.items()}
@@ -347,6 +406,92 @@ class MeshExecutorGroup(object):
         for n, g in grads.items():
             self._grad_dict[n]._write(g)
         self._outputs_from = "bwd"
+
+    def _materialize_backward(self):
+        """Early outputs/grads read while a one-program step was pending:
+        run the plain fwd+bwd now (params are still pre-update)."""
+        pend = getattr(self, "_pending_bwd", None)
+        if pend is None:
+            return
+        self._pending_bwd = None
+        for g in self._grad_dict.values():
+            g._chunk.force = None
+        inputs, rng = pend
+        self._run_fwd_bwd(inputs, rng)
+
+    def step_update(self, updater, num_device=1):
+        """Run the pending fwd+bwd AND the optimizer as one XLA program.
+
+        Returns False (caller must use the classic update path) when no
+        step is pending or the optimizer has no pure fused apply. The
+        updater's state dict / update counters are maintained exactly as
+        Updater.update_multi would (same (index*num_device) state keys).
+        """
+        pend = getattr(self, "_pending_bwd", None)
+        if pend is None:
+            return False
+        opt = updater.optimizer
+        fa = updater.fused_apply_or_none()
+        if fa is None:
+            return False
+        import jax
+        import numpy as np
+
+        inputs, rng = pend
+        # state keys follow _update_params: index over param_names of the
+        # grads-bearing params, times num_device (one block here)
+        triples = []
+        for index, n in enumerate(self.param_names):
+            if n in self._grad_dict:
+                triples.append((index * num_device, n))
+        ws = {}
+        states, lrs, wds = [], [], []
+        for key, n in triples:
+            w = self._param_dict[n]
+            if key not in updater.states:
+                updater.states[key] = opt.create_state(key, w)
+            opt._update_count(key)
+            get_lr = getattr(opt, "_fused_lr", opt._get_lr)
+            lrs.append(get_lr(key))
+            wds.append(opt._get_wd(key))
+            ws[n] = w._read()
+            states.append(updater.read_state_tree(key, ws[n]))
+        self._pending_bwd = None
+        for g in self._grad_dict.values():
+            g._chunk.force = None
+
+        self._step_fa = fa
+        # per-instance token, NOT id(): ids are reused after GC, and the
+        # fa closure bakes trace-time hypers (momentum, betas) into the
+        # compiled program — a recycled id would silently reuse them
+        token = getattr(opt, "_mxtpu_step_token", None)
+        if token is None:
+            token = opt._mxtpu_step_token = next(_STEP_TOKENS)
+        fn = self._get_jit("train_step:%s:%d" % (type(opt).__name__, token))
+        params = {n: b._read() for n, b in self._param_dict.items()}
+        # pre-forward aux snapshot (same contract as _run_fwd_bwd): if the
+        # forward already materialized, _aux_dict holds post-EMA stats —
+        # re-running from them would apply the BN EMA twice
+        aux = self._last_aux if getattr(self, "_last_aux", None) is not None \
+            else {n: b._read() for n, b in self._aux_dict.items()}
+        args = (params, aux, tuple(states), inputs, rng,
+                np.asarray(lrs, np.float32), np.asarray(wds, np.float32))
+        # aval skeleton for diagnostics (bench cost analysis) — the real
+        # buffers are donated below and unusable afterwards
+        self._last_step = (fn, jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, args))
+        outs, new_aux, grads, new_params, new_states = fn(*args)
+        self._write_outs(outs)
+        self._write_aux(new_aux)
+        for n, g in grads.items():
+            self._grad_dict[n]._write(g)
+        for n, p in new_params.items():
+            self._param_dict[n]._write(p)
+        for (key, n), ns in zip(triples, new_states):
+            updater.write_state_tree(key, ns)
+        self._outputs_from = "bwd"
+        return True
 
     def _write_outs(self, outs):
         for o, v in zip(self._out_arrays, outs):

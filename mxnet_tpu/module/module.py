@@ -407,6 +407,11 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
+            if getattr(self._exec_group, "fused", False) and not kvstore:
+                # one-program train step: backward defers so update() can
+                # run fwd+bwd+optimizer as a single XLA launch
+                # (mesh_executor_group.step_update)
+                self._exec_group._step_enabled = True
 
         self.optimizer_initialized = True
 
@@ -443,6 +448,11 @@ class Module(BaseModule):
                                       self._kvstore)
         else:
             fused = getattr(self._exec_group, "fused", False)
+            if fused and self._kvstore is None and \
+                    self._exec_group.step_update(
+                        self._updater,
+                        num_device=self._num_update_blocks):
+                return  # ran fwd+bwd+optimizer as one XLA program
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,

@@ -458,6 +458,57 @@ class Updater(object):
             self.states[index] = self.optimizer.create_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])
 
+    def fused_apply_or_none(self):
+        """The optimizer's pure per-param apply, or None when per-param
+        update() must run (no _fused_apply, or a subclass overrode
+        update() below the class defining _fused_apply — e.g. NAG
+        overrides SGD.update but inherits SGD._fused_apply, whose
+        numerics would be wrong)."""
+        opt = self.optimizer
+        fa = getattr(opt, "_fused_apply", None)
+        if fa is None:
+            return None
+
+        def _defining(name):
+            for c in type(opt).__mro__:
+                if name in c.__dict__:
+                    return c
+            return None
+
+        cf, cu = _defining("_fused_apply"), _defining("update")
+        if cf is None or cu is None or not issubclass(cf, cu):
+            return None
+        return fa
+
+    def read_state_tree(self, index, like=None):
+        """The state for ``index`` as a tree of jax values placed on
+        ``like``'s sharding (None leaves pass through)."""
+        import jax
+
+        def tree_read(state):
+            if state is None:
+                return None
+            if isinstance(state, (tuple, list)):
+                return tuple(tree_read(s) for s in state)
+            v = state._read()
+            if like is not None and v.sharding != like.sharding:
+                v = jax.device_put(v, like.sharding)
+            return v
+
+        return tree_read(self.states[index])
+
+    def write_state_tree(self, index, new):
+        def tree_write(state, val):
+            if state is None:
+                return
+            if isinstance(state, (tuple, list)):
+                for s, n in zip(state, val):
+                    tree_write(s, n)
+                return
+            state._write(val)
+
+        tree_write(self.states[index], new)
+
     def update_multi(self, triples, donate=False):
         """One jitted XLA call updating EVERY parameter (the TPU-native
         replacement for per-param engine pushes): ``triples`` is a list of
@@ -467,21 +518,7 @@ class Updater(object):
         ``donate=True`` donates weight/state buffers to XLA so the update is
         in-place in HBM — only safe when no live reference to the old buffers
         remains (the fused Module path guarantees this)."""
-        opt = self.optimizer
-        fa = getattr(opt, "_fused_apply", None)
-        if fa is not None:
-            # the fused fn is only valid if no subclass overrode update()
-            # below the class that defined _fused_apply (e.g. NAG overrides
-            # SGD.update but inherits SGD._fused_apply — wrong numerics)
-            def _defining(name):
-                for c in type(opt).__mro__:
-                    if name in c.__dict__:
-                        return c
-                return None
-
-            cf, cu = _defining("_fused_apply"), _defining("update")
-            if cf is None or cu is None or not issubclass(cf, cu):
-                fa = None
+        fa = self.fused_apply_or_none()
         if fa is None:
             for index, grad, weight in triples:
                 self(index, grad, weight)
@@ -509,22 +546,12 @@ class Updater(object):
         wds = np.asarray([opt._get_wd(i) for i, _, _ in triples],
                          np.float32)
 
-        def tree_read(state, like=None):
-            if state is None:
-                return ()
-            if isinstance(state, (tuple, list)):
-                return tuple(tree_read(s, like) for s in state)
-            v = state._read()
-            # optimizer state must live on the weight's sharding (the fused
-            # Module path keeps weights mesh-replicated; create_state made a
-            # single-device array)
-            if like is not None and v.sharding != like.sharding:
-                v = jax.device_put(v, like.sharding)
-            return v
-
         ws = [w._read() for _, _, w in triples]
         gs = [g._read() for _, g, _ in triples]
-        ss = [tree_read(self.states[i], w) for (i, _, _), w
+        # state placed on the weight's sharding (the fused Module path
+        # keeps weights mesh-replicated; create_state made a
+        # single-device array)
+        ss = [self.read_state_tree(i, w) for (i, _, _), w
               in zip(triples, ws)]
 
         key = (dev, donate) + tuple((tuple(w.shape), str(w.dtype))
@@ -543,18 +570,9 @@ class Updater(object):
 
         new_ws, new_ss = self._fused_fns[key](ws, gs, ss, lrs, wds)
 
-        def tree_write(state, new):
-            if state is None:
-                return
-            if isinstance(state, (tuple, list)):
-                for s, n in zip(state, new):
-                    tree_write(s, n)
-                return
-            state._write(new)
-
         for (i, _, w), nw, ns in zip(triples, new_ws, new_ss):
             w._write(nw)
-            tree_write(self.states[i], ns)
+            self.write_state_tree(i, ns)
 
     def set_states(self, states):
         self.states = pickle.loads(states)

@@ -242,3 +242,159 @@ def test_fused_replicated_outputs_and_scalar_heads():
              zip(mod._exec_group.param_names, mod._exec_group.grad_arrays)}
     # d(sum(x W^T + b))/db = batch size
     np.testing.assert_allclose(grads["fc_bias"], 16.0, rtol=1e-5)
+
+
+def _seeded_module(step_enabled, opt="sgd", opt_kw=None):
+    mx.random.seed(42)
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(0)])
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.07))
+    mod.init_optimizer(optimizer=opt,
+                       optimizer_params=opt_kw or
+                       {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    mod._exec_group._step_enabled = step_enabled
+    return mod
+
+
+def _run_steps(mod, steps=5):
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 6).astype(np.float32)
+    y = rng.randint(0, 10, 8).astype(np.float32)
+    b = mx.io.DataBatch([mx.nd.array(X)], [mx.nd.array(y)])
+    for _ in range(steps):
+        mod.forward_backward(b)
+        mod.update()
+    return b
+
+
+def test_one_program_step_matches_classic():
+    """forward_backward+update as ONE XLA program (step_update) must be
+    bitwise identical to the two-program path, incl. optimizer state."""
+    for opt, kw in (("sgd", None),
+                    ("adam", {"learning_rate": 0.05})):
+        mods = []
+        for enabled in (False, True):
+            m = _seeded_module(enabled, opt, kw)
+            _run_steps(m)
+            mods.append(m)
+        a, bmod = mods
+        assert "train_step:" in "".join(
+            k for k in bmod._exec_group._jits if isinstance(k, str))
+        for n, p in a._exec_group._param_dict.items():
+            np.testing.assert_array_equal(
+                np.asarray(p._read()),
+                np.asarray(bmod._exec_group._param_dict[n]._read()),
+                err_msg="%s/%s" % (opt, n))
+        def flat(st):
+            if st is None:
+                return []
+            if isinstance(st, (tuple, list)):
+                return [x for s in st for x in flat(s)]
+            return [np.asarray(st._read())]
+
+        for k, st in a._updater.states.items():
+            for sa, sb in zip(flat(st), flat(bmod._updater.states[k])):
+                np.testing.assert_array_equal(sa, sb)
+
+
+def test_one_program_step_early_grad_read_falls_back():
+    """Reading grads between backward() and update() materializes the
+    plain fwd+bwd (params still pre-update) and the classic update path
+    runs — numerics must still match."""
+    ref = _seeded_module(False)
+    _run_steps(ref, steps=3)
+
+    mod = _seeded_module(True)
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 6).astype(np.float32)
+    y = rng.randint(0, 10, 8).astype(np.float32)
+    b = mx.io.DataBatch([mx.nd.array(X)], [mx.nd.array(y)])
+    for i in range(3):
+        mod.forward_backward(b)
+        g = mod._exec_group._grad_dict["fc1_weight"].asnumpy()
+        assert np.isfinite(g).all()
+        mod.update()
+    for n, p in ref._exec_group._param_dict.items():
+        np.testing.assert_array_equal(
+            np.asarray(p._read()),
+            np.asarray(mod._exec_group._param_dict[n]._read()), err_msg=n)
+
+
+def test_one_program_step_outputs_and_metric():
+    """get_outputs()/update_metric after update() (the fit loop order)
+    sees the step program's outputs."""
+    mod = _seeded_module(True)
+    b = _run_steps(mod, steps=2)
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (8, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-3)
+    metric = mx.metric.Accuracy()
+    mod.update_metric(metric, b.label)
+    assert 0.0 <= metric.get()[1] <= 1.0
+
+
+def _bn_module(step_enabled):
+    mx.random.seed(7)
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc1")
+    net = sym.BatchNorm(net, name="bn", fix_gamma=False)
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(0)])
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.07))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    mod._exec_group._step_enabled = step_enabled
+    return mod
+
+
+def _bn_aux(mod):
+    return {n: np.asarray(b._read(), np.float32)
+            for n, b in mod._exec_group._aux_dict.items()}
+
+
+def test_one_program_step_no_double_bn_ema():
+    """get_outputs() between forward and update materializes the forward
+    (aux EMA applied once); the step program must re-run from the
+    pre-forward aux snapshot, not apply the EMA twice (r2 review)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 6).astype(np.float32)
+    y = rng.randint(0, 10, 8).astype(np.float32)
+    b = mx.io.DataBatch([mx.nd.array(X)], [mx.nd.array(y)])
+    auxes = []
+    for enabled in (False, True):
+        mod = _bn_module(enabled)
+        mod.forward(b, is_train=True)
+        mod.get_outputs()[0].asnumpy()   # materialize forward
+        mod.backward()
+        mod.update()
+        auxes.append(_bn_aux(mod))
+    for n in auxes[0]:
+        np.testing.assert_array_equal(auxes[0][n], auxes[1][n], err_msg=n)
+
+
+def test_one_program_step_no_dropped_batch():
+    """Two forward_backward calls before one update: the first batch's
+    deferred fwd+bwd (incl. BN EMA) must still execute (r2 review)."""
+    rng = np.random.RandomState(0)
+    batches = [mx.io.DataBatch(
+        [mx.nd.array(rng.rand(8, 6).astype(np.float32))],
+        [mx.nd.array(rng.randint(0, 10, 8).astype(np.float32))])
+        for _ in range(2)]
+    auxes = []
+    for enabled in (False, True):
+        mod = _bn_module(enabled)
+        mod.forward_backward(batches[0])
+        mod.forward_backward(batches[1])
+        mod.update()
+        auxes.append(_bn_aux(mod))
+    for n in auxes[0]:
+        np.testing.assert_array_equal(auxes[0][n], auxes[1][n], err_msg=n)
