@@ -312,14 +312,37 @@ def autograd_compute_gradient(outputs):
 
 
 # ------------------------------------------------------------ op reflection
+_ATTR_TYPE_NAMES = {int: "int", float: "float", bool: "boolean",
+                    str: "string", tuple: "Shape(tuple)",
+                    list: "Shape(tuple)"}
+
+
 def func_info(op_name):
     """(name, description, arg_names, arg_types, arg_descs, key_var_num_args)
-    for MXFuncGetInfo / MXSymbolGetAtomicSymbolInfo."""
+    for MXFuncGetInfo / MXSymbolGetAtomicSymbolInfo.
+
+    Mirrors the reference's dmlc::Parameter reflection
+    (include/dmlc/parameter.h __FIELDS__): tensor inputs are reported as
+    NDArray-or-Symbol, keyword parameters with the type names declared in
+    the registry's attr_types (registry.py OpDef)."""
     op = get_op(op_name)
     args = [a for a in op.list_arguments(None)]
     doc = (op.fcompute.__doc__ or "").strip() if op.fcompute else ""
     types = ["NDArray-or-Symbol"] * len(args)
     descs = [""] * len(args)
+    for attr, typ in sorted(op.attr_types.items()):
+        args.append(attr)
+        tname = _ATTR_TYPE_NAMES.get(typ, getattr(typ, "__name__",
+                                                  str(typ)))
+        required = (attr == op.variable_args or
+                    attr in op.required_attrs)
+        types.append("%s, %s" % (tname,
+                                 "required" if required else "optional"))
+        descs.append("")
+    if op.variable_args and op.variable_args not in op.attr_types:
+        args.append(op.variable_args)
+        types.append("int, required")
+        descs.append("number of variadic inputs")
     # report the queried name, not the canonical target an alias resolves
     # to (the reference registry keys aliases as distinct entries);
     # key_var_num_args names the param that carries the vararg count

@@ -48,7 +48,7 @@ class MeshExecutorGroup(object):
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", compute_dtype=None):
+                 grad_req="write", compute_dtype=None, remat=None):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -64,6 +64,10 @@ class MeshExecutorGroup(object):
         self.logger = logger
         self.fixed_param_names = fixed_param_names or []
         self.compute_dtype = compute_dtype
+        if remat not in (None, "full", "dots"):
+            raise ValueError(
+                "remat must be None, 'full', or 'dots' (got %r)" % (remat,))
+        self.remat = remat
         self._grad_names = [n for n in param_names
                             if n not in self.fixed_param_names] \
             if for_training and grad_req == "write" else []
@@ -207,7 +211,19 @@ class MeshExecutorGroup(object):
             # statistics math in f32 and casts its output to the activation
             # dtype, so mixed-precision dtype agreement is the op's job
             auxv = [aux[n] for n in self.aux_names]
-            outs, new_aux = self._eval_fn(vals, auxv, rng, is_train)
+            if self.remat and is_train:
+                # rematerialization trades HBM for recompute in backward
+                # (jax.checkpoint; the reference's external memonger tool).
+                # "full": recompute everything; "dots": keep matmul/conv
+                # outputs, recompute the cheap elementwise chains.
+                policy = (jax.checkpoint_policies.dots_saveable
+                          if self.remat == "dots" else None)
+                ev = jax.checkpoint(
+                    lambda v, a, r: self._eval_fn(v, a, r, True),
+                    policy=policy)
+                outs, new_aux = ev(vals, auxv, rng)
+            else:
+                outs, new_aux = self._eval_fn(vals, auxv, rng, is_train)
             return outs, dict(zip(self.aux_names, new_aux))
 
         repl, batch = self._repl, self._batch_sharding

@@ -32,9 +32,13 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 compute_dtype=None, _allow_fused=True):
+                 compute_dtype=None, remat=None, _allow_fused=True):
         super().__init__(logger=logger)
         self._compute_dtype = compute_dtype
+        if remat not in (None, "full", "dots"):
+            raise ValueError(
+                "remat must be None, 'full', or 'dots' (got %r)" % (remat,))
+        self._remat = remat
         self._allow_fused = _allow_fused
         if context is None:
             context = ctx_mod.current_context()
@@ -218,13 +222,18 @@ class Module(BaseModule):
                 self._data_shapes, self._label_shapes, self._param_names,
                 for_training, inputs_need_grad, shared_group, self.logger,
                 self._fixed_param_names, grad_req,
-                compute_dtype=self._compute_dtype)
+                compute_dtype=self._compute_dtype, remat=self._remat)
         elif shared_is_fused:
             raise ValueError(
                 "shared_module uses the fused mesh group but this bind is "
                 "not fused-eligible; bind the shared module with "
                 "MXNET_MODULE_FUSED=0 to share classic executors")
         else:
+            if self._remat is not None:
+                self.logger.warning(
+                    "remat=%r is only supported on the fused mesh path; "
+                    "this bind fell back to per-executor groups and will "
+                    "NOT rematerialize", self._remat)
             self._exec_group = DataParallelExecutorGroup(
                 self._symbol, self._context, self._work_load_list,
                 self._data_shapes, self._label_shapes, self._param_names,
@@ -323,6 +332,10 @@ class Module(BaseModule):
                 "%s: falling back to per-executor groups; compute_dtype=%s "
                 "only applies on the fused path, execution continues in "
                 "float32", reason, self._compute_dtype)
+        if self._remat is not None:
+            self.logger.warning(
+                "%s: falling back to per-executor groups; remat=%r only "
+                "applies on the fused path", reason, self._remat)
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,

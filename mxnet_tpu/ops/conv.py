@@ -62,6 +62,7 @@ def _conv_infer(attrs, in_shapes, aux):
                       "pad": tuple, "num_filter": int, "num_group": int,
                       "workspace": int, "no_bias": bool, "cudnn_tune": str,
                       "cudnn_off": bool, "layout": str},
+          required_attrs=("kernel", "num_filter"),
           infer_shape=_conv_infer, alias=("Convolution_v1",))
 def _convolution(attrs, ins, octx):
     lax = _lax()
@@ -120,6 +121,7 @@ def _deconv_args(attrs):
           attr_types={"kernel": tuple, "stride": tuple, "pad": tuple,
                       "adj": tuple, "target_shape": tuple, "num_filter": int,
                       "num_group": int, "workspace": int, "no_bias": bool},
+          required_attrs=("kernel", "num_filter"),
           infer_shape=_deconv_infer)
 def _deconvolution(attrs, ins, octx):
     """Transposed convolution = conv with lhs dilation

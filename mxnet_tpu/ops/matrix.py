@@ -252,6 +252,7 @@ def _embedding_infer(attrs, in_shapes, aux):
 
 @register("Embedding", arg_names=("data", "weight"),
           attr_types={"input_dim": int, "output_dim": int},
+          required_attrs=("input_dim", "output_dim"),
           infer_shape=_embedding_infer)
 def _embedding(attrs, ins, octx):
     """Embedding lookup — gather from the weight table; backward is XLA

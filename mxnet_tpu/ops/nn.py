@@ -48,7 +48,7 @@ def _fc_infer(attrs, in_shapes, aux):
 
 @register("FullyConnected", arg_names=_fc_args,
           attr_types={"num_hidden": int, "no_bias": bool},
-          infer_shape=_fc_infer)
+          required_attrs=("num_hidden",), infer_shape=_fc_infer)
 def _fully_connected(attrs, ins, octx):
     """Y = X·Wᵀ + b. Flattens input to 2-D like the reference; the matmul is
     the MXU fast path (reference: mshadow dot() + repmat)."""

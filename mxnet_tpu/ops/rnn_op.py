@@ -142,6 +142,7 @@ def _scan_layer(jax, jnp, mode, x, h0, c0, w, r, bw, br, state_size, reverse):
                       "bidirectional": bool, "mode": str, "p": float,
                       "state_outputs": bool, "lstm_state_clip_min": float,
                       "lstm_state_clip_max": float},
+          required_attrs=("state_size", "num_layers", "mode"),
           infer_shape=_rnn_infer, needs_rng=True)
 def _rnn(attrs, ins, octx):
     import jax

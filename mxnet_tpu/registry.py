@@ -55,7 +55,8 @@ class OpDef:
     def __init__(self, name, fcompute, arg_names=("data",), out_names=("output",),
                  aux_names=(), attr_types=None, infer_shape=None,
                  needs_rng=False, variable_args=None, num_outputs=None,
-                 alias=(), backward_ignores_head_grads=False):
+                 alias=(), backward_ignores_head_grads=False,
+                 required_attrs=()):
         self.name = name
         self.fcompute = fcompute
         # arg_names may be a callable(attrs) -> names for ops whose input
@@ -73,6 +74,9 @@ class OpDef:
         self._num_outputs = num_outputs  # int, or callable(attrs)->int
         self.alias = tuple(alias)
         self.backward_ignores_head_grads = backward_ignores_head_grads
+        # attrs with no usable default (dmlc::Parameter's .set_default-less
+        # fields report "required" through GetAtomicSymbolInfo)
+        self.required_attrs = tuple(required_attrs)
 
     # -- arity -------------------------------------------------------------
     def list_arguments(self, attrs=None):

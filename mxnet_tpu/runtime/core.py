@@ -11,6 +11,17 @@ are kept in a table keyed by an integer ctx and dropped after execution).
 src/storage pooled managers, re-targeted at staging buffers): ``alloc_array``
 hands out 64-byte-aligned numpy views whose backing memory recycles through
 the pool.
+
+Resource-manager contract (reference include/mxnet/resource.h): of the
+reference's two op resources, ``kRandom`` is provided by the key-chain PRNG
+(random.py — every op declaring ``needs_rng`` receives a fresh fold of the
+global key), while ``kTempSpace`` (per-op scratch HBM the reference doles
+out through ResourceManager) is INTENTIONALLY ABSENT as a user-visible
+resource: XLA plans every kernel's scratch during buffer assignment, sizing
+and reusing it across the whole fused program — a per-op temp-space request
+API would defeat that planning. Ops that would ask for temp space in the
+reference (sorting, conv workspaces, CTC alphas) simply materialize
+intermediates and let XLA fuse/allocate them.
 """
 from __future__ import annotations
 

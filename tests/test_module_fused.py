@@ -46,8 +46,9 @@ def _data(batch=32, shape=(1, 8, 8), nclass=10, seed=7):
     return X, y
 
 
-def _train(net, contexts, X, y, batch, steps=8, seed_params=None):
-    mod = mx.mod.Module(net, context=contexts)
+def _train(net, contexts, X, y, batch, steps=8, seed_params=None,
+           **module_kwargs):
+    mod = mx.mod.Module(net, context=contexts, **module_kwargs)
     mod.bind(data_shapes=[("data", (batch,) + X.shape[1:])],
              label_shapes=[("softmax_label", (batch,))])
     if seed_params is None:
@@ -398,3 +399,23 @@ def test_one_program_step_no_dropped_batch():
         auxes.append(_bn_aux(mod))
     for n in auxes[0]:
         np.testing.assert_array_equal(auxes[0][n], auxes[1][n], err_msg=n)
+
+
+def test_remat_matches_baseline():
+    """Module(remat="full"/"dots") wraps the forward in jax.checkpoint;
+    training numerics are unchanged (memory-for-recompute only)."""
+    net = _mlp_net()
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 64).astype(np.float32)
+    y = rng.randint(0, 10, 32).astype(np.float32)
+
+    base = _train(net, [mx.cpu(0)], X, y, 8, steps=3)
+    for mode in ("full", "dots"):
+        r = _train(net, [mx.cpu(0)], X, y, 8, steps=3, remat=mode)
+        for n in base[0]:
+            np.testing.assert_array_equal(base[0][n].asnumpy(),
+                                          r[0][n].asnumpy(),
+                                          err_msg="%s/%s" % (mode, n))
+
+    with pytest.raises(ValueError):
+        mx.mod.Module(net, context=[mx.cpu(0)], remat="dot")
