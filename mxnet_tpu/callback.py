@@ -1,4 +1,18 @@
-"""Training callbacks (python/mxnet/callback.py): checkpointing + Speedometer."""
+"""Training callbacks.
+
+API counterpart of the reference's python/mxnet/callback.py. Two kinds:
+
+- epoch callbacks ``f(epoch, symbol, arg_params, aux_params)`` invoked by
+  ``Module.fit`` after each epoch (checkpointing lives here);
+- batch callbacks ``f(BatchEndParam)`` invoked after every batch
+  (throughput logging, progress display).
+
+TPU note: train steps dispatch asynchronously — a batch callback that
+only looks at ``param.nbatch`` measures the host-side dispatch rate, not
+device progress. Callbacks that read ``param.eval_metric`` force the
+outputs to materialize, which synchronizes with the device; that is why
+``Speedometer`` readings with a metric attached are the honest ones.
+"""
 from __future__ import annotations
 
 import logging
@@ -10,93 +24,110 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Epoch callback saving the module (callback.module_checkpoint)."""
-    period = int(max(1, period))
+    """Epoch callback: save ``mod`` every ``period`` epochs as
+    ``prefix-%04d.params`` (+ ``.states``)."""
+    period = max(1, int(period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+        epoch = iter_no + 1
+        if epoch % period == 0:
+            mod.save_checkpoint(prefix, epoch, save_optimizer_states)
 
     return _callback
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch callback saving symbol+params (callback.do_checkpoint)."""
+    """Epoch callback: save the passed symbol+params every ``period``
+    epochs (the FeedForward-era twin of :func:`module_checkpoint`)."""
     from .model import save_checkpoint
-    period = int(max(1, period))
+    period = max(1, int(period))
 
     def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        epoch = iter_no + 1
+        if epoch % period == 0:
+            save_checkpoint(prefix, epoch, sym, arg, aux)
 
     return _callback
 
 
 def log_train_metric(period, auto_reset=False):
+    """Batch callback: log the training metric every ``period`` batches,
+    optionally resetting it afterwards (windowed rather than running
+    averages)."""
+
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        metric = param.eval_metric
+        if metric is None or param.nbatch % period != 0:
+            return
+        for name, value in metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            metric.reset()
 
     return _callback
 
 
 class Speedometer(object):
-    """Log samples/sec every ``frequent`` batches (callback.Speedometer)."""
+    """Batch callback: log samples/sec (and the training metric, if one
+    is attached) every ``frequent`` batches. The window restarts at every
+    epoch boundary (detected by ``nbatch`` wrapping backwards)."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._tic = None
+        self._last_count = 0
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
+        if count < self._last_count:
+            self._tic = None  # new epoch: restart the timing window
+        self._last_count = count
 
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
-                    for name, value in name_value:
-                        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
-                                     param.epoch, count, speed, name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        if self._tic is None:
+            self._tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+
+        speed = self.frequent * self.batch_size / (time.time() - self._tic)
+        metric = param.eval_metric
+        if metric is not None:
+            # reading the metric materializes outputs -> device-synced rate
+            pairs = metric.get_name_value()
+            metric.reset()
+            for name, value in pairs:
+                logging.info(
+                    "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    "\tTrain-%s=%f",
+                    param.epoch, count, speed, name, value)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, speed)
+        self._tic = time.time()
 
 
 class ProgressBar(object):
-    """Text progress bar per batch (callback.ProgressBar)."""
+    """Batch callback: text progress bar over ``total`` batches."""
 
     def __init__(self, total, length=80):
         self.bar_len = length
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        done = int(round(self.bar_len * param.nbatch / float(self.total)))
+        pct = math.ceil(100.0 * param.nbatch / float(self.total))
+        logging.info("[%s] %s%%\r",
+                     "=" * done + "-" * (self.bar_len - done), pct)
 
 
 class LogValidationMetricsCallback(object):
+    """Eval-end callback: log every validation metric for the epoch."""
+
     def __call__(self, param):
         if not param.eval_metric:
             return
         for name, value in param.eval_metric.get_name_value():
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)
