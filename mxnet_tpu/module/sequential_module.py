@@ -1,5 +1,17 @@
-"""SequentialModule — chain of modules (python/mxnet/module/
-sequential_module.py:416)."""
+"""SequentialModule — a chain of Modules executed back to back.
+
+API counterpart of the reference's python/mxnet/module/
+sequential_module.py: each sub-module's outputs feed the next one's data
+inputs, gradients flow back through get_input_grads, and per-module
+metas control label routing (``take_labels``) and input renaming
+(``auto_wiring``).
+
+TPU note: each sub-module compiles its own XLA program, so a chain pays
+one program launch per stage per direction. The single-symbol
+:class:`Module` fuses the whole graph into one program and is preferred;
+SequentialModule exists for staged training (frozen feature extractor +
+trainable head) and reference-API parity.
+"""
 from __future__ import annotations
 
 import copy
@@ -14,38 +26,38 @@ __all__ = ["SequentialModule"]
 class SequentialModule(BaseModule):
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
+    _META_KEYS = frozenset((META_TAKE_LABELS, META_AUTO_WIRING))
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
         self._modules = []
         self._metas = []
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
 
     def add(self, module, **kwargs):
+        """Append ``module``; meta kwargs: ``take_labels`` routes the
+        chain's labels to this stage, ``auto_wiring`` renames the
+        previous stage's outputs to this stage's data_names. Returns
+        self for chaining. Invalidates bind/init state."""
+        unknown = set(kwargs) - self._META_KEYS
+        if unknown:
+            raise ValueError("unknown meta keys %s (known: %s)"
+                             % (sorted(unknown), sorted(self._META_KEYS)))
         self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta \"%s\"" % key
         self._metas.append(kwargs)
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # ------------------------------------------------------- introspection
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._modules[0].data_names if self._modules else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._modules[-1].output_names if self._modules else []
 
     @property
     def data_shapes(self):
@@ -62,45 +74,45 @@ class SequentialModule(BaseModule):
         assert self.binded
         return self._modules[-1].output_shapes
 
+    # ------------------------------------------------------------- params
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for m in self._modules:
+            a, x = m.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        for module in self._modules:
-            module.init_params(initializer=initializer, arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, \
-                    "Duplicated parameter names: " + \
-                    ("name \"%s\" in layer %d (%s) is already used in layer "
-                     "%d (%s)." % (name, i, type(modules[i]),
-                                   known_names[name],
-                                   type(modules[known_names[name]])))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        for m in self._modules:
+            m.init_params(initializer=initializer, arg_params=arg_params,
+                          aux_params=aux_params,
+                          allow_missing=allow_missing,
+                          force_init=force_init)
+        self._reject_duplicate_params()
         self.params_initialized = True
 
+    def _reject_duplicate_params(self):
+        """Stages must not share parameter names — get_params merges the
+        dicts, so a collision would silently drop one stage's weights."""
+        owner = {}
+        for i, m in enumerate(self._modules):
+            a, x = m.get_params()
+            for name in list(a) + list(x):
+                if name in owner:
+                    raise ValueError(
+                        "duplicated parameter %r: stage %d (%s) and stage "
+                        "%d (%s)" % (name, owner[name],
+                                     type(self._modules[owner[name]]).
+                                     __name__, i, type(m).__name__))
+                owner[name] = i
+
+    # --------------------------------------------------------------- bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -110,40 +122,35 @@ class SequentialModule(BaseModule):
         if inputs_need_grad:
             assert for_training
         assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty SequentialModule"
+        assert self._modules, "cannot bind an empty SequentialModule"
 
         self.binded = True
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
         self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
+        stage_data = data_shapes
+        labels_used = False
+        for i, (m, meta) in enumerate(zip(self._modules, self._metas)):
+            takes_labels = meta.get(self.META_TAKE_LABELS, False)
+            labels_used = labels_used or takes_labels
+            if meta.get(self.META_AUTO_WIRING, False):
+                names = m.data_names
+                assert len(names) == len(stage_data)
+                stage_data = [(n, shape) for n, (_, shape)
+                              in zip(names, stage_data)]
+            m.bind(data_shapes=stage_data,
+                   label_shapes=label_shapes if takes_labels else None,
+                   for_training=for_training,
+                   # every stage after the first must produce input grads
+                   # so backward() can chain them
+                   inputs_need_grad=bool(
+                       for_training and (inputs_need_grad or i > 0)),
+                   force_rebind=force_rebind, shared_module=None,
+                   grad_req=grad_req)
+            stage_data = m.output_shapes
 
-            my_inputs_need_grad = bool(for_training and
-                                       (inputs_need_grad or i_layer > 0))
-
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            my_data_shapes = module.output_shapes
-
-        if not anybody_ever_needs_label:
+        if not labels_used:
             self._label_shapes = None
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -153,40 +160,43 @@ class SequentialModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for m in self._modules:
+            m.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                             optimizer_params=optimizer_params,
+                             force_init=force_init)
         self.optimizer_initialized = True
 
+    # ---------------------------------------------------------- execution
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        data_batch = copy.copy(data_batch)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = copy.copy(data_batch)
+        last = len(self._modules) - 1
+        for i, m in enumerate(self._modules):
+            m.forward(batch, is_train=is_train)
+            if i == last:
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_names = [x[0] for x in module.output_shapes]
-                assert len(data_names) == len(data_batch.data)
-                data_batch.provide_data = [(name, x.shape) for name, x in
-                                           zip(data_names, data_batch.data)]
+            batch.data = m.get_outputs()
+            if hasattr(batch, "provide_data"):
+                names = [x[0] for x in m.output_shapes]
+                assert len(names) == len(batch.data), (
+                    "stage %s: %d outputs vs %d output_shapes"
+                    % (type(m).__name__, len(batch.data), len(names)))
+                batch.provide_data = [(n, d.shape) for n, d
+                                      in zip(names, batch.data)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(range(len(self._modules)),
-                                                 self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
+        for i in range(len(self._modules) - 1, -1, -1):
+            self._modules[i].backward(out_grads=out_grads)
+            if i == 0:
                 break
-            out_grads = module.get_input_grads()
+            out_grads = self._modules[i].get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        for m in self._modules:
+            m.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -199,12 +209,11 @@ class SequentialModule(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for m, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                m.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for m in self._modules:
+            m.install_monitor(mon)

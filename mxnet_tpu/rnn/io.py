@@ -1,7 +1,22 @@
-"""Bucketed sequence iterators (python/mxnet/rnn/io.py)."""
+"""Bucketed sequence iterators.
+
+API counterpart of the reference's python/mxnet/rnn/io.py
+(BucketSentenceIter / encode_sentences), redesigned around numpy batch
+assembly: sentences are padded into one dense matrix PER BUCKET up
+front, labels are the shifted sequence computed vectorized at reset, and
+each next() slices a contiguous batch out of the bucket matrix — batches
+stay host-side numpy until the train step stages them, so no device
+chatter happens during iteration.
+
+TPU note: every distinct bucket length is a distinct XLA program for the
+BucketingModule (compile cache keyed by bucket_key). Fewer, coarser
+buckets mean fewer compilations; the auto-bucketing below only keeps
+lengths holding at least one full batch for exactly that reason.
+"""
 from __future__ import annotations
 
 import bisect
+import logging
 import random
 
 import numpy as onp
@@ -14,127 +29,122 @@ __all__ = ["BucketSentenceIter", "encode_sentences"]
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0):
-    """Encode sentences to int arrays, building a vocab (rnn/io.py)."""
-    idx = start_label
-    if vocab is None:
+    """Map token sequences to integer id sequences.
+
+    With ``vocab=None`` a new vocabulary is built on the fly (ids
+    assigned in first-seen order from ``start_label``, skipping
+    ``invalid_label``); with a given vocab, unknown tokens raise.
+    Returns ``(encoded_sentences, vocab)``.
+    """
+    building = vocab is None
+    if building:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
-    else:
-        new_vocab = False
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, "Unknown token %s" % word
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+    next_id = start_label
+    encoded = []
+    for sentence in sentences:
+        ids = []
+        for token in sentence:
+            if token not in vocab:
+                if not building:
+                    raise ValueError("unknown token %r with a fixed vocab"
+                                     % (token,))
+                if next_id == invalid_label:
+                    next_id += 1
+                vocab[token] = next_id
+                next_id += 1
+            ids.append(vocab[token])
+        encoded.append(ids)
+    return encoded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """Bucketed iterator for padded variable-length sequences
-    (rnn/io.py BucketSentenceIter)."""
+    """Iterator over padded variable-length sequences grouped into
+    length buckets; emits DataBatch with ``bucket_key`` for the
+    BucketingModule and next-token labels for language modelling."""
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name="data", label_name="softmax_label", dtype="float32",
-                 layout="NTC"):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NTC"):
         super().__init__()
-        if not buckets:
-            buckets = [i for i, j in enumerate(
-                onp.bincount([len(s) for s in sentences]))
-                if j >= batch_size]
-        buckets.sort()
-
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = bisect.bisect_left(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = onp.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-
-        self.data = [onp.asarray(i, dtype=dtype) for i in self.data]
-        if ndiscard:
-            print("WARNING: discarded %d sentences longer than the largest "
-                  "bucket." % ndiscard)
-
         self.batch_size = batch_size
-        self.buckets = buckets
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
+        self.major_axis = layout.find("N")
+        if self.major_axis not in (0, 1):
+            raise ValueError(
+                "layout %r: need batch-major ('NT...') or time-major "
+                "('TN...')" % layout)
+
+        if not buckets:
+            # keep only lengths that can fill at least one whole batch —
+            # each bucket is a separate XLA compilation downstream
+            counts = onp.bincount([len(s) for s in sentences])
+            buckets = [length for length, c in enumerate(counts)
+                       if c >= batch_size]
+        self.buckets = sorted(buckets)
+        self.default_bucket_key = max(self.buckets)
+
+        # dense per-bucket matrices, padded with invalid_label
+        rows = [[] for _ in self.buckets]
+        dropped = 0
+        for s in sentences:
+            b = bisect.bisect_left(self.buckets, len(s))
+            if b == len(self.buckets):
+                dropped += 1
+                continue
+            rows[b].append(s)
+        if dropped:
+            logging.warning(
+                "BucketSentenceIter: dropped %d sentences longer than the "
+                "largest bucket (%d)", dropped, self.default_bucket_key)
+        self.data = []
+        for blen, sents in zip(self.buckets, rows):
+            mat = onp.full((len(sents), blen), invalid_label, dtype=dtype)
+            for r, s in enumerate(sents):
+                mat[r, :len(s)] = s
+            self.data.append(mat)
+
+        bshape = ((batch_size, self.default_bucket_key)
+                  if self.major_axis == 0
+                  else (self.default_bucket_key, batch_size))
+        self.provide_data = [DataDesc(data_name, bshape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, bshape, layout=layout)]
+
+        # (bucket, row-offset) pairs addressing every full batch
+        self.idx = [(b, r)
+                    for b, mat in enumerate(self.data)
+                    for r in range(0, len(mat) - batch_size + 1,
+                                   batch_size)]
+        self.curr_idx = 0
         self.nddata = []
         self.ndlabel = []
-        self.major_axis = layout.find("N")
-        self.default_bucket_key = max(buckets)
-
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(data_name,
-                                          (batch_size,
-                                           self.default_bucket_key),
-                                          layout=layout)]
-            self.provide_label = [DataDesc(label_name,
-                                           (batch_size,
-                                            self.default_bucket_key),
-                                           layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(data_name,
-                                          (self.default_bucket_key,
-                                           batch_size), layout=layout)]
-            self.provide_label = [DataDesc(label_name,
-                                           (self.default_bucket_key,
-                                            batch_size), layout=layout)]
-        else:
-            raise ValueError("Invalid layout %s: Must by NT (batch major) or"
-                             " TN (time major)" % layout)
-
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1,
-                                   batch_size)])
-        self.curr_idx = 0
         self.reset()
 
     def reset(self):
         self.curr_idx = 0
         random.shuffle(self.idx)
-        for buck in self.data:
-            onp.random.shuffle(buck)
-
         self.nddata = []
         self.ndlabel = []
-        for buck in self.data:
-            label = onp.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(ndarray.array(buck, dtype=self.dtype))
-            self.ndlabel.append(ndarray.array(label, dtype=self.dtype))
+        for mat in self.data:
+            onp.random.shuffle(mat)
+            # next-token target: shift left, pad the tail position
+            lab = onp.roll(mat, -1, axis=1)
+            lab[:, -1] = self.invalid_label
+            self.nddata.append(ndarray.array(mat, dtype=self.dtype))
+            self.ndlabel.append(ndarray.array(lab, dtype=self.dtype))
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self.curr_idx >= len(self.idx):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
+        b, r = self.idx[self.curr_idx]
         self.curr_idx += 1
-
-        if self.major_axis == 1:
-            data = self.nddata[i][j:j + self.batch_size].T
-            label = self.ndlabel[i][j:j + self.batch_size].T
-        else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
-
-        return DataBatch([data], [label], pad=0,
-                         bucket_key=self.buckets[i],
-                         provide_data=[DataDesc(self.data_name, data.shape)],
-                         provide_label=[DataDesc(self.label_name,
-                                                 label.shape)])
+        data = self.nddata[b][r:r + self.batch_size]
+        label = self.ndlabel[b][r:r + self.batch_size]
+        if self.major_axis == 1:  # time-major
+            data, label = data.T, label.T
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[b],
+            provide_data=[DataDesc(self.data_name, data.shape)],
+            provide_label=[DataDesc(self.label_name, label.shape)])
