@@ -1,4 +1,12 @@
-"""AlexNet (example/image-classification/symbols/alexnet.py)."""
+"""AlexNet (example/image-classification/symbols/alexnet.py).
+
+Provenance: DERIVED from the reference's model-zoo symbol script — the
+layer wiring, filter counts, and layer names are transcribed so that
+checkpoints and per-layer comparisons line up 1:1 with the reference
+architecture. Model-zoo topology files are the one place where such
+derivation is intentional; the execution machinery underneath is
+original TPU-native code.
+"""
 from .. import symbol as sym
 
 
