@@ -167,7 +167,16 @@ class KVStore(object):
 
 def create(name="local"):
     """Create a KVStore: local | device | dist_sync | dist_device_sync |
-    dist_async (KVStore::Create, src/kvstore/kvstore.cc:17-45)."""
+    dist_async (KVStore::Create, src/kvstore/kvstore.cc:17-45).
+
+    Design note: the reference's ``dist_async`` lets each worker's update
+    land on the parameter server unsynchronized (straggler tolerance at
+    the price of non-determinism, kvstore_dist.h). Here EVERY dist mode
+    synchronizes through XLA collectives over ICI/DCN — the collective is
+    the native TPU mechanism and is itself a sync point — so dist_async
+    provides the same deterministic bitwise-reproducible semantics as
+    dist_sync. Code written for the reference's async mode runs
+    unchanged; it simply gets the stronger guarantee."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "device", "local_allreduce_device",

@@ -105,7 +105,7 @@ def run_crash(kv):
 
 def main():
     mode = sys.argv[1]
-    kv = mx.kv.create("dist_sync")
+    kv = mx.kv.create(os.environ.get("DIST_KV_TYPE", "dist_sync"))
     if mode == "sync":
         run_sync(kv)
     elif mode == "crash":

@@ -10,6 +10,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "dist_worker.py")
 N_WORKER = 3
@@ -53,8 +55,13 @@ def _spawn_workers(mode, extra_env=None, timeout=300):
     return outs
 
 
-def test_dist_sync_push_pull_three_workers():
-    outs = _spawn_workers("sync")
+@pytest.mark.parametrize("kv_type", ["dist_sync", "dist_async"])
+def test_dist_push_pull_three_workers(kv_type):
+    """Exact deterministic sums across 3 real worker processes, for both
+    dist modes — dist_async runs the same collective path (kvstore.py
+    create(): deterministic superset of the reference's async
+    semantics)."""
+    outs = _spawn_workers("sync", extra_env={"DIST_KV_TYPE": kv_type})
     for rank, (rc, out) in enumerate(outs):
         assert rc == 0, "worker %d failed:\n%s" % (rank, out)
         assert "DIST_WORKER_OK" in out
