@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import logging
+import os
 
 from .. import context as ctx_mod
 from .. import ndarray as nd
@@ -35,6 +36,11 @@ class Module(BaseModule):
                  compute_dtype=None, remat=None, _allow_fused=True):
         super().__init__(logger=logger)
         self._compute_dtype = compute_dtype
+        if remat is None and os.environ.get(
+                "MXNET_BACKWARD_DO_MIRROR", "0") == "1":
+            # the reference's activation-recompute switch
+            # (docs/how_to/env_var.md:64-66, graph_executor.cc:210-223)
+            remat = "full"
         if remat not in (None, "full", "dots"):
             raise ValueError(
                 "remat must be None, 'full', or 'dots' (got %r)" % (remat,))

@@ -21,6 +21,32 @@ _state = "stop"
 _events = []
 _lock = threading.Lock()
 _jax_tracing = False
+_ran_undumped = False  # profiling ran but no dump written yet
+
+
+def _autostart():
+    """Honor the reference's MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE
+    env contract (docs/how_to/env_var.md:71-76): profiling begins at
+    library init and the dump fires at exit."""
+    if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") != "1":
+        return
+    mode = "all" if os.environ.get("MXNET_PROFILER_MODE", "0") == "1" \
+        else "symbolic"
+    profiler_set_config(mode=mode, filename=os.environ.get(
+        "MXNET_PROFILER_FILENAME", "profile.json"))
+    profiler_set_state("run")
+    import atexit
+
+    def _stop_and_dump():
+        # sticky: dump whenever profiling ever ran and nothing was
+        # written yet (reference enable_output_ semantics,
+        # initialize.cc:42-47) — a manual stop() must not lose the data
+        if _state == "run":
+            profiler_set_state("stop")
+        if _ran_undumped:
+            dump_profile()
+
+    atexit.register(_stop_and_dump)
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -32,10 +58,12 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 def profiler_set_state(state="stop"):
     """state: 'run' or 'stop' (MXSetProfilerState); also starts/stops a
     jax.profiler trace next to the chrome-trace output."""
-    global _state, _jax_tracing
+    global _state, _jax_tracing, _ran_undumped
     if state == _state:
         return
     _state = state
+    if state == "run":
+        _ran_undumped = True
     trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
     from . import engine as _engine
     if state == "run":
@@ -105,7 +133,17 @@ def dump_profile():
         finally:
             os.unlink(path)
     with _lock:
-        data = {"traceEvents": list(_events) + list(_native_events),
-                "displayTimeUnit": "ms"}
+        events = list(_events)
+        # "symbolic" mode (MXNET_PROFILER_MODE=0, the reference default)
+        # reports executor/step regions only; "all" adds the engine's
+        # per-imperative-op stamps (profiler.h:63-66 mode semantics)
+        if _config.get("mode") == "all":
+            events += list(_native_events)
+        data = {"traceEvents": events, "displayTimeUnit": "ms"}
         with open(_config["filename"], "w") as f:
             json.dump(data, f)
+    global _ran_undumped
+    _ran_undumped = False
+
+
+_autostart()
