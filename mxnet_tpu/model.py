@@ -9,6 +9,7 @@ layer has no TPU-side reason to exist.
 from __future__ import annotations
 
 import logging
+import os
 
 import numpy as onp
 
@@ -45,6 +46,11 @@ def _create_kvstore(kvstore, num_device, arg_params):
                     update_on_kvstore = False
     else:
         raise TypeError("kvstore must be KVStore, str or None")
+    # MXNET_UPDATE_ON_KVSTORE: direct override of the heuristic (the
+    # upstream env contract for forcing either update path)
+    env_override = os.environ.get("MXNET_UPDATE_ON_KVSTORE")
+    if env_override is not None and kv is not None:
+        update_on_kvstore = env_override == "1"
     if kv is None:
         update_on_kvstore = False
     return (kv, update_on_kvstore)

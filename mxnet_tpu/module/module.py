@@ -445,6 +445,11 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        if getattr(self._exec_group, "fused", False) and \
+                not self._update_on_kvstore and self._kvstore is None:
+            # keep the one-program train step across bucket switches
+            # (BucketingModule borrows the master bucket's optimizer)
+            self._exec_group._step_enabled = True
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):

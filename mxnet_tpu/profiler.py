@@ -38,12 +38,14 @@ def _autostart():
     import atexit
 
     def _stop_and_dump():
-        # sticky: dump whenever profiling ever ran and nothing was
-        # written yet (reference enable_output_ semantics,
-        # initialize.cc:42-47) — a manual stop() must not lose the data
-        if _state == "run":
+        # sticky: dump whenever profiling ever ran and data may be
+        # undumped (reference enable_output_ semantics,
+        # initialize.cc:42-47) — neither a manual stop() nor a mid-run
+        # dump may lose the tail of the trace
+        was_running = _state == "run"
+        if was_running:
             profiler_set_state("stop")
-        if _ran_undumped:
+        if was_running or _ran_undumped:
             dump_profile()
 
     atexit.register(_stop_and_dump)
@@ -86,8 +88,10 @@ def profiler_set_state(state="stop"):
 
 def record_event(name, begin_us, end_us, pid=0):
     """Append one duration event (engine's AddOprStat equivalent)."""
+    global _ran_undumped
     if _state != "run":
         return
+    _ran_undumped = True
     with _lock:
         _events.append({"name": name, "cat": "operator", "ph": "B",
                         "ts": begin_us, "pid": pid, "tid": pid})
@@ -119,7 +123,9 @@ def dump_profile():
     Callable repeatedly — both event sources accumulate across dumps."""
     from . import engine as _engine
     eng = _engine.get()
-    if eng.is_native:
+    # "symbolic" mode never emits per-op stamps — skip the temp-file
+    # drain entirely rather than accumulating events nobody will see
+    if eng.is_native and _config.get("mode") == "all":
         import tempfile
         with tempfile.NamedTemporaryFile(suffix=".json",
                                          delete=False) as tmp:
