@@ -9,8 +9,8 @@ contract, the construction is original.
 """
 from .. import symbol as sym
 
-# (num_1x1, reduce_3x3, num_3x3, reduce_5x5, num_5x5, pool_proj) per
-# inception block, grouped by stage; "P" entries are 3x3/s2 max-pools
+# (name, num_1x1, reduce_3x3, num_3x3, reduce_5x5, num_5x5, pool_proj)
+# per inception block, grouped by stage; "P" entries are 3x3/s2 max-pools
 _STAGES = [
     "P",
     ("in3a", 64, 96, 128, 16, 32, 32),

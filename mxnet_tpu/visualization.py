@@ -26,6 +26,8 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
         shape_dict = dict(zip(interals.list_outputs(), out_shapes))
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
+    aux_names = set(symbol.list_auxiliary_states())
+    counted = set()  # variable node ids already attributed (weight tying)
 
     if positions[-1] <= 1:
         positions = [int(line_length * p) for p in positions]
@@ -44,7 +46,7 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
     print_row(to_display, positions)
     print("=" * line_length)
 
-    total_params = [0]
+    total_params = 0
 
     def print_layer_summary(node, out_shape):
         op = node["op"]
@@ -56,16 +58,30 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
                 input_name = input_node["name"]
                 if input_node["op"] != "null" or item[0] in heads:
                     pre_node.append(input_name)
+        nonlocal total_params
         cur_param = 0
         if op != "null":
             for item in node["inputs"]:
                 input_node = nodes[item[0]]
+                # trainable parameters only: skip data/labels, BN moving
+                # stats (auxiliary states), and variables already counted
+                # at another consumer (weight tying)
                 if input_node["op"] == "null" and \
                         not input_node["name"].endswith("label") and \
-                        input_node["name"] != "data":
-                    key = input_node["name"] + "_output"
-                    # count via shape of the variable itself
-                    vshape = shape_dict.get(input_node["name"] + "_output")
+                        input_node["name"] != "data" and \
+                        input_node["name"] not in aux_names and \
+                        item[0] not in counted:
+                    # a variable's internal output is named either bare
+                    # or with the _output suffix depending on position
+                    vshape = shape_dict.get(input_node["name"]) or \
+                        shape_dict.get(input_node["name"] + "_output")
+                    if vshape:
+                        counted.add(item[0])
+                        n = 1
+                        for d in vshape:
+                            n *= int(d)
+                        cur_param += n
+        total_params += cur_param
         name = node["name"]
         first_connection = "" if not pre_node else pre_node[0]
         fields = ["%s(%s)" % (name, op), str(out_shape), cur_param,
@@ -85,6 +101,9 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
                 out_shape = shape_dict[key]
         print_layer_summary(node, out_shape)
     print("=" * line_length)
+    if show_shape:
+        print("Total params: {:,}".format(total_params))
+        print("_" * line_length)
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
@@ -92,6 +111,8 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
     """Build a graphviz Digraph (or DOT text if graphviz isn't installed)."""
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
+    aux_names = set(symbol.list_auxiliary_states())
+    counted = set()  # variable node ids already attributed (weight tying)
     hidden = set()
     if hide_weights:
         for node in nodes:

@@ -163,3 +163,16 @@ def test_executor_reshape_flags():
     exe2.forward(is_train=False,
                  data=np.zeros((4, 16), np.float32))
     assert exe2.outputs[0].shape == (4, 4)
+
+
+def test_print_summary_param_counts(capsys):
+    """viz.print_summary counts parameters from inferred shapes
+    (reference visualization.py print_summary)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    net = models.get_symbol("lenet", num_classes=10)
+    mx.viz.print_summary(net, shape={"data": (1, 1, 28, 28)})
+    out = capsys.readouterr().out
+    # classic LeNet (conv20/conv50/fc500/fc10) parameter count
+    assert "Total params: 431,080" in out
+    assert "conv1(Convolution)" in out and "(1, 20, 24, 24)" in out
