@@ -190,7 +190,8 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         if img_fmt in (".jpg", ".jpeg"):
             encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
         elif img_fmt == ".png":
-            encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+            # PNG takes a 0-9 compression level, not JPEG's 0-100 quality
+            encode_params = [cv2.IMWRITE_PNG_COMPRESSION, min(quality, 9)]
         ret, buf = cv2.imencode(img_fmt, img, encode_params)
         assert ret, "failed to encode image"
         return pack(header, buf.tobytes())
