@@ -20,23 +20,47 @@ def score(network, dev, batch_size, num_batches):
         data_shape = (batch_size, 3, 224, 224)
     sym = models.get_symbol(network, num_classes=1000)
 
-    mod = mx.mod.Module(sym, context=dev, label_names=[])
+    mod = mx.mod.Module(sym, context=dev,
+                        label_names=["softmax_label"])
     mod.bind(for_training=False, inputs_need_grad=False,
              data_shapes=[("data", data_shape)], label_shapes=None)
     mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
     from mxnet_tpu.io import DataBatch
-    batch = DataBatch([mx.nd.array(np.random.rand(*data_shape)
-                                   .astype(np.float32))], [])
-    # warm up (compile)
-    for _ in range(2):
+    X = np.random.rand(*data_shape).astype(np.float32)
+    eg = mod._exec_group
+    if getattr(eg, "fused", False):
+        # device-resident batch: scoring measures the model, not staging
+        import jax
+        batch = DataBatch([mx.nd.NDArray(
+            jax.device_put(X, eg._batch_sharding))], [])
+    else:
+        batch = DataBatch([mx.nd.array(X)], [])
+
+    import jax
+    import jax.numpy as jnp
+    tiny = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))
+
+    def dispatch():
+        # the fused group defers forward until outputs are read; _read()
+        # materializes (async dispatch) WITHOUT waiting for completion —
+        # a second forward() before this would supersede the batch
         mod.forward(batch, is_train=False)
-        for o in mod.get_outputs():
-            o.wait_to_read()
+        return mod.get_outputs()[0]._read()
+
+    def barrier(out):
+        # data-dependent 4-byte fetch: on remote-attached TPUs
+        # block_until_ready/wait_to_read can return at enqueue (PERF.md)
+        return float(tiny(out))
+
+    # warm up (compile; incl. the barrier program)
+    for _ in range(2):
+        out = dispatch()
+    barrier(out)
     tic = time.time()
     for _ in range(num_batches):
-        mod.forward(batch, is_train=False)
-        for o in mod.get_outputs():
-            o.wait_to_read()
+        out = dispatch()
+    # single-queue device: the last forward completes after all others
+    barrier(out)
     return num_batches * batch_size / (time.time() - tic)
 
 
