@@ -1,0 +1,55 @@
+# Internal helpers shared across the package.
+#
+# Reference counterpart: R-package/R/util.R + the Rcpp glue implicit in
+# R-package/src/export.cc. Here every native entry point is a registered
+# .Call routine in src/mxnet_r.cc (no Rcpp).
+
+# string helpers (reference util.R mx.util.str.endswith)
+mx.util.str.endswith <- function(name, suffix) {
+  slen <- nchar(suffix)
+  nlen <- nchar(name)
+  if (slen > nlen) return(FALSE)
+  substr(name, nlen - slen + 1, nlen) == suffix
+}
+
+mx.util.filter.null <- function(lst) {
+  lst[!sapply(lst, is.null)]
+}
+
+# Split kwargs into (string attrs, symbol args) the way the symbol
+# composer expects: symbols compose, everything else stringifies.
+mx.internal.split.kwargs <- function(args) {
+  is.sym <- sapply(args, inherits, what = "MXSymbol")
+  syms <- args[is.sym]
+  attrs <- args[!is.sym]
+  attrs <- lapply(attrs, mx.internal.as.param)
+  list(attrs = attrs, syms = syms)
+}
+
+# scalar/vector R value -> op parameter string ("(2,2)" tuples, "TRUE" ->
+# "True" python-style booleans, numerics unquoted)
+mx.internal.as.param <- function(v) {
+  if (is.logical(v)) return(ifelse(v, "True", "False"))
+  if (length(v) > 1) {
+    return(paste0("(", paste(as.character(v), collapse = ","), ")"))
+  }
+  as.character(v)
+}
+
+mx.internal.ndarray.ptr <- function(nd) {
+  if (!inherits(nd, "MXNDArray")) stop("expected an MXNDArray")
+  attr(nd, "ptr")
+}
+
+mx.internal.symbol.ptr <- function(sym) {
+  if (!inherits(sym, "MXSymbol")) stop("expected an MXSymbol")
+  attr(sym, "ptr")
+}
+
+mx.internal.new.ndarray <- function(ptr) {
+  structure(list(), ptr = ptr, class = "MXNDArray")
+}
+
+mx.internal.new.symbol <- function(ptr) {
+  structure(list(), ptr = ptr, class = "MXSymbol")
+}

@@ -1,0 +1,22 @@
+# Training callbacks (reference R-package/R/callback.R).
+
+#' Log the train metric every `period` batches.
+#' @export
+mx.callback.log.train.metric <- function(period = 50) {
+  function(epoch, nbatch, metric.value) {
+    if (nbatch %% period == 0) {
+      message(sprintf("Batch [%d] Train-metric=%f", nbatch, metric.value))
+    }
+    TRUE
+  }
+}
+
+#' Save a checkpoint (<prefix>-symbol.json + <prefix>-NNNN.params) at the
+#' end of every epoch.
+#' @export
+mx.callback.save.checkpoint <- function(prefix) {
+  function(epoch, metric.value, model) {
+    mx.model.save(model, prefix, epoch)
+    TRUE
+  }
+}

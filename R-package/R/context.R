@@ -1,0 +1,50 @@
+# Device contexts (reference R-package/R/context.R: mx.cpu/mx.gpu and the
+# default-context stack). Device type codes follow include/mxnet_tpu/c_api.h:
+# 1 = cpu, 2 = gpu (alias of the accelerator), 3 = cpu_pinned, 4 = tpu.
+
+.MXContextEnv <- new.env(parent = emptyenv())
+
+mx.internal.ctx <- function(dev.type, dev.typeid, dev.id) {
+  structure(list(device = dev.type, device_typeid = dev.typeid,
+                 device_id = dev.id),
+            class = "MXContext")
+}
+
+#' Create a CPU context.
+#' @param dev.id device id (default 0)
+#' @export
+mx.cpu <- function(dev.id = 0) mx.internal.ctx("cpu", 1L, as.integer(dev.id))
+
+#' Create an accelerator context (alias of \code{mx.tpu} on this build).
+#' @param dev.id device id (default 0)
+#' @export
+mx.gpu <- function(dev.id = 0) mx.internal.ctx("gpu", 2L, as.integer(dev.id))
+
+#' Create a TPU context.
+#' @param dev.id device id (default 0)
+#' @export
+mx.tpu <- function(dev.id = 0) mx.internal.ctx("tpu", 4L, as.integer(dev.id))
+
+#' Test whether an object is an MXContext.
+#' @export
+is.mx.context <- function(x) inherits(x, "MXContext")
+
+#' Default context used when none is supplied.
+#' @param new optional context to install as the default
+#' @export
+mx.ctx.default <- function(new = NULL) {
+  if (!is.null(new)) {
+    if (!is.mx.context(new)) stop("not an MXContext")
+    assign("default", new, envir = .MXContextEnv)
+  }
+  if (!exists("default", envir = .MXContextEnv)) {
+    assign("default", mx.cpu(), envir = .MXContextEnv)
+  }
+  get("default", envir = .MXContextEnv)
+}
+
+#' @export
+print.MXContext <- function(x, ...) {
+  cat(sprintf("mx.%s(%d)\n", x$device, x$device_id))
+  invisible(x)
+}

@@ -1,0 +1,130 @@
+# Executor: bind a symbol + argument arrays into a runnable program.
+#
+# Reference counterpart: R-package/R/executor.R + src/executor.cc
+# (mx.simple.bind / mx.exec.forward / mx.exec.backward /
+# mx.exec.update.arg.arrays). grad_req codes: 0 = null, 1 = write, 3 = add.
+
+.mx.grad.req.code <- function(req) {
+  switch(req, "null" = 0L, "write" = 1L, "add" = 3L,
+         stop("grad.req must be one of null/write/add"))
+}
+
+#' Bind a symbol with user-allocated arrays.
+#'
+#' @param symbol the network
+#' @param ctx MXContext to run on
+#' @param arg.arrays named list of MXNDArray, one per argument
+#' @param aux.arrays named list of MXNDArray auxiliary states
+#' @param grad.reqs per-argument gradient request ("null"/"write"/"add"),
+#'   recycled if length 1
+#' @export
+mx.executor.bind <- function(symbol, ctx, arg.arrays, aux.arrays = list(),
+                             grad.reqs = "write") {
+  argnames <- arguments(symbol)
+  ordered <- arg.arrays[argnames]
+  if (any(sapply(ordered, is.null))) {
+    stop("arg.arrays must contain every argument: ",
+         paste(argnames[sapply(ordered, is.null)], collapse = ", "))
+  }
+  if (length(grad.reqs) == 1) {
+    grad.reqs <- rep(grad.reqs, length(argnames))
+  }
+  reqs <- vapply(grad.reqs, .mx.grad.req.code, integer(1),
+                 USE.NAMES = FALSE)
+  # allocate gradient buffers for every "write"/"add" argument
+  grads <- vector("list", length(argnames))
+  for (i in seq_along(argnames)) {
+    if (reqs[i] != 0L) {
+      grads[[i]] <- mx.nd.zeros(dim(ordered[[i]]), ctx)
+    }
+  }
+  auxnames <- mx.symbol.auxiliary.states(symbol)
+  aux.ordered <- if (length(auxnames)) aux.arrays[auxnames] else list()
+  ptr <- .Call(MXR_exec_bind, mx.internal.symbol.ptr(symbol),
+               ctx$device_typeid, ctx$device_id,
+               lapply(ordered, mx.internal.ndarray.ptr),
+               lapply(grads, function(g) {
+                 if (is.null(g)) NULL else mx.internal.ndarray.ptr(g)
+               }),
+               as.integer(reqs),
+               lapply(aux.ordered, mx.internal.ndarray.ptr))
+  names(grads) <- argnames
+  structure(list(arg.arrays = ordered, grad.arrays = grads,
+                 aux.arrays = aux.ordered, symbol = symbol, ctx = ctx),
+            ptr = ptr, class = "MXExecutor")
+}
+
+#' Bind a symbol, inferring and allocating every array from input shapes.
+#'
+#' @param symbol the network
+#' @param ctx MXContext
+#' @param grad.req gradient request for all non-input arguments
+#' @param ... input shapes in R dim order, e.g. data = c(784, 64)
+#' @export
+mx.simple.bind <- function(symbol, ctx = NULL, grad.req = "write", ...) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  shapes <- mx.symbol.infer.shape(symbol, ...)
+  if (is.null(shapes)) stop("cannot infer shapes from the provided inputs")
+  init <- function(shape) mx.nd.zeros(shape, ctx)
+  arg.arrays <- lapply(shapes$arg.shapes, init)
+  aux.arrays <- lapply(shapes$aux.shapes, init)
+  # inputs (data/label) never need gradients
+  inputs <- names(list(...))
+  argnames <- arguments(symbol)
+  reqs <- ifelse(argnames %in% inputs, "null", grad.req)
+  mx.executor.bind(symbol, ctx, arg.arrays, aux.arrays, reqs)
+}
+
+#' Run the forward pass.
+#' @param exec MXExecutor
+#' @param is.train whether to run in training mode (dropout/BN behavior)
+#' @export
+mx.exec.forward <- function(exec, is.train = TRUE) {
+  .Call(MXR_exec_forward, attr(exec, "ptr"), as.integer(is.train))
+  invisible(exec)
+}
+
+#' Run the backward pass.
+#' @param exec MXExecutor
+#' @param head.grads optional list of output-gradient MXNDArrays (loss
+#'   symbols supply their own)
+#' @export
+mx.exec.backward <- function(exec, head.grads = list()) {
+  .Call(MXR_exec_backward, attr(exec, "ptr"),
+        lapply(head.grads, mx.internal.ndarray.ptr))
+  invisible(exec)
+}
+
+#' Outputs of the last forward pass (list of MXNDArray).
+#' @export
+mx.exec.outputs <- function(exec) {
+  lapply(.Call(MXR_exec_outputs, attr(exec, "ptr")),
+         mx.internal.new.ndarray)
+}
+
+#' Copy new values into a subset of the bound argument arrays.
+#'
+#' The executor is bound to fixed buffers; this writes in place through the
+#' engine (reference mx.exec.update.arg.arrays with match.name=TRUE).
+#' @export
+mx.exec.update.arg.arrays <- function(exec, arg.arrays,
+                                      match.name = TRUE) {
+  for (nm in names(arg.arrays)) {
+    dst <- exec$arg.arrays[[nm]]
+    if (is.null(dst)) {
+      if (match.name) next
+      stop("unknown argument: ", nm)
+    }
+    src <- arg.arrays[[nm]]
+    if (inherits(src, "MXNDArray")) src <- as.array(src)
+    tmp <- mx.nd.array(src, exec$ctx)
+    mx.nd.internal.invoke("_copy", list(tmp), list(), out = list(dst))
+  }
+  invisible(exec)
+}
+
+#' @export
+print.MXExecutor <- function(x, ...) {
+  cat(.Call(MXR_exec_print, attr(x, "ptr")))
+  invisible(x)
+}

@@ -1,0 +1,66 @@
+# Weight initializers (reference R-package/R/initializer.R). An initializer
+# is function(name, shape) -> R array; bias/beta/gamma/running stats follow
+# the same conventions as the Python initializer.py hierarchy.
+
+.mx.init.special <- function(name, shape) {
+  if (mx.util.str.endswith(name, "bias") ||
+      mx.util.str.endswith(name, "beta")) {
+    return(array(0, dim = shape))
+  }
+  if (mx.util.str.endswith(name, "gamma") ||
+      mx.util.str.endswith(name, "moving_var")) {
+    return(array(1, dim = shape))
+  }
+  if (mx.util.str.endswith(name, "moving_mean")) {
+    return(array(0, dim = shape))
+  }
+  NULL
+}
+
+#' Uniform(-scale, scale) initializer.
+#' @export
+mx.init.uniform <- function(scale = 0.07) {
+  function(name, shape) {
+    sp <- .mx.init.special(name, shape)
+    if (!is.null(sp)) return(sp)
+    array(stats::runif(prod(shape), -scale, scale), dim = shape)
+  }
+}
+
+#' Normal(0, sd) initializer.
+#' @export
+mx.init.normal <- function(sd = 0.01) {
+  function(name, shape) {
+    sp <- .mx.init.special(name, shape)
+    if (!is.null(sp)) return(sp)
+    array(stats::rnorm(prod(shape), 0, sd), dim = shape)
+  }
+}
+
+#' Xavier initializer (reference initializer.py Xavier; factor over
+#' fan-in/fan-out computed on the NDArray-order shape).
+#' @export
+mx.init.Xavier <- function(rnd_type = "uniform", factor_type = "avg",
+                           magnitude = 3) {
+  function(name, shape) {
+    sp <- .mx.init.special(name, shape)
+    if (!is.null(sp)) return(sp)
+    # R dim order is reversed: fan.in spans all but the LAST R dim
+    # (= all but the first NDArray dim), fan.out the last R dim
+    n <- length(shape)
+    fan.out <- shape[n]
+    fan.in <- prod(shape[-n])
+    factor <- switch(factor_type,
+                     "avg" = (fan.in + fan.out) / 2,
+                     "in" = fan.in,
+                     "out" = fan.out,
+                     stop("factor_type must be avg/in/out"))
+    scale <- sqrt(magnitude / factor)
+    vals <- if (rnd_type == "uniform") {
+      stats::runif(prod(shape), -scale, scale)
+    } else {
+      stats::rnorm(prod(shape), 0, scale)
+    }
+    array(vals, dim = shape)
+  }
+}

@@ -1,0 +1,144 @@
+# Data iterators.
+#
+# Reference counterpart: R-package/R/io.R (mx.io.arrayiter over the C API
+# NDArrayIter; MNISTIter/CSVIter/ImageRecordIter through the registered C
+# iterators). Same split here: mx.io.arrayiter is pure R over in-memory
+# arrays; the registered native iterators (mx.io.MNISTIter etc.) come from
+# the framework's iterator registry via the C ABI (MXListDataIters).
+
+#' List the natively registered data iterators.
+#' @export
+mx.io.list <- function() .Call(MXR_list_data_iters)
+
+#' Create a registered native iterator by name with string parameters,
+#' e.g. mx.io.internal.create("MNISTIter", image = ..., batch_size = 64).
+#' @export
+mx.io.internal.create <- function(name, ...) {
+  params <- list(...)
+  keys <- as.character(names(params))
+  vals <- vapply(params, mx.internal.as.param, character(1),
+                 USE.NAMES = FALSE)
+  ptr <- .Call(MXR_iter_create, name, keys, vals)
+  structure(list(kind = name), ptr = ptr, native = TRUE,
+            class = "MXDataIter")
+}
+
+#' MNIST iterator (native).
+#' @export
+mx.io.MNISTIter <- function(...) mx.io.internal.create("MNISTIter", ...)
+
+#' CSV iterator (native).
+#' @export
+mx.io.CSVIter <- function(...) mx.io.internal.create("CSVIter", ...)
+
+#' ImageRecordIter (native RecordIO + decode pipeline).
+#' @export
+mx.io.ImageRecordIter <- function(...) {
+  mx.io.internal.create("ImageRecordIter", ...)
+}
+
+#' In-memory array iterator (pure R).
+#'
+#' @param data matrix/array with observations on the LAST R dim
+#' @param label vector of labels
+#' @param batch.size batch size; the final partial batch wraps around
+#'   (pad semantics like the reference NDArrayIter)
+#' @export
+mx.io.arrayiter <- function(data, label, batch.size = 128,
+                            shuffle = FALSE) {
+  env <- new.env(parent = emptyenv())
+  env$data <- data
+  env$label <- label
+  env$batch.size <- batch.size
+  env$shuffle <- shuffle
+  env$cursor <- 0L
+  d <- dim(data)
+  env$n <- if (is.null(d)) length(data) else d[length(d)]
+  env$order <- seq_len(env$n)
+  structure(list(kind = "arrayiter"), env = env, native = FALSE,
+            class = "MXDataIter")
+}
+
+#' Rewind an iterator to the first batch.
+#' @export
+mx.io.reset <- function(iter) {
+  if (isTRUE(attr(iter, "native"))) {
+    .Call(MXR_iter_reset, attr(iter, "ptr"))
+  } else {
+    env <- attr(iter, "env")
+    env$cursor <- 0L
+    if (env$shuffle) env$order <- sample(env$n)
+  }
+  invisible(iter)
+}
+
+#' Advance to the next batch; FALSE at end of epoch.
+#' @export
+mx.io.next <- function(iter) {
+  if (isTRUE(attr(iter, "native"))) {
+    return(.Call(MXR_iter_next, attr(iter, "ptr")))
+  }
+  env <- attr(iter, "env")
+  if (env$cursor >= env$n) return(FALSE)
+  env$cursor <- env$cursor + env$batch.size
+  TRUE
+}
+
+#' The current batch: list(data=MXNDArray, label=MXNDArray).
+#' @export
+mx.io.value <- function(iter) {
+  if (isTRUE(attr(iter, "native"))) {
+    return(list(
+      data = mx.internal.new.ndarray(.Call(MXR_iter_data,
+                                           attr(iter, "ptr"))),
+      label = mx.internal.new.ndarray(.Call(MXR_iter_label,
+                                            attr(iter, "ptr")))))
+  }
+  env <- attr(iter, "env")
+  lo <- env$cursor - env$batch.size + 1L
+  idx <- env$order[(((lo:env$cursor) - 1L) %% env$n) + 1L]  # wrap pad
+  d <- dim(env$data)
+  slice <- if (is.null(d)) env$data[idx] else {
+    do.call(`[`, c(list(env$data), rep(list(quote(expr = )),
+                                       length(d) - 1), list(idx),
+                   list(drop = FALSE)))
+  }
+  list(data = mx.nd.array(slice), label = mx.nd.array(env$label[idx]))
+}
+
+#' Number of pad (wrapped) observations in the current batch.
+#' @export
+mx.io.pad <- function(iter) {
+  if (isTRUE(attr(iter, "native"))) {
+    return(.Call(MXR_iter_pad, attr(iter, "ptr")))
+  }
+  env <- attr(iter, "env")
+  max(0L, env$cursor - env$n)
+}
+
+#' Extract all data or labels from an iterator into one R array.
+#' @export
+mx.io.extract <- function(iter, field = "label") {
+  mx.io.reset(iter)
+  out <- NULL
+  while (mx.io.next(iter)) {
+    v <- mx.io.value(iter)[[field]]
+    arr <- as.array(v)
+    pad <- mx.io.pad(iter)
+    d <- dim(arr)
+    keep <- d[length(d)] - pad
+    if (keep < d[length(d)]) {
+      arr <- do.call(`[`, c(list(arr), rep(list(quote(expr = )),
+                                           length(d) - 1),
+                            list(seq_len(keep)), list(drop = FALSE)))
+    }
+    out <- if (is.null(out)) arr else {
+      da <- dim(out)
+      db <- dim(arr)
+      array(c(out, arr), c(da[-length(da)],
+                           da[length(da)] + db[length(db)]))
+    }
+  }
+  mx.io.reset(iter)
+  out
+}

@@ -1,0 +1,57 @@
+# KVStore client (reference R-package/R/kvstore.R). Types local/device run
+# in-process; dist_* ride the collective backend (parallel/dist.py) when a
+# distributed session is initialized.
+
+#' Create a KVStore ("local", "device", "dist_sync", "dist_async").
+#' @export
+mx.kv.create <- function(type = "local") {
+  structure(list(type = type), ptr = .Call(MXR_kv_create, type),
+            class = "MXKVStore")
+}
+
+#' Initialize keys with values (list of MXNDArray).
+#' @export
+mx.kv.init <- function(kv, keys, values) {
+  invisible(.Call(MXR_kv_init, attr(kv, "ptr"), as.integer(keys),
+                  lapply(values, mx.internal.ndarray.ptr)))
+}
+
+#' Push values; merged (summed) across pushers per key.
+#' @export
+mx.kv.push <- function(kv, keys, values, priority = 0) {
+  invisible(.Call(MXR_kv_push, attr(kv, "ptr"), as.integer(keys),
+                  lapply(values, mx.internal.ndarray.ptr),
+                  as.integer(priority)))
+}
+
+#' Pull current values into the provided MXNDArrays.
+#' @export
+mx.kv.pull <- function(kv, keys, outs, priority = 0) {
+  .Call(MXR_kv_pull, attr(kv, "ptr"), as.integer(keys),
+        lapply(outs, mx.internal.ndarray.ptr), as.integer(priority))
+  invisible(outs)
+}
+
+#' Install an R updater: function(key, recv, local) applied at merge time.
+#' @export
+mx.kv.set.updater <- function(kv, updater) {
+  invisible(.Call(MXR_kv_set_updater, attr(kv, "ptr"), updater,
+                  environment(updater)))
+}
+
+#' @export
+mx.kv.rank <- function(kv) .Call(MXR_kv_rank, attr(kv, "ptr"))
+
+#' @export
+mx.kv.num.workers <- function(kv) .Call(MXR_kv_num_workers,
+                                        attr(kv, "ptr"))
+
+#' @export
+mx.kv.barrier <- function(kv) invisible(.Call(MXR_kv_barrier,
+                                              attr(kv, "ptr")))
+
+#' @export
+print.MXKVStore <- function(x, ...) {
+  cat(sprintf("<MXKVStore %s>\n", .Call(MXR_kv_type, attr(x, "ptr"))))
+  invisible(x)
+}

@@ -1,0 +1,19 @@
+# Learning-rate schedulers (reference R-package/R/lr_scheduler.R).
+# A scheduler is function(iteration) -> multiplier on the base rate.
+
+#' Multiply the rate by `factor` every `step` iterations.
+#' @export
+mx.lr_scheduler.FactorScheduler <- function(step, factor = 0.9,
+                                            stop_factor_lr = 1e-8) {
+  function(iteration) {
+    max(factor^(iteration %/% step), stop_factor_lr)
+  }
+}
+
+#' Multiply the rate by `factor` at each listed iteration.
+#' @export
+mx.lr_scheduler.MultiFactorScheduler <- function(step, factor = 0.9) {
+  function(iteration) {
+    factor^sum(iteration >= step)
+  }
+}

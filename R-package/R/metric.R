@@ -1,0 +1,49 @@
+# Evaluation metrics (reference R-package/R/metric.R: mx.metric.custom +
+# accuracy/mae/mse/rmse). A metric is list(name, init, update, get) over an
+# opaque state, so metrics compose with the training loop functionally.
+
+#' Build a custom metric from a function(label, pred) -> numeric.
+#' @export
+mx.metric.custom <- function(name, feval) {
+  list(
+    name = name,
+    init = function() list(sum = 0, n = 0),
+    update = function(label, pred, state) {
+      state$sum <- state$sum + feval(label, pred)
+      state$n <- state$n + 1
+      state
+    },
+    get = function(state) if (state$n == 0) NA_real_ else state$sum / state$n
+  )
+}
+
+#' Classification accuracy. Predictions arrive as a class-probability
+#' array in R layout: dim c(num.class, batch).
+#' @export
+mx.metric.accuracy <- mx.metric.custom("accuracy", function(label, pred) {
+  pd <- dim(pred)
+  pred.label <- if (is.null(pd) || length(pd) == 1) {
+    as.numeric(pred > 0.5)
+  } else {
+    apply(pred, 2, which.max) - 1
+  }
+  mean(as.vector(label) == pred.label)
+})
+
+#' Mean absolute error.
+#' @export
+mx.metric.mae <- mx.metric.custom("mae", function(label, pred) {
+  mean(abs(as.vector(label) - as.vector(pred)))
+})
+
+#' Mean squared error.
+#' @export
+mx.metric.mse <- mx.metric.custom("mse", function(label, pred) {
+  mean((as.vector(label) - as.vector(pred))^2)
+})
+
+#' Root mean squared error.
+#' @export
+mx.metric.rmse <- mx.metric.custom("rmse", function(label, pred) {
+  sqrt(mean((as.vector(label) - as.vector(pred))^2))
+})

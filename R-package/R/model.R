@@ -1,0 +1,251 @@
+# FeedForward model API: create/train/predict/save/load.
+#
+# Reference counterpart: R-package/R/model.R (mx.model.FeedForward.create,
+# predict.MXFeedForwardModel, mx.model.save/load). Single-context training
+# loop over an executor; multi-device data parallelism belongs to the
+# Python Module path (module/mesh_executor_group.py) — the R frontend
+# matches the reference R package, which trains one executor per call.
+
+mx.model.check.arguments <- function(symbol) {
+  data <- NULL
+  label <- NULL
+  for (nm in arguments(symbol)) {
+    if (mx.util.str.endswith(nm, "data")) {
+      if (!is.null(data)) stop("multiple arguments end with 'data'")
+      data <- nm
+    }
+    if (mx.util.str.endswith(nm, "label")) {
+      if (!is.null(label)) stop("multiple arguments end with 'label'")
+      label <- nm
+    }
+  }
+  if (is.null(data)) {
+    stop("the network needs exactly one argument ending in 'data'")
+  }
+  list(data = data, label = label)
+}
+
+mx.model.init.params <- function(symbol, input.shapes, initializer) {
+  shapes <- do.call(mx.symbol.infer.shape,
+                    c(list(symbol = symbol), input.shapes))
+  if (is.null(shapes)) stop("cannot infer shape from input shapes")
+  argnames <- names(shapes$arg.shapes)
+  inputs <- names(input.shapes)
+  arg.params <- list()
+  for (nm in argnames) {
+    if (nm %in% inputs) next
+    arg.params[[nm]] <- initializer(nm, shapes$arg.shapes[[nm]])
+  }
+  aux.params <- lapply(names(shapes$aux.shapes), function(nm) {
+    initializer(nm, shapes$aux.shapes[[nm]])
+  })
+  names(aux.params) <- names(shapes$aux.shapes)
+  list(arg.params = arg.params, aux.params = aux.params)
+}
+
+#' Train a model from a symbol and a data iterator (or X/y matrices).
+#'
+#' @param symbol network with a loss output (e.g. mx.symbol.SoftmaxOutput)
+#' @param X mx.io data iterator, or a design matrix/array
+#' @param y labels (when X is a matrix)
+#' @param ctx MXContext to train on
+#' @param num.round epochs
+#' @param optimizer name ("sgd"/"adam") or an object from mx.opt.create
+#' @param initializer from mx.init.* (default mx.init.uniform(0.01))
+#' @param eval.metric from mx.metric.* (default mx.metric.accuracy)
+#' @param epoch.end.callback called as f(epoch, metric.value, model)
+#' @param batch.end.callback called as f(epoch, nbatch, metric.value)
+#' @param array.batch.size batch size when X is a matrix
+#' @param verbose print a line per epoch
+#' @export
+mx.model.FeedForward.create <- function(
+    symbol, X, y = NULL, ctx = NULL, num.round = 10, optimizer = "sgd",
+    initializer = mx.init.uniform(0.01), eval.metric = mx.metric.accuracy,
+    epoch.end.callback = NULL, batch.end.callback = NULL,
+    array.batch.size = 128, learning.rate = 0.01, momentum = 0.9,
+    wd = 0, verbose = TRUE, ...) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  iter <- if (inherits(X, "MXDataIter")) X else {
+    mx.io.arrayiter(X, y, batch.size = array.batch.size)
+  }
+  io.names <- mx.model.check.arguments(symbol)
+  data.name <- io.names$data
+  label.name <- io.names$label
+  if (is.null(label.name)) {
+    stop("training needs a loss output with a '*_label' argument")
+  }
+
+  # peek one batch for shapes, then rewind
+  mx.io.reset(iter)
+  if (!mx.io.next(iter)) stop("empty data iterator")
+  first <- mx.io.value(iter)
+  input.shapes <- list(dim(first$data), dim(first$label))
+  names(input.shapes) <- c(data.name, label.name)
+  mx.io.reset(iter)
+
+  params <- mx.model.init.params(symbol, input.shapes, initializer)
+  arrays <- c(lapply(params$arg.params, function(a) {
+    mx.nd.array(as.array(a), ctx)
+  }), stats::setNames(list(mx.nd.zeros(input.shapes[[data.name]], ctx),
+                           mx.nd.zeros(input.shapes[[label.name]], ctx)),
+                      c(data.name, label.name)))
+  aux <- lapply(params$aux.params, function(a) mx.nd.array(as.array(a), ctx))
+  reqs <- ifelse(arguments(symbol) %in% c(data.name, label.name),
+                 "null", "write")
+  exec <- mx.executor.bind(symbol, ctx, arrays, aux, reqs)
+
+  if (is.character(optimizer)) {
+    optimizer <- mx.opt.create(optimizer, learning.rate = learning.rate,
+                               momentum = momentum, wd = wd, ...)
+  }
+  updaters <- list()
+  trainable <- setdiff(arguments(symbol), c(data.name, label.name))
+  for (nm in trainable) updaters[[nm]] <- optimizer$create.state()
+
+  for (epoch in seq_len(num.round)) {
+    mx.io.reset(iter)
+    eval.metric.state <- eval.metric$init()
+    nbatch <- 0
+    while (mx.io.next(iter)) {
+      batch <- mx.io.value(iter)
+      mx.exec.update.arg.arrays(
+        exec, stats::setNames(list(batch$data, batch$label),
+                              c(data.name, label.name)))
+      mx.exec.forward(exec, is.train = TRUE)
+      mx.exec.backward(exec)
+      for (nm in trainable) {
+        updaters[[nm]] <- optimizer$update(
+          exec$arg.arrays[[nm]], exec$grad.arrays[[nm]], updaters[[nm]])
+      }
+      out <- mx.exec.outputs(exec)[[1]]
+      eval.metric.state <- eval.metric$update(
+        as.array(batch$label), as.array(out), eval.metric.state)
+      nbatch <- nbatch + 1
+      if (!is.null(batch.end.callback)) {
+        batch.end.callback(epoch, nbatch, eval.metric$get(eval.metric.state))
+      }
+    }
+    value <- eval.metric$get(eval.metric.state)
+    if (verbose) {
+      message(sprintf("Epoch [%d] Train-%s=%f", epoch, eval.metric$name,
+                      value))
+    }
+    model <- mx.model.extract(symbol, exec)
+    if (!is.null(epoch.end.callback)) {
+      epoch.end.callback(epoch, value, model)
+    }
+  }
+  mx.model.extract(symbol, exec)
+}
+
+mx.model.extract <- function(symbol, exec) {
+  io.names <- unlist(mx.model.check.arguments(symbol))
+  structure(list(symbol = symbol,
+                 arg.params = exec$arg.arrays[
+                   setdiff(names(exec$arg.arrays), io.names)],
+                 aux.params = exec$aux.arrays),
+            class = "MXFeedForwardModel")
+}
+
+#' Predict with a trained model.
+#' @param model MXFeedForwardModel
+#' @param X matrix/array (R dim order, batch on the last R dim) or iterator
+#' @export
+predict.MXFeedForwardModel <- function(object, X, ctx = NULL,
+                                       array.batch.size = 128, ...) {
+  model <- object
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  io.names <- mx.model.check.arguments(model$symbol)
+  data.name <- io.names$data
+  label.name <- io.names$label
+
+  data.dim <- dim(X)
+  if (is.null(data.dim)) data.dim <- length(X)
+  n <- data.dim[length(data.dim)]
+  bs <- min(array.batch.size, n)
+
+  # bind ONCE at a fixed batch size; per-batch work is one in-place
+  # engine write + forward. The final partial batch is zero-padded and
+  # its outputs truncated (reference data-batch pad semantics).
+  batch.dim <- data.dim
+  batch.dim[length(batch.dim)] <- bs
+  arrays <- c(lapply(model$arg.params, function(a) {
+    mx.nd.array(as.array(a), ctx)
+  }), stats::setNames(list(mx.nd.zeros(batch.dim, ctx)), data.name))
+  argnames <- arguments(model$symbol)
+  if (!is.null(label.name) && label.name %in% argnames) {
+    arrays[[label.name]] <- mx.nd.zeros(bs, ctx)
+  }
+  aux <- lapply(model$aux.params, function(a) mx.nd.array(as.array(a),
+                                                          ctx))
+  exec <- mx.executor.bind(model$symbol, ctx, arrays, aux, "null")
+
+  outs <- NULL
+  done <- 0
+  while (done < n) {
+    take <- min(bs, n - done)
+    idx <- seq(done + 1, done + take)
+    slice <- if (length(data.dim) == 1) X[idx] else {
+      do.call(`[`, c(list(X), rep(list(quote(expr = )),
+                                  length(data.dim) - 1), list(idx),
+                     list(drop = FALSE)))
+    }
+    if (take < bs) {  # zero-pad the tail batch up to the bound size
+      padded <- array(0, batch.dim)
+      pidx <- seq_len(take)
+      padded <- do.call(`[<-`, c(list(padded),
+                                 rep(list(quote(expr = )),
+                                     length(batch.dim) - 1),
+                                 list(pidx), list(slice)))
+      slice <- padded
+    }
+    mx.exec.update.arg.arrays(
+      exec, stats::setNames(list(slice), data.name))
+    mx.exec.forward(exec, is.train = FALSE)
+    out <- as.array(mx.exec.outputs(exec)[[1]])
+    if (take < bs) {  # drop pad rows from the output
+      od <- dim(out)
+      out <- do.call(`[`, c(list(out), rep(list(quote(expr = )),
+                                           length(od) - 1),
+                            list(seq_len(take)), list(drop = FALSE)))
+    }
+    # column-major: concatenation along the LAST R dim is plain c(a, b)
+    outs <- if (is.null(outs)) out else {
+      da <- dim(outs)
+      db <- dim(out)
+      array(c(outs, out), c(da[-length(da)],
+                            da[length(da)] + db[length(db)]))
+    }
+    done <- done + take
+  }
+  outs
+}
+
+#' Save a model as <prefix>-symbol.json + <prefix>-<epoch>.params — the
+#' same two-file layout every frontend (Python/C++/Perl/MATLAB) reads.
+#' @export
+mx.model.save <- function(model, prefix, iteration = 0) {
+  mx.symbol.save(model$symbol, sprintf("%s-symbol.json", prefix))
+  args <- model$arg.params
+  names(args) <- paste0("arg:", names(args))
+  aux <- model$aux.params
+  if (length(aux)) names(aux) <- paste0("aux:", names(aux))
+  mx.nd.save(c(args, aux), sprintf("%s-%04d.params", prefix, iteration))
+  invisible(model)
+}
+
+#' Load a model saved by mx.model.save (or any other frontend).
+#' @export
+mx.model.load <- function(prefix, iteration = 0) {
+  symbol <- mx.symbol.load(sprintf("%s-symbol.json", prefix))
+  blob <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
+  tags <- sub(":.*$", "", names(blob))
+  keys <- sub("^[^:]*:", "", names(blob))
+  arg.params <- blob[tags == "arg"]
+  names(arg.params) <- keys[tags == "arg"]
+  aux.params <- blob[tags == "aux"]
+  names(aux.params) <- keys[tags == "aux"]
+  structure(list(symbol = symbol, arg.params = arg.params,
+                 aux.params = aux.params),
+            class = "MXFeedForwardModel")
+}

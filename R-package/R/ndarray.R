@@ -1,0 +1,202 @@
+# NDArray: device tensors with R array semantics.
+#
+# Reference counterpart: R-package/R/ndarray.R + src/ndarray.cc. Layout
+# contract (same as the reference): R arrays are column-major, NDArrays
+# row-major; an R dim of c(d1..dk) becomes NDArray shape (dk..d1) and the
+# raw buffer is copied verbatim, so as.array(mx.nd.array(x)) == x always.
+
+#' Create an NDArray from an R vector/matrix/array.
+#' @param src.array numeric vector, matrix or array
+#' @param ctx MXContext (default mx.ctx.default())
+#' @export
+mx.nd.array <- function(src.array, ctx = NULL) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  if (!is.mx.context(ctx)) stop("ctx must be mx.cpu()/mx.gpu()/mx.tpu()")
+  d <- dim(src.array)
+  if (is.null(d)) d <- length(src.array)
+  ptr <- .Call(MXR_nd_from_array, as.double(src.array), as.integer(d),
+               ctx$device_typeid, ctx$device_id)
+  mx.internal.new.ndarray(ptr)
+}
+
+#' Create an NDArray filled with zeros.
+#' @export
+mx.nd.zeros <- function(shape, ctx = NULL) {
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  # MXNDArrayCreate zero-fills (capi contract, capi_bridge.ndarray_create)
+  ptr <- .Call(MXR_nd_create, as.integer(shape), ctx$device_typeid,
+               ctx$device_id)
+  mx.internal.new.ndarray(ptr)
+}
+
+#' Create an NDArray filled with ones.
+#' @export
+mx.nd.ones <- function(shape, ctx = NULL) {
+  nd <- mx.nd.zeros(shape, ctx)
+  mx.nd.internal.invoke("_plus_scalar", list(nd), list(scalar = 1),
+                        out = list(nd))[[1]]
+}
+
+#' Copy an NDArray to another context.
+#' @export
+mx.nd.copyto <- function(src, ctx) {
+  arr <- as.array(src)
+  mx.nd.array(arr, ctx)
+}
+
+#' Invoke a registered operator imperatively on NDArrays.
+#'
+#' The workhorse behind every generated mx.nd.* function: looks the op up
+#' in the registry and runs it through the dependency engine
+#' (MXImperativeInvoke at the C ABI).
+#' @param op op name as registered (see mx.list.ops())
+#' @param nd.args list of MXNDArray inputs
+#' @param params named list of string-convertible op parameters
+#' @param out optional list of output MXNDArrays for in-place writes
+#' @export
+mx.nd.internal.invoke <- function(op, nd.args, params = list(), out = NULL) {
+  ptrs <- lapply(nd.args, mx.internal.ndarray.ptr)
+  keys <- as.character(names(params))
+  vals <- vapply(params, mx.internal.as.param, character(1),
+                 USE.NAMES = FALSE)
+  outp <- if (is.null(out)) NULL else lapply(out, mx.internal.ndarray.ptr)
+  res <- .Call(MXR_nd_invoke, op, ptrs, keys, vals, outp)
+  if (!is.null(out)) return(out)
+  lapply(res, mx.internal.new.ndarray)
+}
+
+#' Save a (list of) NDArray to file (binary, loadable from every frontend).
+#' @export
+mx.nd.save <- function(ndarray, filename) {
+  filename <- path.expand(filename)
+  if (!is.list(ndarray)) ndarray <- list(ndarray)
+  nms <- names(ndarray)
+  if (is.null(nms)) nms <- character(0)
+  ptrs <- lapply(ndarray, mx.internal.ndarray.ptr)
+  invisible(.Call(MXR_nd_save, filename, ptrs, nms))
+}
+
+#' Load NDArrays saved with mx.nd.save (any frontend).
+#' @export
+mx.nd.load <- function(filename) {
+  filename <- path.expand(filename)
+  res <- .Call(MXR_nd_load, filename)
+  out <- lapply(res, mx.internal.new.ndarray)
+  names(out) <- names(res)
+  out
+}
+
+#' Slice an NDArray along its first R dimension (last NDArray axis).
+#' @export
+mx.nd.slice <- function(nd, begin, end) {
+  ptr <- .Call(MXR_nd_slice, mx.internal.ndarray.ptr(nd),
+               as.integer(begin), as.integer(end))
+  mx.internal.new.ndarray(ptr)
+}
+
+#' Reshape an NDArray (R dim order).
+#' @export
+mx.nd.reshape <- function(nd, shape) {
+  ptr <- .Call(MXR_nd_reshape, mx.internal.ndarray.ptr(nd),
+               as.integer(shape))
+  mx.internal.new.ndarray(ptr)
+}
+
+#' Block until all pending engine work has finished.
+#' @export
+mx.nd.waitall <- function() invisible(.Call(MXR_wait_all))
+
+# ------------------------------------------------------------- S3 methods
+#' @export
+as.array.MXNDArray <- function(x, ...) {
+  .Call(MXR_nd_to_array, mx.internal.ndarray.ptr(x))
+}
+
+#' @export
+as.matrix.MXNDArray <- function(x, ...) {
+  arr <- as.array(x)
+  if (length(dim(arr)) != 2) stop("not a 2-D NDArray")
+  as.matrix(arr)
+}
+
+#' @export
+dim.MXNDArray <- function(x) {
+  .Call(MXR_nd_dim, mx.internal.ndarray.ptr(x))
+}
+
+#' @export
+length.MXNDArray <- function(x) prod(dim(x))
+
+#' @export
+print.MXNDArray <- function(x, ...) {
+  d <- dim(x)
+  ctx <- .Call(MXR_nd_context, mx.internal.ndarray.ptr(x))
+  cat(sprintf("<MXNDArray %s @dev %d:%d>\n",
+              paste(d, collapse = "x"), ctx[1], ctx[2]))
+  invisible(x)
+}
+
+#' Context of an NDArray.
+#' @export
+ctx <- function(nd) {
+  info <- .Call(MXR_nd_context, mx.internal.ndarray.ptr(nd))
+  types <- c("cpu", "gpu", "cpu_pinned", "tpu")
+  mx.internal.ctx(types[info[1]], info[1], info[2])
+}
+
+# arithmetic via the op registry — scalar and elementwise forms
+.mx.nd.binop <- function(e1, e2, nd.op, scalar.op, rscalar.op = NULL) {
+  lhs.nd <- inherits(e1, "MXNDArray")
+  rhs.nd <- inherits(e2, "MXNDArray")
+  if (lhs.nd && rhs.nd) {
+    return(mx.nd.internal.invoke(nd.op, list(e1, e2))[[1]])
+  }
+  if (lhs.nd) {
+    return(mx.nd.internal.invoke(scalar.op, list(e1),
+                                 list(scalar = e2))[[1]])
+  }
+  op <- if (is.null(rscalar.op)) scalar.op else rscalar.op
+  mx.nd.internal.invoke(op, list(e2), list(scalar = e1))[[1]]
+}
+
+#' @export
+Ops.MXNDArray <- function(e1, e2) {
+  switch(.Generic,
+    "+" = .mx.nd.binop(e1, e2, "_plus", "_plus_scalar"),
+    "-" = if (missing(e2)) {
+      mx.nd.internal.invoke("_mul_scalar", list(e1),
+                            list(scalar = -1))[[1]]
+    } else {
+      .mx.nd.binop(e1, e2, "_minus", "_minus_scalar", "_rminus_scalar")
+    },
+    "*" = .mx.nd.binop(e1, e2, "_mul", "_mul_scalar"),
+    "/" = .mx.nd.binop(e1, e2, "_div", "_div_scalar", "_rdiv_scalar"),
+    stop(sprintf("operator %s not supported on MXNDArray", .Generic))
+  )
+}
+
+#' Seed every device PRNG (reference mx.set.seed; R's set.seed does not
+#' reach device-side samplers).
+#' @export
+mx.set.seed <- function(seed) invisible(.Call(MXR_random_seed,
+                                              as.integer(seed)))
+
+#' Sample from uniform(low, high).
+#' @export
+mx.runif <- function(shape, min = 0, max = 1, ctx = NULL) {
+  nd <- mx.nd.zeros(shape, ctx)
+  mx.nd.internal.invoke("_random_uniform", list(),
+                        list(low = min, high = max,
+                             shape = rev(as.integer(shape))),
+                        out = list(nd))[[1]]
+}
+
+#' Sample from normal(mean, sd).
+#' @export
+mx.rnorm <- function(shape, mean = 0, sd = 1, ctx = NULL) {
+  nd <- mx.nd.zeros(shape, ctx)
+  mx.nd.internal.invoke("_random_normal", list(),
+                        list(loc = mean, scale = sd,
+                             shape = rev(as.integer(shape))),
+                        out = list(nd))[[1]]
+}

@@ -1,0 +1,83 @@
+# Optimizers for the R training loop.
+#
+# Reference counterpart: R-package/R/optimizer.R (mx.opt.sgd w/ momentum +
+# weight decay, mx.opt.create, mx.opt.get.updater). Updates run through the
+# framework's fused optimizer ops (ops/optimizer_ops.py: sgd_update,
+# sgd_mom_update, adam_update) so the math executes on device, not in R.
+
+#' Create an SGD optimizer (momentum + weight decay).
+#' @export
+mx.opt.sgd <- function(learning.rate = 0.01, momentum = 0, wd = 0,
+                       rescale.grad = 1, clip.gradient = NULL, ...) {
+  list(
+    name = "sgd",
+    create.state = function() NULL,
+    update = function(weight, grad, state) {
+      params <- list(lr = learning.rate, wd = wd,
+                     rescale_grad = rescale.grad)
+      if (!is.null(clip.gradient)) params$clip_gradient <- clip.gradient
+      if (momentum == 0) {
+        mx.nd.internal.invoke("sgd_update", list(weight, grad), params,
+                              out = list(weight))
+        return(NULL)
+      }
+      if (is.null(state)) state <- mx.nd.zeros(dim(weight), ctx(weight))
+      params$momentum <- momentum
+      mx.nd.internal.invoke("sgd_mom_update", list(weight, grad, state),
+                            params, out = list(weight, state))
+      state
+    })
+}
+
+#' Create an Adam optimizer.
+#' @export
+mx.opt.adam <- function(learning.rate = 0.001, beta1 = 0.9, beta2 = 0.999,
+                        epsilon = 1e-8, wd = 0, rescale.grad = 1, ...) {
+  list(
+    name = "adam",
+    create.state = function() NULL,
+    update = function(weight, grad, state) {
+      if (is.null(state)) {
+        state <- list(mean = mx.nd.zeros(dim(weight), ctx(weight)),
+                      var = mx.nd.zeros(dim(weight), ctx(weight)),
+                      t = 0)
+      }
+      state$t <- state$t + 1
+      # bias correction folds into the step size (same as the Python
+      # Optimizer before it calls the fused op, optimizer.py Adam)
+      lr.t <- learning.rate * sqrt(1 - beta2^state$t) / (1 - beta1^state$t)
+      mx.nd.internal.invoke(
+        "adam_update",
+        list(weight, grad, state$mean, state$var),
+        list(lr = lr.t, beta1 = beta1, beta2 = beta2,
+             epsilon = epsilon, wd = wd, rescale_grad = rescale.grad),
+        out = list(weight, state$mean, state$var))
+      state
+    })
+}
+
+#' Create an optimizer by name. Arguments not taken by the chosen
+#' optimizer (e.g. momentum for adam) are absorbed by its dots and
+#' ignored, reference mx.opt.create behavior.
+#' @export
+mx.opt.create <- function(name, ...) {
+  switch(name,
+    "sgd" = mx.opt.sgd(...),
+    "adam" = mx.opt.adam(...),
+    stop("unknown optimizer: ", name))
+}
+
+#' Stateful updater closure over an optimizer (reference
+#' mx.opt.get.updater): one state slot per indexed weight.
+#' @export
+mx.opt.get.updater <- function(optimizer) {
+  states <- new.env(parent = emptyenv())
+  function(index, weight, grad) {
+    key <- as.character(index)
+    prev <- if (exists(key, envir = states)) get(key, envir = states) else {
+      optimizer$create.state()
+    }
+    assign(key, optimizer$update(weight, grad, prev), envir = states)
+    invisible(weight)
+  }
+}

@@ -1,0 +1,15 @@
+# Profiler control (reference R-package/R/profiler.R). Emits the same
+# Chrome-trace JSON the Python profiler.py writes.
+
+#' Configure the profiler. mode: 0 = only symbolic ops, 1 = all.
+#' @export
+mx.profiler.config <- function(filename = "profile.json", mode = 0) {
+  invisible(.Call(MXR_profiler_config, as.integer(mode),
+                  path.expand(filename)))
+}
+
+#' Start (state = 1) or stop (state = 0) profiling.
+#' @export
+mx.profiler.state <- function(state = 0) {
+  invisible(.Call(MXR_profiler_state, as.integer(state)))
+}

@@ -1,0 +1,217 @@
+# Symbolic graph construction.
+#
+# Reference counterpart: R-package/R/symbol.R + src/symbol.cc, where the
+# mx.symbol.* layer constructors are generated at build time. Here a static
+# set of common layers is exported and EVERY registered op is reachable two
+# ways: mx.symbol.create("OpName", ...) and the mx.sym environment populated
+# at load time (mx.sym$Convolution(...), see zzz.R).
+
+#' Create a placeholder variable symbol.
+#' @export
+mx.symbol.Variable <- function(name) {
+  mx.internal.new.symbol(.Call(MXR_sym_variable, name))
+}
+
+#' Create a symbol for any registered operator.
+#'
+#' Symbol-valued arguments become graph inputs; everything else is passed as
+#' a string op parameter. \code{name} names the node.
+#' @param op registered op name (see mx.list.ops())
+#' @export
+mx.symbol.create <- function(op, ..., name = NULL) {
+  args <- list(...)
+  split <- mx.internal.split.kwargs(args)
+  akeys <- names(split$syms)
+  if (is.null(akeys)) akeys <- rep("", length(split$syms))
+  # nnvm Compose contract: inputs are either all positional (keys = NULL
+  # at the C ABI) or all keyword — never mixed
+  named <- nzchar(akeys)
+  if (any(named) && !all(named)) {
+    stop("compose inputs must be all named or all positional")
+  }
+  if (!all(named)) akeys <- character(0)
+  sptrs <- lapply(split$syms, mx.internal.symbol.ptr)
+  pkeys <- as.character(names(split$attrs))
+  pvals <- vapply(split$attrs, as.character, character(1), USE.NAMES = FALSE)
+  ptr <- .Call(MXR_sym_create, op, pkeys, pvals, name, akeys, sptrs)
+  mx.internal.new.symbol(ptr)
+}
+
+# static wrappers for the common trainable layers (reference exports these
+# as generated code; the full registry lives in mx.sym — zzz.R)
+#' @export
+mx.symbol.FullyConnected <- function(...) {
+  mx.symbol.create("FullyConnected", ...)
+}
+#' @export
+mx.symbol.Convolution <- function(...) mx.symbol.create("Convolution", ...)
+#' @export
+mx.symbol.Activation <- function(...) mx.symbol.create("Activation", ...)
+#' @export
+mx.symbol.BatchNorm <- function(...) mx.symbol.create("BatchNorm", ...)
+#' @export
+mx.symbol.Pooling <- function(...) mx.symbol.create("Pooling", ...)
+#' @export
+mx.symbol.SoftmaxOutput <- function(...) {
+  mx.symbol.create("SoftmaxOutput", ...)
+}
+#' @export
+mx.symbol.LinearRegressionOutput <- function(...) {
+  mx.symbol.create("LinearRegressionOutput", ...)
+}
+#' @export
+mx.symbol.Flatten <- function(...) mx.symbol.create("Flatten", ...)
+#' @export
+mx.symbol.Dropout <- function(...) mx.symbol.create("Dropout", ...)
+#' @export
+mx.symbol.Concat <- function(...) {
+  # Concat takes a variable number of inputs: num_args is mandatory
+  args <- list(...)
+  syms <- args[sapply(args, inherits, what = "MXSymbol")]
+  if (!("num.args" %in% names(args) || "num_args" %in% names(args))) {
+    args$num_args <- length(syms)
+  }
+  do.call(mx.symbol.create, c(list(op = "Concat"), args))
+}
+#' @export
+mx.symbol.LRN <- function(...) mx.symbol.create("LRN", ...)
+#' @export
+mx.symbol.Reshape <- function(...) mx.symbol.create("Reshape", ...)
+#' @export
+mx.symbol.Embedding <- function(...) mx.symbol.create("Embedding", ...)
+#' @export
+mx.symbol.LeakyReLU <- function(...) mx.symbol.create("LeakyReLU", ...)
+
+#' Group several symbols into a multi-output symbol.
+#' @export
+mx.symbol.Group <- function(...) {
+  syms <- list(...)
+  if (length(syms) == 1 && is.list(syms[[1]]) &&
+      !inherits(syms[[1]], "MXSymbol")) {
+    syms <- syms[[1]]
+  }
+  ptrs <- lapply(syms, mx.internal.symbol.ptr)
+  mx.internal.new.symbol(.Call(MXR_sym_group, ptrs))
+}
+
+#' Load a symbol from a JSON file.
+#' @export
+mx.symbol.load <- function(filename) {
+  mx.internal.new.symbol(.Call(MXR_sym_loadfile, path.expand(filename)))
+}
+
+#' Save a symbol to a JSON file.
+#' @export
+mx.symbol.save <- function(symbol, filename) {
+  invisible(.Call(MXR_sym_savefile, mx.internal.symbol.ptr(symbol),
+                  path.expand(filename)))
+}
+
+#' Parse a symbol from a JSON string.
+#' @export
+mx.symbol.load.json <- function(json) {
+  mx.internal.new.symbol(.Call(MXR_sym_fromjson, json))
+}
+
+#' Serialize a symbol to its JSON string.
+#' @export
+mx.symbol.tojson <- function(symbol) {
+  .Call(MXR_sym_tojson, mx.internal.symbol.ptr(symbol))
+}
+
+#' List all registered operator names.
+#' @export
+mx.list.ops <- function() .Call(MXR_list_ops)
+
+#' Argument (input) names of a symbol.
+#' @export
+arguments <- function(symbol) {
+  .Call(MXR_sym_arguments, mx.internal.symbol.ptr(symbol))
+}
+
+#' Output names of a symbol.
+#' @export
+mx.symbol.outputs <- function(symbol) {
+  .Call(MXR_sym_outputs, mx.internal.symbol.ptr(symbol))
+}
+
+#' Auxiliary-state names of a symbol (e.g. BatchNorm running stats).
+#' @export
+mx.symbol.auxiliary.states <- function(symbol) {
+  .Call(MXR_sym_auxiliary, mx.internal.symbol.ptr(symbol))
+}
+
+#' Symbol of all internal nodes' outputs.
+#' @export
+internals <- function(symbol) {
+  mx.internal.new.symbol(.Call(MXR_sym_internals,
+                               mx.internal.symbol.ptr(symbol)))
+}
+
+#' Take the i-th (1-based) output of a multi-output symbol.
+#' @export
+mx.symbol.get.output <- function(symbol, index) {
+  mx.internal.new.symbol(.Call(MXR_sym_get_output,
+                               mx.internal.symbol.ptr(symbol),
+                               as.integer(index) - 1L))
+}
+
+#' Infer shapes for every argument/output/aux state.
+#'
+#' Supply known input shapes as named arguments in R dim order, e.g.
+#' \code{mx.symbol.infer.shape(net, data = c(28, 28, 1, 64))}.
+#' Returns list(arg.shapes, out.shapes, aux.shapes) of named shape vectors
+#' (R dim order), or NULL if inference is incomplete.
+#' @export
+mx.symbol.infer.shape <- function(symbol, ...) {
+  kwargs <- list(...)
+  keys <- names(kwargs)
+  # CSR-encode in NDArray order (reverse each R dim vector)
+  ind <- c(0L, cumsum(vapply(kwargs, length, integer(1))))
+  sdata <- unlist(lapply(kwargs, function(d) rev(as.integer(d))),
+                  use.names = FALSE)
+  if (is.null(sdata)) sdata <- integer(0)
+  res <- .Call(MXR_sym_infer_shape, mx.internal.symbol.ptr(symbol),
+               keys, as.integer(ind), as.integer(sdata))
+  if (!res[[4]]) return(NULL)
+  arg.shapes <- res[[1]]
+  names(arg.shapes) <- arguments(symbol)
+  out.shapes <- res[[2]]
+  names(out.shapes) <- mx.symbol.outputs(symbol)
+  aux.shapes <- res[[3]]
+  names(aux.shapes) <- mx.symbol.auxiliary.states(symbol)
+  list(arg.shapes = arg.shapes, out.shapes = out.shapes,
+       aux.shapes = aux.shapes)
+}
+
+#' @export
+print.MXSymbol <- function(x, ...) {
+  cat(.Call(MXR_sym_print, mx.internal.symbol.ptr(x)))
+  cat("\n")
+  invisible(x)
+}
+
+# symbol-symbol / symbol-scalar arithmetic composes graph nodes
+.mx.sym.binop <- function(e1, e2, sym.op, scalar.op, rscalar.op = NULL) {
+  lhs <- inherits(e1, "MXSymbol")
+  rhs <- inherits(e2, "MXSymbol")
+  if (lhs && rhs) return(mx.symbol.create(sym.op, e1, e2))
+  if (lhs) return(mx.symbol.create(scalar.op, e1, scalar = e2))
+  op <- if (is.null(rscalar.op)) scalar.op else rscalar.op
+  mx.symbol.create(op, e2, scalar = e1)
+}
+
+#' @export
+Ops.MXSymbol <- function(e1, e2) {
+  switch(.Generic,
+    "+" = .mx.sym.binop(e1, e2, "_plus", "_plus_scalar"),
+    "-" = if (missing(e2)) {
+      mx.symbol.create("_mul_scalar", e1, scalar = -1)
+    } else {
+      .mx.sym.binop(e1, e2, "_minus", "_minus_scalar", "_rminus_scalar")
+    },
+    "*" = .mx.sym.binop(e1, e2, "_mul", "_mul_scalar"),
+    "/" = .mx.sym.binop(e1, e2, "_div", "_div_scalar", "_rdiv_scalar"),
+    stop(sprintf("operator %s not supported on MXSymbol", .Generic))
+  )
+}

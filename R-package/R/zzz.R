@@ -1,0 +1,33 @@
+# Package load hooks (reference R-package/R/zzz.R).
+#
+# Loads libmxnetr.so (the .Call shim, built by R CMD INSTALL from
+# src/mxnet_r.cc) which links libmxnet_tpu.so — the C ABI library that
+# embeds the JAX/XLA runtime (capi/c_api.cpp). Set MXNET_TPU_HOME to the
+# framework checkout if libmxnet_tpu.so is not on the default search path.
+#
+# After the dynlib is up, every registered operator is exposed through the
+# `mx.sym` environment: mx.sym$Convolution(data = d, kernel = c(3, 3), ...)
+# behaves exactly like the static mx.symbol.* wrappers.
+
+#' Environment holding one symbol-constructor per registered op.
+#' @export
+mx.sym <- new.env(parent = emptyenv())
+
+.onLoad <- function(libname, pkgname) {
+  # the dynlib itself is loaded by useDynLib(libmxnetr) in NAMESPACE;
+  # here we only populate the op environment
+  ops <- tryCatch(mx.list.ops(), error = function(e) character(0))
+  for (op in ops) {
+    local({
+      op.name <- op
+      assign(op.name,
+             function(...) mx.symbol.create(op.name, ...),
+             envir = mx.sym)
+    })
+  }
+}
+
+.onUnload <- function(libpath) {
+  tryCatch(.Call(MXR_notify_shutdown), error = function(e) NULL)
+  library.dynam.unload("libmxnetr", libpath)
+}

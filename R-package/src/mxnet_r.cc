@@ -1,0 +1,884 @@
+// mxnet_tpu R bindings — .Call shim over the flat C ABI.
+//
+// Reference counterpart: R-package/src/{ndarray,symbol,executor,io,kvstore,
+// export}.cc (Rcpp modules over the C++ core). Here the binding is the plain
+// R C API (.Call + external pointers, no Rcpp), and the engine behind the ABI
+// is the JAX/XLA runtime inside libmxnet_tpu.so (capi/c_api.cpp).
+//
+// Layout contract (same as the reference R package): R arrays are
+// column-major, NDArrays row-major. An R array with dim c(d1..dk) maps to an
+// NDArray of shape (dk..d1) with the raw buffer copied verbatim — reversing
+// the dim vector converts between the two layouts with zero data movement.
+// All R<->device numeric traffic converts double <-> float32 in this shim.
+//
+// Handle ownership: every MX* handle returned to R is wrapped in an
+// EXTPTRSXP whose C finalizer releases it (the capi hands out a +1 ref that
+// MX*Free drops). Handles passed IN are borrowed for the call duration only.
+#include <R.h>
+#include <Rinternals.h>
+#include <R_ext/Rdynload.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../include/mxnet_tpu/c_api.h"
+
+namespace {
+
+void chk(int rc) {
+  if (rc != 0) Rf_error("%s", MXGetLastError());
+}
+
+// ------------------------------------------------------------ extptr utils
+template <int (*FreeFn)(void*)>
+void handle_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    FreeFn(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP wrap_handle(void* h, void (*fin)(SEXP)) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  if (fin != nullptr) R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+void* unwrap(SEXP ptr) {
+  if (TYPEOF(ptr) != EXTPTRSXP)
+    Rf_error("expected an mxnet handle (external pointer)");
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h == nullptr) Rf_error("handle already freed");
+  return h;
+}
+
+constexpr auto nd_fin = handle_finalizer<MXNDArrayFree>;
+constexpr auto sym_fin = handle_finalizer<MXSymbolFree>;
+constexpr auto exec_fin = handle_finalizer<MXExecutorFree>;
+constexpr auto iter_fin = handle_finalizer<MXDataIterFree>;
+constexpr auto kv_fin = handle_finalizer<MXKVStoreFree>;
+constexpr auto pred_fin = handle_finalizer<MXPredFree>;
+
+// ------------------------------------------------------------- conversions
+// STRSXP -> owned strings + char* view (view valid while `store` lives)
+struct StrVec {
+  std::vector<std::string> store;
+  std::vector<const char*> ptrs;
+  explicit StrVec(SEXP s) {
+    R_xlen_t n = (s == R_NilValue) ? 0 : Rf_xlength(s);
+    store.reserve(n);
+    for (R_xlen_t i = 0; i < n; ++i)
+      store.emplace_back(CHAR(STRING_ELT(s, i)));
+    for (auto& v : store) ptrs.push_back(v.c_str());
+  }
+  mx_uint size() const { return static_cast<mx_uint>(store.size()); }
+  const char** data() { return ptrs.empty() ? nullptr : ptrs.data(); }
+};
+
+// R dim vector (column-major order) -> NDArray shape (reversed)
+std::vector<mx_uint> rdim_to_shape(SEXP rdim) {
+  R_xlen_t n = Rf_xlength(rdim);
+  std::vector<mx_uint> shape(n);
+  for (R_xlen_t i = 0; i < n; ++i)
+    shape[n - 1 - i] = static_cast<mx_uint>(INTEGER(rdim)[i]);
+  return shape;
+}
+
+SEXP shape_to_rdim(const mx_uint* shape, mx_uint ndim) {
+  SEXP rdim = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i)
+    INTEGER(rdim)[i] = static_cast<int>(shape[ndim - 1 - i]);
+  UNPROTECT(1);
+  return rdim;
+}
+
+std::vector<NDArrayHandle> unwrap_nd_list(SEXP lst) {
+  R_xlen_t n = (lst == R_NilValue) ? 0 : Rf_xlength(lst);
+  std::vector<NDArrayHandle> out(n);
+  for (R_xlen_t i = 0; i < n; ++i) out[i] = unwrap(VECTOR_ELT(lst, i));
+  return out;
+}
+
+size_t nd_size(NDArrayHandle h, mx_uint* out_ndim = nullptr,
+               const mx_uint** out_shape = nullptr) {
+  mx_uint ndim;
+  const mx_uint* shape;
+  chk(MXNDArrayGetShape(h, &ndim, &shape));
+  size_t total = 1;
+  for (mx_uint i = 0; i < ndim; ++i) total *= shape[i];
+  if (out_ndim) *out_ndim = ndim;
+  if (out_shape) *out_shape = shape;
+  return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ================================================================= ndarray
+SEXP MXR_nd_create(SEXP rdim, SEXP dev_type, SEXP dev_id) {
+  std::vector<mx_uint> shape = rdim_to_shape(rdim);
+  NDArrayHandle h;
+  chk(MXNDArrayCreate(shape.data(), static_cast<mx_uint>(shape.size()),
+                      Rf_asInteger(dev_type), Rf_asInteger(dev_id), 0, &h));
+  return wrap_handle(h, nd_fin);
+}
+
+SEXP MXR_nd_from_array(SEXP data, SEXP rdim, SEXP dev_type, SEXP dev_id) {
+  std::vector<mx_uint> shape = rdim_to_shape(rdim);
+  NDArrayHandle h;
+  chk(MXNDArrayCreate(shape.data(), static_cast<mx_uint>(shape.size()),
+                      Rf_asInteger(dev_type), Rf_asInteger(dev_id), 0, &h));
+  R_xlen_t n = Rf_xlength(data);
+  std::vector<float> buf(n);
+  const double* src = REAL(data);
+  for (R_xlen_t i = 0; i < n; ++i) buf[i] = static_cast<float>(src[i]);
+  chk(MXNDArraySyncCopyFromCPU(h, buf.data(), static_cast<size_t>(n)));
+  return wrap_handle(h, nd_fin);
+}
+
+SEXP MXR_nd_to_array(SEXP ptr) {
+  NDArrayHandle h = unwrap(ptr);
+  mx_uint ndim;
+  const mx_uint* shape;
+  size_t total = nd_size(h, &ndim, &shape);
+  SEXP rdim = PROTECT(shape_to_rdim(shape, ndim));
+  std::vector<float> buf(total);
+  chk(MXNDArraySyncCopyToCPU(h, buf.data(), total));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, static_cast<R_xlen_t>(total)));
+  double* dst = REAL(out);
+  for (size_t i = 0; i < total; ++i) dst[i] = buf[i];
+  Rf_setAttrib(out, R_DimSymbol, rdim);
+  UNPROTECT(2);
+  return out;
+}
+
+SEXP MXR_nd_dim(SEXP ptr) {
+  mx_uint ndim;
+  const mx_uint* shape;
+  nd_size(unwrap(ptr), &ndim, &shape);
+  return shape_to_rdim(shape, ndim);
+}
+
+SEXP MXR_nd_context(SEXP ptr) {
+  int dt, di;
+  chk(MXNDArrayGetContext(unwrap(ptr), &dt, &di));
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, 2));
+  INTEGER(out)[0] = dt;
+  INTEGER(out)[1] = di;
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_nd_dtype(SEXP ptr) {
+  int dt;
+  chk(MXNDArrayGetDType(unwrap(ptr), &dt));
+  return Rf_ScalarInteger(dt);
+}
+
+SEXP MXR_nd_slice(SEXP ptr, SEXP begin, SEXP end) {
+  NDArrayHandle out;
+  chk(MXNDArraySlice(unwrap(ptr), Rf_asInteger(begin), Rf_asInteger(end),
+                     &out));
+  return wrap_handle(out, nd_fin);
+}
+
+SEXP MXR_nd_reshape(SEXP ptr, SEXP rdim) {
+  std::vector<mx_uint> shape = rdim_to_shape(rdim);
+  std::vector<int> dims(shape.begin(), shape.end());
+  NDArrayHandle out;
+  chk(MXNDArrayReshape(unwrap(ptr), static_cast<int>(dims.size()),
+                       dims.data(), &out));
+  return wrap_handle(out, nd_fin);
+}
+
+SEXP MXR_nd_save(SEXP fname, SEXP lst, SEXP names) {
+  std::vector<NDArrayHandle> arrs = unwrap_nd_list(lst);
+  StrVec keys(names);
+  chk(MXNDArraySave(CHAR(STRING_ELT(fname, 0)),
+                    static_cast<mx_uint>(arrs.size()),
+                    arrs.empty() ? nullptr : arrs.data(), keys.data()));
+  return R_NilValue;
+}
+
+SEXP MXR_nd_load(SEXP fname) {
+  mx_uint n, n_names;
+  NDArrayHandle* arrs;
+  const char** names;
+  chk(MXNDArrayLoad(CHAR(STRING_ELT(fname, 0)), &n, &arrs, &n_names,
+                    &names));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_VECTOR_ELT(out, i, wrap_handle(arrs[i], nd_fin));
+  if (n_names == n) {
+    SEXP nm = PROTECT(Rf_allocVector(STRSXP, n));
+    for (mx_uint i = 0; i < n; ++i)
+      SET_STRING_ELT(nm, i, Rf_mkChar(names[i]));
+    Rf_setAttrib(out, R_NamesSymbol, nm);
+    UNPROTECT(1);
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+// invoke a registered op imperatively. outs == R_NilValue -> op allocates;
+// otherwise outs is a list of NDArray handles written in place.
+SEXP MXR_nd_invoke(SEXP opname, SEXP ndargs, SEXP pkeys, SEXP pvals,
+                   SEXP outs) {
+  FunctionHandle creator;
+  chk(MXGetFunction(CHAR(STRING_ELT(opname, 0)), &creator));
+  std::vector<NDArrayHandle> ins = unwrap_nd_list(ndargs);
+  StrVec keys(pkeys), vals(pvals);
+  std::vector<NDArrayHandle> provided = unwrap_nd_list(outs);
+  int num_outputs = static_cast<int>(provided.size());
+  NDArrayHandle* outputs = provided.empty() ? nullptr : provided.data();
+  chk(MXImperativeInvoke(const_cast<void*>(creator),
+                         static_cast<int>(ins.size()),
+                         ins.empty() ? nullptr : ins.data(), &num_outputs,
+                         &outputs, static_cast<int>(keys.size()),
+                         keys.data(), vals.data()));
+  if (!provided.empty()) {
+    // in-place form: returned handles are the provided ones with an extra
+    // ref each — drop it and hand back the caller's wrappers
+    for (int i = 0; i < num_outputs; ++i) MXNDArrayFree(outputs[i]);
+    return outs;
+  }
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, num_outputs));
+  for (int i = 0; i < num_outputs; ++i)
+    SET_VECTOR_ELT(out, i, wrap_handle(outputs[i], nd_fin));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_random_seed(SEXP seed) {
+  chk(MXRandomSeed(Rf_asInteger(seed)));
+  return R_NilValue;
+}
+
+SEXP MXR_wait_all(void) {
+  chk(MXNDArrayWaitAll());
+  return R_NilValue;
+}
+
+// ================================================================== symbol
+SEXP MXR_sym_variable(SEXP name) {
+  SymbolHandle h;
+  chk(MXSymbolCreateVariable(CHAR(STRING_ELT(name, 0)), &h));
+  return wrap_handle(h, sym_fin);
+}
+
+// create an atomic op symbol and compose it with named symbol inputs
+SEXP MXR_sym_create(SEXP opname, SEXP pkeys, SEXP pvals, SEXP name,
+                    SEXP arg_keys, SEXP arg_syms) {
+  FunctionHandle creator;
+  chk(MXGetFunction(CHAR(STRING_ELT(opname, 0)), &creator));
+  StrVec keys(pkeys), vals(pvals);
+  SymbolHandle h;
+  chk(MXSymbolCreateAtomicSymbol(const_cast<void*>(creator), keys.size(),
+                                 keys.data(), vals.data(), &h));
+  SEXP wrapped = PROTECT(wrap_handle(h, sym_fin));
+  StrVec akeys(arg_keys);
+  R_xlen_t nargs = (arg_syms == R_NilValue) ? 0 : Rf_xlength(arg_syms);
+  std::vector<SymbolHandle> args(nargs);
+  for (R_xlen_t i = 0; i < nargs; ++i)
+    args[i] = unwrap(VECTOR_ELT(arg_syms, i));
+  const char* cname =
+      (name == R_NilValue) ? nullptr : CHAR(STRING_ELT(name, 0));
+  chk(MXSymbolCompose(h, cname, static_cast<mx_uint>(nargs),
+                      akeys.size() > 0 ? akeys.data() : nullptr,
+                      args.empty() ? nullptr : args.data()));
+  UNPROTECT(1);
+  return wrapped;
+}
+
+SEXP MXR_sym_tojson(SEXP ptr) {
+  const char* json;
+  chk(MXSymbolSaveToJSON(unwrap(ptr), &json));
+  return Rf_ScalarString(Rf_mkChar(json));
+}
+
+SEXP MXR_sym_fromjson(SEXP json) {
+  SymbolHandle h;
+  chk(MXSymbolCreateFromJSON(CHAR(STRING_ELT(json, 0)), &h));
+  return wrap_handle(h, sym_fin);
+}
+
+SEXP MXR_sym_savefile(SEXP ptr, SEXP fname) {
+  chk(MXSymbolSaveToFile(unwrap(ptr), CHAR(STRING_ELT(fname, 0))));
+  return R_NilValue;
+}
+
+SEXP MXR_sym_loadfile(SEXP fname) {
+  SymbolHandle h;
+  chk(MXSymbolCreateFromFile(CHAR(STRING_ELT(fname, 0)), &h));
+  return wrap_handle(h, sym_fin);
+}
+
+SEXP MXR_sym_copy(SEXP ptr) {
+  SymbolHandle h;
+  chk(MXSymbolCopy(unwrap(ptr), &h));
+  return wrap_handle(h, sym_fin);
+}
+
+SEXP MXR_sym_print(SEXP ptr) {
+  const char* s;
+  chk(MXSymbolPrint(unwrap(ptr), &s));
+  return Rf_ScalarString(Rf_mkChar(s));
+}
+
+SEXP MXR_sym_name(SEXP ptr) {
+  const char* s;
+  int ok;
+  chk(MXSymbolGetName(unwrap(ptr), &s, &ok));
+  return ok ? Rf_ScalarString(Rf_mkChar(s)) : R_NilValue;
+}
+
+SEXP MXR_sym_getattr(SEXP ptr, SEXP key) {
+  const char* s;
+  int ok;
+  chk(MXSymbolGetAttr(unwrap(ptr), CHAR(STRING_ELT(key, 0)), &s, &ok));
+  return ok ? Rf_ScalarString(Rf_mkChar(s)) : R_NilValue;
+}
+
+SEXP MXR_sym_setattr(SEXP ptr, SEXP key, SEXP val) {
+  chk(MXSymbolSetAttr(unwrap(ptr), CHAR(STRING_ELT(key, 0)),
+                      CHAR(STRING_ELT(val, 0))));
+  return R_NilValue;
+}
+
+namespace {
+SEXP strlist_result(int rc, mx_uint n, const char** strs) {
+  chk(rc);
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_STRING_ELT(out, i, Rf_mkChar(strs[i]));
+  UNPROTECT(1);
+  return out;
+}
+}  // namespace
+
+SEXP MXR_sym_arguments(SEXP ptr) {
+  mx_uint n;
+  const char** strs;
+  int rc = MXSymbolListArguments(unwrap(ptr), &n, &strs);
+  return strlist_result(rc, n, strs);
+}
+
+SEXP MXR_sym_outputs(SEXP ptr) {
+  mx_uint n;
+  const char** strs;
+  int rc = MXSymbolListOutputs(unwrap(ptr), &n, &strs);
+  return strlist_result(rc, n, strs);
+}
+
+SEXP MXR_sym_auxiliary(SEXP ptr) {
+  mx_uint n;
+  const char** strs;
+  int rc = MXSymbolListAuxiliaryStates(unwrap(ptr), &n, &strs);
+  return strlist_result(rc, n, strs);
+}
+
+SEXP MXR_sym_group(SEXP lst) {
+  R_xlen_t n = Rf_xlength(lst);
+  std::vector<SymbolHandle> syms(n);
+  for (R_xlen_t i = 0; i < n; ++i) syms[i] = unwrap(VECTOR_ELT(lst, i));
+  SymbolHandle h;
+  chk(MXSymbolCreateGroup(static_cast<mx_uint>(n), syms.data(), &h));
+  return wrap_handle(h, sym_fin);
+}
+
+SEXP MXR_sym_internals(SEXP ptr) {
+  SymbolHandle h;
+  chk(MXSymbolGetInternals(unwrap(ptr), &h));
+  return wrap_handle(h, sym_fin);
+}
+
+SEXP MXR_sym_get_output(SEXP ptr, SEXP idx) {
+  SymbolHandle h;
+  chk(MXSymbolGetOutput(unwrap(ptr), Rf_asInteger(idx), &h));
+  return wrap_handle(h, sym_fin);
+}
+
+// shapes in: keys + CSR (ind_ptr, shape_data) already in NDArray order
+// (the R wrapper reverses dim vectors). Returns list(arg/out/aux, complete),
+// every shape back in R dim order.
+SEXP MXR_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind_ptr, SEXP shape_data) {
+  StrVec ks(keys);
+  R_xlen_t n_ind = Rf_xlength(ind_ptr);
+  std::vector<mx_uint> ind(n_ind), sdata(Rf_xlength(shape_data));
+  for (R_xlen_t i = 0; i < n_ind; ++i)
+    ind[i] = static_cast<mx_uint>(INTEGER(ind_ptr)[i]);
+  for (R_xlen_t i = 0; i < (R_xlen_t)sdata.size(); ++i)
+    sdata[i] = static_cast<mx_uint>(INTEGER(shape_data)[i]);
+
+  mx_uint in_n, out_n, aux_n;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sh, **out_sh, **aux_sh;
+  int complete;
+  chk(MXSymbolInferShape(unwrap(ptr), ks.size(), ks.data(), ind.data(),
+                         sdata.data(), &in_n, &in_nd, &in_sh, &out_n,
+                         &out_nd, &out_sh, &aux_n, &aux_nd, &aux_sh,
+                         &complete));
+
+  auto pack = [](mx_uint n, const mx_uint* nd, const mx_uint** sh) {
+    SEXP lst = PROTECT(Rf_allocVector(VECSXP, n));
+    for (mx_uint i = 0; i < n; ++i)
+      SET_VECTOR_ELT(lst, i, shape_to_rdim(sh[i], nd[i]));
+    UNPROTECT(1);
+    return lst;
+  };
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, 4));
+  SET_VECTOR_ELT(out, 0, pack(in_n, in_nd, in_sh));
+  SET_VECTOR_ELT(out, 1, pack(out_n, out_nd, out_sh));
+  SET_VECTOR_ELT(out, 2, pack(aux_n, aux_nd, aux_sh));
+  SET_VECTOR_ELT(out, 3, Rf_ScalarLogical(complete));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_list_ops(void) {
+  mx_uint n;
+  const char** names;
+  int rc = MXListAllOpNames(&n, &names);
+  return strlist_result(rc, n, names);
+}
+
+SEXP MXR_op_info(SEXP opname) {
+  FunctionHandle creator;
+  chk(MXGetFunction(CHAR(STRING_ELT(opname, 0)), &creator));
+  const char *name, *desc, *kv, *rtype;
+  mx_uint n_args;
+  const char **anames, **atypes, **adescs;
+  chk(MXSymbolGetAtomicSymbolInfo(const_cast<void*>(creator), &name, &desc,
+                                  &n_args, &anames, &atypes, &adescs, &kv,
+                                  &rtype));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, 5));
+  SET_VECTOR_ELT(out, 0, Rf_ScalarString(Rf_mkChar(name)));
+  SET_VECTOR_ELT(out, 1, Rf_ScalarString(Rf_mkChar(desc ? desc : "")));
+  SEXP an = PROTECT(Rf_allocVector(STRSXP, n_args));
+  SEXP at = PROTECT(Rf_allocVector(STRSXP, n_args));
+  for (mx_uint i = 0; i < n_args; ++i) {
+    SET_STRING_ELT(an, i, Rf_mkChar(anames[i]));
+    SET_STRING_ELT(at, i, Rf_mkChar(atypes[i] ? atypes[i] : ""));
+  }
+  SET_VECTOR_ELT(out, 2, an);
+  SET_VECTOR_ELT(out, 3, at);
+  SET_VECTOR_ELT(out, 4, Rf_ScalarString(Rf_mkChar(kv ? kv : "")));
+  UNPROTECT(3);
+  return out;
+}
+
+// ================================================================ executor
+// arg_grads: list of NDArray handles or NULL elements (no grad for that arg)
+SEXP MXR_exec_bind(SEXP sym, SEXP dev_type, SEXP dev_id, SEXP in_args,
+                   SEXP arg_grads, SEXP grad_reqs, SEXP aux_states) {
+  std::vector<NDArrayHandle> args = unwrap_nd_list(in_args);
+  R_xlen_t n = Rf_xlength(in_args);
+  std::vector<NDArrayHandle> grads(n, nullptr);
+  if (arg_grads != R_NilValue) {
+    if (Rf_xlength(arg_grads) != n)
+      Rf_error("arg_grads length %d != %d arguments",
+               (int)Rf_xlength(arg_grads), (int)n);
+    for (R_xlen_t i = 0; i < n; ++i) {
+      SEXP g = VECTOR_ELT(arg_grads, i);
+      if (g != R_NilValue) grads[i] = unwrap(g);
+    }
+  }
+  std::vector<mx_uint> reqs(n, 1);
+  if (grad_reqs != R_NilValue) {
+    if (Rf_xlength(grad_reqs) != n)
+      Rf_error("grad_reqs length %d != %d arguments",
+               (int)Rf_xlength(grad_reqs), (int)n);
+    for (R_xlen_t i = 0; i < n; ++i)
+      reqs[i] = static_cast<mx_uint>(INTEGER(grad_reqs)[i]);
+  }
+  std::vector<NDArrayHandle> aux = unwrap_nd_list(aux_states);
+  ExecutorHandle h;
+  chk(MXExecutorBind(unwrap(sym), Rf_asInteger(dev_type),
+                     Rf_asInteger(dev_id), static_cast<mx_uint>(n),
+                     args.empty() ? nullptr : args.data(), grads.data(),
+                     reqs.data(), static_cast<mx_uint>(aux.size()),
+                     aux.empty() ? nullptr : aux.data(), &h));
+  return wrap_handle(h, exec_fin);
+}
+
+SEXP MXR_exec_forward(SEXP ptr, SEXP is_train) {
+  chk(MXExecutorForward(unwrap(ptr), Rf_asInteger(is_train)));
+  return R_NilValue;
+}
+
+SEXP MXR_exec_backward(SEXP ptr, SEXP head_grads) {
+  std::vector<NDArrayHandle> hg = unwrap_nd_list(head_grads);
+  chk(MXExecutorBackward(unwrap(ptr), static_cast<mx_uint>(hg.size()),
+                         hg.empty() ? nullptr : hg.data()));
+  return R_NilValue;
+}
+
+SEXP MXR_exec_outputs(SEXP ptr) {
+  mx_uint n;
+  NDArrayHandle* outs;
+  chk(MXExecutorOutputs(unwrap(ptr), &n, &outs));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_VECTOR_ELT(out, i, wrap_handle(outs[i], nd_fin));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_exec_print(SEXP ptr) {
+  const char* s;
+  chk(MXExecutorPrint(unwrap(ptr), &s));
+  return Rf_ScalarString(Rf_mkChar(s));
+}
+
+// =============================================================== data iter
+SEXP MXR_list_data_iters(void) {
+  mx_uint n;
+  DataIterCreator* creators;
+  chk(MXListDataIters(&n, &creators));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name, *desc;
+    mx_uint n_args;
+    const char **anames, **atypes, **adescs;
+    chk(MXDataIterGetIterInfo(creators[i], &name, &desc, &n_args, &anames,
+                              &atypes, &adescs));
+    SET_STRING_ELT(out, i, Rf_mkChar(name));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_iter_create(SEXP iname, SEXP pkeys, SEXP pvals) {
+  mx_uint n;
+  DataIterCreator* creators;
+  chk(MXListDataIters(&n, &creators));
+  const char* want = CHAR(STRING_ELT(iname, 0));
+  DataIterCreator creator = nullptr;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name, *desc;
+    mx_uint n_args;
+    const char **anames, **atypes, **adescs;
+    chk(MXDataIterGetIterInfo(creators[i], &name, &desc, &n_args, &anames,
+                              &atypes, &adescs));
+    if (std::strcmp(name, want) == 0) {
+      creator = creators[i];
+      break;
+    }
+  }
+  if (creator == nullptr) Rf_error("unknown data iter: %s", want);
+  StrVec keys(pkeys), vals(pvals);
+  DataIterHandle h;
+  chk(MXDataIterCreateIter(creator, keys.size(), keys.data(), vals.data(),
+                           &h));
+  return wrap_handle(h, iter_fin);
+}
+
+SEXP MXR_iter_next(SEXP ptr) {
+  int has_next;
+  chk(MXDataIterNext(unwrap(ptr), &has_next));
+  return Rf_ScalarLogical(has_next);
+}
+
+SEXP MXR_iter_reset(SEXP ptr) {
+  chk(MXDataIterBeforeFirst(unwrap(ptr)));
+  return R_NilValue;
+}
+
+SEXP MXR_iter_data(SEXP ptr) {
+  NDArrayHandle h;
+  chk(MXDataIterGetData(unwrap(ptr), &h));
+  return wrap_handle(h, nd_fin);
+}
+
+SEXP MXR_iter_label(SEXP ptr) {
+  NDArrayHandle h;
+  chk(MXDataIterGetLabel(unwrap(ptr), &h));
+  return wrap_handle(h, nd_fin);
+}
+
+SEXP MXR_iter_pad(SEXP ptr) {
+  int pad;
+  chk(MXDataIterGetPadNum(unwrap(ptr), &pad));
+  return Rf_ScalarInteger(pad);
+}
+
+// ================================================================= kvstore
+namespace {
+// R closure registered through mx.kv.set.updater; called from the engine
+struct RUpdater {
+  SEXP fn = R_NilValue;
+  SEXP env = R_NilValue;
+};
+RUpdater g_updater;
+
+void kv_updater_trampoline(int key, NDArrayHandle recv, NDArrayHandle local,
+                           void* handle) {
+  RUpdater* u = static_cast<RUpdater*>(handle);
+  if (u->fn == R_NilValue) return;
+  // borrowed handles: the store owns them, so no finalizer on the wrappers
+  SEXP r = PROTECT(wrap_handle(recv, nullptr));
+  SEXP l = PROTECT(wrap_handle(local, nullptr));
+  SEXP k = PROTECT(Rf_ScalarInteger(key));
+  SEXP call = PROTECT(Rf_lang4(u->fn, k, r, l));
+  int err = 0;
+  R_tryEval(call, u->env == R_NilValue ? R_GlobalEnv : u->env, &err);
+  UNPROTECT(4);
+}
+}  // namespace
+
+SEXP MXR_kv_create(SEXP type) {
+  KVStoreHandle h;
+  chk(MXKVStoreCreate(CHAR(STRING_ELT(type, 0)), &h));
+  return wrap_handle(h, kv_fin);
+}
+
+SEXP MXR_kv_init(SEXP ptr, SEXP keys, SEXP vals) {
+  std::vector<NDArrayHandle> arrs = unwrap_nd_list(vals);
+  chk(MXKVStoreInit(unwrap(ptr), static_cast<mx_uint>(arrs.size()),
+                    INTEGER(keys), arrs.data()));
+  return R_NilValue;
+}
+
+SEXP MXR_kv_push(SEXP ptr, SEXP keys, SEXP vals, SEXP priority) {
+  std::vector<NDArrayHandle> arrs = unwrap_nd_list(vals);
+  chk(MXKVStorePush(unwrap(ptr), static_cast<mx_uint>(arrs.size()),
+                    INTEGER(keys), arrs.data(), Rf_asInteger(priority)));
+  return R_NilValue;
+}
+
+SEXP MXR_kv_pull(SEXP ptr, SEXP keys, SEXP vals, SEXP priority) {
+  std::vector<NDArrayHandle> arrs = unwrap_nd_list(vals);
+  chk(MXKVStorePull(unwrap(ptr), static_cast<mx_uint>(arrs.size()),
+                    INTEGER(keys), arrs.data(), Rf_asInteger(priority)));
+  return R_NilValue;
+}
+
+SEXP MXR_kv_set_updater(SEXP ptr, SEXP fn, SEXP env) {
+  if (g_updater.fn != R_NilValue) R_ReleaseObject(g_updater.fn);
+  if (g_updater.env != R_NilValue) R_ReleaseObject(g_updater.env);
+  R_PreserveObject(fn);
+  R_PreserveObject(env);
+  g_updater.fn = fn;
+  g_updater.env = env;
+  chk(MXKVStoreSetUpdater(unwrap(ptr), kv_updater_trampoline, &g_updater));
+  return R_NilValue;
+}
+
+SEXP MXR_kv_type(SEXP ptr) {
+  const char* t;
+  chk(MXKVStoreGetType(unwrap(ptr), &t));
+  return Rf_ScalarString(Rf_mkChar(t));
+}
+
+SEXP MXR_kv_rank(SEXP ptr) {
+  int r;
+  chk(MXKVStoreGetRank(unwrap(ptr), &r));
+  return Rf_ScalarInteger(r);
+}
+
+SEXP MXR_kv_num_workers(SEXP ptr) {
+  int n;
+  chk(MXKVStoreGetGroupSize(unwrap(ptr), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP MXR_kv_barrier(SEXP ptr) {
+  chk(MXKVStoreBarrier(unwrap(ptr)));
+  return R_NilValue;
+}
+
+// =============================================================== predictor
+SEXP MXR_pred_create(SEXP json, SEXP param_bytes, SEXP dev_type, SEXP dev_id,
+                     SEXP input_keys, SEXP ind_ptr, SEXP shape_data) {
+  StrVec keys(input_keys);
+  R_xlen_t n_ind = Rf_xlength(ind_ptr);
+  std::vector<mx_uint> ind(n_ind), sdata(Rf_xlength(shape_data));
+  for (R_xlen_t i = 0; i < n_ind; ++i)
+    ind[i] = static_cast<mx_uint>(INTEGER(ind_ptr)[i]);
+  for (R_xlen_t i = 0; i < (R_xlen_t)sdata.size(); ++i)
+    sdata[i] = static_cast<mx_uint>(INTEGER(shape_data)[i]);
+  const void* params = nullptr;
+  size_t param_size = 0;
+  if (param_bytes != R_NilValue && Rf_xlength(param_bytes) > 0) {
+    params = RAW(param_bytes);
+    param_size = static_cast<size_t>(Rf_xlength(param_bytes));
+  }
+  PredictorHandle h;
+  chk(MXPredCreate(CHAR(STRING_ELT(json, 0)), params, param_size,
+                   Rf_asInteger(dev_type), Rf_asInteger(dev_id), keys.size(),
+                   keys.data(), ind.data(), sdata.data(), &h));
+  return wrap_handle(h, pred_fin);
+}
+
+SEXP MXR_pred_set_input(SEXP ptr, SEXP key, SEXP data) {
+  R_xlen_t n = Rf_xlength(data);
+  std::vector<float> buf(n);
+  const double* src = REAL(data);
+  for (R_xlen_t i = 0; i < n; ++i) buf[i] = static_cast<float>(src[i]);
+  chk(MXPredSetInput(unwrap(ptr), CHAR(STRING_ELT(key, 0)), buf.data(),
+                     static_cast<mx_uint>(n)));
+  return R_NilValue;
+}
+
+SEXP MXR_pred_forward(SEXP ptr) {
+  chk(MXPredForward(unwrap(ptr)));
+  return R_NilValue;
+}
+
+SEXP MXR_pred_get_output(SEXP ptr, SEXP idx) {
+  mx_uint* shape;
+  mx_uint ndim;
+  chk(MXPredGetOutputShape(unwrap(ptr), Rf_asInteger(idx), &shape, &ndim));
+  size_t total = 1;
+  for (mx_uint i = 0; i < ndim; ++i) total *= shape[i];
+  std::vector<float> buf(total);
+  chk(MXPredGetOutput(unwrap(ptr), Rf_asInteger(idx), buf.data(),
+                      static_cast<mx_uint>(total)));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, static_cast<R_xlen_t>(total)));
+  for (size_t i = 0; i < total; ++i) REAL(out)[i] = buf[i];
+  Rf_setAttrib(out, R_DimSymbol, shape_to_rdim(shape, ndim));
+  UNPROTECT(1);
+  return out;
+}
+
+// ================================================================ recordio
+SEXP MXR_recio_writer_create(SEXP uri) {
+  RecordIOHandle h;
+  chk(MXRecordIOWriterCreate(CHAR(STRING_ELT(uri, 0)), &h));
+  return wrap_handle(h, nullptr);  // closed explicitly
+}
+
+SEXP MXR_recio_writer_write(SEXP ptr, SEXP bytes) {
+  chk(MXRecordIOWriterWriteRecord(
+      unwrap(ptr), reinterpret_cast<const char*>(RAW(bytes)),
+      static_cast<size_t>(Rf_xlength(bytes))));
+  return R_NilValue;
+}
+
+SEXP MXR_recio_writer_close(SEXP ptr) {
+  chk(MXRecordIOWriterFree(unwrap(ptr)));
+  R_ClearExternalPtr(ptr);
+  return R_NilValue;
+}
+
+SEXP MXR_recio_reader_create(SEXP uri) {
+  RecordIOHandle h;
+  chk(MXRecordIOReaderCreate(CHAR(STRING_ELT(uri, 0)), &h));
+  return wrap_handle(h, nullptr);
+}
+
+SEXP MXR_recio_reader_read(SEXP ptr) {
+  const char* buf;
+  size_t size;
+  chk(MXRecordIOReaderReadRecord(unwrap(ptr), &buf, &size));
+  if (buf == nullptr) return R_NilValue;
+  SEXP out = PROTECT(Rf_allocVector(RAWSXP, static_cast<R_xlen_t>(size)));
+  std::memcpy(RAW(out), buf, size);
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_recio_reader_close(SEXP ptr) {
+  chk(MXRecordIOReaderFree(unwrap(ptr)));
+  R_ClearExternalPtr(ptr);
+  return R_NilValue;
+}
+
+// ================================================================ profiler
+SEXP MXR_profiler_config(SEXP mode, SEXP fname) {
+  chk(MXSetProfilerConfig(Rf_asInteger(mode), CHAR(STRING_ELT(fname, 0))));
+  return R_NilValue;
+}
+
+SEXP MXR_profiler_state(SEXP state) {
+  chk(MXSetProfilerState(Rf_asInteger(state)));
+  return R_NilValue;
+}
+
+SEXP MXR_notify_shutdown(void) {
+  chk(MXNotifyShutdown());
+  return R_NilValue;
+}
+
+// ============================================================ registration
+static const R_CallMethodDef CallEntries[] = {
+    {"MXR_nd_create", (DL_FUNC)&MXR_nd_create, 3},
+    {"MXR_nd_from_array", (DL_FUNC)&MXR_nd_from_array, 4},
+    {"MXR_nd_to_array", (DL_FUNC)&MXR_nd_to_array, 1},
+    {"MXR_nd_dim", (DL_FUNC)&MXR_nd_dim, 1},
+    {"MXR_nd_context", (DL_FUNC)&MXR_nd_context, 1},
+    {"MXR_nd_dtype", (DL_FUNC)&MXR_nd_dtype, 1},
+    {"MXR_nd_slice", (DL_FUNC)&MXR_nd_slice, 3},
+    {"MXR_nd_reshape", (DL_FUNC)&MXR_nd_reshape, 2},
+    {"MXR_nd_save", (DL_FUNC)&MXR_nd_save, 3},
+    {"MXR_nd_load", (DL_FUNC)&MXR_nd_load, 1},
+    {"MXR_nd_invoke", (DL_FUNC)&MXR_nd_invoke, 5},
+    {"MXR_random_seed", (DL_FUNC)&MXR_random_seed, 1},
+    {"MXR_wait_all", (DL_FUNC)&MXR_wait_all, 0},
+    {"MXR_sym_variable", (DL_FUNC)&MXR_sym_variable, 1},
+    {"MXR_sym_create", (DL_FUNC)&MXR_sym_create, 6},
+    {"MXR_sym_tojson", (DL_FUNC)&MXR_sym_tojson, 1},
+    {"MXR_sym_fromjson", (DL_FUNC)&MXR_sym_fromjson, 1},
+    {"MXR_sym_savefile", (DL_FUNC)&MXR_sym_savefile, 2},
+    {"MXR_sym_loadfile", (DL_FUNC)&MXR_sym_loadfile, 1},
+    {"MXR_sym_copy", (DL_FUNC)&MXR_sym_copy, 1},
+    {"MXR_sym_print", (DL_FUNC)&MXR_sym_print, 1},
+    {"MXR_sym_name", (DL_FUNC)&MXR_sym_name, 1},
+    {"MXR_sym_getattr", (DL_FUNC)&MXR_sym_getattr, 2},
+    {"MXR_sym_setattr", (DL_FUNC)&MXR_sym_setattr, 3},
+    {"MXR_sym_arguments", (DL_FUNC)&MXR_sym_arguments, 1},
+    {"MXR_sym_outputs", (DL_FUNC)&MXR_sym_outputs, 1},
+    {"MXR_sym_auxiliary", (DL_FUNC)&MXR_sym_auxiliary, 1},
+    {"MXR_sym_group", (DL_FUNC)&MXR_sym_group, 1},
+    {"MXR_sym_internals", (DL_FUNC)&MXR_sym_internals, 1},
+    {"MXR_sym_get_output", (DL_FUNC)&MXR_sym_get_output, 2},
+    {"MXR_sym_infer_shape", (DL_FUNC)&MXR_sym_infer_shape, 4},
+    {"MXR_list_ops", (DL_FUNC)&MXR_list_ops, 0},
+    {"MXR_op_info", (DL_FUNC)&MXR_op_info, 1},
+    {"MXR_exec_bind", (DL_FUNC)&MXR_exec_bind, 7},
+    {"MXR_exec_forward", (DL_FUNC)&MXR_exec_forward, 2},
+    {"MXR_exec_backward", (DL_FUNC)&MXR_exec_backward, 2},
+    {"MXR_exec_outputs", (DL_FUNC)&MXR_exec_outputs, 1},
+    {"MXR_exec_print", (DL_FUNC)&MXR_exec_print, 1},
+    {"MXR_list_data_iters", (DL_FUNC)&MXR_list_data_iters, 0},
+    {"MXR_iter_create", (DL_FUNC)&MXR_iter_create, 3},
+    {"MXR_iter_next", (DL_FUNC)&MXR_iter_next, 1},
+    {"MXR_iter_reset", (DL_FUNC)&MXR_iter_reset, 1},
+    {"MXR_iter_data", (DL_FUNC)&MXR_iter_data, 1},
+    {"MXR_iter_label", (DL_FUNC)&MXR_iter_label, 1},
+    {"MXR_iter_pad", (DL_FUNC)&MXR_iter_pad, 1},
+    {"MXR_kv_create", (DL_FUNC)&MXR_kv_create, 1},
+    {"MXR_kv_init", (DL_FUNC)&MXR_kv_init, 3},
+    {"MXR_kv_push", (DL_FUNC)&MXR_kv_push, 4},
+    {"MXR_kv_pull", (DL_FUNC)&MXR_kv_pull, 4},
+    {"MXR_kv_set_updater", (DL_FUNC)&MXR_kv_set_updater, 3},
+    {"MXR_kv_type", (DL_FUNC)&MXR_kv_type, 1},
+    {"MXR_kv_rank", (DL_FUNC)&MXR_kv_rank, 1},
+    {"MXR_kv_num_workers", (DL_FUNC)&MXR_kv_num_workers, 1},
+    {"MXR_kv_barrier", (DL_FUNC)&MXR_kv_barrier, 1},
+    {"MXR_pred_create", (DL_FUNC)&MXR_pred_create, 7},
+    {"MXR_pred_set_input", (DL_FUNC)&MXR_pred_set_input, 3},
+    {"MXR_pred_forward", (DL_FUNC)&MXR_pred_forward, 1},
+    {"MXR_pred_get_output", (DL_FUNC)&MXR_pred_get_output, 2},
+    {"MXR_recio_writer_create", (DL_FUNC)&MXR_recio_writer_create, 1},
+    {"MXR_recio_writer_write", (DL_FUNC)&MXR_recio_writer_write, 2},
+    {"MXR_recio_writer_close", (DL_FUNC)&MXR_recio_writer_close, 1},
+    {"MXR_recio_reader_create", (DL_FUNC)&MXR_recio_reader_create, 1},
+    {"MXR_recio_reader_read", (DL_FUNC)&MXR_recio_reader_read, 1},
+    {"MXR_recio_reader_close", (DL_FUNC)&MXR_recio_reader_close, 1},
+    {"MXR_profiler_config", (DL_FUNC)&MXR_profiler_config, 2},
+    {"MXR_profiler_state", (DL_FUNC)&MXR_profiler_state, 1},
+    {"MXR_notify_shutdown", (DL_FUNC)&MXR_notify_shutdown, 0},
+    {NULL, NULL, 0}};
+
+void R_init_libmxnetr(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, CallEntries, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}
+
+}  // extern "C"

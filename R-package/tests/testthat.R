@@ -1,0 +1,3 @@
+library(testthat)
+library(mxnet.tpu)
+test_check("mxnet.tpu")

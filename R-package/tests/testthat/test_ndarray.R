@@ -1,0 +1,43 @@
+# Run under real R: R CMD check / testthat::test_dir. In the TPU build
+# image (no R) the same flows are exercised by tests/test_r_package.py
+# through the r_stub harness.
+library(mxnet.tpu)
+
+test_that("ndarray round trip preserves layout", {
+  x <- array(seq_len(24), dim = c(2, 3, 4))
+  nd <- mx.nd.array(x)
+  expect_equal(dim(nd), c(2, 3, 4))
+  expect_equal(as.array(nd), x, tolerance = 1e-6)
+})
+
+test_that("arithmetic matches R", {
+  a <- matrix(c(1, 2, 3, 4), 2)
+  b <- matrix(c(5, 6, 7, 8), 2)
+  nd <- mx.nd.array(a) + mx.nd.array(b)
+  expect_equal(as.array(nd), a + b, tolerance = 1e-6)
+  expect_equal(as.array(mx.nd.array(a) * 2), a * 2, tolerance = 1e-6)
+})
+
+test_that("save/load round trip", {
+  f <- tempfile(fileext = ".params")
+  x <- matrix(stats::rnorm(12), 3)
+  mx.nd.save(list(w = mx.nd.array(x)), f)
+  back <- mx.nd.load(f)
+  expect_equal(names(back), "w")
+  expect_equal(as.array(back$w), x, tolerance = 1e-6)
+})
+
+test_that("simple bind trains a step", {
+  data <- mx.symbol.Variable("data")
+  fc <- mx.symbol.FullyConnected(data = data, num_hidden = 2,
+                                 name = "fc1")
+  net <- mx.symbol.SoftmaxOutput(data = fc, name = "softmax")
+  exec <- mx.simple.bind(net, mx.cpu(), data = c(4, 8),
+                         softmax_label = 8)
+  mx.exec.forward(exec)
+  out <- as.array(mx.exec.outputs(exec)[[1]])
+  expect_equal(dim(out), c(2, 8))
+  expect_equal(colSums(out), rep(1, 8), tolerance = 1e-5)
+  mx.exec.backward(exec)
+  expect_false(is.null(exec$grad.arrays$fc1_weight))
+})

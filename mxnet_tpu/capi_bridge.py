@@ -91,20 +91,11 @@ def symbol_create_atomic(op_name, keys, vals):
 
 
 def symbol_compose(s, name, keys, args):
-    """nnvm Symbol::Compose semantics: for an atomic symbol, keyword names
-    are the op's ARGUMENT names (data/weight/...); translate them to the
-    implicit placeholder variables _create generated for the head node."""
+    """nnvm Symbol::Compose semantics. Atomic-head keyword names (the op's
+    argument names, data/weight/...) are translated to placeholder
+    variables by Symbol._compose itself (symbol.py)."""
     if keys:
-        kwargs = dict(zip(keys, args))
-        head = s._heads[0][0]
-        if head.op is not None:
-            argnames = head.op.list_arguments(head.attrs)
-            trans = {}
-            for (src, _), nm in zip(head.inputs, argnames):
-                if src.op is None:
-                    trans[nm] = src.name
-            kwargs = {trans.get(k, k): v for k, v in kwargs.items()}
-        s._compose(name=name or None, **kwargs)
+        s._compose(name=name or None, **dict(zip(keys, args)))
     else:
         s._compose(*args, name=name or None)
     return s

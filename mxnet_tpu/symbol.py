@@ -32,16 +32,18 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
 class _Node:
     """One graph node: an op application or a variable (op=None)."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "_attr_dict")
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "_attr_dict",
+                 "auto_named")
 
     def __init__(self, op, name, attrs=None, inputs=None, is_aux=False,
-                 attr_dict=None):
+                 attr_dict=None, auto_named=False):
         self.op = op            # OpDef or None for variables
         self.name = name
         self.attrs = attrs or {}          # op parameters (typed)
         self.inputs = inputs or []        # list of (node, out_idx)
         self.is_aux = is_aux
         self._attr_dict = attr_dict or {}  # user attrs (ctx_group, ...)
+        self.auto_named = auto_named  # name came from NameManager, not user
 
     def num_outputs(self):
         return 1 if self.op is None else self.op.num_outputs(self.attrs)
@@ -124,12 +126,30 @@ class Symbol:
         kwargs match variable *names* anywhere in the graph; positional args
         match free variables in list_arguments order."""
         name = kwargs.pop("name", None)
-        if name and len(self._heads) == 1 and self._heads[0][0].op is not None:
-            self._heads[0][0].name = name
+        # "one head node" includes multi-output atomics (SliceChannel, RNN)
+        # whose heads are N outputs of the SAME node
+        single = len({id(n) for (n, _) in self._heads}) == 1
+        head = self._heads[0][0] if single else None
+        if kwargs and single and head.op is not None:
+            # nnvm Compose on an ATOMIC head matches kwargs against the
+            # op's argument names (data/weight/...). Our placeholders are
+            # eager, so "atomic" = every input is still the placeholder
+            # variable _create generated (named <head>_<arg>); once any
+            # input was bound, the symbol is composite and kwargs match
+            # variable names like everywhere else.
+            argnames = head.op.list_arguments(head.attrs)
+            pairs = list(zip(head.inputs, argnames))
+            if all(src.op is None and src.auto_named
+                   and src.name == head.name + "_" + nm
+                   for (src, _), nm in pairs) and pairs:
+                trans = {nm: src.name for (src, _), nm in pairs}
+                kwargs = {trans.get(k, k): v for k, v in kwargs.items()}
         order = self._topo()
         free_vars = [n for n in order if n.op is None]
         repl = {}  # id(var node) -> (node, out_idx) replacement head
-        for var, s in zip(free_vars, args):
+        # positional args bind in list_arguments order, which excludes aux
+        # states (reference symbol.py __call__ / nnvm Symbol::Compose)
+        for var, s in zip([n for n in free_vars if not n.is_aux], args):
             repl[id(var)] = s._heads[0]
         by_name = {n.name: n for n in free_vars}
         for k, v in kwargs.items():
@@ -140,6 +160,22 @@ class Symbol:
             n.inputs = [repl.get(id(src), (src, oi))
                         for (src, oi) in n.inputs]
         self._heads = [repl.get(id(n), (n, oi)) for (n, oi) in self._heads]
+        if name and single and head.op is not None:
+            # nnvm Symbol::Compose assigns the node name BEFORE argument
+            # names are synthesized (nnvm/src/core/symbolic.cc), so a
+            # compose-time name flows into auto param names (fc1_weight).
+            # Our placeholders are eager: rename the head's still-free
+            # direct-input PLACEHOLDERS (auto_named vars _create made)
+            # that carry its auto-generated prefix. User-chosen names —
+            # even ones sharing the prefix — are never touched.
+            old = head.name
+            head.name = name
+            if old != name and head.auto_named:
+                for (src, _) in head.inputs:
+                    if src.op is None and src.auto_named \
+                            and src.name.startswith(old + "_"):
+                        src.name = name + src.name[len(old):]
+            head.auto_named = False
 
     def __copy__(self):
         # deep copy of reachable graph
@@ -149,7 +185,7 @@ class Symbol:
             if id(n) in mapping:
                 return mapping[id(n)]
             c = _Node(n.op, n.name, dict(n.attrs), [], n.is_aux,
-                      dict(n._attr_dict))
+                      dict(n._attr_dict), auto_named=n.auto_named)
             mapping[id(n)] = c
             c.inputs = [(copy_node(s), i) for (s, i) in n.inputs]
             return c
@@ -519,6 +555,7 @@ def _sym_binary(lhs, rhs, op_name, scalar_op_name):
 def _create(op_name, input_syms, attrs, name=None, named_inputs=None):
     op = _registry.get_op(op_name)
     hint = op.name.lower().lstrip("_")
+    auto_named = name is None
     name = NameManager.current().get(name, hint)
     user_attrs = AttrScope.current().get(None)
 
@@ -536,7 +573,8 @@ def _create(op_name, input_syms, attrs, name=None, named_inputs=None):
             inputs.append(pos.pop(0)._heads[0])
         else:
             vnode = _Node(None, "%s_%s" % (name, nm),
-                          attr_dict=dict(user_attrs) if user_attrs else {})
+                          attr_dict=dict(user_attrs) if user_attrs else {},
+                          auto_named=True)
             inputs.append((vnode, 0))
     # aux states appended after args, auto-created (BatchNorm moving stats)
     for nm in op.aux_names:
@@ -545,11 +583,13 @@ def _create(op_name, input_syms, attrs, name=None, named_inputs=None):
             head[0].is_aux = True
             inputs.append(head)
         else:
-            vnode = _Node(None, "%s_%s" % (name, nm), is_aux=True)
+            vnode = _Node(None, "%s_%s" % (name, nm), is_aux=True,
+                          auto_named=True)
             inputs.append((vnode, 0))
 
     node = _Node(op, name, attrs, inputs,
-                 attr_dict=dict(user_attrs) if user_attrs else {})
+                 attr_dict=dict(user_attrs) if user_attrs else {},
+                 auto_named=auto_named)
     n_out = node.num_outputs()
     return Symbol([(node, i) for i in range(n_out)])
 

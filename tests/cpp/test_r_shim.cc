@@ -1,0 +1,293 @@
+// End-to-end exercise of the R frontend's .Call shim
+// (R-package/src/mxnet_r.cc) against the REAL libmxnet_tpu.so, hosted on
+// the R-runtime test double in tests/r_stub/. Run by
+// tests/test_r_package.py. Flows covered: NDArray round trip + layout
+// contract, imperative invoke, save/load, symbol compose + infer_shape,
+// executor bind/forward/backward, predictor, CSVIter, KVStore incl. an
+// R-closure updater through the trampoline.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <stdexcept>
+#include <string>
+
+#include "../r_stub/Rinternals.h"
+#include "../r_stub/R_ext/Rdynload.h"
+
+extern "C" void R_init_libmxnetr(DllInfo* dll);
+extern "C" SEXP r_stub_make_closure(SEXP (*fn)(SEXP, SEXP, SEXP));
+
+#define ASSERT(cond)                                                      \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      fprintf(stderr, "ASSERT FAILED at %s:%d: %s\n", __FILE__, __LINE__, \
+              #cond);                                                     \
+      exit(1);                                                            \
+    }                                                                     \
+  } while (0)
+
+typedef SEXP (*Call0)();
+typedef SEXP (*Call1)(SEXP);
+typedef SEXP (*Call2)(SEXP, SEXP);
+typedef SEXP (*Call3)(SEXP, SEXP, SEXP);
+typedef SEXP (*Call4)(SEXP, SEXP, SEXP, SEXP);
+typedef SEXP (*Call5)(SEXP, SEXP, SEXP, SEXP, SEXP);
+typedef SEXP (*Call6)(SEXP, SEXP, SEXP, SEXP, SEXP, SEXP);
+typedef SEXP (*Call7)(SEXP, SEXP, SEXP, SEXP, SEXP, SEXP, SEXP);
+
+static DL_FUNC find(const char* name) {
+  DL_FUNC f = r_stub_find_call(name);
+  if (f == nullptr) {
+    fprintf(stderr, "missing .Call routine: %s\n", name);
+    exit(1);
+  }
+  return f;
+}
+
+static SEXP ints(const int* v, int n) {
+  SEXP s = Rf_allocVector(INTSXP, n);
+  for (int i = 0; i < n; ++i) INTEGER(s)[i] = v[i];
+  return s;
+}
+
+static SEXP reals(const double* v, int n) {
+  SEXP s = Rf_allocVector(REALSXP, n);
+  for (int i = 0; i < n; ++i) REAL(s)[i] = v[i];
+  return s;
+}
+
+static SEXP strs(const char* const* v, int n) {
+  SEXP s = Rf_allocVector(STRSXP, n);
+  for (int i = 0; i < n; ++i) SET_STRING_ELT(s, i, Rf_mkChar(v[i]));
+  return s;
+}
+
+static SEXP list1(SEXP a) {
+  SEXP s = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(s, 0, a);
+  return s;
+}
+
+static SEXP list2(SEXP a, SEXP b) {
+  SEXP s = Rf_allocVector(VECSXP, 2);
+  SET_VECTOR_ELT(s, 0, a);
+  SET_VECTOR_ELT(s, 1, b);
+  return s;
+}
+
+// updater used in the KVStore trampoline test: local += recv via _plus
+static Call5 g_nd_invoke;
+static SEXP updater_closure(SEXP key, SEXP recv, SEXP local) {
+  (void)key;
+  const char* op = "_plus";
+  SEXP args = list2(local, recv);
+  SEXP empty = Rf_allocVector(STRSXP, 0);
+  g_nd_invoke(Rf_mkString(op), args, empty, empty, list1(local));
+  return R_NilValue;
+}
+
+int main() {
+  R_init_libmxnetr(nullptr);
+
+  Call3 nd_create = (Call3)find("MXR_nd_create");
+  Call4 nd_from = (Call4)find("MXR_nd_from_array");
+  Call1 nd_to = (Call1)find("MXR_nd_to_array");
+  Call1 nd_dim = (Call1)find("MXR_nd_dim");
+  g_nd_invoke = (Call5)find("MXR_nd_invoke");
+  Call3 nd_save = (Call3)find("MXR_nd_save");
+  Call1 nd_load = (Call1)find("MXR_nd_load");
+
+  SEXP cpu = Rf_ScalarInteger(1);
+  SEXP dev0 = Rf_ScalarInteger(0);
+
+  // --- NDArray round trip + layout contract ----------------------------
+  // R dim c(2,3) column-major <-> NDArray (3,2) row-major, buffer verbatim
+  double xv[6] = {1, 2, 3, 4, 5, 6};
+  int xdim[2] = {2, 3};
+  SEXP x = nd_from(reals(xv, 6), ints(xdim, 2), cpu, dev0);
+  SEXP back = nd_to(x);
+  ASSERT(Rf_xlength(back) == 6);
+  for (int i = 0; i < 6; ++i) ASSERT(REAL(back)[i] == xv[i]);
+  SEXP bdim = Rf_getAttrib(back, R_DimSymbol);
+  ASSERT(INTEGER(bdim)[0] == 2 && INTEGER(bdim)[1] == 3);
+  SEXP d = nd_dim(x);
+  ASSERT(Rf_xlength(d) == 2 && INTEGER(d)[0] == 2 && INTEGER(d)[1] == 3);
+
+  // --- imperative invoke: y = x + x ------------------------------------
+  SEXP empty = Rf_allocVector(STRSXP, 0);
+  SEXP sum = g_nd_invoke(Rf_mkString("_plus"), list2(x, x), empty, empty,
+                         R_NilValue);
+  SEXP sumv = nd_to(VECTOR_ELT(sum, 0));
+  for (int i = 0; i < 6; ++i) ASSERT(REAL(sumv)[i] == 2 * xv[i]);
+
+  // --- save / load ------------------------------------------------------
+  const char* fname = "/tmp/r_shim_test.params";
+  const char* key_w[1] = {"w"};
+  nd_save(Rf_mkString(fname), list1(x), strs(key_w, 1));
+  SEXP loaded = nd_load(Rf_mkString(fname));
+  ASSERT(Rf_xlength(loaded) == 1);
+  SEXP lv = nd_to(VECTOR_ELT(loaded, 0));
+  for (int i = 0; i < 6; ++i) ASSERT(REAL(lv)[i] == xv[i]);
+  remove(fname);
+
+  // --- symbol: data -> FullyConnected(num_hidden=4, no_bias) ----------
+  Call1 sym_var = (Call1)find("MXR_sym_variable");
+  Call6 sym_create = (Call6)find("MXR_sym_create");
+  Call1 sym_args = (Call1)find("MXR_sym_arguments");
+  Call1 sym_tojson = (Call1)find("MXR_sym_tojson");
+  Call4 sym_infer = (Call4)find("MXR_sym_infer_shape");
+
+  SEXP data = sym_var(Rf_mkString("data"));
+  const char* pk[2] = {"num_hidden", "no_bias"};
+  const char* pv[2] = {"4", "True"};
+  const char* ak[1] = {"data"};
+  SEXP fc = sym_create(Rf_mkString("FullyConnected"), strs(pk, 2),
+                       strs(pv, 2), Rf_mkString("fc1"), strs(ak, 1),
+                       list1(data));
+  SEXP args = sym_args(fc);
+  ASSERT(Rf_xlength(args) == 2);  // data, fc1_weight
+  ASSERT(strcmp(CHAR(STRING_ELT(args, 0)), "data") == 0);
+  ASSERT(strcmp(CHAR(STRING_ELT(args, 1)), "fc1_weight") == 0);
+
+  // infer shape with data = R dim c(3, 2): batch 2, feature 3
+  const char* ikeys[1] = {"data"};
+  int ind[2] = {0, 2};
+  int sdata[2] = {2, 3};  // NDArray order (batch, feature)
+  SEXP inferred = sym_infer(fc, strs(ikeys, 1), ints(ind, 2),
+                            ints(sdata, 2));
+  ASSERT(Rf_xlength(inferred) == 4);
+  SEXP argshapes = VECTOR_ELT(inferred, 0);
+  // fc1_weight NDArray shape (4,3) -> R dim c(3,4)
+  SEXP wdim = VECTOR_ELT(argshapes, 1);
+  ASSERT(INTEGER(wdim)[0] == 3 && INTEGER(wdim)[1] == 4);
+
+  // --- positional compose (the Ops.MXSymbol arithmetic path) -----------
+  SEXP bvar = sym_var(Rf_mkString("b"));
+  SEXP empty_s = Rf_allocVector(STRSXP, 0);
+  SEXP plus = sym_create(Rf_mkString("_plus"), empty_s, empty_s, R_NilValue,
+                         empty_s, list2(data, bvar));
+  SEXP pargs = sym_args(plus);
+  ASSERT(Rf_xlength(pargs) == 2);
+  ASSERT(strcmp(CHAR(STRING_ELT(pargs, 0)), "data") == 0);
+  ASSERT(strcmp(CHAR(STRING_ELT(pargs, 1)), "b") == 0);
+
+  // --- executor: bind + forward + backward -----------------------------
+  Call7 exec_bind = (Call7)find("MXR_exec_bind");
+  Call2 exec_fwd = (Call2)find("MXR_exec_forward");
+  Call2 exec_bwd = (Call2)find("MXR_exec_backward");
+  Call1 exec_outs = (Call1)find("MXR_exec_outputs");
+
+  // data: R dim c(3,2) = NDArray (2,3); weight: R dim c(3,4) = ND (4,3)
+  double dv[6] = {1, 0, 0, 0, 1, 0};  // rows of ND (2,3)
+  int ddim[2] = {3, 2};
+  double wv[12];
+  for (int i = 0; i < 12; ++i) wv[i] = i + 1;  // ND (4,3) row-major
+  int wdim2[2] = {3, 4};
+  SEXP dnd = nd_from(reals(dv, 6), ints(ddim, 2), cpu, dev0);
+  SEXP wnd = nd_from(reals(wv, 12), ints(wdim2, 2), cpu, dev0);
+  SEXP dgrad = nd_create(ints(ddim, 2), cpu, dev0);
+  SEXP wgrad = nd_create(ints(wdim2, 2), cpu, dev0);
+  int reqs[2] = {1, 1};
+  SEXP exec = exec_bind(fc, cpu, dev0, list2(dnd, wnd),
+                        list2(dgrad, wgrad), ints(reqs, 2),
+                        Rf_allocVector(VECSXP, 0));
+  exec_fwd(exec, Rf_ScalarInteger(1));
+  SEXP outs = exec_outs(exec);
+  ASSERT(Rf_xlength(outs) == 1);
+  SEXP o = nd_to(VECTOR_ELT(outs, 0));
+  // out[b,h] = sum_f data[b,f] * w[h,f]; data row0 = e0, row1 = e1
+  // ND out (2,4) row-major: row0 = w[:,0] = {1,4,7,10}, row1 = w[:,1]
+  ASSERT(std::fabs(REAL(o)[0] - 1) < 1e-5 &&
+         std::fabs(REAL(o)[1] - 4) < 1e-5);
+  ASSERT(std::fabs(REAL(o)[4] - 2) < 1e-5 &&
+         std::fabs(REAL(o)[5] - 5) < 1e-5);
+  exec_bwd(exec, Rf_allocVector(VECSXP, 0));
+  SEXP wg = nd_to(wgrad);
+  // all-ones head grad: dW[h,f] = sum_b data[b,f] = {1,1,0} each row
+  ASSERT(std::fabs(REAL(wg)[0] - 1) < 1e-5 &&
+         std::fabs(REAL(wg)[2] - 0) < 1e-5);
+
+  // --- predictor --------------------------------------------------------
+  Call7 pred_create = (Call7)find("MXR_pred_create");
+  Call3 pred_set = (Call3)find("MXR_pred_set_input");
+  Call1 pred_fwd = (Call1)find("MXR_pred_forward");
+  Call2 pred_out = (Call2)find("MXR_pred_get_output");
+
+  SEXP json = sym_tojson(fc);
+  // weights serialized as arg:fc1_weight
+  const char* key_aw[1] = {"arg:fc1_weight"};
+  nd_save(Rf_mkString("/tmp/r_shim_pred.params"), list1(wnd),
+          strs(key_aw, 1));
+  FILE* f = fopen("/tmp/r_shim_pred.params", "rb");
+  ASSERT(f != nullptr);
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  SEXP blob = Rf_allocVector(RAWSXP, fsize);
+  ASSERT(fread(RAW(blob), 1, fsize, f) == (size_t)fsize);
+  fclose(f);
+  remove("/tmp/r_shim_pred.params");
+
+  SEXP pred = pred_create(json, blob, cpu, dev0, strs(ikeys, 1),
+                          ints(ind, 2), ints(sdata, 2));
+  pred_set(pred, Rf_mkString("data"), reals(dv, 6));
+  pred_fwd(pred);
+  SEXP po = pred_out(pred, Rf_ScalarInteger(0));
+  ASSERT(std::fabs(REAL(po)[0] - 1) < 1e-5 &&
+         std::fabs(REAL(po)[1] - 4) < 1e-5);
+  SEXP podim = Rf_getAttrib(po, R_DimSymbol);
+  ASSERT(INTEGER(podim)[0] == 4 && INTEGER(podim)[1] == 2);  // R order
+
+  // --- CSVIter ----------------------------------------------------------
+  Call0 list_iters = (Call0)find("MXR_list_data_iters");
+  Call3 iter_create = (Call3)find("MXR_iter_create");
+  Call1 iter_next = (Call1)find("MXR_iter_next");
+  Call1 iter_data = (Call1)find("MXR_iter_data");
+
+  SEXP iters = list_iters();
+  bool has_csv = false;
+  for (R_xlen_t i = 0; i < Rf_xlength(iters); ++i) {
+    if (strcmp(CHAR(STRING_ELT(iters, i)), "CSVIter") == 0) has_csv = true;
+  }
+  ASSERT(has_csv);
+  FILE* csv = fopen("/tmp/r_shim_test.csv", "w");
+  fprintf(csv, "1,2,3\n4,5,6\n7,8,9\n10,11,12\n");
+  fclose(csv);
+  const char* ck[3] = {"data_csv", "data_shape", "batch_size"};
+  const char* cv[3] = {"/tmp/r_shim_test.csv", "(3,)", "2"};
+  SEXP citer = iter_create(Rf_mkString("CSVIter"), strs(ck, 3),
+                           strs(cv, 3));
+  ASSERT(Rf_asInteger(iter_next(citer)) == 1);
+  SEXP cb = nd_to(iter_data(citer));
+  ASSERT(Rf_xlength(cb) == 6);
+  ASSERT(REAL(cb)[0] == 1 && REAL(cb)[3] == 4);
+  remove("/tmp/r_shim_test.csv");
+
+  // --- KVStore + R-closure updater through the trampoline --------------
+  Call1 kv_create = (Call1)find("MXR_kv_create");
+  Call3 kv_init = (Call3)find("MXR_kv_init");
+  Call4 kv_push = (Call4)find("MXR_kv_push");
+  Call4 kv_pull = (Call4)find("MXR_kv_pull");
+  Call3 kv_setup = (Call3)find("MXR_kv_set_updater");
+
+  SEXP kv = kv_create(Rf_mkString("local"));
+  int k0[1] = {0};
+  double init_v[4] = {1, 1, 1, 1};
+  int vdim[1] = {4};
+  SEXP v0 = nd_from(reals(init_v, 4), ints(vdim, 1), cpu, dev0);
+  kv_init(kv, ints(k0, 1), list1(v0));
+  kv_setup(kv, r_stub_make_closure(updater_closure), R_GlobalEnv);
+  double g1[4] = {2, 3, 4, 5};
+  SEXP gnd = nd_from(reals(g1, 4), ints(vdim, 1), cpu, dev0);
+  kv_push(kv, ints(k0, 1), list1(gnd), Rf_ScalarInteger(0));
+  SEXP pulled = nd_create(ints(vdim, 1), cpu, dev0);
+  kv_pull(kv, ints(k0, 1), list1(pulled), Rf_ScalarInteger(0));
+  SEXP pv2 = nd_to(pulled);
+  // updater: local += recv -> {3,4,5,6}
+  for (int i = 0; i < 4; ++i) ASSERT(std::fabs(REAL(pv2)[i] -
+                                               (init_v[i] + g1[i])) < 1e-5);
+
+  printf("R_SHIM_TEST_PASS\n");
+  return 0;
+}

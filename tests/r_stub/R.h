@@ -1,0 +1,5 @@
+/* Test-double of R.h — see Rinternals.h in this directory. */
+#ifndef R_STUB_R_H_
+#define R_STUB_R_H_
+#include "Rinternals.h"
+#endif

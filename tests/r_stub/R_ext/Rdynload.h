@@ -1,0 +1,35 @@
+/* Test-double of R_ext/Rdynload.h — records the .Call registration table
+ * so the harness can look entry points up by name (r_stub.cc). */
+#ifndef R_STUB_RDYNLOAD_H_
+#define R_STUB_RDYNLOAD_H_
+
+#include "../Rinternals.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* (*DL_FUNC)();
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+
+typedef struct _DllInfo DllInfo;
+typedef R_CallMethodDef R_CMethodDef; /* unused by the shim */
+
+int R_registerRoutines(DllInfo* info, const void* croutines,
+                       const R_CallMethodDef* callRoutines,
+                       const void* fortranRoutines,
+                       const void* externalRoutines);
+int R_useDynamicSymbols(DllInfo* info, int value);
+
+/* harness-side: fetch a registered .Call routine by name (stub-only) */
+DL_FUNC r_stub_find_call(const char* name);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* R_STUB_RDYNLOAD_H_ */

@@ -1,0 +1,108 @@
+/* Minimal R C API test double — tests/r_stub.
+ *
+ * Lets R-package/src/mxnet_r.cc compile and run WITHOUT an R
+ * installation, so the .Call shim can be linked against the real
+ * libmxnet_tpu.so and driven end to end from a C++ harness
+ * (tests/cpp/test_r_shim.cc). Only the subset of the R API the shim
+ * uses is declared; semantics implemented in r_stub.cc. SEXPs are
+ * heap-allocated tagged cells, reference-managed crudely (never freed —
+ * fine for a short test process).
+ *
+ * This header deliberately mirrors the REAL R API names and signatures
+ * (R >= 3.2), so the same shim source builds unmodified under real R.
+ */
+#ifndef R_STUB_RINTERNALS_H_
+#define R_STUB_RINTERNALS_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct SEXPREC* SEXP;
+typedef ptrdiff_t R_xlen_t;
+
+/* type codes (values match real Rinternals.h) */
+#define NILSXP 0
+#define LGLSXP 10
+#define INTSXP 13
+#define REALSXP 14
+#define STRSXP 16
+#define VECSXP 19
+#define EXTPTRSXP 22
+#define RAWSXP 24
+#define CHARSXP 9
+#define CLOSXP 3
+#define ENVSXP 4
+#define LANGSXP 6
+
+extern SEXP R_NilValue;
+extern SEXP R_GlobalEnv;
+extern SEXP R_DimSymbol;
+extern SEXP R_NamesSymbol;
+
+int TYPEOF(SEXP x);
+R_xlen_t Rf_xlength(SEXP x);
+int Rf_length(SEXP x);
+
+SEXP Rf_allocVector(unsigned int type, R_xlen_t n);
+SEXP Rf_protect(SEXP x);
+void Rf_unprotect(int n);
+
+double* REAL(SEXP x);
+int* INTEGER(SEXP x);
+int* LOGICAL(SEXP x);
+unsigned char* RAW(SEXP x);
+
+SEXP Rf_mkChar(const char* s);
+SEXP Rf_mkString(const char* s);
+const char* CHAR(SEXP charsxp);
+SEXP STRING_ELT(SEXP strsxp, R_xlen_t i);
+void SET_STRING_ELT(SEXP strsxp, R_xlen_t i, SEXP charsxp);
+SEXP VECTOR_ELT(SEXP vecsxp, R_xlen_t i);
+SEXP SET_VECTOR_ELT(SEXP vecsxp, R_xlen_t i, SEXP v);
+
+SEXP Rf_ScalarInteger(int v);
+SEXP Rf_ScalarReal(double v);
+SEXP Rf_ScalarLogical(int v);
+SEXP Rf_ScalarString(SEXP charsxp);
+
+int Rf_asInteger(SEXP x);
+double Rf_asReal(SEXP x);
+
+SEXP Rf_install(const char* name);
+void Rf_setAttrib(SEXP x, SEXP sym, SEXP val);
+SEXP Rf_getAttrib(SEXP x, SEXP sym);
+
+SEXP R_MakeExternalPtr(void* p, SEXP tag, SEXP prot);
+void* R_ExternalPtrAddr(SEXP ptr);
+void R_ClearExternalPtr(SEXP ptr);
+typedef void (*R_CFinalizer_t)(SEXP);
+void R_RegisterCFinalizerEx(SEXP ptr, R_CFinalizer_t fin, int onexit);
+
+void R_PreserveObject(SEXP x);
+void R_ReleaseObject(SEXP x);
+
+SEXP Rf_lang4(SEXP fn, SEXP a1, SEXP a2, SEXP a3);
+SEXP R_tryEval(SEXP call, SEXP env, int* err);
+
+void Rf_error(const char* fmt, ...)
+#ifdef __GNUC__
+    __attribute__((noreturn))
+#endif
+    ;
+
+/* Rboolean for R_RegisterCFinalizerEx's onexit param is int here */
+#define TRUE 1
+#define FALSE 0
+
+/* PROTECT macros as used by package code */
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* R_STUB_RINTERNALS_H_ */

@@ -1,0 +1,241 @@
+// Implementation of the minimal R runtime test double (see Rinternals.h
+// in this directory). Enough semantics to host R-package/src/mxnet_r.cc:
+// tagged heap cells, attribute map, extptr finalizers, a .Call
+// registration table, and a one-trick evaluator (stub closures wrap C
+// function pointers) for callback paths like the KVStore updater.
+//
+// Memory: cells are never freed — the harness is a short-lived test
+// process and leak-freedom is not what it verifies.
+#include "Rinternals.h"
+#include "R_ext/Rdynload.h"
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+struct SEXPREC {
+  int type = NILSXP;
+  std::vector<double> reals;
+  std::vector<int> ints;          // INTSXP / LGLSXP
+  std::vector<unsigned char> raws;
+  std::string chars;              // CHARSXP payload
+  std::vector<SEXP> vec;          // VECSXP / STRSXP / LANGSXP elements
+  void* extptr = nullptr;
+  R_CFinalizer_t fin = nullptr;
+  std::map<std::string, SEXP> attrs;
+  SEXP (*cfun)(SEXP, SEXP, SEXP) = nullptr;  // stub closure payload
+};
+
+static SEXPREC g_nil{NILSXP};
+SEXP R_NilValue = &g_nil;
+static SEXPREC g_env{ENVSXP};
+SEXP R_GlobalEnv = &g_env;
+static SEXPREC g_dim_sym{CHARSXP};
+static SEXPREC g_names_sym{CHARSXP};
+SEXP R_DimSymbol = &g_dim_sym;
+SEXP R_NamesSymbol = &g_names_sym;
+
+namespace {
+SEXP new_cell(int type) {
+  SEXP s = new SEXPREC();
+  s->type = type;
+  return s;
+}
+struct SymbolInit {
+  SymbolInit() {
+    g_dim_sym.chars = "dim";
+    g_names_sym.chars = "names";
+  }
+} g_symbol_init;
+}  // namespace
+
+extern "C" {
+
+int TYPEOF(SEXP x) { return x->type; }
+
+R_xlen_t Rf_xlength(SEXP x) {
+  switch (x->type) {
+    case NILSXP: return 0;
+    case REALSXP: return (R_xlen_t)x->reals.size();
+    case INTSXP:
+    case LGLSXP: return (R_xlen_t)x->ints.size();
+    case RAWSXP: return (R_xlen_t)x->raws.size();
+    case STRSXP:
+    case VECSXP:
+    case LANGSXP: return (R_xlen_t)x->vec.size();
+    case CHARSXP: return (R_xlen_t)x->chars.size();
+    default: return 1;
+  }
+}
+
+int Rf_length(SEXP x) { return (int)Rf_xlength(x); }
+
+SEXP Rf_allocVector(unsigned int type, R_xlen_t n) {
+  SEXP s = new_cell((int)type);
+  switch (type) {
+    case REALSXP: s->reals.resize(n, 0.0); break;
+    case INTSXP:
+    case LGLSXP: s->ints.resize(n, 0); break;
+    case RAWSXP: s->raws.resize(n, 0); break;
+    case STRSXP:
+    case VECSXP:
+    case LANGSXP: s->vec.resize(n, R_NilValue); break;
+    default: break;
+  }
+  return s;
+}
+
+SEXP Rf_protect(SEXP x) { return x; }
+void Rf_unprotect(int) {}
+
+double* REAL(SEXP x) { return x->reals.data(); }
+int* INTEGER(SEXP x) { return x->ints.data(); }
+int* LOGICAL(SEXP x) { return x->ints.data(); }
+unsigned char* RAW(SEXP x) { return x->raws.data(); }
+
+SEXP Rf_mkChar(const char* s) {
+  SEXP c = new_cell(CHARSXP);
+  c->chars = s;
+  return c;
+}
+
+SEXP Rf_mkString(const char* s) {
+  SEXP v = Rf_allocVector(STRSXP, 1);
+  v->vec[0] = Rf_mkChar(s);
+  return v;
+}
+
+const char* CHAR(SEXP c) { return c->chars.c_str(); }
+SEXP STRING_ELT(SEXP s, R_xlen_t i) { return s->vec[i]; }
+void SET_STRING_ELT(SEXP s, R_xlen_t i, SEXP c) { s->vec[i] = c; }
+SEXP VECTOR_ELT(SEXP v, R_xlen_t i) { return v->vec[i]; }
+SEXP SET_VECTOR_ELT(SEXP v, R_xlen_t i, SEXP e) {
+  v->vec[i] = e;
+  return e;
+}
+
+SEXP Rf_ScalarInteger(int v) {
+  SEXP s = Rf_allocVector(INTSXP, 1);
+  s->ints[0] = v;
+  return s;
+}
+
+SEXP Rf_ScalarReal(double v) {
+  SEXP s = Rf_allocVector(REALSXP, 1);
+  s->reals[0] = v;
+  return s;
+}
+
+SEXP Rf_ScalarLogical(int v) {
+  SEXP s = Rf_allocVector(LGLSXP, 1);
+  s->ints[0] = v;
+  return s;
+}
+
+SEXP Rf_ScalarString(SEXP c) {
+  SEXP v = Rf_allocVector(STRSXP, 1);
+  v->vec[0] = c;
+  return v;
+}
+
+int Rf_asInteger(SEXP x) {
+  if (x->type == INTSXP || x->type == LGLSXP) return x->ints[0];
+  if (x->type == REALSXP) return (int)x->reals[0];
+  throw std::runtime_error("asInteger on non-numeric");
+}
+
+double Rf_asReal(SEXP x) {
+  if (x->type == REALSXP) return x->reals[0];
+  if (x->type == INTSXP) return (double)x->ints[0];
+  throw std::runtime_error("asReal on non-numeric");
+}
+
+SEXP Rf_install(const char* name) { return Rf_mkChar(name); }
+
+void Rf_setAttrib(SEXP x, SEXP sym, SEXP val) {
+  x->attrs[sym->chars] = val;
+}
+
+SEXP Rf_getAttrib(SEXP x, SEXP sym) {
+  auto it = x->attrs.find(sym->chars);
+  return it == x->attrs.end() ? R_NilValue : it->second;
+}
+
+SEXP R_MakeExternalPtr(void* p, SEXP, SEXP) {
+  SEXP s = new_cell(EXTPTRSXP);
+  s->extptr = p;
+  return s;
+}
+
+void* R_ExternalPtrAddr(SEXP ptr) { return ptr->extptr; }
+void R_ClearExternalPtr(SEXP ptr) { ptr->extptr = nullptr; }
+
+void R_RegisterCFinalizerEx(SEXP ptr, R_CFinalizer_t fin, int) {
+  ptr->fin = fin;  // stub never GCs; harness may run fins explicitly
+}
+
+void R_PreserveObject(SEXP) {}
+void R_ReleaseObject(SEXP) {}
+
+SEXP Rf_lang4(SEXP fn, SEXP a1, SEXP a2, SEXP a3) {
+  SEXP s = Rf_allocVector(LANGSXP, 4);
+  s->vec[0] = fn;
+  s->vec[1] = a1;
+  s->vec[2] = a2;
+  s->vec[3] = a3;
+  return s;
+}
+
+SEXP R_tryEval(SEXP call, SEXP, int* err) {
+  if (err) *err = 0;
+  SEXP fn = call->vec[0];
+  if (fn->type == CLOSXP && fn->cfun != nullptr) {
+    return fn->cfun(call->vec[1], call->vec[2], call->vec[3]);
+  }
+  if (err) *err = 1;
+  return R_NilValue;
+}
+
+void Rf_error(const char* fmt, ...) {
+  char buf[2048];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  throw std::runtime_error(std::string("Rf_error: ") + buf);
+}
+
+// ------------------------------------------------- registration machinery
+namespace {
+std::map<std::string, DL_FUNC> g_call_table;
+}
+
+int R_registerRoutines(DllInfo*, const void*,
+                       const R_CallMethodDef* callRoutines, const void*,
+                       const void*) {
+  for (const R_CallMethodDef* d = callRoutines; d->name != nullptr; ++d) {
+    g_call_table[d->name] = d->fun;
+  }
+  return 0;
+}
+
+int R_useDynamicSymbols(DllInfo*, int) { return 0; }
+
+DL_FUNC r_stub_find_call(const char* name) {
+  auto it = g_call_table.find(name);
+  return it == g_call_table.end() ? nullptr : it->second;
+}
+
+// harness helper: make a stub closure from a C function (Rdynload.h has
+// the declaration on the harness side via extern)
+SEXP r_stub_make_closure(SEXP (*fn)(SEXP, SEXP, SEXP)) {
+  SEXP s = new_cell(CLOSXP);
+  s->cfun = fn;
+  return s;
+}
+
+}  // extern "C"

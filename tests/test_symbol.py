@@ -176,3 +176,55 @@ def test_print_summary_param_counts(capsys):
     # classic LeNet (conv20/conv50/fc500/fc10) parameter count
     assert "Total params: 431,080" in out
     assert "conv1(Convolution)" in out and "(1, 20, 24, 24)" in out
+
+
+def test_compose_name_and_argname_semantics():
+    """nnvm Symbol::Compose parity (nnvm/src/core/symbolic.cc): atomic
+    heads match kwargs against op ARGUMENT names and a compose-time name
+    flows into auto-generated param names; composite heads match variable
+    names; user-chosen variable names are never renamed."""
+    from mxnet_tpu import capi_bridge as cb
+
+    # compose-time name renames auto placeholders (the C-ABI frontend flow)
+    s = cb.symbol_create_atomic("FullyConnected",
+                                ["num_hidden", "no_bias"], ["4", "True"])
+    cb.symbol_compose(s, "fc1", ["data"], [sym.Variable("data")])
+    assert s.list_arguments() == ["data", "fc1_weight"]
+
+    # multi-output atomic heads (all heads = one node) compose the same way
+    m = cb.symbol_create_atomic("SliceChannel", ["num_outputs"], ["2"])
+    cb.symbol_compose(m, "split1", ["data"], [sym.Variable("x")])
+    assert m.list_arguments() == ["x"]
+    assert m.list_outputs() == ["split1_output0", "split1_output1"]
+
+    # python-frontend late compose: argument-name kwargs + rename
+    fc = sym.FullyConnected(num_hidden=8)
+    net = fc(data=sym.Variable("d"), name="fcA")
+    assert net.list_arguments() == ["d", "fcA_weight", "fcA_bias"]
+
+    # a user variable that happens to share the auto prefix is untouched
+    v = sym.Variable("fullyconnected1_x")
+    fc2 = sym.FullyConnected(num_hidden=8)
+    old = fc2.name
+    net2 = fc2(data=v, name="fcB")
+    args = net2.list_arguments()
+    assert "fullyconnected1_x" in args or v.name in args
+    assert "fcB_weight" in args
+
+    # composite head: kwargs match variable names, incl. one that shadows
+    # an op argument name ('weight')
+    w = sym.Variable("weight")
+    g1 = sym.FullyConnected(data=sym.Variable("x2"), weight=w,
+                            num_hidden=4, no_bias=True, name="g1")
+    g2 = sym.FullyConnected(data=g1, num_hidden=2, no_bias=True)
+    g3 = g2(weight=sym.Variable("w2"))
+    assert "w2" in g3.list_arguments()
+    assert "weight" not in g3.list_arguments()
+
+    # positional compose binds list_arguments order, which excludes aux
+    bn = sym.BatchNorm(name="bn")
+    bound = bn(sym.Variable("din"), sym.Variable("g"), sym.Variable("b"))
+    args = bound.list_arguments()
+    assert args[:3] == ["din", "g", "b"]
+    assert set(bound.list_auxiliary_states()) == {"bn_moving_mean",
+                                                  "bn_moving_var"}
