@@ -36,6 +36,31 @@ mx.internal.as.param <- function(v) {
   as.character(v)
 }
 
+# Subscript an array along its LAST dim (observations axis in R layout),
+# keeping all other dims: x[, ..., idx, drop = FALSE].
+mx.internal.slice.last <- function(x, idx) {
+  d <- dim(x)
+  if (is.null(d)) return(x[idx])
+  do.call(`[`, c(list(x), rep(list(quote(expr = )), length(d) - 1),
+                 list(idx), list(drop = FALSE)))
+}
+
+# Assign into an array along its LAST dim: x[, ..., idx] <- value.
+mx.internal.assign.last <- function(x, idx, value) {
+  d <- dim(x)
+  do.call(`[<-`, c(list(x), rep(list(quote(expr = )), length(d) - 1),
+                   list(idx), list(value)))
+}
+
+# Concatenate two arrays along their LAST dim. Column-major layout makes
+# this plain c(a, b) with an adjusted dim.
+mx.internal.bind.last <- function(a, b) {
+  if (is.null(a)) return(b)
+  da <- dim(a)
+  db <- dim(b)
+  array(c(a, b), c(da[-length(da)], da[length(da)] + db[length(db)]))
+}
+
 mx.internal.ndarray.ptr <- function(nd) {
   if (!inherits(nd, "MXNDArray")) stop("expected an MXNDArray")
   attr(nd, "ptr")

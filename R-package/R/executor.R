@@ -116,9 +116,10 @@ mx.exec.update.arg.arrays <- function(exec, arg.arrays,
       stop("unknown argument: ", nm)
     }
     src <- arg.arrays[[nm]]
-    if (inherits(src, "MXNDArray")) src <- as.array(src)
-    tmp <- mx.nd.array(src, exec$ctx)
-    mx.nd.internal.invoke("_copy", list(tmp), list(), out = list(dst))
+    # device NDArrays copy engine-to-engine; host arrays stage through
+    # one upload. Either way a single _copy lands in the bound buffer.
+    if (!inherits(src, "MXNDArray")) src <- mx.nd.array(src, exec$ctx)
+    mx.nd.internal.invoke("_copy", list(src), list(), out = list(dst))
   }
   invisible(exec)
 }

@@ -45,11 +45,13 @@ mx.init.Xavier <- function(rnd_type = "uniform", factor_type = "avg",
   function(name, shape) {
     sp <- .mx.init.special(name, shape)
     if (!is.null(sp)) return(sp)
-    # R dim order is reversed: fan.in spans all but the LAST R dim
-    # (= all but the first NDArray dim), fan.out the last R dim
+    # reference initializer.py Xavier on NDArray shape (out, in, k...):
+    # hw = prod(k...), fan_in = in*hw, fan_out = out*hw. R dims are
+    # reversed, so out = last R dim, in = next, k... = leading R dims.
     n <- length(shape)
-    fan.out <- shape[n]
-    fan.in <- prod(shape[-n])
+    hw <- if (n > 2) prod(shape[seq_len(n - 2)]) else 1
+    fan.out <- shape[n] * hw
+    fan.in <- if (n > 1) shape[n - 1] * hw else shape[n]
     factor <- switch(factor_type,
                      "avg" = (fan.in + fan.out) / 2,
                      "in" = fan.in,

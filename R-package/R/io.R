@@ -88,21 +88,16 @@ mx.io.next <- function(iter) {
 #' @export
 mx.io.value <- function(iter) {
   if (isTRUE(attr(iter, "native"))) {
+    d <- .Call(MXR_iter_data, attr(iter, "ptr"))
+    l <- .Call(MXR_iter_label, attr(iter, "ptr"))
     return(list(
-      data = mx.internal.new.ndarray(.Call(MXR_iter_data,
-                                           attr(iter, "ptr"))),
-      label = mx.internal.new.ndarray(.Call(MXR_iter_label,
-                                            attr(iter, "ptr")))))
+      data = if (is.null(d)) NULL else mx.internal.new.ndarray(d),
+      label = if (is.null(l)) NULL else mx.internal.new.ndarray(l)))
   }
   env <- attr(iter, "env")
   lo <- env$cursor - env$batch.size + 1L
   idx <- env$order[(((lo:env$cursor) - 1L) %% env$n) + 1L]  # wrap pad
-  d <- dim(env$data)
-  slice <- if (is.null(d)) env$data[idx] else {
-    do.call(`[`, c(list(env$data), rep(list(quote(expr = )),
-                                       length(d) - 1), list(idx),
-                   list(drop = FALSE)))
-  }
+  slice <- mx.internal.slice.last(env$data, idx)
   list(data = mx.nd.array(slice), label = mx.nd.array(env$label[idx]))
 }
 
@@ -128,16 +123,9 @@ mx.io.extract <- function(iter, field = "label") {
     d <- dim(arr)
     keep <- d[length(d)] - pad
     if (keep < d[length(d)]) {
-      arr <- do.call(`[`, c(list(arr), rep(list(quote(expr = )),
-                                           length(d) - 1),
-                            list(seq_len(keep)), list(drop = FALSE)))
+      arr <- mx.internal.slice.last(arr, seq_len(keep))
     }
-    out <- if (is.null(out)) arr else {
-      da <- dim(out)
-      db <- dim(arr)
-      array(c(out, arr), c(da[-length(da)],
-                           da[length(da)] + db[length(db)]))
-    }
+    out <- mx.internal.bind.last(out, arr)
   }
   mx.io.reset(iter)
   out

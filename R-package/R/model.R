@@ -184,38 +184,19 @@ predict.MXFeedForwardModel <- function(object, X, ctx = NULL,
   done <- 0
   while (done < n) {
     take <- min(bs, n - done)
-    idx <- seq(done + 1, done + take)
-    slice <- if (length(data.dim) == 1) X[idx] else {
-      do.call(`[`, c(list(X), rep(list(quote(expr = )),
-                                  length(data.dim) - 1), list(idx),
-                     list(drop = FALSE)))
-    }
+    slice <- mx.internal.slice.last(X, seq(done + 1, done + take))
     if (take < bs) {  # zero-pad the tail batch up to the bound size
-      padded <- array(0, batch.dim)
-      pidx <- seq_len(take)
-      padded <- do.call(`[<-`, c(list(padded),
-                                 rep(list(quote(expr = )),
-                                     length(batch.dim) - 1),
-                                 list(pidx), list(slice)))
-      slice <- padded
+      slice <- mx.internal.assign.last(array(0, batch.dim),
+                                       seq_len(take), slice)
     }
     mx.exec.update.arg.arrays(
       exec, stats::setNames(list(slice), data.name))
     mx.exec.forward(exec, is.train = FALSE)
     out <- as.array(mx.exec.outputs(exec)[[1]])
     if (take < bs) {  # drop pad rows from the output
-      od <- dim(out)
-      out <- do.call(`[`, c(list(out), rep(list(quote(expr = )),
-                                           length(od) - 1),
-                            list(seq_len(take)), list(drop = FALSE)))
+      out <- mx.internal.slice.last(out, seq_len(take))
     }
-    # column-major: concatenation along the LAST R dim is plain c(a, b)
-    outs <- if (is.null(outs)) out else {
-      da <- dim(outs)
-      db <- dim(out)
-      array(c(outs, out), c(da[-length(da)],
-                            da[length(da)] + db[length(db)]))
-    }
+    outs <- mx.internal.bind.last(outs, out)
     done <- done + take
   }
   outs

@@ -65,10 +65,16 @@ mx.symbol.Flatten <- function(...) mx.symbol.create("Flatten", ...)
 mx.symbol.Dropout <- function(...) mx.symbol.create("Dropout", ...)
 #' @export
 mx.symbol.Concat <- function(...) {
-  # Concat takes a variable number of inputs: num_args is mandatory
+  # Concat takes a variable number of inputs: num_args is mandatory and
+  # must match the symbol count (set/normalized here; a user-supplied
+  # dotted num.args is translated to the real attr name)
   args <- list(...)
+  if ("num.args" %in% names(args)) {
+    args$num_args <- args$num.args
+    args$num.args <- NULL
+  }
   syms <- args[sapply(args, inherits, what = "MXSymbol")]
-  if (!("num.args" %in% names(args) || "num_args" %in% names(args))) {
+  if (!("num_args" %in% names(args))) {
     args$num_args <- length(syms)
   }
   do.call(mx.symbol.create, c(list(op = "Concat"), args))

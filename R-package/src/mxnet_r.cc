@@ -590,14 +590,16 @@ SEXP MXR_iter_reset(SEXP ptr) {
 }
 
 SEXP MXR_iter_data(SEXP ptr) {
-  NDArrayHandle h;
+  NDArrayHandle h = nullptr;
   chk(MXDataIterGetData(unwrap(ptr), &h));
+  if (h == nullptr) return R_NilValue;
   return wrap_handle(h, nd_fin);
 }
 
 SEXP MXR_iter_label(SEXP ptr) {
-  NDArrayHandle h;
+  NDArrayHandle h = nullptr;
   chk(MXDataIterGetLabel(unwrap(ptr), &h));
+  if (h == nullptr) return R_NilValue;  // label-less batch
   return wrap_handle(h, nd_fin);
 }
 

@@ -149,7 +149,12 @@ class Symbol:
         repl = {}  # id(var node) -> (node, out_idx) replacement head
         # positional args bind in list_arguments order, which excludes aux
         # states (reference symbol.py __call__ / nnvm Symbol::Compose)
-        for var, s in zip([n for n in free_vars if not n.is_aux], args):
+        pos_vars = [n for n in free_vars if not n.is_aux]
+        if len(args) > len(pos_vars):
+            raise MXNetError(
+                "too many positional arguments: %d given, %d free variables"
+                % (len(args), len(pos_vars)))
+        for var, s in zip(pos_vars, args):
             repl[id(var)] = s._heads[0]
         by_name = {n.name: n for n in free_vars}
         for k, v in kwargs.items():

@@ -1,0 +1,42 @@
+package org.mxnettpu
+
+/** Library bootstrap + error handling (reference Base.scala). Loads
+  * libmxnetscala.so (the JNI shim, which links libmxnet_tpu.so); set
+  * MXNET_TPU_HOME or java.library.path accordingly.
+  */
+object Base {
+  private[mxnettpu] val _LIB = new LibInfo
+
+  try {
+    System.loadLibrary("mxnetscala")
+  } catch {
+    case _: UnsatisfiedLinkError =>
+      val home = sys.env.getOrElse("MXNET_TPU_HOME", ".")
+      System.load(
+        s"$home/scala-package/native/build/libmxnetscala.so")
+  }
+  _LIB.nativeLibInit()
+
+  class MXNetError(msg: String) extends Exception(msg)
+
+  /** Raise on nonzero return code with the native error text. */
+  def checkCall(ret: Int): Unit = {
+    if (ret != 0) throw new MXNetError(_LIB.mxGetLastError())
+  }
+
+  /** Raise when a handle-returning native gave back 0. */
+  def checkHandle(h: Long): Long = {
+    if (h == 0) throw new MXNetError(_LIB.mxGetLastError())
+    h
+  }
+
+  /** Raise when an array-returning native gave back null. */
+  def checkArray[T](a: T): T = {
+    if (a == null) throw new MXNetError(_LIB.mxGetLastError())
+    a
+  }
+
+  def setSeed(seed: Int): Unit = checkCall(_LIB.mxRandomSeed(seed))
+  def listAllOpNames(): IndexedSeq[String] =
+    checkArray(_LIB.mxListAllOpNames()).toIndexedSeq
+}

@@ -1,0 +1,21 @@
+package org.mxnettpu
+
+/** Device context (reference Context.scala). Type codes match
+  * include/mxnet_tpu/c_api.h: 1=cpu, 2=gpu (accelerator alias),
+  * 3=cpu_pinned, 4=tpu.
+  */
+case class Context(deviceTypeid: Int, deviceId: Int = 0) {
+  def deviceType: String = Context.devtype2str(deviceTypeid)
+  override def toString: String = s"$deviceType($deviceId)"
+}
+
+object Context {
+  private val devtype2str =
+    Map(1 -> "cpu", 2 -> "gpu", 3 -> "cpu_pinned", 4 -> "tpu")
+
+  def cpu(deviceId: Int = 0): Context = Context(1, deviceId)
+  def gpu(deviceId: Int = 0): Context = Context(2, deviceId)
+  def tpu(deviceId: Int = 0): Context = Context(4, deviceId)
+
+  var defaultCtx: Context = cpu()
+}

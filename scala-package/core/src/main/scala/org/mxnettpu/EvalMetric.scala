@@ -1,0 +1,42 @@
+package org.mxnettpu
+
+/** Streaming evaluation metrics (reference EvalMetric.scala). */
+abstract class EvalMetric(val name: String) {
+  protected var sumMetric: Double = 0.0
+  protected var numInst: Int = 0
+
+  def update(labels: Array[Float], preds: Array[Float],
+             numClasses: Int): Unit
+
+  def get: (String, Float) =
+    (name, if (numInst == 0) Float.NaN else (sumMetric / numInst).toFloat)
+
+  def reset(): Unit = { sumMetric = 0.0; numInst = 0 }
+}
+
+class Accuracy extends EvalMetric("accuracy") {
+  override def update(labels: Array[Float], preds: Array[Float],
+                      numClasses: Int): Unit = {
+    val batch = labels.length
+    for (b <- 0 until batch) {
+      var best = 0
+      for (c <- 1 until numClasses) {
+        if (preds(b * numClasses + c) > preds(b * numClasses + best))
+          best = c
+      }
+      if (best == labels(b).toInt) sumMetric += 1.0
+      numInst += 1
+    }
+  }
+}
+
+class MSE extends EvalMetric("mse") {
+  override def update(labels: Array[Float], preds: Array[Float],
+                      numClasses: Int): Unit = {
+    for (i <- labels.indices) {
+      val d = labels(i) - preds(i)
+      sumMetric += d * d
+      numInst += 1
+    }
+  }
+}

@@ -1,0 +1,42 @@
+package org.mxnettpu
+
+import Base._
+
+/** Bound executable graph (reference Executor.scala). Owns the bound
+  * argument/gradient/aux arrays; forward/backward push whole-graph XLA
+  * programs through the engine.
+  */
+class Executor private[mxnettpu] (
+    private[mxnettpu] val handle: Long, val symbol: Symbol,
+    val argArrays: IndexedSeq[NDArray],
+    val gradArrays: IndexedSeq[NDArray],
+    val auxArrays: IndexedSeq[NDArray]) extends AutoCloseable {
+  private var closed = false
+
+  lazy val argDict: Map[String, NDArray] =
+    symbol.listArguments().zip(argArrays).toMap
+  lazy val gradDict: Map[String, NDArray] =
+    symbol.listArguments().zip(gradArrays).filter(_._2 != null).toMap
+
+  def forward(isTrain: Boolean = false): this.type = {
+    checkCall(_LIB.mxExecutorForward(handle, if (isTrain) 1 else 0))
+    this
+  }
+
+  def backward(headGrads: Seq[NDArray] = Seq.empty): this.type = {
+    checkCall(_LIB.mxExecutorBackward(handle,
+                                      headGrads.map(_.handle).toArray))
+    this
+  }
+
+  def outputs: IndexedSeq[NDArray] =
+    checkArray(_LIB.mxExecutorOutputs(handle))
+      .map(new NDArray(_)).toIndexedSeq
+
+  override def close(): Unit = {
+    if (!closed) {
+      checkCall(_LIB.mxExecutorFree(handle))
+      closed = true
+    }
+  }
+}

@@ -1,0 +1,142 @@
+package org.mxnettpu
+
+import Base._
+
+/** Model trainer/predictor (reference FeedForward.scala, 685 LoC). Binds
+  * one executor on `ctx` and drives fit/predict; checkpoints use the
+  * two-file layout (<prefix>-symbol.json + <prefix>-NNNN.params) shared
+  * by every frontend.
+  */
+class FeedForward(val symbol: Symbol, val ctx: Context = Context.defaultCtx,
+                  var argParams: Map[String, NDArray] = Map.empty,
+                  var auxParams: Map[String, NDArray] = Map.empty) {
+
+  private def ioNames: (String, String) = {
+    val args = symbol.listArguments()
+    val data = args.filter(_.endsWith("data"))
+    val label = args.filter(_.endsWith("label"))
+    require(data.length == 1, "need exactly one *data argument")
+    (data.head, if (label.isEmpty) null else label.head)
+  }
+
+  def fit(iter: NDArrayIter, numEpoch: Int, optimizer: Optimizer,
+          initializer: Initializer = new Xavier(), metric: EvalMetric =
+            new Accuracy(), batchSize: Int, dataShape: Shape): this.type = {
+    val (dataName, labelName) = ioNames
+    require(labelName != null, "training needs a *_label loss input")
+    val inputShapes = Map(
+      dataName -> Shape((batchSize +: dataShape.dims.tail).toIndexedSeq),
+      labelName -> Shape(batchSize))
+    val (argShapes, outShapes, auxShapes) =
+      symbol.inferShape(inputShapes).getOrElse(
+        throw new MXNetError(
+          s"cannot infer shapes from inputs $inputShapes"))
+    val argNames = symbol.listArguments()
+
+    // init params (keep user-provided ones)
+    val args = argNames.zip(argShapes).map { case (n, s) =>
+      if (inputShapes.contains(n)) NDArray.zeros(s, ctx)
+      else argParams.getOrElse(n, NDArray.array(initializer(n, s), s, ctx))
+    }
+    val aux = symbol.listAuxiliaryStates().zip(auxShapes).map {
+      case (n, s) =>
+        auxParams.getOrElse(n, NDArray.array(initializer(n, s), s, ctx))
+    }
+    val reqs = argNames.map(n => if (inputShapes.contains(n)) 0 else 1)
+    val grads = argNames.zip(argShapes).map { case (n, s) =>
+      if (inputShapes.contains(n)) null else NDArray.zeros(s, ctx)
+    }
+    val exec = symbol.bind(ctx, args, grads, reqs, aux)
+    val dataIdx = argNames.indexOf(dataName)
+    val labelIdx = argNames.indexOf(labelName)
+    val numClasses = outShapes.head.dims.last
+    val states = scala.collection.mutable.Map[Int, AnyRef]()
+
+    for (epoch <- 0 until numEpoch) {
+      iter.reset()
+      metric.reset()
+      while (iter.hasNext) {
+        // host buffers go straight into the bound device arrays — one
+        // upload per input per batch, no intermediate device allocs
+        val (dbuf, lbuf, pad) = iter.nextHost()
+        exec.argArrays(dataIdx).set(dbuf)
+        exec.argArrays(labelIdx).set(lbuf)
+        exec.forward(isTrain = true).backward()
+        for (i <- argNames.indices if exec.gradArrays(i) != null) {
+          states(i) = optimizer.update(exec.argArrays(i),
+                                       exec.gradArrays(i),
+                                       states.getOrElse(i, null))
+        }
+        val keep = lbuf.length - pad
+        val out = exec.outputs.head
+        metric.update(lbuf.take(keep),
+                      out.toArray.take(keep * numClasses), numClasses)
+        out.close()
+      }
+    }
+    argParams = argNames.zip(exec.argArrays).filterNot { case (n, _) =>
+      inputShapes.contains(n)
+    }.toMap
+    auxParams = symbol.listAuxiliaryStates().zip(exec.auxArrays).toMap
+    this
+  }
+
+  /** Class-probability rows for `data` (row-major, batch-first). All
+    * device arrays allocated here are closed before returning — repeated
+    * predict calls hold no growing native state.
+    */
+  def predict(data: Array[Float], dataShape: Shape): Array[Float] = {
+    val (dataName, labelName) = ioNames
+    val n = dataShape(0)
+    val inputShapes =
+      Map(dataName -> dataShape) ++
+        (if (labelName != null) Map(labelName -> Shape(n)) else Map.empty)
+    val (argShapes, _, auxShapes) =
+      symbol.inferShape(inputShapes).getOrElse(
+        throw new MXNetError(
+          s"cannot infer shapes from inputs $inputShapes"))
+    val argNames = symbol.listArguments()
+    val args = argNames.zip(argShapes).map { case (nm, s) =>
+      if (nm == dataName) NDArray.array(data, s, ctx)
+      else if (labelName != null && nm == labelName) NDArray.zeros(s, ctx)
+      else argParams(nm).copyTo(ctx)
+    }
+    val aux = symbol.listAuxiliaryStates().zip(auxShapes).map {
+      case (nm, s) => auxParams(nm).copyTo(ctx)
+    }
+    val exec = symbol.bind(ctx, args, argNames.map(_ => null),
+                           argNames.map(_ => 0), aux)
+    val outNd = exec.forward(isTrain = false).outputs.head
+    val out = outNd.toArray
+    outNd.close()
+    exec.close()
+    args.foreach(_.close())
+    aux.foreach(_.close())
+    out
+  }
+
+  def save(prefix: String, epoch: Int = 0): Unit = {
+    val json = symbol.toJson
+    val w = new java.io.PrintWriter(s"$prefix-symbol.json")
+    w.write(json); w.close()
+    val tagged = argParams.map { case (k, v) => (s"arg:$k", v) } ++
+      auxParams.map { case (k, v) => (s"aux:$k", v) }
+    NDArray.save(f"$prefix-$epoch%04d.params", tagged)
+  }
+}
+
+object FeedForward {
+  def load(prefix: String, epoch: Int = 0,
+           ctx: Context = Context.defaultCtx): FeedForward = {
+    val json = scala.io.Source.fromFile(s"$prefix-symbol.json").mkString
+    val sym = Symbol.loadJson(json)
+    val blob = NDArray.load(f"$prefix-$epoch%04d.params")
+    val arg = blob.collect { case (k, v) if k.startsWith("arg:") =>
+      (k.stripPrefix("arg:"), v)
+    }
+    val aux = blob.collect { case (k, v) if k.startsWith("aux:") =>
+      (k.stripPrefix("aux:"), v)
+    }
+    new FeedForward(sym, ctx, arg, aux)
+  }
+}

@@ -1,0 +1,54 @@
+package org.mxnettpu
+
+/** In-memory data iterator (reference IO.scala NDArrayIter). Batches on
+  * the FIRST axis; the final partial batch wraps to the epoch start (pad
+  * semantics reported via `pad`).
+  */
+class NDArrayIter(data: Array[Float], dataShape: Shape,
+                  label: Array[Float], batchSize: Int,
+                  shuffle: Boolean = false)
+    extends Iterator[(NDArray, NDArray, Int)] {
+  require(data.length == dataShape.product,
+          s"data has ${data.length} values, shape $dataShape needs " +
+            s"${dataShape.product}")
+  require(label.length == dataShape(0),
+          s"label has ${label.length} values, need ${dataShape(0)}")
+  private val n = dataShape(0)
+  private val rowSize = dataShape.product / n
+  private var cursor = 0
+  private var order: Array[Int] = (0 until n).toArray
+  private val rng = new scala.util.Random(0)
+
+  def reset(): Unit = {
+    cursor = 0
+    if (shuffle) order = rng.shuffle(order.toSeq).toArray
+  }
+
+  override def hasNext: Boolean = cursor < n
+
+  /** Host-buffer batch: (data, label, pad). The training loop copies
+    * these straight into its bound device arrays — one upload per batch.
+    */
+  def nextHost(): (Array[Float], Array[Float], Int) = {
+    val idx = (cursor until cursor + batchSize).map(i => order(i % n))
+    val dbuf = new Array[Float](batchSize * rowSize)
+    val lbuf = new Array[Float](batchSize)
+    for ((src, bi) <- idx.zipWithIndex) {
+      System.arraycopy(data, src * rowSize, dbuf, bi * rowSize, rowSize)
+      lbuf(bi) = label(src)
+    }
+    val pad = math.max(0, cursor + batchSize - n)
+    cursor += batchSize
+    (dbuf, lbuf, pad)
+  }
+
+  /** Returns (dataBatch, labelBatch, pad) as device NDArrays (caller
+    * closes them).
+    */
+  override def next(): (NDArray, NDArray, Int) = {
+    val (dbuf, lbuf, pad) = nextHost()
+    val bshape = Shape((batchSize +: dataShape.dims.tail).toIndexedSeq)
+    (NDArray.array(dbuf, bshape), NDArray.array(lbuf, Shape(batchSize)),
+     pad)
+  }
+}

@@ -1,0 +1,45 @@
+package org.mxnettpu
+
+/** Weight initializers (reference Initializer.scala). */
+abstract class Initializer {
+  private val rng = new scala.util.Random(0)
+
+  def apply(name: String, shape: Shape): Array[Float] = {
+    if (name.endsWith("bias") || name.endsWith("beta") ||
+        name.endsWith("moving_mean")) {
+      Array.fill(shape.product)(0f)
+    } else if (name.endsWith("gamma") || name.endsWith("moving_var")) {
+      Array.fill(shape.product)(1f)
+    } else initWeight(shape)
+  }
+
+  protected def initWeight(shape: Shape): Array[Float]
+  protected def uniform(n: Int, scale: Float): Array[Float] =
+    Array.fill(n)((rng.nextFloat() * 2 - 1) * scale)
+  protected def normal(n: Int, sd: Float): Array[Float] =
+    Array.fill(n)(rng.nextGaussian().toFloat * sd)
+}
+
+class Uniform(scale: Float = 0.07f) extends Initializer {
+  override protected def initWeight(shape: Shape): Array[Float] =
+    uniform(shape.product, scale)
+}
+
+class Xavier(rndType: String = "uniform", factorType: String = "avg",
+             magnitude: Float = 3f) extends Initializer {
+  override protected def initWeight(shape: Shape): Array[Float] = {
+    // reference initializer.py Xavier: shape (out, in, k...) with
+    // hw = prod(k...), fan_in = in*hw, fan_out = out*hw
+    val hw = if (shape.length > 2) shape.dims.drop(2).product else 1
+    val fanOut = shape(0) * hw
+    val fanIn = (if (shape.length > 1) shape(1) else shape(0)) * hw
+    val factor = factorType match {
+      case "avg" => (fanIn + fanOut) / 2.0f
+      case "in" => fanIn.toFloat
+      case "out" => fanOut.toFloat
+    }
+    val scale = math.sqrt(magnitude / factor).toFloat
+    if (rndType == "uniform") uniform(shape.product, scale)
+    else normal(shape.product, scale)
+  }
+}

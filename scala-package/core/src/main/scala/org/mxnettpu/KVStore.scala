@@ -1,0 +1,42 @@
+package org.mxnettpu
+
+import Base._
+
+/** KVStore client (reference KVStore.scala). local/device run in-process;
+  * dist_* ride the collective backend when a distributed session exists.
+  * Optimizer application on pulled values is done JVM-side via
+  * Optimizer.update (no pickled-updater transport at this boundary).
+  */
+class KVStore private[mxnettpu] (private[mxnettpu] val handle: Long)
+    extends AutoCloseable {
+  private var closed = false
+
+  def init(keys: Array[Int], values: Seq[NDArray]): Unit =
+    checkCall(_LIB.mxKVStoreInit(handle, keys,
+                                 values.map(_.handle).toArray))
+
+  def push(keys: Array[Int], values: Seq[NDArray],
+           priority: Int = 0): Unit =
+    checkCall(_LIB.mxKVStorePush(handle, keys,
+                                 values.map(_.handle).toArray, priority))
+
+  def pull(keys: Array[Int], outs: Seq[NDArray],
+           priority: Int = 0): Unit =
+    checkCall(_LIB.mxKVStorePull(handle, keys,
+                                 outs.map(_.handle).toArray, priority))
+
+  def rank: Int = _LIB.mxKVStoreGetRank(handle)
+  def numWorkers: Int = _LIB.mxKVStoreGetGroupSize(handle)
+
+  override def close(): Unit = {
+    if (!closed) {
+      checkCall(_LIB.mxKVStoreFree(handle))
+      closed = true
+    }
+  }
+}
+
+object KVStore {
+  def create(kvType: String = "local"): KVStore =
+    new KVStore(checkHandle(_LIB.mxKVStoreCreate(kvType)))
+}

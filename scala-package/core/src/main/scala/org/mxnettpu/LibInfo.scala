@@ -1,0 +1,90 @@
+package org.mxnettpu
+
+/** Native boundary — one @native per exported JNI function in
+  * native/src/main/native/org_mxnettpu_LibInfo.cc.
+  *
+  * Reference counterpart: scala-package/core/.../LibInfo.scala (Ref-object
+  * out params over the C++ core). This boundary is primitive-first:
+  * results return directly (arrays/strings/long handles), failures are
+  * null / rc<0 with the message in mxGetLastError().
+  */
+private[mxnettpu] class LibInfo {
+  @native def nativeLibInit(): Int
+  @native def mxGetLastError(): String
+  @native def mxRandomSeed(seed: Int): Int
+  @native def mxNotifyShutdown(): Int
+  @native def mxListAllOpNames(): Array[String]
+
+  // ndarray
+  @native def mxNDArrayCreate(shape: Array[Int], devType: Int,
+                              devId: Int): Long
+  @native def mxNDArrayFree(handle: Long): Int
+  @native def mxNDArrayGetShape(handle: Long): Array[Int]
+  @native def mxNDArrayGetContext(handle: Long): Array[Int]
+  @native def mxNDArraySyncCopyFromCPU(handle: Long,
+                                       data: Array[Float]): Int
+  @native def mxNDArraySyncCopyToCPU(handle: Long,
+                                     size: Int): Array[Float]
+  @native def mxNDArrayWaitAll(): Int
+  @native def mxNDArraySave(fname: String, handles: Array[Long],
+                            keys: Array[String]): Int
+  @native def mxNDArrayLoad(fname: String, out: Array[AnyRef]): Int
+  @native def mxImperativeInvoke(opName: String, inputs: Array[Long],
+                                 paramKeys: Array[String],
+                                 paramVals: Array[String],
+                                 outputs: Array[Long]): Array[Long]
+
+  // symbol
+  @native def mxSymbolCreateVariable(name: String): Long
+  @native def mxSymbolCreate(opName: String, paramKeys: Array[String],
+                             paramVals: Array[String], name: String,
+                             argKeys: Array[String],
+                             argHandles: Array[Long]): Long
+  @native def mxSymbolFree(handle: Long): Int
+  @native def mxSymbolSaveToJSON(handle: Long): String
+  @native def mxSymbolCreateFromJSON(json: String): Long
+  @native def mxSymbolListArguments(handle: Long): Array[String]
+  @native def mxSymbolListOutputs(handle: Long): Array[String]
+  @native def mxSymbolListAuxiliaryStates(handle: Long): Array[String]
+  @native def mxSymbolInferShape(handle: Long, keys: Array[String],
+                                 indPtr: Array[Int],
+                                 shapeData: Array[Int],
+                                 out: Array[AnyRef]): Int
+
+  // executor
+  @native def mxExecutorBind(sym: Long, devType: Int, devId: Int,
+                             argHandles: Array[Long],
+                             gradHandles: Array[Long],
+                             gradReqs: Array[Int],
+                             auxHandles: Array[Long]): Long
+  @native def mxExecutorForward(handle: Long, isTrain: Int): Int
+  @native def mxExecutorBackward(handle: Long,
+                                 headGrads: Array[Long]): Int
+  @native def mxExecutorOutputs(handle: Long): Array[Long]
+  @native def mxExecutorFree(handle: Long): Int
+
+  // predictor (deployment API, c_predict_api.h counterpart)
+  @native def mxPredCreate(json: String, paramBytes: Array[Byte],
+                           devType: Int, devId: Int,
+                           inputKeys: Array[String], indPtr: Array[Int],
+                           shapeData: Array[Int]): Long
+  @native def mxPredSetInput(handle: Long, key: String,
+                             data: Array[Float]): Int
+  @native def mxPredForward(handle: Long): Int
+  @native def mxPredGetOutputShape(handle: Long, idx: Int): Array[Int]
+  @native def mxPredGetOutput(handle: Long, idx: Int,
+                              size: Int): Array[Float]
+  @native def mxPredFree(handle: Long): Int
+
+  // kvstore
+  @native def mxKVStoreCreate(kvType: String): Long
+  @native def mxKVStoreInit(handle: Long, keys: Array[Int],
+                            vals: Array[Long]): Int
+  @native def mxKVStorePush(handle: Long, keys: Array[Int],
+                            vals: Array[Long], priority: Int): Int
+  @native def mxKVStorePull(handle: Long, keys: Array[Int],
+                            vals: Array[Long], priority: Int): Int
+  @native def mxKVStoreGetRank(handle: Long): Int
+  @native def mxKVStoreGetGroupSize(handle: Long): Int
+  @native def mxKVStoreFree(handle: Long): Int
+}

@@ -1,0 +1,109 @@
+package org.mxnettpu
+
+import Base._
+
+/** Device tensor (reference NDArray.scala). Wraps a C-ABI handle; every
+  * operation routes through the dependency engine via mxImperativeInvoke.
+  * Row-major float32; `toArray` syncs a host copy.
+  */
+class NDArray private[mxnettpu] (private[mxnettpu] val handle: Long)
+    extends AutoCloseable {
+  private var closed = false
+
+  def shape: Shape = Shape(checkArray(_LIB.mxNDArrayGetShape(handle)))
+  def size: Int = shape.product
+  def context: Context = {
+    val c = checkArray(_LIB.mxNDArrayGetContext(handle))
+    Context(c(0), c(1))
+  }
+
+  def toArray: Array[Float] =
+    checkArray(_LIB.mxNDArraySyncCopyToCPU(handle, size))
+
+  def set(data: Array[Float]): NDArray = {
+    require(data.length == size, s"need $size values, got ${data.length}")
+    checkCall(_LIB.mxNDArraySyncCopyFromCPU(handle, data))
+    this
+  }
+
+  def copyTo(ctx: Context): NDArray = {
+    val dst = NDArray.empty(shape, ctx)
+    dst.set(toArray)
+  }
+
+  // arithmetic via the op registry
+  def +(other: NDArray): NDArray = NDArray.invoke1("_plus", this, other)
+  def -(other: NDArray): NDArray = NDArray.invoke1("_minus", this, other)
+  def *(other: NDArray): NDArray = NDArray.invoke1("_mul", this, other)
+  def /(other: NDArray): NDArray = NDArray.invoke1("_div", this, other)
+  def +(s: Float): NDArray = NDArray.invokeScalar("_plus_scalar", this, s)
+  def -(s: Float): NDArray = NDArray.invokeScalar("_minus_scalar", this, s)
+  def *(s: Float): NDArray = NDArray.invokeScalar("_mul_scalar", this, s)
+  def /(s: Float): NDArray = NDArray.invokeScalar("_div_scalar", this, s)
+
+  override def close(): Unit = {
+    if (!closed) {
+      checkCall(_LIB.mxNDArrayFree(handle))
+      closed = true
+    }
+  }
+
+  override def toString: String = s"NDArray$shape@${context}"
+}
+
+object NDArray {
+  /** Uninitialized (zero-filled at the C ABI) array. */
+  def empty(shape: Shape, ctx: Context = Context.defaultCtx): NDArray =
+    new NDArray(checkHandle(
+      _LIB.mxNDArrayCreate(shape.toArray, ctx.deviceTypeid, ctx.deviceId)))
+
+  def zeros(shape: Shape, ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx)
+
+  def ones(shape: Shape, ctx: Context = Context.defaultCtx): NDArray =
+    invokeScalar("_plus_scalar", empty(shape, ctx), 1f, inPlace = true)
+
+  def array(data: Array[Float], shape: Shape,
+            ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx).set(data)
+
+  def waitall(): Unit = checkCall(_LIB.mxNDArrayWaitAll())
+
+  /** Invoke any registered op; new outputs unless `outputs` given. */
+  def invoke(opName: String, inputs: Seq[NDArray],
+             params: Map[String, String] = Map.empty,
+             outputs: Seq[NDArray] = null): IndexedSeq[NDArray] = {
+    val keys = params.keys.toArray
+    val vals = params.values.toArray
+    val outHandles =
+      if (outputs == null) null else outputs.map(_.handle).toArray
+    val res = checkArray(_LIB.mxImperativeInvoke(
+      opName, inputs.map(_.handle).toArray, keys, vals, outHandles))
+    if (outputs != null) outputs.toIndexedSeq
+    else res.map(new NDArray(_)).toIndexedSeq
+  }
+
+  private[mxnettpu] def invoke1(op: String, a: NDArray,
+                                b: NDArray): NDArray =
+    invoke(op, Seq(a, b)).head
+
+  private[mxnettpu] def invokeScalar(op: String, a: NDArray, s: Float,
+                                     inPlace: Boolean = false): NDArray =
+    invoke(op, Seq(a), Map("scalar" -> s.toString),
+           if (inPlace) Seq(a) else null).head
+
+  /** Save named arrays; interchangeable with every other frontend. */
+  def save(fname: String, arrays: Map[String, NDArray]): Unit = {
+    val (names, nds) = arrays.toSeq.unzip
+    checkCall(_LIB.mxNDArraySave(fname, nds.map(_.handle).toArray,
+                                 names.toArray))
+  }
+
+  def load(fname: String): Map[String, NDArray] = {
+    val out = new Array[AnyRef](2)
+    checkCall(_LIB.mxNDArrayLoad(fname, out))
+    val handles = out(0).asInstanceOf[Array[Long]]
+    val names = out(1).asInstanceOf[Array[String]]
+    names.zip(handles.map(new NDArray(_))).toMap
+  }
+}

@@ -1,0 +1,63 @@
+package org.mxnettpu
+
+/** Optimizers over the fused update ops (reference Optimizer.scala; the
+  * math runs on device via ops/optimizer_ops.py — sgd_update,
+  * sgd_mom_update, adam_update — not in JVM code).
+  */
+abstract class Optimizer(val learningRate: Float, val wd: Float,
+                         val rescaleGrad: Float) {
+  /** Mutates weight (and its state) in place. Returns the state to carry
+    * to the next step (created lazily on first use).
+    */
+  def update(weight: NDArray, grad: NDArray, state: AnyRef): AnyRef
+}
+
+class SGD(learningRate: Float = 0.01f, momentum: Float = 0f,
+          wd: Float = 0f, rescaleGrad: Float = 1f)
+    extends Optimizer(learningRate, wd, rescaleGrad) {
+  override def update(weight: NDArray, grad: NDArray,
+                      state: AnyRef): AnyRef = {
+    val params = Map("lr" -> learningRate.toString, "wd" -> wd.toString,
+                     "rescale_grad" -> rescaleGrad.toString)
+    if (momentum == 0f) {
+      NDArray.invoke("sgd_update", Seq(weight, grad), params, Seq(weight))
+      null
+    } else {
+      val mom = if (state == null) NDArray.zeros(weight.shape,
+                                                 weight.context)
+                else state.asInstanceOf[NDArray]
+      NDArray.invoke("sgd_mom_update", Seq(weight, grad, mom),
+                     params + ("momentum" -> momentum.toString),
+                     Seq(weight, mom))
+      mom
+    }
+  }
+}
+
+class Adam(learningRate: Float = 0.001f, beta1: Float = 0.9f,
+           beta2: Float = 0.999f, epsilon: Float = 1e-8f, wd: Float = 0f,
+           rescaleGrad: Float = 1f)
+    extends Optimizer(learningRate, wd, rescaleGrad) {
+  private class State(val mean: NDArray, val variance: NDArray,
+                      var t: Int)
+
+  override def update(weight: NDArray, grad: NDArray,
+                      state: AnyRef): AnyRef = {
+    val s = if (state == null) {
+      new State(NDArray.zeros(weight.shape, weight.context),
+                NDArray.zeros(weight.shape, weight.context), 0)
+    } else state.asInstanceOf[State]
+    s.t += 1
+    // bias correction folds into the step size (same as optimizer.py)
+    val lrT = learningRate *
+      math.sqrt(1 - math.pow(beta2, s.t)).toFloat /
+      (1 - math.pow(beta1, s.t)).toFloat
+    NDArray.invoke(
+      "adam_update", Seq(weight, grad, s.mean, s.variance),
+      Map("lr" -> lrT.toString, "beta1" -> beta1.toString,
+          "beta2" -> beta2.toString, "epsilon" -> epsilon.toString,
+          "wd" -> wd.toString, "rescale_grad" -> rescaleGrad.toString),
+      Seq(weight, s.mean, s.variance))
+    s
+  }
+}

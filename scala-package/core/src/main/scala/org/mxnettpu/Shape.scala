@@ -1,0 +1,17 @@
+package org.mxnettpu
+
+/** Immutable tensor shape (reference Shape.scala), row-major like the
+  * NDArray itself — no axis reversal at this frontend.
+  */
+case class Shape(dims: IndexedSeq[Int]) {
+  def apply(i: Int): Int = dims(i)
+  def length: Int = dims.length
+  def product: Int = dims.product
+  def toArray: Array[Int] = dims.toArray
+  override def toString: String = s"(${dims.mkString(",")})"
+}
+
+object Shape {
+  def apply(dims: Int*): Shape = new Shape(dims.toIndexedSeq)
+  def apply(dims: Array[Int]): Shape = new Shape(dims.toIndexedSeq)
+}

@@ -1,0 +1,143 @@
+package org.mxnettpu
+
+import Base._
+
+/** Symbolic graph node (reference Symbol.scala). Construction goes
+  * through mxSymbolCreate (atomic + compose at the C ABI); any of the
+  * 260+ registered ops is reachable via Symbol.create("OpName", ...).
+  */
+class Symbol private[mxnettpu] (private[mxnettpu] val handle: Long)
+    extends AutoCloseable {
+  private var closed = false
+
+  def listArguments(): IndexedSeq[String] =
+    checkArray(_LIB.mxSymbolListArguments(handle)).toIndexedSeq
+  def listOutputs(): IndexedSeq[String] =
+    checkArray(_LIB.mxSymbolListOutputs(handle)).toIndexedSeq
+  def listAuxiliaryStates(): IndexedSeq[String] =
+    checkArray(_LIB.mxSymbolListAuxiliaryStates(handle)).toIndexedSeq
+
+  def toJson: String = checkArray(_LIB.mxSymbolSaveToJSON(handle))
+
+  /** Infer shapes from named input shapes (row-major). Returns
+    * (argShapes, outShapes, auxShapes) or None if incomplete.
+    */
+  def inferShape(known: Map[String, Shape])
+      : Option[(IndexedSeq[Shape], IndexedSeq[Shape], IndexedSeq[Shape])] = {
+    val keys = known.keys.toArray
+    val shapes = known.values.toSeq
+    val indPtr = shapes.scanLeft(0)(_ + _.length).toArray
+    val data = shapes.flatMap(_.dims).toArray
+    val out = new Array[AnyRef](6)
+    val rc = _LIB.mxSymbolInferShape(handle, keys, indPtr, data, out)
+    if (rc < 0) throw new MXNetError(_LIB.mxGetLastError())
+    if (rc == 0) return None
+    def unpack(slot: Int): IndexedSeq[Shape] = {
+      val ip = out(slot).asInstanceOf[Array[Int]]
+      val flat = out(slot + 1).asInstanceOf[Array[Int]]
+      (0 until ip.length - 1).map { i =>
+        Shape(flat.slice(ip(i), ip(i + 1)))
+      }
+    }
+    Some((unpack(0), unpack(2), unpack(4)))
+  }
+
+  /** Bind with user arrays; gradReqs: 0=null 1=write 3=add. */
+  def bind(ctx: Context, args: Seq[NDArray], argGrads: Seq[NDArray],
+           gradReqs: Seq[Int], auxStates: Seq[NDArray] = Seq.empty)
+      : Executor = {
+    // validated here so the failure carries a real message (the shim's
+    // defensive size check can only return a bare null handle)
+    require(argGrads.length == args.length,
+            s"argGrads has ${argGrads.length} entries for ${args.length}" +
+              " arguments")
+    require(gradReqs.length == args.length,
+            s"gradReqs has ${gradReqs.length} entries for ${args.length}" +
+              " arguments")
+    val h = checkHandle(_LIB.mxExecutorBind(
+      handle, ctx.deviceTypeid, ctx.deviceId, args.map(_.handle).toArray,
+      argGrads.map(g => if (g == null) 0L else g.handle).toArray,
+      gradReqs.toArray, auxStates.map(_.handle).toArray))
+    new Executor(h, this, args.toIndexedSeq, argGrads.toIndexedSeq,
+                 auxStates.toIndexedSeq)
+  }
+
+  /** Infer + allocate + bind (reference simpleBind). */
+  def simpleBind(ctx: Context, gradReq: Int,
+                 inputShapes: Map[String, Shape]): Executor = {
+    val (argShapes, _, auxShapes) = inferShape(inputShapes).getOrElse(
+      throw new MXNetError("cannot infer shapes from the given inputs"))
+    val argNames = listArguments()
+    val args = argShapes.map(NDArray.zeros(_, ctx))
+    val reqs = argNames.map(n =>
+      if (inputShapes.contains(n)) 0 else gradReq)
+    val grads = argNames.zip(argShapes).map { case (n, s) =>
+      if (inputShapes.contains(n)) null else NDArray.zeros(s, ctx)
+    }
+    val aux = auxShapes.map(NDArray.zeros(_, ctx))
+    bind(ctx, args, grads, reqs, aux)
+  }
+
+  override def close(): Unit = {
+    if (!closed) {
+      checkCall(_LIB.mxSymbolFree(handle))
+      closed = true
+    }
+  }
+}
+
+object Symbol {
+  def Variable(name: String): Symbol =
+    new Symbol(checkHandle(_LIB.mxSymbolCreateVariable(name)))
+
+  /** Create any registered op node. Symbol args compose as inputs;
+    * everything else stringifies into op parameters.
+    */
+  def create(opName: String, args: Map[String, Symbol],
+             params: Map[String, String] = Map.empty,
+             name: String = null): Symbol = {
+    val h = checkHandle(_LIB.mxSymbolCreate(
+      opName, params.keys.toArray, params.values.toArray, name,
+      args.keys.toArray, args.values.map(_.handle).toArray))
+    new Symbol(h)
+  }
+
+  def loadJson(json: String): Symbol =
+    new Symbol(checkHandle(_LIB.mxSymbolCreateFromJSON(json)))
+
+  // common layer helpers (reference generates these; the full registry is
+  // reachable through create)
+  def FullyConnected(data: Symbol, numHidden: Int, noBias: Boolean = false,
+                     name: String = null): Symbol =
+    create("FullyConnected", Map("data" -> data),
+           Map("num_hidden" -> numHidden.toString,
+               "no_bias" -> (if (noBias) "True" else "False")), name)
+
+  def Activation(data: Symbol, actType: String,
+                 name: String = null): Symbol =
+    create("Activation", Map("data" -> data), Map("act_type" -> actType),
+           name)
+
+  def Convolution(data: Symbol, kernel: Shape, numFilter: Int,
+                  stride: Shape = Shape(1, 1), pad: Shape = Shape(0, 0),
+                  name: String = null): Symbol =
+    create("Convolution", Map("data" -> data),
+           Map("kernel" -> kernel.toString, "num_filter" ->
+             numFilter.toString, "stride" -> stride.toString,
+             "pad" -> pad.toString), name)
+
+  def Pooling(data: Symbol, kernel: Shape, poolType: String = "max",
+              stride: Shape = Shape(1, 1), name: String = null): Symbol =
+    create("Pooling", Map("data" -> data),
+           Map("kernel" -> kernel.toString, "pool_type" -> poolType,
+               "stride" -> stride.toString), name)
+
+  def BatchNorm(data: Symbol, name: String = null): Symbol =
+    create("BatchNorm", Map("data" -> data), Map.empty, name)
+
+  def Flatten(data: Symbol, name: String = null): Symbol =
+    create("Flatten", Map("data" -> data), Map.empty, name)
+
+  def SoftmaxOutput(data: Symbol, name: String = "softmax"): Symbol =
+    create("SoftmaxOutput", Map("data" -> data), Map.empty, name)
+}

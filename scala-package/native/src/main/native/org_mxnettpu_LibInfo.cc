@@ -1,0 +1,563 @@
+// mxnet_tpu Scala/JVM bindings — JNI shim over the flat C ABI.
+//
+// Reference counterpart: scala-package/native/src/main/native/
+// ml_dmlc_mxnet_native_c_api.cc (JNI over the C++ core, Ref-object out
+// params). Here the boundary is redesigned primitive-first: every native
+// returns its result directly (arrays/strings/long handles), rc<0 or null
+// signals failure and the message is fetched with mxGetLastError(). That
+// keeps the JNI surface free of field lookups and object construction,
+// which makes the shim small, fast (no reflection per call), and fully
+// hostable on the jni_stub test double (tests/jni_stub/) when no JVM is
+// present.
+//
+// Handles are NDArray/Symbol/Executor/Predictor/KVStore pointers passed to
+// Scala as jlong; Scala wrappers own them and call the matching *Free.
+#include <jni.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../../../../include/mxnet_tpu/c_api.h"
+
+namespace {
+
+// jstring -> std::string (empty for null)
+std::string str(JNIEnv* env, jstring s) {
+  if (s == nullptr) return "";
+  const char* c = env->GetStringUTFChars(s, nullptr);
+  std::string out(c ? c : "");
+  env->ReleaseStringUTFChars(s, c);
+  return out;
+}
+
+// String[] -> owned strings + char* view
+struct StrArr {
+  std::vector<std::string> store;
+  std::vector<const char*> ptrs;
+  StrArr(JNIEnv* env, jobjectArray arr) {
+    jsize n = (arr == nullptr) ? 0 : env->GetArrayLength(arr);
+    store.reserve(n);
+    for (jsize i = 0; i < n; ++i) {
+      jstring s = (jstring)env->GetObjectArrayElement(arr, i);
+      store.push_back(str(env, s));
+    }
+    for (auto& v : store) ptrs.push_back(v.c_str());
+  }
+  mx_uint size() const { return (mx_uint)store.size(); }
+  const char** data() { return ptrs.empty() ? nullptr : ptrs.data(); }
+};
+
+std::vector<mx_uint> uints(JNIEnv* env, jintArray arr) {
+  jsize n = (arr == nullptr) ? 0 : env->GetArrayLength(arr);
+  std::vector<jint> tmp(n);
+  if (n) env->GetIntArrayRegion(arr, 0, n, tmp.data());
+  return std::vector<mx_uint>(tmp.begin(), tmp.end());
+}
+
+std::vector<void*> handles(JNIEnv* env, jlongArray arr) {
+  jsize n = (arr == nullptr) ? 0 : env->GetArrayLength(arr);
+  std::vector<jlong> tmp(n);
+  if (n) env->GetLongArrayRegion(arr, 0, n, tmp.data());
+  std::vector<void*> out(n);
+  for (jsize i = 0; i < n; ++i)
+    out[i] = reinterpret_cast<void*>(tmp[i]);
+  return out;
+}
+
+jintArray to_jints(JNIEnv* env, const mx_uint* v, mx_uint n) {
+  jintArray out = env->NewIntArray(n);
+  std::vector<jint> tmp(v, v + n);
+  if (n) env->SetIntArrayRegion(out, 0, n, tmp.data());
+  return out;
+}
+
+jlongArray to_jlongs(JNIEnv* env, void* const* v, mx_uint n) {
+  jlongArray out = env->NewLongArray(n);
+  std::vector<jlong> tmp(n);
+  for (mx_uint i = 0; i < n; ++i)
+    tmp[i] = reinterpret_cast<jlong>(v[i]);
+  if (n) env->SetLongArrayRegion(out, 0, n, tmp.data());
+  return out;
+}
+
+jobjectArray to_jstrs(JNIEnv* env, const char* const* v, mx_uint n) {
+  jobjectArray out =
+      env->NewObjectArray(n, env->FindClass("java/lang/String"), nullptr);
+  for (mx_uint i = 0; i < n; ++i)
+    env->SetObjectArrayElement(out, i, env->NewStringUTF(v[i]));
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------------ global
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_nativeLibInit(JNIEnv*, jobject) {
+  return 0;  // the C ABI lazy-initializes its runtime on first use
+}
+
+JNIEXPORT jstring JNICALL
+Java_org_mxnettpu_LibInfo_mxGetLastError(JNIEnv* env, jobject) {
+  return env->NewStringUTF(MXGetLastError());
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRandomSeed(JNIEnv*, jobject, jint seed) {
+  return MXRandomSeed(seed);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxNotifyShutdown(JNIEnv*, jobject) {
+  return MXNotifyShutdown();
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_org_mxnettpu_LibInfo_mxListAllOpNames(JNIEnv* env, jobject) {
+  mx_uint n;
+  const char** names;
+  if (MXListAllOpNames(&n, &names) != 0) return nullptr;
+  return to_jstrs(env, names, n);
+}
+
+// ----------------------------------------------------------------- ndarray
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArrayCreate(JNIEnv* env, jobject,
+                                          jintArray shape, jint devType,
+                                          jint devId) {
+  std::vector<mx_uint> s = uints(env, shape);
+  NDArrayHandle h;
+  if (MXNDArrayCreate(s.data(), (mx_uint)s.size(), devType, devId, 0,
+                      &h) != 0)
+    return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArrayFree(JNIEnv*, jobject, jlong h) {
+  return MXNDArrayFree(reinterpret_cast<NDArrayHandle>(h));
+}
+
+JNIEXPORT jintArray JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArrayGetShape(JNIEnv* env, jobject,
+                                            jlong h) {
+  mx_uint ndim;
+  const mx_uint* shape;
+  if (MXNDArrayGetShape(reinterpret_cast<NDArrayHandle>(h), &ndim,
+                        &shape) != 0)
+    return nullptr;
+  return to_jints(env, shape, ndim);
+}
+
+JNIEXPORT jintArray JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArrayGetContext(JNIEnv* env, jobject,
+                                              jlong h) {
+  int dt, di;
+  if (MXNDArrayGetContext(reinterpret_cast<NDArrayHandle>(h), &dt,
+                          &di) != 0)
+    return nullptr;
+  mx_uint v[2] = {(mx_uint)dt, (mx_uint)di};
+  return to_jints(env, v, 2);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(JNIEnv* env, jobject,
+                                                   jlong h,
+                                                   jfloatArray data) {
+  jsize n = env->GetArrayLength(data);
+  std::vector<jfloat> buf(n);
+  env->GetFloatArrayRegion(data, 0, n, buf.data());
+  return MXNDArraySyncCopyFromCPU(reinterpret_cast<NDArrayHandle>(h),
+                                  buf.data(), (size_t)n);
+}
+
+JNIEXPORT jfloatArray JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(JNIEnv* env, jobject,
+                                                 jlong h, jint size) {
+  std::vector<float> buf(size);
+  if (MXNDArraySyncCopyToCPU(reinterpret_cast<NDArrayHandle>(h),
+                             buf.data(), (size_t)size) != 0)
+    return nullptr;
+  jfloatArray out = env->NewFloatArray(size);
+  env->SetFloatArrayRegion(out, 0, size, buf.data());
+  return out;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArrayWaitAll(JNIEnv*, jobject) {
+  return MXNDArrayWaitAll();
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArraySave(JNIEnv* env, jobject,
+                                        jstring fname, jlongArray hs,
+                                        jobjectArray keys) {
+  std::vector<void*> arrs = handles(env, hs);
+  StrArr ks(env, keys);
+  return MXNDArraySave(str(env, fname).c_str(), (mx_uint)arrs.size(),
+                       arrs.empty() ? nullptr : arrs.data(), ks.data());
+}
+
+// out[0] <- long[] handles, out[1] <- String[] names
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxNDArrayLoad(JNIEnv* env, jobject,
+                                        jstring fname, jobjectArray out) {
+  mx_uint n, n_names;
+  NDArrayHandle* arrs;
+  const char** names;
+  if (MXNDArrayLoad(str(env, fname).c_str(), &n, &arrs, &n_names,
+                    &names) != 0)
+    return -1;
+  env->SetObjectArrayElement(out, 0, to_jlongs(env, arrs, n));
+  env->SetObjectArrayElement(out, 1, to_jstrs(env, names, n_names));
+  return 0;
+}
+
+// outputs==null -> op allocates; else in-place into the given handles.
+JNIEXPORT jlongArray JNICALL
+Java_org_mxnettpu_LibInfo_mxImperativeInvoke(
+    JNIEnv* env, jobject, jstring opName, jlongArray inputs,
+    jobjectArray paramKeys, jobjectArray paramVals, jlongArray outputs) {
+  FunctionHandle creator;
+  if (MXGetFunction(str(env, opName).c_str(), &creator) != 0)
+    return nullptr;
+  std::vector<void*> ins = handles(env, inputs);
+  std::vector<void*> provided = handles(env, outputs);
+  StrArr keys(env, paramKeys), vals(env, paramVals);
+  int num_out = (int)provided.size();
+  NDArrayHandle* outs = provided.empty() ? nullptr : provided.data();
+  if (MXImperativeInvoke(const_cast<void*>(creator), (int)ins.size(),
+                         ins.empty() ? nullptr : ins.data(), &num_out,
+                         &outs, (int)keys.size(), keys.data(),
+                         vals.data()) != 0)
+    return nullptr;
+  if (!provided.empty()) {
+    // in-place form: drop the extra ref the capi returned on each handle
+    for (int i = 0; i < num_out; ++i) MXNDArrayFree(outs[i]);
+    return outputs;
+  }
+  return to_jlongs(env, outs, num_out);
+}
+
+// ------------------------------------------------------------------ symbol
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolCreateVariable(JNIEnv* env, jobject,
+                                                 jstring name) {
+  SymbolHandle h;
+  if (MXSymbolCreateVariable(str(env, name).c_str(), &h) != 0) return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+// atomic create + compose, mirroring the R shim's MXR_sym_create
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolCreate(JNIEnv* env, jobject,
+                                         jstring opName,
+                                         jobjectArray paramKeys,
+                                         jobjectArray paramVals,
+                                         jstring name, jobjectArray argKeys,
+                                         jlongArray argHandles) {
+  FunctionHandle creator;
+  if (MXGetFunction(str(env, opName).c_str(), &creator) != 0) return 0;
+  StrArr keys(env, paramKeys), vals(env, paramVals);
+  SymbolHandle h;
+  if (MXSymbolCreateAtomicSymbol(const_cast<void*>(creator), keys.size(),
+                                 keys.data(), vals.data(), &h) != 0)
+    return 0;
+  StrArr aks(env, argKeys);
+  std::vector<void*> args = handles(env, argHandles);
+  std::string nm = str(env, name);
+  if (MXSymbolCompose(h, name == nullptr ? nullptr : nm.c_str(),
+                      (mx_uint)args.size(),
+                      aks.size() > 0 ? aks.data() : nullptr,
+                      args.empty() ? nullptr : args.data()) != 0) {
+    MXSymbolFree(h);
+    return 0;
+  }
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolFree(JNIEnv*, jobject, jlong h) {
+  return MXSymbolFree(reinterpret_cast<SymbolHandle>(h));
+}
+
+JNIEXPORT jstring JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolSaveToJSON(JNIEnv* env, jobject,
+                                             jlong h) {
+  const char* json;
+  if (MXSymbolSaveToJSON(reinterpret_cast<SymbolHandle>(h), &json) != 0)
+    return nullptr;
+  return env->NewStringUTF(json);
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolCreateFromJSON(JNIEnv* env, jobject,
+                                                 jstring json) {
+  SymbolHandle h;
+  if (MXSymbolCreateFromJSON(str(env, json).c_str(), &h) != 0) return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolListArguments(JNIEnv* env, jobject,
+                                                jlong h) {
+  mx_uint n;
+  const char** strs;
+  if (MXSymbolListArguments(reinterpret_cast<SymbolHandle>(h), &n,
+                            &strs) != 0)
+    return nullptr;
+  return to_jstrs(env, strs, n);
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolListOutputs(JNIEnv* env, jobject,
+                                              jlong h) {
+  mx_uint n;
+  const char** strs;
+  if (MXSymbolListOutputs(reinterpret_cast<SymbolHandle>(h), &n,
+                          &strs) != 0)
+    return nullptr;
+  return to_jstrs(env, strs, n);
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolListAuxiliaryStates(JNIEnv* env, jobject,
+                                                      jlong h) {
+  mx_uint n;
+  const char** strs;
+  if (MXSymbolListAuxiliaryStates(reinterpret_cast<SymbolHandle>(h), &n,
+                                  &strs) != 0)
+    return nullptr;
+  return to_jstrs(env, strs, n);
+}
+
+// shapes in CSR (keys + indPtr + flat data); result as CSR triples:
+// out[0]=arg indPtr, out[1]=arg data, out[2]=out indPtr, out[3]=out data,
+// out[4]=aux indPtr, out[5]=aux data. Returns 1 complete, 0 partial, -1
+// error.
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolInferShape(JNIEnv* env, jobject, jlong h,
+                                             jobjectArray keys,
+                                             jintArray indPtr,
+                                             jintArray shapeData,
+                                             jobjectArray out) {
+  StrArr ks(env, keys);
+  std::vector<mx_uint> ind = uints(env, indPtr);
+  std::vector<mx_uint> sdata = uints(env, shapeData);
+  mx_uint in_n, out_n, aux_n;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sh, **out_sh, **aux_sh;
+  int complete;
+  if (MXSymbolInferShape(reinterpret_cast<SymbolHandle>(h), ks.size(),
+                         ks.data(), ind.data(), sdata.data(), &in_n,
+                         &in_nd, &in_sh, &out_n, &out_nd, &out_sh, &aux_n,
+                         &aux_nd, &aux_sh, &complete) != 0)
+    return -1;
+  auto pack = [&](mx_uint n, const mx_uint* nd, const mx_uint** sh,
+                  int slot) {
+    std::vector<mx_uint> ip(1, 0), flat;
+    for (mx_uint i = 0; i < n; ++i) {
+      for (mx_uint j = 0; j < nd[i]; ++j) flat.push_back(sh[i][j]);
+      ip.push_back((mx_uint)flat.size());
+    }
+    env->SetObjectArrayElement(out, slot,
+                               to_jints(env, ip.data(), (mx_uint)ip.size()));
+    env->SetObjectArrayElement(
+        out, slot + 1,
+        to_jints(env, flat.data(), (mx_uint)flat.size()));
+  };
+  pack(in_n, in_nd, in_sh, 0);
+  pack(out_n, out_nd, out_sh, 2);
+  pack(aux_n, aux_nd, aux_sh, 4);
+  return complete ? 1 : 0;
+}
+
+// ---------------------------------------------------------------- executor
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxExecutorBind(JNIEnv* env, jobject, jlong sym,
+                                         jint devType, jint devId,
+                                         jlongArray argHandles,
+                                         jlongArray gradHandles,
+                                         jintArray gradReqs,
+                                         jlongArray auxHandles) {
+  std::vector<void*> args = handles(env, argHandles);
+  std::vector<void*> grads = handles(env, gradHandles);
+  std::vector<mx_uint> reqs = uints(env, gradReqs);
+  std::vector<void*> aux = handles(env, auxHandles);
+  if (grads.size() != args.size() || reqs.size() != args.size()) return 0;
+  ExecutorHandle h;
+  if (MXExecutorBind(reinterpret_cast<SymbolHandle>(sym), devType, devId,
+                     (mx_uint)args.size(),
+                     args.empty() ? nullptr : args.data(), grads.data(),
+                     reqs.data(), (mx_uint)aux.size(),
+                     aux.empty() ? nullptr : aux.data(), &h) != 0)
+    return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxExecutorForward(JNIEnv*, jobject, jlong h,
+                                            jint isTrain) {
+  return MXExecutorForward(reinterpret_cast<ExecutorHandle>(h), isTrain);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxExecutorBackward(JNIEnv* env, jobject, jlong h,
+                                             jlongArray headGrads) {
+  std::vector<void*> hg = handles(env, headGrads);
+  return MXExecutorBackward(reinterpret_cast<ExecutorHandle>(h),
+                            (mx_uint)hg.size(),
+                            hg.empty() ? nullptr : hg.data());
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_org_mxnettpu_LibInfo_mxExecutorOutputs(JNIEnv* env, jobject, jlong h) {
+  mx_uint n;
+  NDArrayHandle* outs;
+  if (MXExecutorOutputs(reinterpret_cast<ExecutorHandle>(h), &n, &outs) !=
+      0)
+    return nullptr;
+  return to_jlongs(env, outs, n);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxExecutorFree(JNIEnv*, jobject, jlong h) {
+  return MXExecutorFree(reinterpret_cast<ExecutorHandle>(h));
+}
+
+// --------------------------------------------------------------- predictor
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxPredCreate(JNIEnv* env, jobject, jstring json,
+                                       jbyteArray paramBytes, jint devType,
+                                       jint devId, jobjectArray inputKeys,
+                                       jintArray indPtr,
+                                       jintArray shapeData) {
+  StrArr keys(env, inputKeys);
+  std::vector<mx_uint> ind = uints(env, indPtr);
+  std::vector<mx_uint> sdata = uints(env, shapeData);
+  std::vector<jbyte> blob;
+  if (paramBytes != nullptr) {
+    jsize n = env->GetArrayLength(paramBytes);
+    blob.resize(n);
+    if (n) env->GetByteArrayRegion(paramBytes, 0, n, blob.data());
+  }
+  PredictorHandle h;
+  if (MXPredCreate(str(env, json).c_str(),
+                   blob.empty() ? nullptr : blob.data(), blob.size(),
+                   devType, devId, keys.size(), keys.data(), ind.data(),
+                   sdata.data(), &h) != 0)
+    return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxPredSetInput(JNIEnv* env, jobject, jlong h,
+                                         jstring key, jfloatArray data) {
+  jsize n = env->GetArrayLength(data);
+  std::vector<jfloat> buf(n);
+  env->GetFloatArrayRegion(data, 0, n, buf.data());
+  return MXPredSetInput(reinterpret_cast<PredictorHandle>(h),
+                        str(env, key).c_str(), buf.data(), (mx_uint)n);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxPredForward(JNIEnv*, jobject, jlong h) {
+  return MXPredForward(reinterpret_cast<PredictorHandle>(h));
+}
+
+JNIEXPORT jintArray JNICALL
+Java_org_mxnettpu_LibInfo_mxPredGetOutputShape(JNIEnv* env, jobject,
+                                               jlong h, jint idx) {
+  mx_uint* shape;
+  mx_uint ndim;
+  if (MXPredGetOutputShape(reinterpret_cast<PredictorHandle>(h), idx,
+                           &shape, &ndim) != 0)
+    return nullptr;
+  return to_jints(env, shape, ndim);
+}
+
+JNIEXPORT jfloatArray JNICALL
+Java_org_mxnettpu_LibInfo_mxPredGetOutput(JNIEnv* env, jobject, jlong h,
+                                          jint idx, jint size) {
+  std::vector<float> buf(size);
+  if (MXPredGetOutput(reinterpret_cast<PredictorHandle>(h), idx,
+                      buf.data(), (mx_uint)size) != 0)
+    return nullptr;
+  jfloatArray out = env->NewFloatArray(size);
+  env->SetFloatArrayRegion(out, 0, size, buf.data());
+  return out;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxPredFree(JNIEnv*, jobject, jlong h) {
+  return MXPredFree(reinterpret_cast<PredictorHandle>(h));
+}
+
+// ----------------------------------------------------------------- kvstore
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxKVStoreCreate(JNIEnv* env, jobject,
+                                          jstring type) {
+  KVStoreHandle h;
+  if (MXKVStoreCreate(str(env, type).c_str(), &h) != 0) return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxKVStoreInit(JNIEnv* env, jobject, jlong h,
+                                        jintArray keys, jlongArray vals) {
+  std::vector<mx_uint> ks = uints(env, keys);
+  std::vector<int> iks(ks.begin(), ks.end());
+  std::vector<void*> vs = handles(env, vals);
+  return MXKVStoreInit(reinterpret_cast<KVStoreHandle>(h),
+                       (mx_uint)vs.size(), iks.data(), vs.data());
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxKVStorePush(JNIEnv* env, jobject, jlong h,
+                                        jintArray keys, jlongArray vals,
+                                        jint priority) {
+  std::vector<mx_uint> ks = uints(env, keys);
+  std::vector<int> iks(ks.begin(), ks.end());
+  std::vector<void*> vs = handles(env, vals);
+  return MXKVStorePush(reinterpret_cast<KVStoreHandle>(h),
+                       (mx_uint)vs.size(), iks.data(), vs.data(),
+                       priority);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxKVStorePull(JNIEnv* env, jobject, jlong h,
+                                        jintArray keys, jlongArray vals,
+                                        jint priority) {
+  std::vector<mx_uint> ks = uints(env, keys);
+  std::vector<int> iks(ks.begin(), ks.end());
+  std::vector<void*> vs = handles(env, vals);
+  return MXKVStorePull(reinterpret_cast<KVStoreHandle>(h),
+                       (mx_uint)vs.size(), iks.data(), vs.data(),
+                       priority);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxKVStoreGetRank(JNIEnv*, jobject, jlong h) {
+  int r;
+  if (MXKVStoreGetRank(reinterpret_cast<KVStoreHandle>(h), &r) != 0)
+    return -1;
+  return r;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxKVStoreGetGroupSize(JNIEnv*, jobject, jlong h) {
+  int n;
+  if (MXKVStoreGetGroupSize(reinterpret_cast<KVStoreHandle>(h), &n) != 0)
+    return -1;
+  return n;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxKVStoreFree(JNIEnv*, jobject, jlong h) {
+  return MXKVStoreFree(reinterpret_cast<KVStoreHandle>(h));
+}
+
+}  // extern "C"

@@ -1,0 +1,305 @@
+// End-to-end exercise of the Scala frontend's JNI shim
+// (scala-package/native/.../org_mxnettpu_LibInfo.cc) against the REAL
+// libmxnet_tpu.so, hosted on the JNI test double in tests/jni_stub/.
+// Run by tests/test_scala_package.py. Flows: NDArray round trip,
+// imperative invoke, save/load, symbol create/compose/infer, executor
+// fwd/bwd, predictor, KVStore push/pull.
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "../jni_stub/jni.h"
+
+#define ASSERT(cond)                                                      \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      fprintf(stderr, "ASSERT FAILED at %s:%d: %s\n", __FILE__, __LINE__, \
+              #cond);                                                     \
+      exit(1);                                                            \
+    }                                                                     \
+  } while (0)
+
+// the shim's exported JNI functions
+extern "C" {
+jint Java_org_mxnettpu_LibInfo_nativeLibInit(JNIEnv*, jobject);
+jstring Java_org_mxnettpu_LibInfo_mxGetLastError(JNIEnv*, jobject);
+jobjectArray Java_org_mxnettpu_LibInfo_mxListAllOpNames(JNIEnv*, jobject);
+jlong Java_org_mxnettpu_LibInfo_mxNDArrayCreate(JNIEnv*, jobject,
+                                                jintArray, jint, jint);
+jint Java_org_mxnettpu_LibInfo_mxNDArrayFree(JNIEnv*, jobject, jlong);
+jintArray Java_org_mxnettpu_LibInfo_mxNDArrayGetShape(JNIEnv*, jobject,
+                                                      jlong);
+jint Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(JNIEnv*, jobject,
+                                                        jlong, jfloatArray);
+jfloatArray Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(JNIEnv*,
+                                                             jobject, jlong,
+                                                             jint);
+jint Java_org_mxnettpu_LibInfo_mxNDArraySave(JNIEnv*, jobject, jstring,
+                                             jlongArray, jobjectArray);
+jint Java_org_mxnettpu_LibInfo_mxNDArrayLoad(JNIEnv*, jobject, jstring,
+                                             jobjectArray);
+jlongArray Java_org_mxnettpu_LibInfo_mxImperativeInvoke(
+    JNIEnv*, jobject, jstring, jlongArray, jobjectArray, jobjectArray,
+    jlongArray);
+jlong Java_org_mxnettpu_LibInfo_mxSymbolCreateVariable(JNIEnv*, jobject,
+                                                       jstring);
+jlong Java_org_mxnettpu_LibInfo_mxSymbolCreate(JNIEnv*, jobject, jstring,
+                                               jobjectArray, jobjectArray,
+                                               jstring, jobjectArray,
+                                               jlongArray);
+jstring Java_org_mxnettpu_LibInfo_mxSymbolSaveToJSON(JNIEnv*, jobject,
+                                                     jlong);
+jobjectArray Java_org_mxnettpu_LibInfo_mxSymbolListArguments(JNIEnv*,
+                                                             jobject, jlong);
+jint Java_org_mxnettpu_LibInfo_mxSymbolInferShape(JNIEnv*, jobject, jlong,
+                                                  jobjectArray, jintArray,
+                                                  jintArray, jobjectArray);
+jlong Java_org_mxnettpu_LibInfo_mxExecutorBind(JNIEnv*, jobject, jlong,
+                                               jint, jint, jlongArray,
+                                               jlongArray, jintArray,
+                                               jlongArray);
+jint Java_org_mxnettpu_LibInfo_mxExecutorForward(JNIEnv*, jobject, jlong,
+                                                 jint);
+jint Java_org_mxnettpu_LibInfo_mxExecutorBackward(JNIEnv*, jobject, jlong,
+                                                  jlongArray);
+jlongArray Java_org_mxnettpu_LibInfo_mxExecutorOutputs(JNIEnv*, jobject,
+                                                       jlong);
+jlong Java_org_mxnettpu_LibInfo_mxPredCreate(JNIEnv*, jobject, jstring,
+                                             jbyteArray, jint, jint,
+                                             jobjectArray, jintArray,
+                                             jintArray);
+jint Java_org_mxnettpu_LibInfo_mxPredSetInput(JNIEnv*, jobject, jlong,
+                                              jstring, jfloatArray);
+jint Java_org_mxnettpu_LibInfo_mxPredForward(JNIEnv*, jobject, jlong);
+jintArray Java_org_mxnettpu_LibInfo_mxPredGetOutputShape(JNIEnv*, jobject,
+                                                         jlong, jint);
+jfloatArray Java_org_mxnettpu_LibInfo_mxPredGetOutput(JNIEnv*, jobject,
+                                                      jlong, jint, jint);
+jlong Java_org_mxnettpu_LibInfo_mxKVStoreCreate(JNIEnv*, jobject, jstring);
+jint Java_org_mxnettpu_LibInfo_mxKVStoreInit(JNIEnv*, jobject, jlong,
+                                             jintArray, jlongArray);
+jint Java_org_mxnettpu_LibInfo_mxKVStorePush(JNIEnv*, jobject, jlong,
+                                             jintArray, jlongArray, jint);
+jint Java_org_mxnettpu_LibInfo_mxKVStorePull(JNIEnv*, jobject, jlong,
+                                             jintArray, jlongArray, jint);
+}
+
+static JNIEnv genv;
+static JNIEnv* env = &genv;
+
+static jintArray ints(const jint* v, int n) {
+  jintArray a = env->NewIntArray(n);
+  env->SetIntArrayRegion(a, 0, n, v);
+  return a;
+}
+static jlongArray longs(const jlong* v, int n) {
+  jlongArray a = env->NewLongArray(n);
+  env->SetLongArrayRegion(a, 0, n, v);
+  return a;
+}
+static jfloatArray floats(const jfloat* v, int n) {
+  jfloatArray a = env->NewFloatArray(n);
+  env->SetFloatArrayRegion(a, 0, n, v);
+  return a;
+}
+static jobjectArray strs(const char* const* v, int n) {
+  jobjectArray a = env->NewObjectArray(n, nullptr, nullptr);
+  for (int i = 0; i < n; ++i)
+    env->SetObjectArrayElement(a, i, env->NewStringUTF(v[i]));
+  return a;
+}
+static const char* cstr(jstring s) {
+  return env->GetStringUTFChars(s, nullptr);
+}
+
+int main() {
+  ASSERT(Java_org_mxnettpu_LibInfo_nativeLibInit(env, nullptr) == 0);
+
+  // op registry visible through JNI
+  jobjectArray ops = Java_org_mxnettpu_LibInfo_mxListAllOpNames(env,
+                                                                nullptr);
+  ASSERT(ops != nullptr && env->GetArrayLength(ops) > 200);
+
+  // --- NDArray round trip ----------------------------------------------
+  jint shape[2] = {2, 3};
+  jlong x = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(env, nullptr,
+                                                      ints(shape, 2), 1, 0);
+  ASSERT(x != 0);
+  jfloat xv[6] = {1, 2, 3, 4, 5, 6};
+  ASSERT(Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(
+             env, nullptr, x, floats(xv, 6)) == 0);
+  jfloatArray back = Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(
+      env, nullptr, x, 6);
+  ASSERT(back != nullptr);
+  jfloat bv[6];
+  env->GetFloatArrayRegion(back, 0, 6, bv);
+  for (int i = 0; i < 6; ++i) ASSERT(bv[i] == xv[i]);
+  jintArray shp = Java_org_mxnettpu_LibInfo_mxNDArrayGetShape(env, nullptr,
+                                                              x);
+  jint sv[2];
+  env->GetIntArrayRegion(shp, 0, 2, sv);
+  ASSERT(sv[0] == 2 && sv[1] == 3);
+
+  // --- imperative invoke: sum = x + x ----------------------------------
+  jlong xin[2] = {x, x};
+  jobjectArray e = strs(nullptr, 0);
+  jlongArray sum = Java_org_mxnettpu_LibInfo_mxImperativeInvoke(
+      env, nullptr, env->NewStringUTF("_plus"), longs(xin, 2), e, e,
+      nullptr);
+  ASSERT(sum != nullptr && env->GetArrayLength(sum) == 1);
+  jlong sh;
+  env->GetLongArrayRegion(sum, 0, 1, &sh);
+  jfloatArray sumv = Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(
+      env, nullptr, sh, 6);
+  env->GetFloatArrayRegion(sumv, 0, 6, bv);
+  for (int i = 0; i < 6; ++i) ASSERT(bv[i] == 2 * xv[i]);
+
+  // --- save / load ------------------------------------------------------
+  const char* knames[1] = {"w"};
+  jlong xs[1] = {x};
+  ASSERT(Java_org_mxnettpu_LibInfo_mxNDArraySave(
+             env, nullptr, env->NewStringUTF("/tmp/scala_jni.params"),
+             longs(xs, 1), strs(knames, 1)) == 0);
+  jobjectArray out2 = env->NewObjectArray(2, nullptr, nullptr);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxNDArrayLoad(
+             env, nullptr, env->NewStringUTF("/tmp/scala_jni.params"),
+             out2) == 0);
+  jlongArray lhs = (jlongArray)env->GetObjectArrayElement(out2, 0);
+  jobjectArray lnames = (jobjectArray)env->GetObjectArrayElement(out2, 1);
+  ASSERT(env->GetArrayLength(lhs) == 1);
+  ASSERT(strcmp(cstr((jstring)env->GetObjectArrayElement(lnames, 0)),
+                "w") == 0);
+  remove("/tmp/scala_jni.params");
+
+  // --- symbol: FullyConnected(num_hidden=4, no_bias) -------------------
+  jlong data = Java_org_mxnettpu_LibInfo_mxSymbolCreateVariable(
+      env, nullptr, env->NewStringUTF("data"));
+  const char* pk[2] = {"num_hidden", "no_bias"};
+  const char* pv[2] = {"4", "True"};
+  const char* ak[1] = {"data"};
+  jlong dhs[1] = {data};
+  jlong fc = Java_org_mxnettpu_LibInfo_mxSymbolCreate(
+      env, nullptr, env->NewStringUTF("FullyConnected"), strs(pk, 2),
+      strs(pv, 2), env->NewStringUTF("fc1"), strs(ak, 1), longs(dhs, 1));
+  ASSERT(fc != 0);
+  jobjectArray args = Java_org_mxnettpu_LibInfo_mxSymbolListArguments(
+      env, nullptr, fc);
+  ASSERT(env->GetArrayLength(args) == 2);
+  ASSERT(strcmp(cstr((jstring)env->GetObjectArrayElement(args, 1)),
+                "fc1_weight") == 0);
+
+  // infer shapes: data (2,3) -> weight (4,3), out (2,4)
+  const char* ikeys[1] = {"data"};
+  jint ind[2] = {0, 2};
+  jint sdata[2] = {2, 3};
+  jobjectArray shapes6 = env->NewObjectArray(6, nullptr, nullptr);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxSymbolInferShape(
+             env, nullptr, fc, strs(ikeys, 1), ints(ind, 2), ints(sdata, 2),
+             shapes6) == 1);
+  jintArray arg_ip = (jintArray)env->GetObjectArrayElement(shapes6, 0);
+  jintArray arg_dt = (jintArray)env->GetObjectArrayElement(shapes6, 1);
+  jint ip[3];
+  env->GetIntArrayRegion(arg_ip, 0, 3, ip);
+  ASSERT(ip[0] == 0 && ip[1] == 2 && ip[2] == 4);
+  jint ad[4];
+  env->GetIntArrayRegion(arg_dt, 0, 4, ad);
+  ASSERT(ad[2] == 4 && ad[3] == 3);  // weight (4,3)
+
+  // --- executor ---------------------------------------------------------
+  jfloat dval[6] = {1, 0, 0, 0, 1, 0};
+  jlong dnd = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(env, nullptr,
+                                                        ints(sdata, 2), 1,
+                                                        0);
+  Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(env, nullptr, dnd,
+                                                     floats(dval, 6));
+  jint wshape[2] = {4, 3};
+  jlong wnd = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(env, nullptr,
+                                                        ints(wshape, 2), 1,
+                                                        0);
+  jfloat wval[12];
+  for (int i = 0; i < 12; ++i) wval[i] = (jfloat)(i + 1);
+  Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(env, nullptr, wnd,
+                                                     floats(wval, 12));
+  jlong dgrad = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(env, nullptr,
+                                                          ints(sdata, 2), 1,
+                                                          0);
+  jlong wgrad = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(env, nullptr,
+                                                          ints(wshape, 2),
+                                                          1, 0);
+  jlong bargs[2] = {dnd, wnd};
+  jlong bgrads[2] = {dgrad, wgrad};
+  jint reqs[2] = {1, 1};
+  jlong exec = Java_org_mxnettpu_LibInfo_mxExecutorBind(
+      env, nullptr, fc, 1, 0, longs(bargs, 2), longs(bgrads, 2),
+      ints(reqs, 2), longs(nullptr, 0));
+  ASSERT(exec != 0);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxExecutorForward(env, nullptr, exec,
+                                                     1) == 0);
+  jlongArray outs = Java_org_mxnettpu_LibInfo_mxExecutorOutputs(env,
+                                                                nullptr,
+                                                                exec);
+  ASSERT(outs != nullptr && env->GetArrayLength(outs) == 1);
+  jlong oh;
+  env->GetLongArrayRegion(outs, 0, 1, &oh);
+  jfloatArray ov = Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(
+      env, nullptr, oh, 8);
+  jfloat ovv[8];
+  env->GetFloatArrayRegion(ov, 0, 8, ovv);
+  // out[b,h] = sum_f d[b,f] w[h,f]: row0 = w[:,0] = {1,4,7,10}
+  ASSERT(std::fabs(ovv[0] - 1) < 1e-5 && std::fabs(ovv[1] - 4) < 1e-5);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxExecutorBackward(
+             env, nullptr, exec, longs(nullptr, 0)) == 0);
+  jfloatArray wg = Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(
+      env, nullptr, wgrad, 12);
+  jfloat wgv[12];
+  env->GetFloatArrayRegion(wg, 0, 12, wgv);
+  ASSERT(std::fabs(wgv[0] - 1) < 1e-5 && std::fabs(wgv[2] - 0) < 1e-5);
+
+  // --- predictor --------------------------------------------------------
+  jstring json = Java_org_mxnettpu_LibInfo_mxSymbolSaveToJSON(env, nullptr,
+                                                              fc);
+  ASSERT(json != nullptr);
+  jlong pred = Java_org_mxnettpu_LibInfo_mxPredCreate(
+      env, nullptr, json, nullptr, 1, 0, strs(ikeys, 1), ints(ind, 2),
+      ints(sdata, 2));
+  ASSERT(pred != 0);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxPredSetInput(
+             env, nullptr, pred, env->NewStringUTF("data"),
+             floats(dval, 6)) == 0);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxPredForward(env, nullptr, pred) == 0);
+  jintArray osh = Java_org_mxnettpu_LibInfo_mxPredGetOutputShape(
+      env, nullptr, pred, 0);
+  jint osv[2];
+  env->GetIntArrayRegion(osh, 0, 2, osv);
+  ASSERT(osv[0] == 2 && osv[1] == 4);
+
+  // --- kvstore ----------------------------------------------------------
+  jlong kv = Java_org_mxnettpu_LibInfo_mxKVStoreCreate(
+      env, nullptr, env->NewStringUTF("local"));
+  ASSERT(kv != 0);
+  jint k0[1] = {0};
+  jlong v0[1] = {x};
+  ASSERT(Java_org_mxnettpu_LibInfo_mxKVStoreInit(env, nullptr, kv,
+                                                 ints(k0, 1),
+                                                 longs(v0, 1)) == 0);
+  jlong g0[1] = {sh};  // push x+x
+  ASSERT(Java_org_mxnettpu_LibInfo_mxKVStorePush(env, nullptr, kv,
+                                                 ints(k0, 1), longs(g0, 1),
+                                                 0) == 0);
+  jlong pulled = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(
+      env, nullptr, ints(shape, 2), 1, 0);
+  jlong p0[1] = {pulled};
+  ASSERT(Java_org_mxnettpu_LibInfo_mxKVStorePull(env, nullptr, kv,
+                                                 ints(k0, 1), longs(p0, 1),
+                                                 0) == 0);
+  jfloatArray pf = Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(
+      env, nullptr, pulled, 6);
+  jfloat pfv[6];
+  env->GetFloatArrayRegion(pf, 0, 6, pfv);
+  // push without updater replaces the stored value with the merged grads
+  for (int i = 0; i < 6; ++i) ASSERT(std::fabs(pfv[i] - 2 * xv[i]) < 1e-5);
+
+  printf("SCALA_JNI_TEST_PASS\n");
+  return 0;
+}

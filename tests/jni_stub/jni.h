@@ -1,0 +1,75 @@
+/* Minimal JNI test double — tests/jni_stub.
+ *
+ * Lets the Scala package's JNI shim
+ * (scala-package/native/.../org_mxnettpu_LibInfo.cc) compile and run
+ * WITHOUT a JDK, so it can be linked against the real libmxnet_tpu.so and
+ * driven end to end by tests/cpp/test_scala_jni.cc. Only the JNIEnv
+ * methods the shim uses are provided; the C++ member-call syntax
+ * (env->GetArrayLength(...)) matches the real jni.h, so the same shim
+ * source builds unmodified against a real JDK.
+ */
+#ifndef JNI_STUB_JNI_H_
+#define JNI_STUB_JNI_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef float jfloat;
+typedef jint jsize;
+
+/* opaque reference types (tagged cells in jni_stub.cc) */
+struct _jobject;
+typedef _jobject* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jobject jobjectArray;
+typedef jobject jintArray;
+typedef jobject jlongArray;
+typedef jobject jfloatArray;
+typedef jobject jbyteArray;
+
+struct JNIEnv_;
+typedef JNIEnv_ JNIEnv;
+
+struct JNIEnv_ {
+  const char* GetStringUTFChars(jstring s, unsigned char* isCopy);
+  void ReleaseStringUTFChars(jstring s, const char* chars);
+  jstring NewStringUTF(const char* bytes);
+
+  jsize GetArrayLength(jarray a);
+
+  jintArray NewIntArray(jsize n);
+  void GetIntArrayRegion(jintArray a, jsize start, jsize len, jint* buf);
+  void SetIntArrayRegion(jintArray a, jsize start, jsize len,
+                         const jint* buf);
+
+  jlongArray NewLongArray(jsize n);
+  void GetLongArrayRegion(jlongArray a, jsize start, jsize len, jlong* buf);
+  void SetLongArrayRegion(jlongArray a, jsize start, jsize len,
+                          const jlong* buf);
+
+  jfloatArray NewFloatArray(jsize n);
+  void GetFloatArrayRegion(jfloatArray a, jsize start, jsize len,
+                           jfloat* buf);
+  void SetFloatArrayRegion(jfloatArray a, jsize start, jsize len,
+                           const jfloat* buf);
+
+  jbyteArray NewByteArray(jsize n);
+  void GetByteArrayRegion(jbyteArray a, jsize start, jsize len, jbyte* buf);
+  void SetByteArrayRegion(jbyteArray a, jsize start, jsize len,
+                          const jbyte* buf);
+
+  jclass FindClass(const char* name);
+  jobjectArray NewObjectArray(jsize n, jclass cls, jobject init);
+  jobject GetObjectArrayElement(jobjectArray a, jsize i);
+  void SetObjectArrayElement(jobjectArray a, jsize i, jobject v);
+};
+
+#endif /* JNI_STUB_JNI_H_ */

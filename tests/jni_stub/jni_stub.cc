@@ -1,0 +1,119 @@
+// Implementation of the minimal JNI test double (see jni.h here). jobjects
+// are tagged heap cells; memory is never freed (short-lived test process).
+#include "jni.h"
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+struct _jobject {
+  enum Kind { STR, INTS, LONGS, FLOATS, BYTES, OBJS, CLS } kind;
+  std::string str;
+  std::vector<jint> ints;
+  std::vector<jlong> longs;
+  std::vector<jfloat> floats;
+  std::vector<jbyte> bytes;
+  std::vector<jobject> objs;
+};
+
+namespace {
+jobject cell(_jobject::Kind k) {
+  jobject o = new _jobject();
+  o->kind = k;
+  return o;
+}
+}  // namespace
+
+const char* JNIEnv_::GetStringUTFChars(jstring s, unsigned char*) {
+  return s->str.c_str();
+}
+void JNIEnv_::ReleaseStringUTFChars(jstring, const char*) {}
+jstring JNIEnv_::NewStringUTF(const char* bytes) {
+  jobject o = cell(_jobject::STR);
+  o->str = bytes ? bytes : "";
+  return o;
+}
+
+jsize JNIEnv_::GetArrayLength(jarray a) {
+  switch (a->kind) {
+    case _jobject::INTS: return (jsize)a->ints.size();
+    case _jobject::LONGS: return (jsize)a->longs.size();
+    case _jobject::FLOATS: return (jsize)a->floats.size();
+    case _jobject::BYTES: return (jsize)a->bytes.size();
+    case _jobject::OBJS: return (jsize)a->objs.size();
+    default: return 0;
+  }
+}
+
+jintArray JNIEnv_::NewIntArray(jsize n) {
+  jobject o = cell(_jobject::INTS);
+  o->ints.resize(n, 0);
+  return o;
+}
+void JNIEnv_::GetIntArrayRegion(jintArray a, jsize start, jsize len,
+                                jint* buf) {
+  std::memcpy(buf, a->ints.data() + start, len * sizeof(jint));
+}
+void JNIEnv_::SetIntArrayRegion(jintArray a, jsize start, jsize len,
+                                const jint* buf) {
+  std::memcpy(a->ints.data() + start, buf, len * sizeof(jint));
+}
+
+jlongArray JNIEnv_::NewLongArray(jsize n) {
+  jobject o = cell(_jobject::LONGS);
+  o->longs.resize(n, 0);
+  return o;
+}
+void JNIEnv_::GetLongArrayRegion(jlongArray a, jsize start, jsize len,
+                                 jlong* buf) {
+  std::memcpy(buf, a->longs.data() + start, len * sizeof(jlong));
+}
+void JNIEnv_::SetLongArrayRegion(jlongArray a, jsize start, jsize len,
+                                 const jlong* buf) {
+  std::memcpy(a->longs.data() + start, buf, len * sizeof(jlong));
+}
+
+jfloatArray JNIEnv_::NewFloatArray(jsize n) {
+  jobject o = cell(_jobject::FLOATS);
+  o->floats.resize(n, 0.0f);
+  return o;
+}
+void JNIEnv_::GetFloatArrayRegion(jfloatArray a, jsize start, jsize len,
+                                  jfloat* buf) {
+  std::memcpy(buf, a->floats.data() + start, len * sizeof(jfloat));
+}
+void JNIEnv_::SetFloatArrayRegion(jfloatArray a, jsize start, jsize len,
+                                  const jfloat* buf) {
+  std::memcpy(a->floats.data() + start, buf, len * sizeof(jfloat));
+}
+
+jbyteArray JNIEnv_::NewByteArray(jsize n) {
+  jobject o = cell(_jobject::BYTES);
+  o->bytes.resize(n, 0);
+  return o;
+}
+void JNIEnv_::GetByteArrayRegion(jbyteArray a, jsize start, jsize len,
+                                 jbyte* buf) {
+  std::memcpy(buf, a->bytes.data() + start, len * sizeof(jbyte));
+}
+void JNIEnv_::SetByteArrayRegion(jbyteArray a, jsize start, jsize len,
+                                 const jbyte* buf) {
+  std::memcpy(a->bytes.data() + start, buf, len * sizeof(jbyte));
+}
+
+jclass JNIEnv_::FindClass(const char* name) {
+  jobject o = cell(_jobject::CLS);
+  o->str = name;
+  return o;
+}
+jobjectArray JNIEnv_::NewObjectArray(jsize n, jclass, jobject init) {
+  jobject o = cell(_jobject::OBJS);
+  o->objs.resize(n, init);
+  return o;
+}
+jobject JNIEnv_::GetObjectArrayElement(jobjectArray a, jsize i) {
+  return a->objs[i];
+}
+void JNIEnv_::SetObjectArrayElement(jobjectArray a, jsize i, jobject v) {
+  a->objs[i] = v;
+}
