@@ -1,0 +1,83 @@
+"""Fast Gradient Sign Method adversarial examples (reference
+example/adversary/adversary_generation.ipynb): train a small classifier,
+then perturb inputs along the sign of the loss gradient w.r.t. the DATA
+(``inputs_need_grad=True``) and measure the accuracy collapse.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def make_net():
+    x = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(x, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def accuracy(mod, X, y, batch):
+    correct = 0
+    for i in range(0, len(X), batch):
+        xb = mx.nd.array(X[i:i + batch])
+        mod.forward(mx.io.DataBatch(data=[xb], label=[]), is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        correct += int((pred == y[i:i + batch]).sum())
+    return correct / float(len(X))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="FGSM demo")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epoch", type=int, default=8)
+    parser.add_argument("--epsilon", type=float, default=0.3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    n, dim = 4096, 64
+    protos = rng.rand(10, dim).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = protos[y] + 0.2 * rng.rand(n, dim).astype(np.float32)
+
+    it = mx.io.NDArrayIter(X, y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(make_net())
+    mod.fit(it, num_epoch=args.num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+
+    # rebind with inputs_need_grad to reach d(loss)/d(data)
+    adv = mx.mod.Module(make_net())
+    adv.bind(data_shapes=[("data", (args.batch_size, dim))],
+             label_shapes=[("softmax_label", (args.batch_size,))],
+             inputs_need_grad=True)
+    adv.set_params(*mod.get_params())
+
+    clean_acc = accuracy(adv, X, y, args.batch_size)
+
+    X_adv = X.copy()
+    for i in range(0, n, args.batch_size):
+        xb = mx.nd.array(X[i:i + args.batch_size])
+        yb = mx.nd.array(y[i:i + args.batch_size].astype(np.float32))
+        adv.forward(mx.io.DataBatch(data=[xb], label=[yb]), is_train=True)
+        adv.backward()
+        g = adv.get_input_grads()[0].asnumpy()
+        X_adv[i:i + args.batch_size] += args.epsilon * np.sign(g)
+
+    adv_acc = accuracy(adv, X_adv, y, args.batch_size)
+    print("clean accuracy %.3f -> adversarial accuracy %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, args.epsilon))
+    assert clean_acc > 0.9 and adv_acc < clean_acc - 0.2, \
+        "FGSM should collapse accuracy"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""CNN for sentence classification, Kim 2014 (reference
+example/cnn_text_classification/text_cnn.py): embedding -> parallel
+convolutions with window sizes 2/3/4 -> max-over-time pooling -> concat
+-> dropout -> softmax. Synthetic task: a sentence is positive iff it
+contains any bigram (k, k+1).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def make_net(seq_len, vocab, embed_dim, num_filter, windows):
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed_dim,
+                             name="embed")
+    # NCHW: 1 input channel, H = time, W = embedding
+    conv_input = mx.sym.Reshape(embed, shape=(-1, 1, seq_len, embed_dim))
+    pooled = []
+    for w in windows:
+        c = mx.sym.Convolution(conv_input, kernel=(w, embed_dim),
+                               num_filter=num_filter, name="conv%d" % w)
+        c = mx.sym.Activation(c, act_type="relu")
+        p = mx.sym.Pooling(c, pool_type="max",
+                           kernel=(seq_len - w + 1, 1), name="pool%d" % w)
+        pooled.append(p)
+    h = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Flatten(h)
+    h = mx.sym.Dropout(h, p=0.3)
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="text CNN")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epoch", type=int, default=10)
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--vocab", type=int, default=50)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    n = 4096
+    X = rng.randint(0, args.vocab, (n, args.seq_len))
+    y = np.zeros(n, np.float32)
+    for i in range(n):
+        if i % 2 == 0:  # plant a sentinel bigram (7, 8)
+            pos = rng.randint(0, args.seq_len - 1)
+            X[i, pos], X[i, pos + 1] = 7, 8
+            y[i] = 1
+        else:  # make sure no accidental sentinel bigram survives
+            for t in range(args.seq_len - 1):
+                if X[i, t] == 7 and X[i, t + 1] == 8:
+                    X[i, t + 1] = 9
+    it = mx.io.NDArrayIter(X.astype(np.float32), y,
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(make_net(args.seq_len, args.vocab, 16, 8,
+                                 (2, 3, 4)))
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=args.num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": 0.005},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    acc = metric.get()[1]
+    print("bigram-detection accuracy: %.3f" % acc)
+    assert acc > 0.9, "text CNN should spot the sentinel bigram"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Multi-task training (reference example/multi-task/example_multi_task.py):
+one trunk, two softmax heads (digit class + parity), grouped with
+``mx.sym.Group`` and trained through a Module with two labels.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def make_net():
+    x = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(x, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    digit = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=10, name="fc_digit"),
+        name="digit")
+    parity = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=2, name="fc_parity"),
+        name="parity")
+    return mx.sym.Group([digit, parity])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy via the base class's multi-output (num=) mode."""
+
+    def __init__(self):
+        super(MultiAccuracy, self).__init__("acc", num=2)
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            label = labels[i].asnumpy().astype(int)
+            self.sum_metric[i] += float((pred == label).sum())
+            self.num_inst[i] += len(label)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="multi-task training")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epoch", type=int, default=25)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    n, dim = 4096, 64
+    protos = rng.rand(10, dim).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = protos[y] + 0.2 * rng.rand(n, dim).astype(np.float32)
+    y_par = (y % 2).astype(np.float32)
+
+    it = mx.io.NDArrayIter(
+        X, {"digit_label": y.astype(np.float32), "parity_label": y_par},
+        batch_size=args.batch_size, shuffle=True)
+    mod = mx.mod.Module(make_net(),
+                        label_names=("digit_label", "parity_label"))
+    metric = MultiAccuracy()
+    mod.fit(it, num_epoch=args.num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    names, accs = metric.get()
+    print(" ".join("%s=%.3f" % (nm, v) for nm, v in zip(names, accs)))
+    assert min(accs) > 0.9, "both heads should learn"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Matrix-factorization recommender (reference example/recommenders/
+demo1-MF.ipynb + matrix_fact.py in later releases): user and item
+embeddings, prediction = dot(user_vec, item_vec), trained with
+LinearRegressionOutput on synthetic low-rank ratings.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def make_net(num_users, num_items, factor):
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=factor,
+                         name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items, output_dim=factor,
+                         name="item_embed")
+    pred = mx.sym.sum_axis(u * v, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, name="score")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="matrix factorization")
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--num-epoch", type=int, default=15)
+    parser.add_argument("--factor", type=int, default=8)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    num_users, num_items, rank = 200, 100, 4
+    U = rng.randn(num_users, rank).astype(np.float32) / np.sqrt(rank)
+    V = rng.randn(num_items, rank).astype(np.float32) / np.sqrt(rank)
+    n = 20000
+    users = rng.randint(0, num_users, n)
+    items = rng.randint(0, num_items, n)
+    ratings = (U[users] * V[items]).sum(axis=1) + \
+        0.05 * rng.randn(n).astype(np.float32)
+
+    it = mx.io.NDArrayIter(
+        {"user": users.astype(np.float32),
+         "item": items.astype(np.float32)},
+        ratings, batch_size=args.batch_size, shuffle=True,
+        label_name="score_label")
+    mod = mx.mod.Module(make_net(num_users, num_items, args.factor),
+                        data_names=("user", "item"),
+                        label_names=("score_label",))
+    metric = mx.metric.MSE()
+    mod.fit(it, num_epoch=args.num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.initializer.Normal(0.1), eval_metric=metric)
+    mse = metric.get()[1]
+    var = float(ratings.var())
+    print("rating MSE %.4f (rating variance %.4f)" % (mse, var))
+    assert mse < 0.3 * var, "MF should explain most rating variance"
+
+
+if __name__ == "__main__":
+    main()

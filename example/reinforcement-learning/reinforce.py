@@ -1,0 +1,130 @@
+"""Policy-gradient REINFORCE (reference example/reinforcement-learning/
+a3c + ddpg families; this is the minimal on-policy member). Environment
+is an in-file bandit-gridworld: state = one-hot position on a line,
+actions move left/right, reward at the right end. The policy gradient
+ -log pi(a|s) * advantage is expressed with pick + log + MakeLoss, so
+the whole update is one symbolic graph (no per-sample Python loss).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+class LineWorld(object):
+    """Agent starts at cell 0 of a line of `n` cells; reaching the last
+    cell within the horizon pays +1, each step pays -0.01."""
+
+    def __init__(self, n=12, horizon=36):
+        self.n = n
+        self.horizon = horizon
+
+    def episode(self, policy_fn, rng):
+        states, actions, rewards = [], [], []
+        pos = 0
+        for _ in range(self.horizon):
+            s = np.zeros(self.n, np.float32)
+            s[pos] = 1.0
+            a = policy_fn(s, rng)
+            states.append(s)
+            actions.append(a)
+            pos = max(0, pos - 1) if a == 0 else min(self.n - 1, pos + 1)
+            if pos == self.n - 1:
+                rewards.append(1.0)
+                break
+            rewards.append(-0.01)
+        return states, actions, rewards
+
+
+def make_policy(n_state, n_action):
+    s = mx.sym.Variable("state")
+    act = mx.sym.Variable("action")
+    adv = mx.sym.Variable("advantage")
+    h = mx.sym.FullyConnected(s, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    logits = mx.sym.FullyConnected(h, num_hidden=n_action, name="fc2")
+    prob = mx.sym.softmax(logits, name="prob")
+    logp = mx.sym.log(mx.sym.pick(prob, act, axis=1) + 1e-8)
+    loss = mx.sym.MakeLoss(mx.sym._mul_scalar(logp * adv, scalar=-1.0),
+                           name="pg")
+    # prob exposed (grad-blocked) so sampling uses the same executor
+    return mx.sym.Group([loss, mx.sym.BlockGrad(prob)])
+
+
+def main():
+    parser = argparse.ArgumentParser(description="REINFORCE on LineWorld")
+    parser.add_argument("--episodes", type=int, default=300)
+    parser.add_argument("--gamma", type=float, default=0.98)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    env = LineWorld()
+    rng = np.random.RandomState(0)
+    batch = env.horizon  # max steps per episode
+
+    mod = mx.mod.Module(make_policy(env.n, 2),
+                        data_names=("state", "action", "advantage"),
+                        label_names=())
+    mod.bind(data_shapes=[("state", (batch, env.n)),
+                          ("action", (batch,)),
+                          ("advantage", (batch,))],
+             label_shapes=None)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    zeros_a = mx.nd.array(np.zeros(batch, np.float32))
+
+    def policy_fn(s, rng):
+        st = np.zeros((batch, env.n), np.float32)
+        st[0] = s
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(st), zeros_a, zeros_a], label=[]),
+            is_train=False)
+        p = mod.get_outputs()[1].asnumpy()[0]
+        return int(rng.rand() < p[1])
+
+    returns_hist = []
+    for ep in range(args.episodes):
+        states, actions, rewards = env.episode(policy_fn, rng)
+        # discounted returns, normalized as the advantage
+        G = np.zeros(len(rewards), np.float32)
+        run = 0.0
+        for t in reversed(range(len(rewards))):
+            run = rewards[t] + args.gamma * run
+            G[t] = run
+        returns_hist.append(float(G[0]))
+        adv = (G - G.mean()) / (G.std() + 1e-6) if len(G) > 1 else G
+        T = len(states)
+        st = np.zeros((batch, env.n), np.float32)
+        st[:T] = np.asarray(states)
+        ac = np.zeros(batch, np.float32)
+        ac[:T] = np.asarray(actions, np.float32)
+        ad = np.zeros(batch, np.float32)
+        ad[:T] = adv  # padded steps contribute zero loss
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(st), mx.nd.array(ac), mx.nd.array(ad)],
+            label=[]), is_train=True)
+        mod.backward()
+        mod.update()
+        if (ep + 1) % 100 == 0:
+            logging.info("episode %d  mean return (last 50) %.3f", ep + 1,
+                         np.mean(returns_hist[-50:]))
+
+    final = np.mean(returns_hist[-50:])
+    first = np.mean(returns_hist[:50])
+    print("mean return: first 50 episodes %.3f -> last 50 %.3f"
+          % (first, final))
+    # a random policy on a 12-cell line almost never reaches the goal
+    # within the horizon; a learned right-bias does consistently
+    assert final > 0.4 and final > first, "policy should improve"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Train a classifier with the SVMOutput large-margin loss (reference
+example/svm_mnist/svm_mnist.py): same net as a softmax MLP but the head
+optimizes a hinge loss (L2 regularized by ``regularization_coefficient``).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def main():
+    parser = argparse.ArgumentParser(description="SVM-output MLP")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epoch", type=int, default=10)
+    parser.add_argument("--use-linear", action="store_true",
+                        help="L1 hinge (use_linear) instead of L2")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    n, dim = 4096, 64
+    protos = rng.rand(10, dim).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = protos[y] + 0.2 * rng.rand(n, dim).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    net = mx.sym.SVMOutput(h, margin=1.0,
+                           regularization_coefficient=1.0,
+                           use_linear=args.use_linear, name="svm")
+
+    it = mx.io.NDArrayIter(X, y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="svm_label")
+    mod = mx.mod.Module(net, label_names=("svm_label",))
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=args.num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    acc = metric.get()[1]
+    print("SVM accuracy: %.3f" % acc)
+    assert acc > 0.9, "SVM head should learn"
+
+
+if __name__ == "__main__":
+    main()

@@ -293,6 +293,10 @@ class LSTMBias(Initializer):
         b[num_hidden:2 * num_hidden] = self.forget_bias  # cuDNN order i,f,g,o
         arr[:] = b
 
+    # per-variable __init__ attrs dispatch through _init_weight (reference
+    # initializer.py InitDesc path), so the bias rule must live there too
+    _init_weight = _init_bias
+
 
 @register
 class FusedRNN(Initializer):

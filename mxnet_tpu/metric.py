@@ -219,8 +219,10 @@ class MAE(EvalMetric):
         for label, pred in zip(labels, preds):
             label = label.asnumpy()
             pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
+            # normalize BOTH to (N, -1): a 1-D pred against an (N,1) label
+            # would otherwise broadcast to an (N,N) difference matrix
+            label = label.reshape(label.shape[0], -1)
+            pred = pred.reshape(pred.shape[0], -1)
             self.sum_metric += numpy.abs(label - pred).mean()
             self.num_inst += 1
 
@@ -234,8 +236,10 @@ class MSE(EvalMetric):
         for label, pred in zip(labels, preds):
             label = label.asnumpy()
             pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
+            # normalize BOTH to (N, -1): a 1-D pred against an (N,1) label
+            # would otherwise broadcast to an (N,N) difference matrix
+            label = label.reshape(label.shape[0], -1)
+            pred = pred.reshape(pred.shape[0], -1)
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
 
@@ -249,8 +253,10 @@ class RMSE(EvalMetric):
         for label, pred in zip(labels, preds):
             label = label.asnumpy()
             pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
+            # normalize BOTH to (N, -1): a 1-D pred against an (N,1) label
+            # would otherwise broadcast to an (N,N) difference matrix
+            label = label.reshape(label.shape[0], -1)
+            pred = pred.reshape(pred.shape[0], -1)
             self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
             self.num_inst += 1
 

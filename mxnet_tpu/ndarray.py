@@ -166,6 +166,13 @@ class NDArray:
         """Copy to host numpy array (blocking read, = WaitToRead + copy)."""
         return onp.asarray(self._read())
 
+    def __array__(self, dtype=None, copy=None):
+        # numpy protocol: without this, onp.asarray(nd) walks __getitem__
+        # row by row — one jitted slice per element. asnumpy() is already
+        # a fresh host copy, so copy=False is satisfiable (NumPy 2 kwarg).
+        a = self.asnumpy()
+        return a.astype(dtype, copy=False) if dtype is not None else a
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("The current array is not a scalar")

@@ -85,10 +85,16 @@ class BaseRNNCell(object):
             if func is None:
                 state = symbol.Variable(name, lr_mult=0.0)
             else:
+                # state_info supplies defaults (shape (0, H) = unknown
+                # batch); caller kwargs override them, so
+                # begin_state(func=zeros, shape=(N, H)) yields concrete
+                # shapes (reference rnn_cell.py begin_state)
+                merged = {}
                 if info is not None:
-                    kwargs.update({k: v for k, v in info.items()
+                    merged.update({k: v for k, v in info.items()
                                    if not k.startswith("__")})
-                state = func(name=name, **kwargs)
+                merged.update(kwargs)
+                state = func(name=name, **merged)
             states.append(state)
         return states
 

@@ -68,16 +68,26 @@ class FeedForward(val symbol: Symbol, val ctx: Context = Context.defaultCtx,
                                        states.getOrElse(i, null))
         }
         val keep = lbuf.length - pad
-        val out = exec.outputs.head
+        val outs = exec.outputs
         metric.update(lbuf.take(keep),
-                      out.toArray.take(keep * numClasses), numClasses)
-        out.close()
+                      outs.head.toArray.take(keep * numClasses),
+                      numClasses)
+        outs.foreach(_.close())  // every output handle carries a +1 ref
       }
     }
     argParams = argNames.zip(exec.argArrays).filterNot { case (n, _) =>
       inputShapes.contains(n)
     }.toMap
     auxParams = symbol.listAuxiliaryStates().zip(exec.auxArrays).toMap
+    // free what the model does not keep: the executor, the gradient
+    // buffers, and the bound data/label input arrays (params/aux live on
+    // in argParams/auxParams)
+    exec.close()
+    grads.foreach(g => if (g != null) g.close())
+    argNames.zip(args).foreach { case (n, a) =>
+      if (inputShapes.contains(n)) a.close()
+    }
+    states.values.foreach(optimizer.release)
     this
   }
 

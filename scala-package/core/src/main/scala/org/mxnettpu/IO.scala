@@ -16,8 +16,12 @@ class NDArrayIter(data: Array[Float], dataShape: Shape,
   private val n = dataShape(0)
   private val rowSize = dataShape.product / n
   private var cursor = 0
-  private var order: Array[Int] = (0 until n).toArray
   private val rng = new scala.util.Random(0)
+  // shuffled from the FIRST epoch — callers may drain next() without an
+  // initial reset()
+  private var order: Array[Int] =
+    if (shuffle) rng.shuffle((0 until n).toSeq).toArray
+    else (0 until n).toArray
 
   def reset(): Unit = {
     cursor = 0

@@ -10,6 +10,12 @@ abstract class Optimizer(val learningRate: Float, val wd: Float,
     * to the next step (created lazily on first use).
     */
   def update(weight: NDArray, grad: NDArray, state: AnyRef): AnyRef
+
+  /** Free any native arrays held by an optimizer state. */
+  def release(state: AnyRef): Unit = state match {
+    case nd: NDArray => nd.close()
+    case _ =>
+  }
 }
 
 class SGD(learningRate: Float = 0.01f, momentum: Float = 0f,
@@ -40,6 +46,11 @@ class Adam(learningRate: Float = 0.001f, beta1: Float = 0.9f,
     extends Optimizer(learningRate, wd, rescaleGrad) {
   private class State(val mean: NDArray, val variance: NDArray,
                       var t: Int)
+
+  override def release(state: AnyRef): Unit = state match {
+    case s: State => s.mean.close(); s.variance.close()
+    case _ =>
+  }
 
   override def update(weight: NDArray, grad: NDArray,
                       state: AnyRef): AnyRef = {

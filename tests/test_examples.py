@@ -1,0 +1,46 @@
+"""Integration tier: the example-family scripts run end to end (synthetic
+data) and hit their built-in learning asserts. Mirrors the reference's
+example smoke coverage (tests/python/train + examples run in CI).
+
+Each script asserts its own success criterion (accuracy/MSE/return), so
+a pass here means the family genuinely trains, not just imports.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CASES = [
+    ("autoencoder/autoencoder.py", ["--num-epoch", "6"]),
+    ("adversary/fgsm.py", ["--num-epoch", "5"]),
+    ("multi-task/multitask.py", ["--num-epoch", "25"]),
+    ("svm_mnist/svm_mnist.py", ["--num-epoch", "8"]),
+    ("numpy-ops/custom_softmax.py", ["--num-epoch", "5"]),
+    ("recommenders/matrix_fact.py", ["--num-epoch", "15"]),
+    ("gan/gan_mnist.py", ["--num-iter", "60"]),
+    ("cnn_text_classification/text_cnn.py", ["--num-epoch", "6"]),
+    ("bi-lstm-sort/sort_lstm.py", ["--num-epoch", "8"]),
+    ("reinforcement-learning/reinforce.py", ["--episodes", "250"]),
+]
+
+
+@pytest.mark.parametrize("script,args",
+                         CASES, ids=[c[0].split("/")[0] for c in CASES])
+def test_example_trains(script, args):
+    path = os.path.join(ROOT, "example", script)
+    # single CPU device: examples tune their hyperparameters for one
+    # device; under the suite's 8-way virtual mesh the tiny per-device
+    # batches change training dynamics (multi-chip correctness has its
+    # own tier — test_module_fused / dryrun_multichip)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-u", path] + args,
+                          capture_output=True, text=True, timeout=900,
+                          env=env)
+    assert proc.returncode == 0, (
+        "%s failed:\n%s\n%s" % (script, proc.stdout[-2000:],
+                                proc.stderr[-2000:]))

@@ -158,3 +158,22 @@ def test_module_checkpoint_resume():
         train.reset()
         acc = mod2.score(train, mx.metric.Accuracy())[0][1]
         assert acc > 0.9, "resumed accuracy %f" % acc
+
+
+def test_regression_metrics_1d_pred_no_broadcast():
+    """A 1-D prediction vector against a 1-D label must not broadcast to
+    an (N,N) difference matrix (label was reshaped to (N,1) while pred
+    stayed (N,)) — regression for the metric.py MSE/MAE/RMSE trap."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    label = rng.randn(64).astype(np.float32)
+    pred = rng.randn(64).astype(np.float32)
+    expect_mse = float(((label - pred) ** 2).mean())
+    for metric, expect in [(mx.metric.MSE(), expect_mse),
+                           (mx.metric.MAE(),
+                            float(np.abs(label - pred).mean())),
+                           (mx.metric.RMSE(), float(np.sqrt(expect_mse)))]:
+        metric.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        assert abs(metric.get()[1] - expect) < 1e-5, metric.get()
