@@ -1,0 +1,84 @@
+"""Noise-contrastive estimation for embedding training (reference
+example/nce-loss/{nce.py,wordvec.py}): instead of a full-vocab softmax,
+each positive target is scored against k sampled noise words with a
+shared logistic loss — the classic large-vocab trick.
+
+Synthetic skip-gram-ish task: words co-occur within blocks of 10 ids,
+so NCE-trained embeddings should place same-block words closer.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def make_nce_net(vocab, dim, k):
+    center = mx.sym.Variable("center")          # (N,)
+    targets = mx.sym.Variable("targets")        # (N, 1+k) pos + noise ids
+    nce_label = mx.sym.Variable("nce_label")    # (N, 1+k) 1 for pos
+    c = mx.sym.Embedding(center, input_dim=vocab, output_dim=dim,
+                         name="embed_in")
+    t = mx.sym.Embedding(targets, input_dim=vocab, output_dim=dim,
+                         name="embed_out")
+    # scores: dot(center, target_j) per candidate, (N, 1+k)
+    ce = mx.sym.Reshape(c, shape=(-1, 1, dim))
+    scores = mx.sym.sum_axis(mx.sym.broadcast_mul(ce, t), axis=2)
+    return mx.sym.LogisticRegressionOutput(scores, label=nce_label,
+                                           name="nce")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="NCE embeddings")
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--num-epoch", type=int, default=12)
+    parser.add_argument("--neg", type=int, default=8)
+    parser.add_argument("--dim", type=int, default=16)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    vocab, n = 100, 40960
+    centers = rng.randint(0, vocab, n)
+    block = centers // 10
+    positives = block * 10 + rng.randint(0, 10, n)  # same-block word
+
+    k = args.neg
+    targets = np.empty((n, 1 + k), np.float32)
+    labels = np.zeros((n, 1 + k), np.float32)
+    targets[:, 0] = positives
+    labels[:, 0] = 1.0
+    targets[:, 1:] = rng.randint(0, vocab, (n, k))  # noise ~ uniform
+
+    it = mx.io.NDArrayIter(
+        {"center": centers.astype(np.float32), "targets": targets},
+        {"nce_label": labels}, batch_size=args.batch_size, shuffle=True)
+    mod = mx.mod.Module(make_nce_net(vocab, args.dim, k),
+                        data_names=("center", "targets"),
+                        label_names=("nce_label",))
+    mod.fit(it, num_epoch=args.num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.initializer.Normal(0.1),
+            eval_metric=mx.metric.MSE())
+
+    # same-block pairs must be closer than cross-block pairs
+    E = mod.get_params()[0]["embed_in_weight"].asnumpy()
+    En = E / (np.linalg.norm(E, axis=1, keepdims=True) + 1e-8)
+    sim = En @ En.T
+    same = np.mean([sim[i, j] for i in range(vocab)
+                    for j in range(vocab)
+                    if i != j and i // 10 == j // 10])
+    cross = np.mean([sim[i, j] for i in range(0, vocab, 7)
+                     for j in range(vocab)
+                     if i // 10 != j // 10])
+    print("mean cosine: same-block %.3f vs cross-block %.3f"
+          % (same, cross))
+    assert same > cross + 0.2, "NCE should cluster co-occurring words"
+
+
+if __name__ == "__main__":
+    main()

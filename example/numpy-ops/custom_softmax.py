@@ -1,7 +1,8 @@
 """Custom operators in Python (reference example/numpy-ops/
-custom_softmax.py + numpy_softmax.py): the softmax loss written three
-ways — CustomOp (the modern interface), NumpyOp (legacy), and the
-built-in — all trained on the same data to the same accuracy.
+custom_softmax.py): the softmax loss via CustomOp (the modern
+interface) trained head-to-head against the built-in SoftmaxOutput to
+the same accuracy. (The legacy NumpyOp interface is covered by
+tests/test_custom_op.py.)
 
 CustomOp forward/backward run as host callbacks (pure_callback) inside
 the XLA graph; see mxnet_tpu/operator.py.

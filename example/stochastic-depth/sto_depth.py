@@ -1,0 +1,107 @@
+"""Stochastic depth residual training (reference example/stochastic-depth/
+sd_module.py + sd_mnist.py — there built on custom Modules; here the
+TPU-natural form: residual branches gated by Bernoulli draws resampled
+once per epoch through set_params, keeping the train step a single
+compiled program with no shape changes).
+
+Each residual block computes x + gate * alpha * F(x); `gate` is a
+0/1 auxiliary-style input resampled every epoch with survival
+probability p_l decaying linearly with depth (Huang et al. 2016). At
+test time gates are fixed to their survival probabilities.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def make_net(num_blocks, hidden):
+    x = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(x, num_hidden=hidden, name="stem")
+    h = mx.sym.Activation(h, act_type="relu")
+    for i in range(num_blocks):
+        # non-learned 0/1 draw, one per epoch (lr_mult=0 freezes it;
+        # explicit shape since broadcast can't infer it backward)
+        gate = mx.sym.Variable("gate%d" % i, shape=(1,), lr_mult=0.0)
+        f = mx.sym.FullyConnected(h, num_hidden=hidden,
+                                  name="block%d_fc" % i)
+        f = mx.sym.Activation(f, act_type="relu")
+        h = h + mx.sym.broadcast_mul(f, mx.sym.Reshape(gate,
+                                                       shape=(1, 1)))
+    out = mx.sym.FullyConnected(h, num_hidden=10, name="head")
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="stochastic depth MLP")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epoch", type=int, default=15)
+    parser.add_argument("--blocks", type=int, default=6)
+    parser.add_argument("--p-final", type=float, default=0.5)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    n, dim = 4096, 64
+    protos = rng.rand(10, dim).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = protos[y] + 0.2 * rng.rand(n, dim).astype(np.float32)
+
+    L = args.blocks
+    survival = 1.0 - (np.arange(1, L + 1) / float(L)) * \
+        (1.0 - args.p_final)  # linear decay, p_1≈1 .. p_L=p_final
+
+    net = make_net(L, 64)
+    gate_names = ["gate%d" % i for i in range(L)]
+    it = mx.io.NDArrayIter(X, y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    # gates start at 1 (all branches alive) — Mixed routes them past the
+    # weight initializer's name patterns
+    mod.init_params(mx.initializer.Mixed(
+        ["gate.*", ".*"], [mx.initializer.One(), mx.initializer.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.002})
+    # gates are non-learned args: freeze them out of the update by name
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.num_epoch):
+        gates = (rng.rand(L) < survival).astype(np.float32)
+        arg, aux = mod.get_params()
+        arg = dict(arg)
+        for nm, g in zip(gate_names, gates):
+            arg[nm] = mx.nd.array(np.array([g], np.float32))
+        mod.set_params(arg, aux, allow_missing=True)
+        it.reset()
+        metric.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+            mod.update_metric(metric, b.label)
+        logging.info("epoch %d gates=%s acc=%.3f", epoch,
+                     gates.astype(int).tolist(), metric.get()[1])
+
+    # inference: expected gates = survival probabilities
+    arg, aux = mod.get_params()
+    arg = dict(arg)
+    for nm, p in zip(gate_names, survival):
+        arg[nm] = mx.nd.array(np.array([p], np.float32))
+    mod.set_params(arg, aux, allow_missing=True)
+    it.reset()
+    metric.reset()
+    for b in it:
+        mod.forward(b, is_train=False)
+        mod.update_metric(metric, b.label)
+    acc = metric.get()[1]
+    print("test-mode accuracy (expected gates): %.3f" % acc)
+    assert acc > 0.9, "stochastic-depth net should classify"
+
+
+if __name__ == "__main__":
+    main()

@@ -107,10 +107,12 @@ class Accuracy(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
             p = pred_label.asnumpy()
-            # reference: argmax over channels whenever shapes differ
-            # (metric.py Accuracy / argmax_channel)
+            # reference: argmax over the CHANNEL axis (axis 1) whenever
+            # shapes differ (metric.py Accuracy / ndarray argmax_channel);
+            # for the common (N, C) case that equals argmax(-1), and for
+            # multi_output softmax (N, C, H, W) it yields per-pixel labels
             if p.shape != tuple(label.shape) and p.ndim > 1:
-                p = numpy.argmax(p, axis=-1)
+                p = numpy.argmax(p, axis=1)
             p = p.astype("int32").reshape(-1)
             l = label.asnumpy().astype("int32").reshape(-1)
             check_label_shapes(l, p)

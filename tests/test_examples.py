@@ -20,10 +20,14 @@ CASES = [
     ("svm_mnist/svm_mnist.py", ["--num-epoch", "8"]),
     ("numpy-ops/custom_softmax.py", ["--num-epoch", "5"]),
     ("recommenders/matrix_fact.py", ["--num-epoch", "15"]),
-    ("gan/gan_mnist.py", ["--num-iter", "60"]),
+    ("gan/gan_mnist.py", ["--num-iter", "500"]),
     ("cnn_text_classification/text_cnn.py", ["--num-epoch", "6"]),
     ("bi-lstm-sort/sort_lstm.py", ["--num-epoch", "8"]),
     ("reinforcement-learning/reinforce.py", ["--episodes", "250"]),
+    ("fcn-xs/fcn_xs.py", ["--num-epoch", "8"]),
+    ("nce-loss/nce_embedding.py", ["--num-epoch", "8"]),
+    ("stochastic-depth/sto_depth.py", ["--num-epoch", "12"]),
+    ("module/mnist_mlp.py", []),
 ]
 
 
