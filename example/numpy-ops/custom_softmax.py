@@ -1,8 +1,8 @@
 """Custom operators in Python (reference example/numpy-ops/
 custom_softmax.py): the softmax loss via CustomOp (the modern
 interface) trained head-to-head against the built-in SoftmaxOutput to
-the same accuracy. (The legacy NumpyOp interface is covered by
-tests/test_custom_op.py.)
+the same accuracy. (The legacy NumpyOp alias is exercised by
+tests/test_custom_op.py::test_legacy_numpy_op_alias.)
 
 CustomOp forward/backward run as host callbacks (pure_callback) inside
 the XLA graph; see mxnet_tpu/operator.py.

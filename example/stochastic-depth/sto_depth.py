@@ -69,7 +69,8 @@ def main():
         ["gate.*", ".*"], [mx.initializer.One(), mx.initializer.Xavier()]))
     mod.init_optimizer(optimizer="adam",
                        optimizer_params={"learning_rate": 0.002})
-    # gates are non-learned args: freeze them out of the update by name
+    # (gates are frozen by the lr_mult=0.0 on their Variables, honored
+    # through __lr_mult__ symbol attrs in the optimizer)
     metric = mx.metric.Accuracy()
     for epoch in range(args.num_epoch):
         gates = (rng.rand(L) < survival).astype(np.float32)

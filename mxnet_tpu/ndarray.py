@@ -278,11 +278,16 @@ class NDArray:
     def __setitem__(self, key, value):
         view = self[key] if not (isinstance(key, _py_slice) and key.start is None
                                  and key.stop is None and key.step is None) else self
-        jnp = _jnp()
         if isinstance(value, NDArray):
             value.copyto(view)
         elif isinstance(value, numeric_types):
-            view._write(jnp.full(view.shape, value, dtype=view.dtype))
+            # fill on the array's OWN device — jnp.full would land on the
+            # default accelerator and silently migrate a cpu-ctx array
+            # (then one jitted step over mixed devices fails to compile)
+            import jax
+            view._write(jax.device_put(
+                onp.full(view.shape, value, dtype=view.dtype),
+                view.context.jax_device()))
         elif isinstance(value, (onp.ndarray, onp.generic, list, tuple)):
             view._sync_copyfrom(onp.asarray(value))
         else:

@@ -117,3 +117,40 @@ def test_custom_op_in_middle_of_graph():
     e.backward()
     np.testing.assert_allclose(e.grad_dict["data"].asnumpy(), 2 * x,
                                rtol=1e-5)
+
+
+def test_legacy_numpy_op_alias():
+    """NumpyOp/NDArrayOp are the legacy spellings of CustomOp
+    (operator.py:229-233); subclassing through the alias must behave
+    identically (the numpy-ops example's legacy interface)."""
+    class Sqr(mx.operator.NumpyOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0].asnumpy() ** 2)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            self.assign(in_grad[0], req[0],
+                        2 * in_data[0].asnumpy() * out_grad[0].asnumpy())
+
+    assert mx.operator.NumpyOp is mx.operator.CustomOp
+    assert mx.operator.NDArrayOp is mx.operator.CustomOp
+
+    @mx.operator.register("legacy_sqr")
+    class SqrProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sqr()
+
+    data = sym.Variable("data")
+    s = sym.sum(sym.Custom(data, op_type="legacy_sqr"))
+    x = np.random.rand(3, 3).astype(np.float32) + 0.5
+    e = s.simple_bind(mx.cpu(), data=(3, 3))
+    e.arg_dict["data"][:] = x
+    e.forward(is_train=True)
+    np.testing.assert_allclose(e.outputs[0].asnumpy(), (x ** 2).sum(),
+                               rtol=1e-5)
+    e.backward()
+    np.testing.assert_allclose(e.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-5)

@@ -1,0 +1,450 @@
+"""Numpy-parity sweep over the long tail of registered ops (reference
+tests/python/unittest/test_operator.py strategy: every op checked against
+a host-math reference). Complements tests/test_operator.py, which covers
+the trainable layers in depth — this file sweeps the elementwise /
+broadcast / reduction / sampling / misc registry entries that no other
+test names explicitly.
+
+Forward values go through the imperative path (mx.nd.invoke semantics);
+gradient spot-checks go through simple_bind on representative entries.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def _invoke(op, arrays, **attrs):
+    from mxnet_tpu.capi_bridge import imperative_invoke
+    ins = [mx.nd.array(a) if isinstance(a, onp.ndarray) else a
+           for a in arrays]
+    outs = imperative_invoke(op, ins, [str(k) for k in attrs],
+                             [str(v) for v in attrs.values()], None)
+    return [o.asnumpy() for o in outs]
+
+
+RNG = onp.random.RandomState(7)
+A = RNG.rand(3, 4).astype(onp.float32) + 0.5   # (0.5, 1.5): safe domain
+B = RNG.rand(3, 4).astype(onp.float32) + 0.5
+POSNEG = (RNG.rand(3, 4).astype(onp.float32) - 0.5) * 1.8  # (-0.9, 0.9)
+COL = RNG.rand(3, 1).astype(onp.float32) + 0.5
+
+# ------------------------------------------------------------- unary math
+UNARY = [
+    ("arccos", POSNEG, onp.arccos),
+    ("arcsin", POSNEG, onp.arcsin),
+    ("arctan", POSNEG, onp.arctan),
+    ("arccosh", A + 1.0, onp.arccosh),
+    ("arcsinh", POSNEG, onp.arcsinh),
+    ("arctanh", POSNEG, onp.arctanh),
+    ("sinh", POSNEG, onp.sinh),
+    ("cosh", POSNEG, onp.cosh),
+    ("ceil", POSNEG * 3, onp.ceil),
+    ("floor", POSNEG * 3, onp.floor),
+    ("expm1", POSNEG, onp.expm1),
+    ("log1p", A, onp.log1p),
+    ("log2", A, onp.log2),
+    ("log10", A, onp.log10),
+    ("rsqrt", A, lambda x: 1.0 / onp.sqrt(x)),
+    ("reciprocal", A, lambda x: 1.0 / x),
+    ("negative", A, lambda x: -x),
+    ("degrees", POSNEG, onp.degrees),
+    ("radians", POSNEG * 90, onp.radians),
+    ("gammaln", A + 0.5, None),  # checked via scipy-free identity below
+    ("softsign", POSNEG, lambda x: x / (1 + onp.abs(x))),
+]
+
+
+@pytest.mark.parametrize("op,x,ref", UNARY, ids=[u[0] for u in UNARY])
+def test_unary(op, x, ref):
+    out = _invoke(op, [x])[0]
+    if ref is None and op == "gammaln":
+        # ln Γ(x+1) = ln Γ(x) + ln x
+        out1 = _invoke(op, [x + 1.0])[0]
+        onp.testing.assert_allclose(out1, out + onp.log(x), rtol=2e-5,
+                                    atol=2e-5)
+        return
+    onp.testing.assert_allclose(out, ref(x), rtol=2e-5, atol=2e-6)
+
+
+# --------------------------------------------- binary / scalar / broadcast
+BINARY = [
+    ("_plus", lambda a, b: a + b), ("_minus", lambda a, b: a - b),
+    ("_mul", lambda a, b: a * b), ("_div", lambda a, b: a / b),
+    ("_power", onp.power), ("_maximum", onp.maximum),
+    ("_minimum", onp.minimum), ("_hypot", onp.hypot),
+    ("elemwise_add", lambda a, b: a + b),
+    ("elemwise_sub", lambda a, b: a - b),
+    ("elemwise_mul", lambda a, b: a * b),
+    ("elemwise_div", lambda a, b: a / b),
+    ("_greater", lambda a, b: (a > b).astype(onp.float32)),
+    ("_greater_equal", lambda a, b: (a >= b).astype(onp.float32)),
+    ("_lesser", lambda a, b: (a < b).astype(onp.float32)),
+    ("_lesser_equal", lambda a, b: (a <= b).astype(onp.float32)),
+    ("_not_equal", lambda a, b: (a != b).astype(onp.float32)),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY, ids=[b[0] for b in BINARY])
+def test_binary(op, ref):
+    onp.testing.assert_allclose(_invoke(op, [A, B])[0], ref(A, B),
+                                rtol=2e-5, atol=2e-6)
+
+
+SCALAR = [
+    ("_plus_scalar", lambda a, s: a + s),
+    ("_minus_scalar", lambda a, s: a - s),
+    ("_rminus_scalar", lambda a, s: s - a),
+    ("_mul_scalar", lambda a, s: a * s),
+    ("_div_scalar", lambda a, s: a / s),
+    ("_rdiv_scalar", lambda a, s: s / a),
+    ("_power_scalar", lambda a, s: a ** s),
+    ("_rpower_scalar", lambda a, s: s ** a),
+    ("_mod_scalar", lambda a, s: onp.mod(a, s)),
+    ("_rmod_scalar", lambda a, s: onp.mod(s, a)),
+    ("_maximum_scalar", lambda a, s: onp.maximum(a, s)),
+    ("_minimum_scalar", lambda a, s: onp.minimum(a, s)),
+    ("_hypot_scalar", lambda a, s: onp.hypot(a, s)),
+    ("_equal_scalar", lambda a, s: (a == s).astype(onp.float32)),
+    ("_not_equal_scalar", lambda a, s: (a != s).astype(onp.float32)),
+    ("_greater_scalar", lambda a, s: (a > s).astype(onp.float32)),
+    ("_greater_equal_scalar",
+     lambda a, s: (a >= s).astype(onp.float32)),
+    ("_lesser_scalar", lambda a, s: (a < s).astype(onp.float32)),
+    ("_lesser_equal_scalar",
+     lambda a, s: (a <= s).astype(onp.float32)),
+]
+
+
+@pytest.mark.parametrize("op,ref", SCALAR, ids=[s[0] for s in SCALAR])
+def test_scalar(op, ref):
+    onp.testing.assert_allclose(_invoke(op, [A], scalar=0.7)[0],
+                                ref(A, onp.float32(0.7)), rtol=2e-5,
+                                atol=2e-6)
+
+
+BROADCAST = [
+    ("broadcast_plus", lambda a, b: a + b),
+    ("broadcast_minus", lambda a, b: a - b),
+    ("broadcast_sub", lambda a, b: a - b),
+    ("broadcast_mul", lambda a, b: a * b),
+    ("broadcast_div", lambda a, b: a / b),
+    ("broadcast_power", onp.power),
+    ("broadcast_maximum", onp.maximum),
+    ("broadcast_minimum", onp.minimum),
+    ("broadcast_hypot", onp.hypot),
+    ("broadcast_mod", onp.mod),
+    ("broadcast_equal", lambda a, b: (a == b).astype(onp.float32)),
+    ("broadcast_not_equal", lambda a, b: (a != b).astype(onp.float32)),
+    ("broadcast_greater", lambda a, b: (a > b).astype(onp.float32)),
+    ("broadcast_greater_equal",
+     lambda a, b: (a >= b).astype(onp.float32)),
+    ("broadcast_lesser", lambda a, b: (a < b).astype(onp.float32)),
+    ("broadcast_lesser_equal",
+     lambda a, b: (a <= b).astype(onp.float32)),
+]
+
+
+@pytest.mark.parametrize("op,ref", BROADCAST,
+                         ids=[b[0] for b in BROADCAST])
+def test_broadcast(op, ref):
+    onp.testing.assert_allclose(_invoke(op, [A, COL])[0], ref(A, COL),
+                                rtol=2e-5, atol=2e-6)
+
+
+def test_broadcast_axis():
+    out = _invoke("broadcast_axis", [COL.reshape(3, 1)], axis=1, size=4)[0]
+    onp.testing.assert_allclose(out, onp.broadcast_to(COL, (3, 4)))
+    out = _invoke("broadcast_axes", [COL.reshape(3, 1)], axis=(1,),
+                  size=(4,))[0]
+    onp.testing.assert_allclose(out, onp.broadcast_to(COL, (3, 4)))
+
+
+# -------------------------------------------------------------- reductions
+def test_reductions():
+    X = POSNEG.copy()
+    onp.testing.assert_allclose(_invoke("sum_axis", [X], axis=1)[0],
+                                X.sum(axis=1), rtol=1e-5)
+    onp.testing.assert_allclose(_invoke("max_axis", [X], axis=0)[0],
+                                X.max(axis=0))
+    onp.testing.assert_allclose(_invoke("min_axis", [X], axis=0)[0],
+                                X.min(axis=0))
+    onp.testing.assert_allclose(_invoke("argmin", [X], axis=1)[0],
+                                X.argmin(axis=1).astype(onp.float32))
+    onp.testing.assert_allclose(_invoke("argmax_channel", [X])[0],
+                                X.argmax(axis=1).astype(onp.float32))
+    Xn = X.copy()
+    Xn[0, 0] = onp.nan
+    onp.testing.assert_allclose(_invoke("nansum", [Xn])[0],
+                                onp.nansum(Xn), rtol=1e-5)
+    onp.testing.assert_allclose(_invoke("nanprod", [Xn])[0],
+                                onp.nanprod(Xn), rtol=1e-5)
+
+
+# ---------------------------------------------------------- init / arange
+def test_init_ops():
+    onp.testing.assert_allclose(_invoke("_ones", [], shape=(2, 3))[0],
+                                onp.ones((2, 3), onp.float32))
+    onp.testing.assert_allclose(_invoke("_zeros", [], shape=(2, 3))[0],
+                                onp.zeros((2, 3), onp.float32))
+    onp.testing.assert_allclose(
+        _invoke("_arange", [], start=1, stop=7, step=2)[0],
+        onp.arange(1, 7, 2, dtype=onp.float32))
+
+
+# ------------------------------------------------------ indexing / gather
+def test_indexing_ops():
+    data = RNG.rand(4, 5).astype(onp.float32)
+    idx = onp.array([3, 0, 2, 1], onp.float32)
+    out = _invoke("batch_take", [data, idx])[0]
+    onp.testing.assert_allclose(
+        out, data[onp.arange(4), idx.astype(int)])
+    nd_idx = onp.array([[0, 2, 3], [1, 0, 4]], onp.float32)  # (2, 3)
+    out = _invoke("gather_nd", [data, nd_idx])[0]
+    onp.testing.assert_allclose(out, data[[0, 2, 3], [1, 0, 4]])
+
+
+def test_linalg_gemm2():
+    X = RNG.rand(2, 3, 4).astype(onp.float32)
+    Y = RNG.rand(2, 4, 5).astype(onp.float32)
+    out = _invoke("linalg_gemm2", [X, Y])[0]
+    onp.testing.assert_allclose(out, onp.einsum("bij,bjk->bik", X, Y),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_smooth_l1():
+    x = (POSNEG * 3).astype(onp.float32)
+    out = _invoke("smooth_l1", [x], scalar=1.0)[0]
+    ref = onp.where(onp.abs(x) < 1.0, 0.5 * x * x, onp.abs(x) - 0.5)
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_add_n_and_elementwise_sum():
+    arrs = [RNG.rand(2, 3).astype(onp.float32) for _ in range(3)]
+    for op in ("add_n", "ElementWiseSum"):
+        out = _invoke(op, arrs, num_args=3)[0]
+        onp.testing.assert_allclose(out, sum(arrs), rtol=1e-6)
+
+
+# ----------------------------------------------------------- grad-control
+def test_grad_control_ops():
+    x = mx.sym.Variable("x")
+    for opname in ("stop_gradient", "BlockGrad"):
+        y = getattr(mx.sym, opname)(x * 2.0) + x
+        loss = mx.sym.MakeLoss(mx.sym.sum(y))
+        ex = loss.simple_bind(mx.cpu(), x=(2, 2))
+        ex.arg_dict["x"][:] = onp.ones((2, 2), onp.float32)
+        ex.forward(is_train=True)
+        ex.backward()
+        # only the un-blocked path contributes: d/dx = 1
+        onp.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                                    onp.ones((2, 2)), rtol=1e-6)
+
+    # identity ops are transparent forward
+    x1 = RNG.rand(2, 3).astype(onp.float32)
+    out = _invoke("IdentityAttachKLSparseReg", [x1])[0]
+    onp.testing.assert_allclose(out, x1)
+
+
+# ------------------------------------------------------- layer-level refs
+def test_lrn_forward():
+    X = RNG.rand(2, 4, 3, 3).astype(onp.float32)
+    alpha, beta, knorm, nsize = 1e-4, 0.75, 2.0, 3
+    out = _invoke("LRN", [X], alpha=alpha, beta=beta, knorm=knorm,
+                  nsize=nsize)[0]
+    ref = onp.empty_like(X)
+    half = nsize // 2
+    for c in range(4):
+        lo, hi = max(0, c - half), min(4, c + half + 1)
+        sq = (X[:, lo:hi] ** 2).sum(axis=1)
+        ref[:, c] = X[:, c] / (knorm + alpha / nsize * sq) ** beta
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_activation():
+    X = POSNEG.copy()
+    out = _invoke("SoftmaxActivation", [X])[0]
+    e = onp.exp(X - X.max(axis=1, keepdims=True))
+    onp.testing.assert_allclose(out, e / e.sum(axis=1, keepdims=True),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_mae_regression_output_grad():
+    data = mx.sym.Variable("data")
+    net = mx.sym.MAERegressionOutput(data, name="mae")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3), mae_label=(2, 3))
+    x = POSNEG[:2, :3].copy()
+    lbl = onp.zeros((2, 3), onp.float32)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["mae_label"][:] = lbl
+    ex.forward(is_train=True)
+    onp.testing.assert_allclose(ex.outputs[0].asnumpy(), x)
+    ex.backward()
+    # reference regression grad: grad_scale/num_output * sign(pred-label)
+    # (regression_output-inl.h:70-76 divides by the per-sample outputs)
+    onp.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                                onp.sign(x) / 3.0, rtol=1e-5)
+
+
+def test_sequence_reverse():
+    X = RNG.rand(4, 2, 3).astype(onp.float32)  # (T, N, C)
+    out = _invoke("SequenceReverse", [X])[0]
+    onp.testing.assert_allclose(out, X[::-1])
+    slen = onp.array([2, 4], onp.float32)
+    out = _invoke("SequenceReverse", [X, slen],
+                  use_sequence_length=True)[0]
+    ref = X.copy()
+    ref[:2, 0] = X[:2, 0][::-1]
+    ref[:, 1] = X[:, 1][::-1]
+    onp.testing.assert_allclose(out, ref)
+
+
+def test_crop_center_and_offset():
+    X = RNG.rand(1, 1, 6, 8).astype(onp.float32)
+    out = _invoke("Crop", [X], h_w=(4, 4), center_crop=True)[0]
+    onp.testing.assert_allclose(out, X[:, :, 1:5, 2:6])
+    out = _invoke("Crop", [X], h_w=(2, 3), offset=(1, 2))[0]
+    onp.testing.assert_allclose(out, X[:, :, 1:3, 2:5])
+
+
+def test_v1_layer_aliases_match_v2():
+    X = RNG.rand(2, 3, 8, 8).astype(onp.float32)
+    W = RNG.rand(4, 3, 3, 3).astype(onp.float32)
+    bias = onp.zeros(4, onp.float32)
+    a = _invoke("Convolution", [X, W, bias], kernel=(3, 3),
+                num_filter=4)[0]
+    b = _invoke("Convolution_v1", [X, W, bias], kernel=(3, 3),
+                num_filter=4)[0]
+    onp.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    a = _invoke("Pooling", [X], kernel=(2, 2), stride=(2, 2),
+                pool_type="max")[0]
+    b = _invoke("Pooling_v1", [X], kernel=(2, 2), stride=(2, 2),
+                pool_type="max")[0]
+    onp.testing.assert_allclose(a, b)
+
+
+def test_cudnn_batchnorm_alias():
+    X = RNG.rand(2, 3, 4, 4).astype(onp.float32)
+    gamma = onp.ones(3, onp.float32)
+    beta = onp.zeros(3, onp.float32)
+    mean = onp.zeros(3, onp.float32)
+    var = onp.ones(3, onp.float32)
+    a = _invoke("BatchNorm", [X, gamma, beta, mean, var])
+    b = _invoke("CuDNNBatchNorm", [X, gamma, beta, mean, var])
+    onp.testing.assert_allclose(a[0], b[0], rtol=1e-5, atol=1e-5)
+
+
+def test_svm_output_forward_identity():
+    X = POSNEG.copy()
+    lbl = onp.array([0, 1, 2], onp.float32)
+    out = _invoke("SVMOutput", [X, lbl], margin=1.0)[0]
+    onp.testing.assert_allclose(out, X)  # forward passes scores through
+
+
+# ------------------------------------------------- spatial transformer ops
+def test_grid_generator_and_bilinear_sampler_identity():
+    # identity affine: sampling grid == pixel grid -> sampler is identity
+    theta = onp.tile(onp.array([1, 0, 0, 0, 1, 0], onp.float32), (1, 1))
+    grid = _invoke("GridGenerator", [theta],
+                   transform_type="affine", target_shape=(4, 4))[0]
+    assert grid.shape == (1, 2, 4, 4)
+    X = RNG.rand(1, 2, 4, 4).astype(onp.float32)
+    out = _invoke("BilinearSampler", [X, grid])[0]
+    onp.testing.assert_allclose(out, X, rtol=1e-4, atol=1e-4)
+
+
+def test_spatial_transformer_identity():
+    X = RNG.rand(1, 2, 4, 4).astype(onp.float32)
+    theta = onp.tile(onp.array([1, 0, 0, 0, 1, 0], onp.float32), (1, 1))
+    out = _invoke("SpatialTransformer", [X, theta],
+                  transform_type="affine", sampler_type="bilinear",
+                  target_shape=(4, 4))[0]
+    onp.testing.assert_allclose(out, X, rtol=1e-4, atol=1e-4)
+
+
+# -------------------------------------------------------------------- fft
+def test_fft_ifft_roundtrip():
+    X = RNG.rand(2, 8).astype(onp.float32)
+    f = _invoke("_contrib_fft", [X])[0]
+    # layout: interleaved re/im pairs, shape (2, 16) (fft-inl.h)
+    assert f.shape == (2, 16)
+    ref = onp.fft.fft(X, axis=1)
+    onp.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-4,
+                                atol=1e-4)
+    onp.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-4,
+                                atol=1e-4)
+    back = _invoke("_contrib_ifft", [f])[0]
+    # reference ifft is the UNSCALED cuFFT inverse: round trip gains N
+    onp.testing.assert_allclose(back, X * 8, rtol=1e-4, atol=1e-3)
+
+
+# -------------------------------------------------------------- sampling
+def test_random_ops_statistics():
+    shape = (20000,)
+    u = _invoke("_random_uniform", [], shape=shape, low=-1.0, high=3.0)[0]
+    assert -1.0 <= u.min() and u.max() < 3.0
+    assert abs(u.mean() - 1.0) < 0.1
+    g = _invoke("_random_normal", [], shape=shape, loc=2.0, scale=0.5)[0]
+    assert abs(g.mean() - 2.0) < 0.05 and abs(g.std() - 0.5) < 0.05
+    e = _invoke("_random_exponential", [], shape=shape, lam=2.0)[0]
+    assert abs(e.mean() - 0.5) < 0.05
+    p = _invoke("_random_poisson", [], shape=shape, lam=3.0)[0]
+    assert abs(p.mean() - 3.0) < 0.2
+    gm = _invoke("_random_gamma", [], shape=shape, alpha=2.0, beta=1.5)[0]
+    assert abs(gm.mean() - 3.0) < 0.2
+    nb = _invoke("_random_negative_binomial", [], shape=shape, k=4,
+                 p=0.5)[0]
+    assert abs(nb.mean() - 4.0) < 0.3
+
+
+# ------------------------------------------------------ optimizer updates
+def test_fused_optimizer_updates_match_numpy():
+    w = RNG.rand(5).astype(onp.float32)
+    g = RNG.rand(5).astype(onp.float32)
+
+    out = _invoke("sgd_update", [w, g], lr=0.1, wd=0.01,
+                  rescale_grad=1.0)[0]
+    onp.testing.assert_allclose(out, w - 0.1 * (g + 0.01 * w), rtol=1e-5)
+
+    mom = onp.zeros(5, onp.float32)
+    out = _invoke("sgd_mom_update", [w, g, mom], lr=0.1, wd=0.0,
+                  momentum=0.9, rescale_grad=1.0)
+    onp.testing.assert_allclose(out[0], w - 0.1 * g, rtol=1e-5)
+
+    mean = onp.zeros(5, onp.float32)
+    var = onp.zeros(5, onp.float32)
+    out = _invoke("adam_update", [w, g, mean, var], lr=0.1, beta1=0.9,
+                  beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0)
+    m1 = 0.1 * g
+    v1 = 0.001 * g * g
+    onp.testing.assert_allclose(
+        out[0], w - 0.1 * m1 / (onp.sqrt(v1) + 1e-8), rtol=1e-4)
+
+    n = onp.zeros(5, onp.float32)
+    out = _invoke("rmsprop_update", [w, g, n], lr=0.1, gamma1=0.9,
+                  epsilon=1e-8, wd=0.0, rescale_grad=1.0)
+    n1 = 0.1 * g * g
+    onp.testing.assert_allclose(out[0], w - 0.1 * g /
+                                (onp.sqrt(n1) + 1e-8), rtol=1e-4)
+
+
+def test_sample_op_aliases():
+    # _sample_* are the legacy imperative names of _random_*
+    for op in ("_sample_uniform", "_sample_normal", "_sample_exponential",
+               "_sample_poisson", "_sample_gamma", "_sample_negbinomial"):
+        kwargs = {"shape": (16,)}
+        if "negbinomial" in op:
+            kwargs.update(k=3, p=0.5)
+        out = _invoke(op, [], **kwargs)[0]
+        assert out.shape == (16,)
+
+
+def test_grad_add_combines():
+    out = _invoke("_grad_add", [A, B])[0]
+    onp.testing.assert_allclose(out, A + B, rtol=1e-6)
+
+
+def test_identity_with_attr_like_rhs():
+    out = _invoke("_identity_with_attr_like_rhs", [A, B])[0]
+    onp.testing.assert_allclose(out, A)
