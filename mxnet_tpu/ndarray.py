@@ -284,10 +284,8 @@ class NDArray:
             # fill on the array's OWN device — jnp.full would land on the
             # default accelerator and silently migrate a cpu-ctx array
             # (then one jitted step over mixed devices fails to compile)
-            import jax
-            view._write(jax.device_put(
-                onp.full(view.shape, value, dtype=view.dtype),
-                view.context.jax_device()))
+            view._sync_copyfrom(onp.full(view.shape, value,
+                                         dtype=view.dtype))
         elif isinstance(value, (onp.ndarray, onp.generic, list, tuple)):
             view._sync_copyfrom(onp.asarray(value))
         else:

@@ -1,0 +1,149 @@
+"""Numeric-gradient sweep (reference tests/python/unittest/
+test_operator.py check_numeric_gradient strategy): symbolic backward of
+representative registry families checked against finite differences.
+Complements tests/test_operator_parity.py (forward values only).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = onp.random.RandomState(11)
+
+
+def _x(shape=(3, 4), lo=0.5, hi=1.5):
+    return (RNG.rand(*shape) * (hi - lo) + lo).astype(onp.float32)
+
+
+UNARY = [
+    ("exp", (0.1, 1.0)), ("log", (0.5, 2.0)), ("sqrt", (0.5, 2.0)),
+    ("tanh", (-0.8, 0.8)), ("sigmoid", (-2.0, 2.0)),
+    ("arctan", (-0.8, 0.8)), ("sinh", (-0.8, 0.8)),
+    ("cosh", (-0.8, 0.8)), ("expm1", (-0.5, 0.5)),
+    ("log1p", (0.1, 1.0)), ("rsqrt", (0.5, 2.0)),
+    ("reciprocal", (0.5, 2.0)), ("softsign", (-0.8, 0.8)),
+    ("square", (0.5, 1.5)), ("abs", (0.3, 1.2)),
+]
+
+
+@pytest.mark.parametrize("op,dom", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_grad(op, dom):
+    x = sym.Variable("x")
+    y = sym.MakeLoss(sym.sum(getattr(sym, op)(x)))
+    check_numeric_gradient(y, {"x": _x(lo=dom[0], hi=dom[1])},
+                           numeric_eps=1e-3, rtol=0.02, atol=1e-3)
+
+
+BINARY = ["_plus", "_minus", "_mul", "_div", "_power", "_maximum",
+          "_minimum", "_hypot"]
+
+
+@pytest.mark.parametrize("op", BINARY)
+def test_binary_grad(op):
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    y = sym.MakeLoss(sym.sum(getattr(sym, op)(a, b)))
+    check_numeric_gradient(y, {"a": _x(), "b": _x(lo=0.6, hi=1.4)},
+                           numeric_eps=1e-3, rtol=0.02, atol=1e-3)
+
+
+BCAST = ["broadcast_plus", "broadcast_mul", "broadcast_div",
+         "broadcast_power", "broadcast_maximum", "broadcast_minimum"]
+
+
+@pytest.mark.parametrize("op", BCAST)
+def test_broadcast_grad(op):
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    y = sym.MakeLoss(sym.sum(getattr(sym, op)(a, b)))
+    check_numeric_gradient(
+        y, {"a": _x((3, 4)), "b": _x((3, 1), lo=0.6, hi=1.4)},
+        numeric_eps=1e-3, rtol=0.02, atol=1e-3)
+
+
+REDUCE = [("sum", {}), ("sum_axis", {"axis": 1}), ("mean", {}),
+          ("max", {}), ("min", {}), ("prod", {})]
+
+
+@pytest.mark.parametrize("op,kw", REDUCE, ids=[r[0] for r in REDUCE])
+def test_reduce_grad(op, kw):
+    x = sym.Variable("x")
+    y = sym.MakeLoss(sym.sum(getattr(sym, op)(x, **kw)))
+    # distinct values so max/min have a unique argpoint (stable gradient)
+    base = onp.arange(12, dtype=onp.float32).reshape(3, 4) / 7.0 + 0.3
+    check_numeric_gradient(y, {"x": base}, numeric_eps=1e-3, rtol=0.02,
+                           atol=1e-3)
+
+
+SHAPE_OPS = [
+    ("transpose", lambda x: sym.transpose(x)),
+    ("reshape", lambda x: sym.Reshape(x, shape=(4, 3))),
+    ("flatten", lambda x: sym.Flatten(x)),
+    ("slice_axis", lambda x: sym.slice_axis(x, axis=1, begin=1, end=3)),
+    ("repeat", lambda x: sym.repeat(x, repeats=2, axis=0)),
+    ("tile", lambda x: sym.tile(x, reps=(2, 1))),
+    ("reverse", lambda x: sym.reverse(x, axis=0)),
+    ("expand_dims", lambda x: sym.expand_dims(x, axis=1)),
+    ("clip", lambda x: sym.clip(x, a_min=0.6, a_max=1.2)),
+]
+
+
+@pytest.mark.parametrize("name,fn", SHAPE_OPS,
+                         ids=[s[0] for s in SHAPE_OPS])
+def test_shape_op_grad(name, fn):
+    x = sym.Variable("x")
+    y = sym.MakeLoss(sym.sum(fn(x) * fn(x)))  # nonlinear so grad varies
+    check_numeric_gradient(y, {"x": _x()}, numeric_eps=1e-3, rtol=0.02,
+                           atol=1e-3)
+
+
+def test_dot_grads():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    y = sym.MakeLoss(sym.sum(sym.dot(a, b)))
+    check_numeric_gradient(y, {"a": _x((3, 4)), "b": _x((4, 2))},
+                           numeric_eps=1e-3, rtol=0.02, atol=1e-3)
+    y = sym.MakeLoss(sym.sum(sym.batch_dot(a, b)))
+    check_numeric_gradient(y, {"a": _x((2, 3, 4)), "b": _x((2, 4, 2))},
+                           numeric_eps=1e-3, rtol=0.02, atol=1e-3)
+
+
+def test_layer_grads():
+    x = sym.Variable("x")
+    net = sym.MakeLoss(sym.sum(sym.Activation(
+        sym.FullyConnected(x, num_hidden=5, name="fc"),
+        act_type="tanh")))
+    check_numeric_gradient(
+        net, {"x": _x((2, 3)), "fc_weight": _x((5, 3), -0.5, 0.5),
+              "fc_bias": _x((5,), -0.1, 0.1)},
+        numeric_eps=1e-3, rtol=0.03, atol=1e-3)
+
+    net = sym.MakeLoss(sym.sum(sym.Convolution(
+        sym.Variable("x"), kernel=(3, 3), num_filter=2, name="cv")))
+    check_numeric_gradient(
+        net, {"x": _x((1, 2, 5, 5)), "cv_weight": _x((2, 2, 3, 3),
+                                                     -0.5, 0.5),
+              "cv_bias": _x((2,), -0.1, 0.1)},
+        numeric_eps=1e-3, rtol=0.03, atol=1e-3)
+
+
+def test_take_and_embedding_grads():
+    # embedding weight gradient is a scatter-add of output grads
+    w = sym.Variable("w")
+    idx = sym.Variable("idx")
+    y = sym.MakeLoss(sym.sum(sym.Embedding(
+        idx, weight=w, input_dim=5, output_dim=3, name="em") ** 2))
+    widx = onp.array([1, 3, 1], onp.float32)
+    wdat = _x((5, 3))
+    ex = y.simple_bind(mx.cpu(), idx=(3,), w=(5, 3), grad_req="write")
+    ex.arg_dict["idx"][:] = widx
+    ex.arg_dict["w"][:] = wdat
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["w"].asnumpy()
+    ref = onp.zeros_like(wdat)
+    for i in widx.astype(int):
+        ref[i] += 2 * wdat[i]
+    onp.testing.assert_allclose(g, ref, rtol=1e-4, atol=1e-5)
