@@ -10,6 +10,7 @@ from . import lenet
 from . import alexnet
 from . import vgg
 from . import resnet
+from . import resnext
 from . import inception_bn
 from . import inception_v3
 from . import googlenet
@@ -17,14 +18,16 @@ from . import lstm
 
 _MODELS = {
     "mlp": mlp, "lenet": lenet, "alexnet": alexnet, "vgg": vgg,
-    "resnet": resnet, "inception-bn": inception_bn,
+    "resnet": resnet, "resnext": resnext,
+    "inception-bn": inception_bn,
     "inception-v3": inception_v3, "googlenet": googlenet,
 }
 
 
 def get_symbol(name, **kwargs):
     """Look up a model by the reference's --network names."""
-    if name.startswith("resnet"):
-        num_layers = int(name[len("resnet-"):]) if "-" in name else 50
-        return resnet.get_symbol(num_layers=num_layers, **kwargs)
+    for prefix, mod in (("resnext", resnext), ("resnet", resnet)):
+        if name.startswith(prefix):
+            num_layers = int(name[len(prefix) + 1:]) if "-" in name else 50
+            return mod.get_symbol(num_layers=num_layers, **kwargs)
     return _MODELS[name].get_symbol(**kwargs)

@@ -28,6 +28,7 @@ CASES = [
     ("nce-loss/nce_embedding.py", ["--num-epoch", "8"]),
     ("stochastic-depth/sto_depth.py", ["--num-epoch", "12"]),
     ("module/mnist_mlp.py", []),
+    ("image-classification/fine_tune.py", []),
 ]
 
 

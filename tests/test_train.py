@@ -177,3 +177,54 @@ def test_regression_metrics_1d_pred_no_broadcast():
                            (mx.metric.RMSE(), float(np.sqrt(expect_mse)))]:
         metric.update([mx.nd.array(label)], [mx.nd.array(pred)])
         assert abs(metric.get()[1] - expect) < 1e-5, metric.get()
+
+
+def test_resnext_grouped_conv_trains():
+    """ResNeXt (models/resnext.py): grouped-conv bottlenecks build,
+    infer, and take a training step; grouped Convolution lowers to
+    feature_group_count (validated against a split-concat reference in
+    test_operator_parity-style check here)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.capi_bridge import imperative_invoke
+
+    # grouped conv == concat of per-group convs
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 4, 5, 5).astype(np.float32)
+    w = rng.rand(6, 2, 3, 3).astype(np.float32)
+    out = imperative_invoke(
+        "Convolution",
+        [mx.nd.array(x), mx.nd.array(w),
+         mx.nd.array(np.zeros(6, np.float32))],
+        ["kernel", "num_filter", "num_group"], ["(3,3)", "6", "2"],
+        None)[0].asnumpy()
+    import jax.numpy as jnp
+    from jax import lax
+    ref = np.concatenate([
+        np.asarray(lax.conv_general_dilated(
+            jnp.asarray(x[:, :2]), jnp.asarray(w[:3]), (1, 1), "VALID")),
+        np.asarray(lax.conv_general_dilated(
+            jnp.asarray(x[:, 2:]), jnp.asarray(w[3:]), (1, 1), "VALID")),
+    ], axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    # resnext-50 (bottleneck units — the ones that actually use grouped
+    # convs) trains one step through Module on the cifar stem
+    net = models.get_symbol("resnext-50", num_classes=4, num_group=8,
+                            image_shape=(3, 32, 32))
+    X = rng.rand(4, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 4, 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    b = next(it)
+    mod.forward_backward(b)
+    mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (4, 4)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(4), rtol=1e-4)
