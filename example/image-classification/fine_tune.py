@@ -103,12 +103,12 @@ def main():
     assert np.allclose(got, want), "trunk weights were not transferred"
 
     metric = mx.metric.Accuracy()
+    # params are already warm-initialized (and asserted) above, so fit
+    # must not re-init them — force_init=False trains exactly that state
     tuned.fit(itb, num_epoch=args.tune_epochs, optimizer="adam",
               optimizer_params={"learning_rate": 0.002},
               initializer=mx.initializer.Xavier(),
-              arg_params=warm_args, aux_params=aux_params,
-              allow_missing=True, eval_metric=metric,
-              force_rebind=False, force_init=True)
+              eval_metric=metric, force_rebind=False, force_init=False)
     warm_acc = metric.get()[1]
 
     print("fine-tuned accuracy on task B: %.3f" % warm_acc)

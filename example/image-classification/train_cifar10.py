@@ -1,0 +1,95 @@
+"""Train CIFAR-10 (reference example/image-classification/
+train_cifar10.py) with ``--gpus`` swapped for ``--tpus``.
+
+Uses a real CIFAR-10 python-pickle batch directory when ``--data-dir``
+has one, else a synthetic CIFAR-shaped dataset (no network egress).
+Like the reference, images are center-cropped to 28x28 — the zoo's
+cifar depth tables key on height<=28 (symbols/resnet.py:124).
+"""
+import argparse
+import logging
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def load_cifar_dir(data_dir):
+    """cifar-10-batches-py layout (data_batch_1..5 + test_batch)."""
+    def _load(names):
+        xs, ys = [], []
+        for n in names:
+            with open(os.path.join(data_dir, n), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"].reshape(-1, 3, 32, 32)[:, :, 2:30, 2:30])
+            ys.append(np.array(d[b"labels"]))
+        return (np.concatenate(xs).astype(np.float32) / 255.0,
+                np.concatenate(ys).astype(np.float32))
+    train = _load(["data_batch_%d" % i for i in range(1, 6)])
+    test = _load(["test_batch"])
+    return train, test
+
+
+def synthetic_cifar(rng, n=4096):
+    protos = rng.rand(10, 3, 7, 7).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    up = np.kron(protos[y], np.ones((1, 1, 4, 4), np.float32))
+    X = up + 0.25 * rng.rand(n, 3, 28, 28).astype(np.float32)
+    return X, y.astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train cifar10")
+    parser.add_argument("--network", default="resnet-20",
+                        help="model zoo name (resnet-N, resnext-N, vgg, "
+                             "alexnet, inception-bn, ...)")
+    parser.add_argument("--data-dir", default="cifar10/")
+    parser.add_argument("--tpus", "--gpus", dest="tpus", default=None)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--model-prefix", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+
+    batch_dir = os.path.join(args.data_dir, "cifar-10-batches-py")
+    if os.path.exists(batch_dir):
+        (Xtr, ytr), (Xte, yte) = load_cifar_dir(batch_dir)
+    else:
+        logging.warning("CIFAR batches not found in %s; synthetic data",
+                        args.data_dir)
+        rng = np.random.RandomState(0)
+        Xtr, ytr = synthetic_cifar(rng)
+        Xte, yte = Xtr[:512], ytr[:512]
+
+    train = mx.io.NDArrayIter(Xtr, ytr, batch_size=args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(Xte, yte, batch_size=args.batch_size)
+
+    net = models.get_symbol(args.network, num_classes=10,
+                            image_shape=(3, 28, 28))
+    mod = mx.mod.Module(net, context=ctx)
+    checkpoint = mx.callback.do_checkpoint(args.model_prefix) \
+        if args.model_prefix else None
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            kvstore=args.kv_store,
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       20),
+            epoch_end_callback=checkpoint)
+    print("final validation:", mod.score(val, "acc"))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,10 +18,9 @@ from . import lstm
 
 _MODELS = {
     "mlp": mlp, "lenet": lenet, "alexnet": alexnet, "vgg": vgg,
-    "resnet": resnet, "resnext": resnext,
     "inception-bn": inception_bn,
     "inception-v3": inception_v3, "googlenet": googlenet,
-}
+}  # resnet/resnext dispatch via the prefix loop in get_symbol
 
 
 def get_symbol(name, **kwargs):

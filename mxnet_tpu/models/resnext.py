@@ -87,12 +87,28 @@ _DEPTHS = {
 
 def get_symbol(num_classes=1000, num_layers=50, num_group=32, bn_mom=0.9,
                workspace=256, image_shape=(3, 224, 224)):
-    if num_layers not in _DEPTHS:
-        raise ValueError("no resnext-%d schedule" % num_layers)
-    bottle_neck, units = _DEPTHS[num_layers]
-    filter_list = [64, 256, 512, 1024, 2048] if bottle_neck else \
-        [64, 64, 128, 256, 512]
+    if isinstance(image_shape, str):
+        image_shape = tuple(int(x) for x in image_shape.split(","))
     height = image_shape[1]
+    if height <= 28:
+        # cifar schedules (reference resnext.py: 3 stages, depth tables
+        # like resnet's — resnext-29 = 3 bottleneck units per stage)
+        if (num_layers - 2) % 9 == 0:
+            bottle_neck = True
+            units = [(num_layers - 2) // 9] * 3
+            filter_list = [16, 64, 128, 256]
+        elif (num_layers - 2) % 6 == 0:
+            bottle_neck = False
+            units = [(num_layers - 2) // 6] * 3
+            filter_list = [16, 16, 32, 64]
+        else:
+            raise ValueError("no cifar resnext-%d schedule" % num_layers)
+    elif num_layers in _DEPTHS:
+        bottle_neck, units = _DEPTHS[num_layers]
+        filter_list = [64, 256, 512, 1024, 2048] if bottle_neck else \
+            [64, 64, 128, 256, 512]
+    else:
+        raise ValueError("no resnext-%d schedule" % num_layers)
 
     data = sym.Variable("data")
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5,

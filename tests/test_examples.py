@@ -29,6 +29,8 @@ CASES = [
     ("stochastic-depth/sto_depth.py", ["--num-epoch", "12"]),
     ("module/mnist_mlp.py", []),
     ("image-classification/fine_tune.py", []),
+    ("image-classification/train_cifar10.py",
+     ["--num-epochs", "3"]),
 ]
 
 
@@ -49,3 +51,17 @@ def test_example_trains(script, args):
     assert proc.returncode == 0, (
         "%s failed:\n%s\n%s" % (script, proc.stdout[-2000:],
                                 proc.stderr[-2000:]))
+
+
+def test_ring_attention_lm_on_mesh():
+    """Long-context example: ring attention over the suite's 8-device
+    virtual mesh — exact-match vs full attention plus the long-range
+    copy-task learning assert (example/long-context/)."""
+    path = os.path.join(ROOT, "example", "long-context",
+                        "ring_attention_lm.py")
+    proc = subprocess.run(
+        [sys.executable, "-u", path, "--steps", "600"],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (
+        "ring_attention_lm failed:\n%s\n%s"
+        % (proc.stdout[-2000:], proc.stderr[-2000:]))
