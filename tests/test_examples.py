@@ -31,6 +31,7 @@ CASES = [
     ("image-classification/fine_tune.py", []),
     ("image-classification/train_cifar10.py",
      ["--num-epochs", "3"]),
+    ("neural-style/neural_style.py", ["--iters", "200"]),
 ]
 
 
