@@ -501,7 +501,10 @@ def _make_op_func(op):
         kwargs.pop("name", None)
         ctx = kwargs.pop("ctx", None)
         inputs = [a for a in args if isinstance(a, NDArray)]
-        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+        # None kwargs mean "default" — the reference's generated wrappers
+        # drop them before the C call (they would stringify to "None")
+        attrs = {k: v for k, v in kwargs.items()
+                 if v is not None and not isinstance(v, NDArray)}
         named_in = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
         if named_in:
             order = op.list_arguments(attrs) + list(op.aux_names)

@@ -605,8 +605,10 @@ def _make_sym_func(op):
         attr = kwargs.pop("attr", None)
         named_inputs = {k: v for k, v in kwargs.items()
                         if isinstance(v, Symbol)}
+        # None kwargs mean "default" — dropped before they reach node
+        # attrs (same contract as the ndarray wrapper, ndarray.py)
         attrs = {k: v for k, v in kwargs.items()
-                 if not isinstance(v, Symbol)}
+                 if v is not None and not isinstance(v, Symbol)}
         input_syms = [a for a in args if isinstance(a, Symbol)]
         s = _create(op.name, input_syms, attrs, name=name,
                     named_inputs=named_inputs)

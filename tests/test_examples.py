@@ -32,6 +32,9 @@ CASES = [
     ("image-classification/train_cifar10.py",
      ["--num-epochs", "3"]),
     ("neural-style/neural_style.py", ["--iters", "200"]),
+    ("warpctc/ctc_train.py", ["--num-epoch", "10"]),
+    ("bayesian-methods/sgld.py",
+     ["--steps", "2000", "--burn-in", "500"]),
 ]
 
 

@@ -228,3 +228,17 @@ def test_compose_name_and_argname_semantics():
     assert args[:3] == ["din", "g", "b"]
     assert set(bound.list_auxiliary_states()) == {"bn_moving_mean",
                                                   "bn_moving_var"}
+
+
+def test_none_kwargs_dropped_on_both_wrappers():
+    """None-valued kwargs mean "use the default" on BOTH generated
+    wrappers (nd + sym) — they must never reach attrs as "None"."""
+    x = np.ones((2, 3), np.float32)
+    out = mx.nd.softmax(mx.nd.array(x), axis=None).asnumpy()
+    np.testing.assert_allclose(out.sum(axis=-1), np.ones(2), rtol=1e-5)
+    s = sym.softmax(sym.Variable("d"), axis=None)
+    e = s.simple_bind(mx.cpu(), d=(2, 3), grad_req="null")
+    e.arg_dict["d"][:] = x
+    e.forward(is_train=False)
+    np.testing.assert_allclose(e.outputs[0].asnumpy().sum(axis=-1),
+                               np.ones(2), rtol=1e-5)
