@@ -1,0 +1,29 @@
+"""bench.py is the driver's scoring gate — a syntax error or API drift
+inside it would only surface in the end-of-round TPU run. This smoke
+test executes it end to end on the CPU backend with tiny dimensions and
+validates the one-line JSON contract."""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_contract_json():
+    sys.path.insert(0, ROOT)
+    from __graft_entry__ import virtual_cpu_env  # the one clean-env home
+    env = virtual_cpu_env(1)
+    env.update(BENCH_BATCH="4", BENCH_STEPS="2", BENCH_PIPELINE="0",
+               BENCH_DTYPE="float32")
+    proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                          capture_output=True, text=True, timeout=1200,
+                          env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["metric"] == "resnet50_train_throughput"
+    assert rec["value"] > 0
+    assert rec["path"] == "module" and rec["fused_group"] is True
