@@ -35,6 +35,7 @@ CASES = [
     ("warpctc/ctc_train.py", ["--num-epoch", "10"]),
     ("bayesian-methods/sgld.py",
      ["--steps", "2000", "--burn-in", "500"]),
+    ("dec/dec.py", ["--pretrain-epochs", "8"]),
 ]
 
 
