@@ -33,11 +33,12 @@ def main():
     parser = argparse.ArgumentParser(description="train an autoencoder")
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--num-epoch", type=int, default=10)
-    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--lr", type=float, default=0.005)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     rng = np.random.RandomState(0)
+    np.random.seed(0)  # initializers draw from the global numpy RNG
     n, dim, rank = 2048, 64, 4
     basis = rng.randn(rank, dim).astype(np.float32)
     codes = rng.randn(n, rank).astype(np.float32)

@@ -35,9 +35,10 @@ def make_ae(latent):
     return mx.sym.LinearRegressionOutput(h, name="rec")
 
 
-def make_dec(latent, k, batch, alpha=1.0):
-    """KL(P||Q) over Student-t soft assignments (dec.py's t-distribution
-    kernel). centers: (k, latent) trainable; target: (batch, k) input."""
+def make_dec(latent, k, batch):
+    """KL(P||Q) over Student-t soft assignments with one degree of
+    freedom (dec.py's alpha=1 kernel, q ∝ (1+d²)⁻¹). centers:
+    (k, latent) trainable; target: (batch, k) input."""
     z = make_encoder(latent)                       # (N, L)
     centers = mx.sym.Variable("centers", shape=(k, latent))
     target = mx.sym.Variable("target", shape=(batch, k))
@@ -45,9 +46,9 @@ def make_dec(latent, k, batch, alpha=1.0):
     cc = mx.sym.Reshape(centers, shape=(1, k, latent))
     d2 = mx.sym.sum_axis(mx.sym.square(
         mx.sym.broadcast_minus(zc, cc)), axis=2)   # (N, k)
-    # Student-t kernel: q_ij ∝ (1 + d²/α)⁻¹  (dec.py eq. 1)
+    # Student-t kernel, alpha=1: q_ij ∝ (1 + d²)⁻¹  (dec.py eq. 1)
     qu = mx.sym._rdiv_scalar(
-        mx.sym._plus_scalar(d2, scalar=alpha), scalar=alpha)
+        mx.sym._plus_scalar(d2, scalar=1.0), scalar=1.0)
     q = mx.sym.broadcast_div(qu, mx.sym.sum_axis(qu, axis=1,
                                                  keepdims=True))
     kl = mx.sym.sum_axis(

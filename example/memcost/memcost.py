@@ -1,0 +1,146 @@
+"""Memory-cost tradeoff via rematerialization (reference example/memcost:
+the mxnet memonger re-plans the graph to trade compute for memory; the
+TPU-native equivalent is sqrt-N segmented ``jax.checkpoint`` over the
+symbol evaluator — executor.py ``_build_eval_segmented`` — surfaced as
+``Module(remat="full"|"dots")``).
+
+Part 1 measures the segmented evaluator directly: XLA's compiled
+temp-buffer footprint of grad(loss) over a deep conv net, plain vs
+segmented. On a TPU this is a real ~2.5-3x peak-memory reduction for
+~20% recompute flops. (XLA:CPU schedules through checkpoint boundaries,
+so there the flop increase is the observable signature.)
+
+Part 2 drives the same knob through ``Module(remat=...)`` end to end
+and asserts the recompute structure is present in the fused train step.
+(The wrapper-level buffer win through the Module jit is tracked
+separately — the evaluator is where the schedule lives.)
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+# MXNET_BACKWARD_DO_MIRROR=1 would silently promote the remat=None
+# baseline to 'full' (module.py) and void the comparison
+os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+
+import mxnet_tpu as mx
+
+
+def deep_net(depth, width):
+    body = mx.sym.Variable("data")
+    for i in range(depth):
+        body = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=width, name="conv%d" % i)
+        body = mx.sym.Activation(body, act_type="relu")
+    body = mx.sym.Pooling(body, global_pool=True, kernel=(1, 1),
+                          pool_type="avg")
+    body = mx.sym.FullyConnected(mx.sym.Flatten(body), num_hidden=10,
+                                 name="fc")
+    return mx.sym.SoftmaxOutput(body, name="softmax")
+
+
+def evaluator_footprint(net, args, segmented):
+    """Temp bytes + flops of grad(sum(loss)) over the evaluator."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.executor import _build_eval, _build_eval_segmented
+
+    arg_names = net.list_arguments()
+    shapes, _, _ = net.infer_shape(
+        data=(args.batch_size, 3, args.img, args.img),
+        softmax_label=(args.batch_size,))
+    shape_of = dict(zip(arg_names, shapes))
+    rng0 = jax.random.PRNGKey(0)
+    rng = np.random.RandomState(0)
+    vals = [rng.rand(*shape_of[n]).astype(np.float32) * 0.1
+            for n in arg_names]
+    p_idx = [i for i, n in enumerate(arg_names)
+             if n not in ("data", "softmax_label")]
+
+    ev, _ = (_build_eval_segmented(net, "full") if segmented
+             else _build_eval(net))
+
+    def loss(params):
+        v = list(vals)
+        for i, p in zip(p_idx, params):
+            v[i] = p
+        outs, _ = ev(v, [], rng0, True)
+        return jnp.sum(outs[0])
+
+    comp = jax.jit(jax.grad(loss)).lower(
+        [vals[i] for i in p_idx]).compile()
+    ca = comp.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    return (int(comp.memory_analysis().temp_size_in_bytes),
+            float(ca.get("flops", 0.0)))
+
+
+def module_flops(net, args, remat):
+    """Flops of the fused Module train step under remat=..."""
+    from mxnet_tpu.io import DataBatch
+    mod = mx.mod.Module(net, remat=remat)
+    mod.bind(data_shapes=[("data", (args.batch_size, 3, args.img,
+                                    args.img))],
+             label_shapes=[("softmax_label", (args.batch_size,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    rng = np.random.RandomState(0)
+    b = DataBatch(
+        data=[mx.nd.array(rng.rand(args.batch_size, 3, args.img,
+                                   args.img).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 10, args.batch_size)
+                           .astype(np.float32))])
+    mod.forward_backward(b)
+    mod.update()
+    eg = mod._exec_group
+    fn, structs = eg._last_step
+    comp = fn.lower(*structs).compile()
+    ca = comp.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    return float(ca.get("flops", 0.0))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="remat memory tradeoff")
+    parser.add_argument("--depth", type=int, default=12)
+    parser.add_argument("--width", type=int, default=32)
+    parser.add_argument("--img", type=int, default=64)
+    parser.add_argument("--batch-size", type=int, default=64)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    platform = jax.devices()[0].platform
+
+    net = deep_net(args.depth, args.width)
+    mem_p, fl_p = evaluator_footprint(net, args, segmented=False)
+    mem_s, fl_s = evaluator_footprint(net, args, segmented=True)
+    logging.info("evaluator plain:     temp %8.1f MiB  flops %.3g",
+                 mem_p / 2**20, fl_p)
+    logging.info("evaluator segmented: temp %8.1f MiB  flops %.3g",
+                 mem_s / 2**20, fl_s)
+
+    fl_none = module_flops(net, args, None)
+    fl_full = module_flops(net, args, "full")
+    print("segmented remat: temp %.1f -> %.1f MiB (ratio %.2f), "
+          "recompute flops +%.0f%%; Module(remat) step flops "
+          "%.3g -> %.3g (platform %s)"
+          % (mem_p / 2**20, mem_s / 2**20, mem_s / max(1, mem_p),
+             100.0 * (fl_s / fl_p - 1), fl_none, fl_full, platform))
+
+    assert fl_s > fl_p * 1.05, "segmentation must add recompute flops"
+    assert fl_full > fl_none * 1.05, \
+        "Module(remat='full') must recompute in the train step"
+    if platform != "cpu":
+        # the point of the exercise: a real peak-memory reduction
+        assert mem_s < 0.6 * mem_p, \
+            "segmented remat must shrink peak temp memory on TPU"
+
+
+if __name__ == "__main__":
+    main()

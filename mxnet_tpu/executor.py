@@ -33,6 +33,27 @@ from .registry import OpContext
 __all__ = ["Executor"]
 
 
+def _run_op(n, get, put, rng, is_train, aux_sink=None):
+    """Execute one op node: rng split, fcompute, output + aux write-back.
+    Shared by the plain and segmented evaluators so their semantics
+    (dropout streams, BN stat updates) can never diverge."""
+    import jax
+    ins = [get(id(s), oi) for (s, oi) in n.inputs]
+    sub = None
+    if n.op.needs_rng:
+        rng, sub = jax.random.split(rng)
+    octx = OpContext(is_train=is_train, rng=sub)
+    res = n.op.fcompute(n.attrs, ins, octx)
+    n_out = n.op.num_outputs(n.attrs)
+    for oi in range(n_out):
+        put(id(n), oi, res[oi])
+    if n.op.aux_names and aux_sink is not None:
+        n_args = len(n.op.list_arguments(n.attrs))
+        for (src, _), newv in zip(n.inputs[n_args:], res[n_out:]):
+            aux_sink(id(src), jax.lax.stop_gradient(newv))
+    return rng, res, n_out
+
+
 def _build_eval(symbol):
     """Compile the symbol's DAG into a pure function
     (arg_vals, aux_vals, rng, is_train) -> (outs, new_aux)."""
@@ -44,35 +65,148 @@ def _build_eval(symbol):
     needs_rng = any(n.op.needs_rng for n in op_nodes)
 
     def eval_fn(arg_vals, aux_vals, rng, is_train, tap=None):
-        import jax
         env = {}
         for n, v in zip(arg_nodes, arg_vals):
-            env[id(n)] = (v,)
+            env[(id(n), 0)] = v
         for n, v in zip(aux_nodes, aux_vals):
-            env[id(n)] = (v,)
+            env[(id(n), 0)] = v
         aux_out = {id(n): v for n, v in zip(aux_nodes, aux_vals)}
+        aux_ids = {id(n) for n in aux_nodes}
+
+        def sink(aid, v):
+            if aid in aux_ids:
+                aux_out[aid] = v
+
         for n in op_nodes:
-            ins = [env[id(s)][oi] for (s, oi) in n.inputs]
-            sub = None
-            if n.op.needs_rng:
-                rng, sub = jax.random.split(rng)
-            octx = OpContext(is_train=is_train, rng=sub)
-            res = n.op.fcompute(n.attrs, ins, octx)
-            n_out = n.op.num_outputs(n.attrs)
-            env[id(n)] = tuple(res[:n_out])
+            rng, res, n_out = _run_op(
+                n, lambda i, oi: env[(i, oi)],
+                lambda i, oi, v: env.__setitem__((i, oi), v), rng,
+                is_train, aux_sink=sink)
             if tap is not None:
                 if n_out == 1:
                     tap("%s_output" % n.name, res[0])
                 else:
                     for oi in range(n_out):
                         tap("%s_output%d" % (n.name, oi), res[oi])
-            if n.op.aux_names:
-                n_args = len(n.op.list_arguments(n.attrs))
-                for (src, _), newv in zip(n.inputs[n_args:], res[n_out:]):
-                    aux_out[id(src)] = jax.lax.stop_gradient(newv)
-        outs = tuple(env[id(n)][oi] for (n, oi) in heads)
+        outs = tuple(env[(id(n), oi)] for (n, oi) in heads)
         new_aux = tuple(aux_out[id(n)] for n in aux_nodes)
         return outs, new_aux
+
+    return eval_fn, needs_rng
+
+
+def _build_eval_segmented(symbol, remat="full", n_segments=None):
+    """Like :func:`_build_eval`, but the op sequence is split into
+    ~sqrt(N) contiguous segments, each wrapped in ``jax.checkpoint``.
+
+    A SINGLE checkpoint around the whole forward saves nothing (the
+    backward's recompute re-materializes every activation at the same
+    peak); the sqrt-N segment schedule keeps only segment-boundary
+    values live plus one segment's internals — the classic
+    O(sqrt(N))-memory rematerialization the reference's memonger tool
+    approximates by graph re-planning (example/memcost).
+
+    remat="dots" keeps matmul/conv outputs inside segments
+    (``jax.checkpoint_policies.dots_saveable``); "full" recomputes
+    everything inside a segment. Training-mode only, no tap support
+    (the monitor path uses the per-node evaluator).
+    """
+    import math
+
+    order = symbol._topo()
+    arg_nodes = [n for n in order if n.op is None and not n.is_aux]
+    aux_nodes = [n for n in order if n.op is None and n.is_aux]
+    op_nodes = [n for n in order if n.op is not None]
+    heads = symbol._heads
+    needs_rng = any(n.op.needs_rng for n in op_nodes)
+    aux_ids = {id(n) for n in aux_nodes}
+
+    n_ops = len(op_nodes)
+    if n_segments is None:
+        n_segments = max(1, int(math.ceil(math.sqrt(n_ops))))
+    seg_size = int(math.ceil(n_ops / float(n_segments)))
+    segments = [op_nodes[i:i + seg_size]
+                for i in range(0, n_ops, seg_size)]
+
+    # liveness, computed ONCE at build time: per segment, the slots it
+    # consumes from before it and the products needed later (or heads)
+    head_slots = {(id(n), oi) for (n, oi) in heads}
+    produced_in = {}
+    consumed_in = {}  # slot -> set of segment indices that read it
+    for si, seg in enumerate(segments):
+        for n in seg:
+            for oi in range(n.op.num_outputs(n.attrs)):
+                produced_in[(id(n), oi)] = si
+            for (src, oi) in n.inputs:
+                consumed_in.setdefault((id(src), oi), set()).add(si)
+
+    seg_plan = []  # (seg, in_slots, out_slots, aux_updates)
+    for si, seg in enumerate(segments):
+        in_slots, seen = [], set()
+        for n in seg:
+            for (src, oi) in n.inputs:
+                slot = (id(src), oi)
+                if produced_in.get(slot, -1) != si and slot not in seen:
+                    seen.add(slot)
+                    in_slots.append(slot)
+        out_slots, aux_updates = [], []
+        for n in seg:
+            for oi in range(n.op.num_outputs(n.attrs)):
+                slot = (id(n), oi)
+                later = consumed_in.get(slot, set())
+                if any(sj > si for sj in later) or slot in head_slots:
+                    out_slots.append(slot)
+            if n.op.aux_names:
+                n_args = len(n.op.list_arguments(n.attrs))
+                for (src, _) in n.inputs[n_args:]:
+                    if id(src) in aux_ids:
+                        aux_updates.append(id(src))
+        seg_plan.append((seg, tuple(in_slots), tuple(out_slots),
+                         tuple(aux_updates)))
+
+    def eval_fn(arg_vals, aux_vals, rng, is_train, tap=None):
+        import jax
+
+        assert tap is None, "segmented remat has no monitor taps"
+        policy = (jax.checkpoint_policies.dots_saveable
+                  if remat == "dots" else None)
+        env = {}
+        for n, v in zip(arg_nodes, arg_vals):
+            env[(id(n), 0)] = v
+        for n, v in zip(aux_nodes, aux_vals):
+            env[(id(n), 0)] = v
+        aux_out = {id(n): v for n, v in zip(aux_nodes, aux_vals)}
+
+        for seg, in_slots, out_slots, aux_updates in seg_plan:
+
+            def seg_fn(in_vals, rng_in, _seg=seg, _in=in_slots,
+                       _out=out_slots):
+                local = dict(zip(_in, in_vals))
+                upd = []
+
+                def sink(aid, v):
+                    if aid in aux_ids:
+                        upd.append(v)
+
+                r = rng_in
+                for n in _seg:
+                    r, _, _ = _run_op(
+                        n, lambda i, oi: local[(i, oi)],
+                        lambda i, oi, v: local.__setitem__((i, oi), v),
+                        r, is_train, aux_sink=sink)
+                return (tuple(local[s] for s in _out), tuple(upd), r)
+
+            in_vals = tuple(env[s] for s in in_slots)
+            outs, upd, rng = jax.checkpoint(seg_fn, policy=policy)(
+                in_vals, rng)
+            for slot, v in zip(out_slots, outs):
+                env[slot] = v
+            for aid, v in zip(aux_updates, upd):
+                aux_out[aid] = v
+
+        out_vals = tuple(env[(id(n), oi)] for (n, oi) in heads)
+        new_aux = tuple(aux_out[id(n)] for n in aux_nodes)
+        return out_vals, new_aux
 
     return eval_fn, needs_rng
 

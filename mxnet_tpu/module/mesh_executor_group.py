@@ -32,7 +32,7 @@ import itertools
 from .. import ndarray as nd
 from .. import random as _random
 from ..base import MXNetError
-from ..executor import _build_eval
+from ..executor import _build_eval, _build_eval_segmented
 
 # monotonic tokens for optimizer instances (train_step jit cache keys)
 _STEP_TOKENS = itertools.count()
@@ -79,6 +79,13 @@ class MeshExecutorGroup(object):
         self._platform = devices[0].platform
 
         self._eval_fn, self._needs_rng = _build_eval(symbol)
+        if self.remat:
+            # sqrt-N segmented checkpoints (training only): a single
+            # checkpoint around the whole forward saves no memory
+            self._remat_eval_fn, _ = _build_eval_segmented(
+                symbol, remat=self.remat)
+        else:
+            self._remat_eval_fn = None
         self._jits = {}
         self._pending = None     # (inputs dict of device arrays, is_train)
         self._outputs_from = None  # "fwd" | "bwd"
@@ -213,17 +220,16 @@ class MeshExecutorGroup(object):
             auxv = [aux[n] for n in self.aux_names]
             if self.remat and is_train:
                 # rematerialization trades HBM for recompute in backward
-                # (jax.checkpoint; the reference's external memonger tool).
-                # "full": recompute everything; "dots": keep matmul/conv
-                # outputs, recompute the cheap elementwise chains.
-                policy = (jax.checkpoint_policies.dots_saveable
-                          if self.remat == "dots" else None)
-                ev = jax.checkpoint(
-                    lambda v, a, r: self._eval_fn(v, a, r, True),
-                    policy=policy)
-                outs, new_aux = ev(vals, auxv, rng)
-            else:
-                outs, new_aux = self._eval_fn(vals, auxv, rng, is_train)
+                # (the reference's external memonger tool). sqrt-N
+                # contiguous segments each under jax.checkpoint: only
+                # segment boundaries stay live through backward.
+                # "full": recompute everything inside a segment;
+                # "dots": keep matmul/conv outputs (dots_saveable).
+                outs, new_aux = self._remat_eval_fn(vals, auxv, rng,
+                                                    True)
+                new_aux = dict(zip(self.aux_names, new_aux))
+                return outs, new_aux
+            outs, new_aux = self._eval_fn(vals, auxv, rng, is_train)
             return outs, dict(zip(self.aux_names, new_aux))
 
         repl, batch = self._repl, self._batch_sharding

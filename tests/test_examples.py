@@ -14,7 +14,7 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CASES = [
-    ("autoencoder/autoencoder.py", ["--num-epoch", "6"]),
+    ("autoencoder/autoencoder.py", ["--num-epoch", "15"]),
     ("adversary/fgsm.py", ["--num-epoch", "5"]),
     ("multi-task/multitask.py", ["--num-epoch", "25"]),
     ("svm_mnist/svm_mnist.py", ["--num-epoch", "8"]),
@@ -36,6 +36,8 @@ CASES = [
     ("bayesian-methods/sgld.py",
      ["--steps", "2000", "--burn-in", "500"]),
     ("dec/dec.py", ["--pretrain-epochs", "8"]),
+    ("memcost/memcost.py",
+     ["--width", "16", "--img", "32", "--batch-size", "32"]),
 ]
 
 
