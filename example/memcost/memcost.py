@@ -10,10 +10,14 @@ segmented. On a TPU this is a real ~2.5-3x peak-memory reduction for
 ~20% recompute flops. (XLA:CPU schedules through checkpoint boundaries,
 so there the flop increase is the observable signature.)
 
-Part 2 drives the same knob through ``Module(remat=...)`` end to end
-and asserts the recompute structure is present in the fused train step.
-(The wrapper-level buffer win through the Module jit is tracked
-separately — the evaluator is where the schedule lives.)
+Part 2 drives the same knob through ``Module(remat=...)`` end to end —
+the fused one-program train step (fwd+bwd+optimizer) — and asserts both
+the recompute flops and, on accelerator backends, the same peak-temp
+reduction (measured v5e: 716 -> 295 MiB, 0.41x, for +27% flops).
+The Module must be bound to the accelerator context: a Module left on
+the default cpu() context compiles for XLA:CPU where the reduction
+never materializes (that measurement artifact masqueraded as a
+"wrapper defeater" for a whole round).
 """
 import argparse
 import logging
@@ -80,10 +84,10 @@ def evaluator_footprint(net, args, segmented):
             float(ca.get("flops", 0.0)))
 
 
-def module_flops(net, args, remat):
-    """Flops of the fused Module train step under remat=..."""
+def module_step_footprint(net, args, remat, ctx):
+    """(temp bytes, flops) of the fused Module train step under remat=..."""
     from mxnet_tpu.io import DataBatch
-    mod = mx.mod.Module(net, remat=remat)
+    mod = mx.mod.Module(net, remat=remat, context=ctx)
     mod.bind(data_shapes=[("data", (args.batch_size, 3, args.img,
                                     args.img))],
              label_shapes=[("softmax_label", (args.batch_size,))])
@@ -102,7 +106,8 @@ def module_flops(net, args, remat):
     comp = fn.lower(*structs).compile()
     ca = comp.cost_analysis()
     ca = ca[0] if isinstance(ca, list) else ca
-    return float(ca.get("flops", 0.0))
+    return (int(comp.memory_analysis().temp_size_in_bytes),
+            float(ca.get("flops", 0.0)))
 
 
 def main():
@@ -125,21 +130,29 @@ def main():
     logging.info("evaluator segmented: temp %8.1f MiB  flops %.3g",
                  mem_s / 2**20, fl_s)
 
-    fl_none = module_flops(net, args, None)
-    fl_full = module_flops(net, args, "full")
-    print("segmented remat: temp %.1f -> %.1f MiB (ratio %.2f), "
-          "recompute flops +%.0f%%; Module(remat) step flops "
-          "%.3g -> %.3g (platform %s)"
+    # bind to the accelerator: on the default cpu() context the step
+    # compiles for XLA:CPU, which never realizes the reduction
+    ctx = mx.cpu() if platform == "cpu" else mx.tpu()
+    mm_none, fl_none = module_step_footprint(net, args, None, ctx)
+    mm_full, fl_full = module_step_footprint(net, args, "full", ctx)
+    print("segmented remat: evaluator temp %.1f -> %.1f MiB (ratio %.2f), "
+          "recompute flops +%.0f%%; Module(remat) train step temp "
+          "%.1f -> %.1f MiB, flops %.3g -> %.3g (platform %s)"
           % (mem_p / 2**20, mem_s / 2**20, mem_s / max(1, mem_p),
-             100.0 * (fl_s / fl_p - 1), fl_none, fl_full, platform))
+             100.0 * (fl_s / fl_p - 1), mm_none / 2**20, mm_full / 2**20,
+             fl_none, fl_full, platform))
 
     assert fl_s > fl_p * 1.05, "segmentation must add recompute flops"
     assert fl_full > fl_none * 1.05, \
         "Module(remat='full') must recompute in the train step"
     if platform != "cpu":
-        # the point of the exercise: a real peak-memory reduction
+        # the point of the exercise: a real peak-memory reduction,
+        # both at the evaluator level AND through Module.fit's fused step
         assert mem_s < 0.6 * mem_p, \
             "segmented remat must shrink peak temp memory on TPU"
+        assert mm_full < 0.6 * mm_none, \
+            "Module(remat='full') must shrink the fused train step's " \
+            "peak temp memory on TPU"
 
 
 if __name__ == "__main__":

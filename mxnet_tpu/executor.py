@@ -122,6 +122,10 @@ def _build_eval_segmented(symbol, remat="full", n_segments=None):
     aux_ids = {id(n) for n in aux_nodes}
 
     n_ops = len(op_nodes)
+    if n_ops == 0:
+        # variable-only symbol: nothing to checkpoint (range() below would
+        # get a zero step) — the plain evaluator is already optimal
+        return _build_eval(symbol)
     if n_segments is None:
         n_segments = max(1, int(math.ceil(math.sqrt(n_ops))))
     seg_size = int(math.ceil(n_ops / float(n_segments)))

@@ -419,3 +419,91 @@ def test_remat_matches_baseline():
 
     with pytest.raises(ValueError):
         mx.mod.Module(net, context=[mx.cpu(0)], remat="dot")
+
+
+def test_remat_module_program_identical_to_direct_jit():
+    """The Module-path remat program must be THE SAME program as a direct
+    jit of the segmented evaluator — byte-identical lowered HLO and equal
+    compiled temp footprint.
+
+    This pins the round-2 'wrapper defeater' diagnosis: the fused
+    fwd_bwd through MeshExecutorGroup lowers to exactly what a standalone
+    jax.jit produces, so the peak-temp reduction measured for the direct
+    jit on TPU (708->260 MiB, example/memcost) is guaranteed to hold
+    through Module.fit as well. (XLA:CPU — this suite's backend — shows
+    equal-but-unreduced temps for both; program identity is the portable
+    assertion, and the TPU-side reduction itself is asserted by
+    example/memcost on accelerator runs.)"""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.executor import _build_eval_segmented
+
+    net = _conv_bn_net()
+    batch = 16
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                        remat="full")
+    mod.bind(data_shapes=[("data", (batch, 1, 8, 8))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.initializer.Xavier())
+    eg = mod._exec_group
+    assert eg.fused
+
+    arg_names = net.list_arguments()
+    aux_names = net.list_auxiliary_states()
+    arg_shapes, _, aux_shapes = net.infer_shape(
+        data=(batch, 1, 8, 8), softmax_label=(batch,))
+    shape_of = dict(zip(arg_names, arg_shapes))
+    P = {n: jax.ShapeDtypeStruct(tuple(shape_of[n]), np.float32)
+         for n in eg.param_names}
+    AUX = {n: jax.ShapeDtypeStruct(tuple(s), np.float32)
+           for n, s in zip(aux_names, aux_shapes)}
+    INP = {"data": jax.ShapeDtypeStruct((batch, 1, 8, 8), np.float32),
+           "softmax_label": jax.ShapeDtypeStruct((batch,), np.float32)}
+    RNG = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    mod_low = eg._get_jit("fwd_bwd").lower(P, AUX, INP, RNG)
+
+    # standalone mimic: fresh evaluator, same shardings, direct jax.jit
+    ev, _ = _build_eval_segmented(net, "full")
+    grad_names = list(eg._grad_names)
+
+    def fwd_bwd(params, aux, inputs, rng):
+        def f(p):
+            vals = [p[n] if n in p else inputs[n] for n in arg_names]
+            outs, new_aux = ev(vals, [aux[n] for n in aux_names], rng,
+                               True)
+            return tuple(outs), dict(zip(aux_names, new_aux))
+
+        outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+        hs = tuple(jnp.ones_like(o) for o in outs)
+        (grads,) = vjp_fn(hs)
+        grads = {n: grads[n].astype(params[n].dtype) for n in grad_names}
+        outs = tuple(o.astype(np.float32) for o in outs)
+        return outs, new_aux, grads
+
+    mim_low = jax.jit(
+        fwd_bwd,
+        in_shardings=(eg._repl, eg._repl, eg._batch_sharding, None),
+        out_shardings=(eg._out_shardings, eg._repl, eg._repl)).lower(
+            P, AUX, INP, RNG)
+
+    assert mod_low.as_text() == mim_low.as_text(), \
+        "Module-path remat program diverged from the direct jit"
+    # the checkpoint structure is really in the lowered module program
+    assert mod_low.as_text().count("optimization_barrier") >= 2
+    mod_tmp = mod_low.compile().memory_analysis().temp_size_in_bytes
+    mim_tmp = mim_low.compile().memory_analysis().temp_size_in_bytes
+    assert mod_tmp == mim_tmp
+
+
+def test_remat_trivial_symbol_no_ops():
+    """Degenerate guard: a symbol with zero op nodes must not crash the
+    segmented builder (range() step 0 regression, ADVICE r2)."""
+    import jax
+    from mxnet_tpu.executor import _build_eval_segmented
+
+    net = sym.Group([sym.Variable("data")])
+    ev, _ = _build_eval_segmented(net, "full")
+    x = np.ones((2, 3), np.float32)
+    outs, _ = ev([x], [], jax.random.PRNGKey(0), True)
+    np.testing.assert_array_equal(np.asarray(outs[0]), x)
