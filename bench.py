@@ -157,6 +157,21 @@ def main():
         extra["mfu"] = round(achieved_tflops / peak_tf, 4)
     extra.update(_xla_cost(mod, fused, dt / steps, peak_bw, n_dev))
 
+    if os.environ.get("BENCH_HANDWRITTEN", "1") != "0":
+        # independent roofline witness: framework-free NHWC ResNet-50
+        # step in the same harness/barrier (PERF.md "Independent witness")
+        try:
+            import bench_handwritten
+            extra["handwritten_img_per_sec"] = round(
+                bench_handwritten.measure(batch=per_dev_batch,
+                                          steps=steps,
+                                          compute_dtype=dtype_env), 2)
+            # the witness runs on ONE device at the per-device batch;
+            # compare against the headline / n_dev on multi-chip runs
+            extra["handwritten_scope"] = "single_chip_bs%d" % per_dev_batch
+        except Exception as e:
+            extra["handwritten_error"] = str(e)[:120]
+
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
         extra.update(_bench_pipeline(mx, mod, step_batch=batch, steps=steps,
                                      img=img, synthetic_img_s=img_per_sec,

@@ -1,0 +1,160 @@
+"""Transformer LM trained through ``mx.mod.Module.fit`` on a dp×tp mesh.
+
+Demonstrates Module-reachable tensor parallelism: the decoder blocks'
+projection weights are sharded Megatron-style via
+``Module(mesh_axes=..., param_sharding=...)`` — column-parallel q/k/v and
+MLP-expand, row-parallel output/MLP-contract — and GSPMD inserts the
+collectives. The same script trains on one device (``--tp 1``) or any
+dp×tp factorization of the visible devices; numerics are independent of
+the mesh (tests/test_module_tp.py pins this for fit/predict).
+
+The reference has no transformer example (2017-era); its closest surface
+is the user-reachable ctx_group model parallelism
+(example/model-parallel-lstm, graph_executor.cc:318) which this upgrades
+to sharded tensor parallelism through the same Module API.
+
+Task: next-token prediction on synthetic "successor-chain" sequences
+(x_{t+1} = (x_t + step) mod V with a per-sequence step in {1,2,3}) — a
+causal LM must use the history (two tokens determine the step) to beat
+the 1/3 ambiguity of the last token alone; accuracy ≳0.9 after a few
+epochs proves real sequence modeling.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+V, D, H, T, BLOCKS = 32, 64, 4, 16, 2
+DH = D // H
+
+
+def attention(x, name, batch):
+    """Causal multi-head self-attention; q/k/v column-parallel, output
+    projection row-parallel under the Megatron rules below."""
+    x2 = mx.sym.Reshape(x, shape=(-1, D))
+
+    def heads(proj):
+        # (B*T, D) -> (B, T, H, DH) -> (B, H, T, DH) -> (B*H, T, DH)
+        s = mx.sym.Reshape(proj, shape=(batch, T, H, DH))
+        s = mx.sym.transpose(s, axes=(0, 2, 1, 3))
+        return mx.sym.Reshape(s, shape=(-1, T, DH))
+
+    q = heads(mx.sym.FullyConnected(x2, num_hidden=D, name=name + "_q"))
+    k = heads(mx.sym.FullyConnected(x2, num_hidden=D, name=name + "_k"))
+    v = heads(mx.sym.FullyConnected(x2, num_hidden=D, name=name + "_v"))
+
+    scores = mx.sym.batch_dot(q, k, transpose_b=True) * (DH ** -0.5)
+    mask = mx.sym.Variable("causal_mask", shape=(1, T, T))
+    att = mx.sym.softmax(mx.sym.broadcast_add(scores, mask), axis=-1)
+    ctx = mx.sym.batch_dot(att, v)                      # (B*H, T, DH)
+    ctx = mx.sym.Reshape(ctx, shape=(batch, H, T, DH))
+    ctx = mx.sym.transpose(ctx, axes=(0, 2, 1, 3))
+    ctx = mx.sym.Reshape(ctx, shape=(-1, D))            # (B*T, D)
+    out = mx.sym.FullyConnected(ctx, num_hidden=D, name=name + "_o")
+    return mx.sym.Reshape(out, shape=(batch, T, D))
+
+
+def mlp(x, name, batch):
+    x2 = mx.sym.Reshape(x, shape=(-1, D))
+    h = mx.sym.FullyConnected(x2, num_hidden=4 * D, name=name + "_fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=D, name=name + "_fc2")
+    return mx.sym.Reshape(h, shape=(batch, T, D))
+
+
+def lm_symbol(batch):
+    data = mx.sym.Variable("data")                      # (B, T) token ids
+    emb = mx.sym.Embedding(data, input_dim=V, output_dim=D, name="embed")
+    pos = mx.sym.Variable("pos_embed", shape=(1, T, D))
+    x = mx.sym.broadcast_add(emb, pos)
+    for i in range(BLOCKS):
+        x = x + attention(x, "blk%d_att" % i, batch)
+        x = x + mlp(x, "blk%d_mlp" % i, batch)
+    logits = mx.sym.FullyConnected(mx.sym.Reshape(x, shape=(-1, D)),
+                                   num_hidden=V, name="head")
+    label = mx.sym.Reshape(mx.sym.Variable("softmax_label"), shape=(-1,))
+    return mx.sym.SoftmaxOutput(logits, label=label, name="softmax")
+
+
+def megatron_rules():
+    rules = []
+    for i in range(BLOCKS):
+        for p in ("att_q", "att_k", "att_v", "mlp_fc1"):
+            rules.append(("blk%d_%s_weight" % (i, p), ("tp", None)))
+            rules.append(("blk%d_%s_bias" % (i, p), ("tp",)))
+        for p in ("att_o", "mlp_fc2"):
+            rules.append(("blk%d_%s_weight" % (i, p), (None, "tp")))
+    return rules
+
+
+class LMInit(mx.initializer.Xavier):
+    """Xavier for projections + the causal mask / position table."""
+
+    def __call__(self, desc, arr):
+        name = getattr(desc, "name", str(desc))
+        if name == "causal_mask":
+            m = np.triu(np.full((T, T), -1e9, np.float32), k=1)
+            arr[:] = m[None]
+        elif name == "pos_embed":
+            arr[:] = 0.02 * np.random.randn(1, T, D).astype(np.float32)
+        else:
+            super().__call__(desc, arr)
+
+
+def make_data(n, seed):
+    rng = np.random.RandomState(seed)
+    start = rng.randint(0, V, n)
+    step = rng.randint(1, 4, n)
+    t = np.arange(T + 1)
+    seq = (start[:, None] + step[:, None] * t[None, :]) % V
+    return seq[:, :T].astype(np.float32), seq[:, 1:].astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="dp*tp transformer LM")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epoch", type=int, default=15)
+    parser.add_argument("--tp", type=int, default=0,
+                        help="tp axis size (0 = auto from device count)")
+    parser.add_argument("--lr", type=float, default=2e-3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    np.random.seed(0)
+
+    n_dev = mx.context.num_devices() or 1
+    tp = args.tp or (4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1))
+    dp = n_dev // tp
+    ctxs = [mx.tpu(i) for i in range(n_dev)]
+
+    X, y = make_data(1024, seed=1)
+    Xv, yv = make_data(256, seed=2)
+    train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size,
+                            label_name="softmax_label")
+
+    mod = mx.mod.Module(lm_symbol(args.batch_size), context=ctxs,
+                        mesh_axes={"dp": dp, "tp": tp},
+                        param_sharding=megatron_rules(),
+                        fixed_param_names=["causal_mask"])
+    optimizer_params = {"learning_rate": args.lr, "beta1": 0.9,
+                        "beta2": 0.999}
+    mod.fit(train, eval_data=val, optimizer="adam",
+            optimizer_params=optimizer_params, initializer=LMInit(),
+            num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 8))
+
+    val.reset()
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print("dp=%d tp=%d  val next-token accuracy: %.4f" % (dp, tp, acc))
+    assert acc > 0.9, "transformer LM failed to learn (acc %.3f)" % acc
+
+
+if __name__ == "__main__":
+    main()

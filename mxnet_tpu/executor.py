@@ -215,6 +215,410 @@ def _build_eval_segmented(symbol, remat="full", n_segments=None):
     return eval_fn, needs_rng
 
 
+def _split_pipeline_stages(symbol, n_stages):
+    """Classify the symbol's op nodes into preamble / ``n_stages``
+    pipeline stages / postamble from ``ctx_group="stage<i>"`` attrs
+    (the reference's user-facing placement surface, AttrScope ->
+    PlaceDevice, graph_executor.cc:318 — here mapped to GPipe stages).
+
+    Contract (checked, with precise errors):
+      * tagged ops form stages 0..n_stages-1; dataflow between tags is
+        non-decreasing;
+      * untagged ops reachable INTO stages are preamble, ops depending
+        on the last stage are postamble; an untagged op between interior
+        stages is an error;
+      * exactly ONE tensor crosses each stage boundary, same shape at
+        every boundary;
+      * stages are structurally identical (same op types/attrs in the
+        same order) so one stage body can run under ``lax.switch``-free
+        weight-stationary scheduling with stacked per-stage params;
+      * no aux states (BatchNorm) inside stages.
+    Returns (pre_nodes, stage_nodes: list[list], post_nodes,
+    carry_slots, side_slots, stage_param_slots).
+    """
+    import re
+
+    order = symbol._topo()
+    op_nodes = [n for n in order if n.op is not None]
+    tag_of = {}
+    for n in op_nodes:
+        g = n._attr_dict.get("ctx_group")
+        if g is not None:
+            m = re.match(r"stage(\d+)$", g)
+            if m:
+                tag_of[id(n)] = int(m.group(1))
+    if not tag_of:
+        raise MXNetError("pipeline: no ctx_group='stage<i>' attrs found")
+    found = sorted(set(tag_of.values()))
+    if found != list(range(n_stages)):
+        raise MXNetError(
+            "pipeline: mesh pp axis is %d but symbol tags stages %s"
+            % (n_stages, found))
+
+    # transitive "depends on a tagged op of stage s" classification
+    max_dep = {}  # node id -> highest stage it depends on (-1 none)
+    for n in order:
+        d = tag_of.get(id(n), -1)
+        for (src, _) in (n.inputs or []):
+            d = max(d, max_dep.get(id(src), -1))
+        max_dep[id(n)] = d
+
+    pre, post = [], []
+    stage_nodes = [[] for _ in range(n_stages)]
+    for n in op_nodes:
+        s = tag_of.get(id(n))
+        if s is not None:
+            dep = max(max_dep.get(id(src), -1) for (src, _) in n.inputs)
+            if dep > s:
+                raise MXNetError(
+                    "pipeline: op %s tagged stage%d consumes stage%d "
+                    "output — dataflow must be stage-monotone"
+                    % (n.name, s, dep))
+            stage_nodes[s].append(n)
+        elif max_dep[id(n)] == -1:
+            pre.append(n)
+        elif max_dep[id(n)] == n_stages - 1:
+            post.append(n)
+        else:
+            raise MXNetError(
+                "pipeline: untagged op %s depends on interior stage%d — "
+                "tag it or move it out of the pipelined region"
+                % (n.name, max_dep[id(n)]))
+
+    produced_by = {}
+    for s, seg in enumerate(stage_nodes):
+        for n in seg:
+            for oi in range(n.op.num_outputs(n.attrs)):
+                produced_by[(id(n), oi)] = s
+
+    # carry slot per boundary: the single stage-(i-1) product stage i reads
+    carry_slots = []
+    for s in range(n_stages):
+        if s == 0:
+            continue
+        crossing = {slot for n in stage_nodes[s] for slot in
+                    ((id(src), oi) for (src, oi) in n.inputs)
+                    if produced_by.get(slot) == s - 1}
+        if len(crossing) != 1:
+            id2name = {id(n2): n2.name for seg2 in stage_nodes
+                       for n2 in seg2}
+            raise MXNetError(
+                "pipeline: %d tensors cross the stage%d->stage%d "
+                "boundary; exactly one must (crossing outputs of ops %s)"
+                % (len(crossing), s - 1, s,
+                   sorted(id2name.get(i, "?") for (i, _) in crossing)))
+        carry_slots.append(next(iter(crossing)))
+    # final carry: the single last-stage product the postamble reads
+    last_out = {slot for n in post for slot in
+                ((id(src), oi) for (src, oi) in n.inputs)
+                if produced_by.get(slot) == n_stages - 1}
+    for (hn, hoi) in symbol._heads:
+        if produced_by.get((id(hn), hoi)) is not None:
+            if produced_by[(id(hn), hoi)] != n_stages - 1:
+                raise MXNetError("pipeline: output taken from an "
+                                 "interior stage")
+            last_out.add((id(hn), hoi))
+    if len(last_out) != 1:
+        raise MXNetError(
+            "pipeline: the last stage must hand exactly one tensor to "
+            "the postamble (got %d)" % len(last_out))
+    carry_slots.append(next(iter(last_out)))
+    # postamble must not peek inside interior stages
+    for n in post:
+        for (src, oi) in n.inputs:
+            p = produced_by.get((id(src), oi))
+            if p is not None and p != n_stages - 1:
+                raise MXNetError(
+                    "pipeline: postamble op %s reads stage%d internals"
+                    % (n.name, p))
+
+    # structural identity + positional input classification
+    ref_seg = stage_nodes[0]
+    for s, seg in enumerate(stage_nodes[1:], 1):
+        if len(seg) != len(ref_seg):
+            raise MXNetError(
+                "pipeline: stage%d has %d ops, stage0 has %d — stages "
+                "must be structurally identical" % (s, len(seg),
+                                                    len(ref_seg)))
+        for a, b in zip(ref_seg, seg):
+            if a.op.name != b.op.name or a.attrs != b.attrs:
+                raise MXNetError(
+                    "pipeline: stage%d op %s (%s) does not match stage0 "
+                    "op %s (%s)" % (s, b.name, b.op.name, a.name,
+                                    a.op.name))
+
+    if n_stages < 2:
+        raise MXNetError("pipeline: needs a pp axis of size >= 2")
+
+    # which stages consume each Variable (param-vs-shared classification)
+    var_stages = {}
+    for n in pre + post:
+        for (src, _) in n.inputs:
+            if src.op is None:
+                var_stages.setdefault(id(src), set()).add("outside")
+    for s, seg in enumerate(stage_nodes):
+        for n in seg:
+            for (src, _) in n.inputs:
+                if src.op is None:
+                    var_stages.setdefault(id(src), set()).add(s)
+
+    # positional input classification per stage:
+    # ("internal", j, oi) | ("carry",) | ("param", k) | ("side", k)
+    stage_param_slots = [[] for _ in range(n_stages)]
+    sides_of = [[] for _ in range(n_stages)]
+    kinds_of = [[] for _ in range(n_stages)]
+    for s, seg in enumerate(stage_nodes):
+        local_pos = {}
+        for j, n in enumerate(seg):
+            for oi in range(n.op.num_outputs(n.attrs)):
+                local_pos[(id(n), oi)] = (j, oi)
+        seen_p, seen_s = {}, {}
+        for n in seg:
+            for (src, oi) in n.inputs:
+                slot = (id(src), oi)
+                if slot in local_pos:
+                    kinds_of[s].append(("internal",) + local_pos[slot])
+                elif produced_by.get(slot) is not None:
+                    kinds_of[s].append(("carry",))  # single, checked above
+                elif src.op is None and src.is_aux:
+                    raise MXNetError(
+                        "pipeline: aux state %s used inside stage%d — "
+                        "BatchNorm-style ops cannot be pipelined"
+                        % (src.name, s))
+                elif src.op is None and var_stages[id(src)] == {s}:
+                    # consumed by exactly this stage -> its private param
+                    if slot not in seen_p:
+                        seen_p[slot] = len(stage_param_slots[s])
+                        stage_param_slots[s].append(slot)
+                    kinds_of[s].append(("param", seen_p[slot]))
+                else:
+                    # preamble product or a Variable shared across stages
+                    # (e.g. a causal mask): a broadcast side input
+                    if slot not in seen_s:
+                        seen_s[slot] = len(sides_of[s])
+                        sides_of[s].append(slot)
+                    kinds_of[s].append(("side", seen_s[slot]))
+
+    # stages 1..K-1 must wire identically; stage0's carry positions hold
+    # the pipeline input x0 (a preamble product / arg), classified side
+    ref = kinds_of[1]
+    for s in range(2, n_stages):
+        if kinds_of[s] != ref:
+            raise MXNetError(
+                "pipeline: stage%d wires its inputs differently from "
+                "stage1 — stages must be structurally identical" % s)
+    carry_pos = [i for i, k in enumerate(ref) if k == ("carry",)]
+    if not carry_pos:
+        raise MXNetError("pipeline: stages do not consume the carry")
+    k0 = list(kinds_of[0])
+    x0_slots = {sides_of[0][k0[i][1]] if k0[i][0] == "side" else None
+                for i in carry_pos}
+    if len(x0_slots) != 1 or None in x0_slots:
+        raise MXNetError(
+            "pipeline: stage0 must read one preamble/arg tensor at the "
+            "positions where later stages read the carry")
+    x0_slot = next(iter(x0_slots))
+    # re-key stage0: x0 becomes the carry; drop it from stage0's sides
+    x0_side_idx = sides_of[0].index(x0_slot)
+    sides0 = [sl for sl in sides_of[0] if sl != x0_slot]
+    remap = {}
+    for i, sl in enumerate(sides_of[0]):
+        if sl != x0_slot:
+            remap[i] = sides0.index(sl)
+    k0 = [("carry",) if k[0] == "side" and k[1] == x0_side_idx else
+          (("side", remap[k[1]]) if k[0] == "side" else k) for k in k0]
+    if k0 != ref:
+        raise MXNetError(
+            "pipeline: stage0 wires its inputs differently from stage1")
+    # shared side inputs must be the SAME source slots for every stage
+    for s in range(2, n_stages):
+        if sides_of[s] != sides_of[1]:
+            raise MXNetError(
+                "pipeline: stage%d consumes different shared inputs "
+                "than stage1" % s)
+    if sides0 != sides_of[1]:
+        raise MXNetError(
+            "pipeline: stage0 consumes different shared inputs than "
+            "stage1")
+
+    # the outgoing carry must sit at the same local position in every
+    # stage (one stage body serves all pp ranks, weight-stationary)
+    out_pos = None
+    for s, seg in enumerate(stage_nodes):
+        local_pos = {}
+        for j, n in enumerate(seg):
+            for oi in range(n.op.num_outputs(n.attrs)):
+                local_pos[(id(n), oi)] = (j, oi)
+        p = local_pos.get(carry_slots[s])
+        if p is None:
+            raise MXNetError(
+                "pipeline: stage%d does not produce its carry" % s)
+        if out_pos is None:
+            out_pos = p
+        elif p != out_pos:
+            raise MXNetError(
+                "pipeline: stage%d emits its carry from a different op "
+                "position than stage0" % s)
+
+    return {"pre": pre, "stages": stage_nodes, "post": post,
+            "carry_slots": carry_slots, "x0_slot": x0_slot,
+            "side_slots": sides_of[1], "kinds": ref, "out_pos": out_pos,
+            "stage_param_slots": stage_param_slots}
+
+
+def _build_eval_pipelined(symbol, mesh, n_microbatch, pp_axis="pp",
+                          dp_axis="dp"):
+    """Like :func:`_build_eval`, but the symbol's ``ctx_group="stage<i>"``
+    region runs as a GPipe pipeline over the mesh's ``pp`` axis.
+
+    One fused program: preamble ops execute under GSPMD as usual; the
+    staged region becomes a ``shard_map`` over the full mesh running the
+    GPipe schedule (``lax.scan`` of compute + ``lax.ppermute`` ring hops,
+    parallel/pipeline_parallel.py design) with each pp rank holding its
+    stage's parameters (stacked leading stage axis, sharded on 'pp');
+    the postamble (loss head) runs on the re-assembled sequence output.
+    ``jax.vjp`` differentiates straight through the schedule, so the
+    enclosing fused fwd+bwd/train-step machinery is unchanged.
+
+    Microbatching splits the global batch B into ``n_microbatch`` chunks
+    along axis 0 (B % (n_microbatch * dp) == 0); pipeline bubble is the
+    standard (S-1)/(M+S-1). Stage bodies must be batch-size-polymorphic
+    (Reshape with -1, no BatchNorm inside stages — checked).
+    """
+    order = symbol._topo()
+    arg_nodes = [n for n in order if n.op is None and not n.is_aux]
+    aux_nodes = [n for n in order if n.op is None and n.is_aux]
+    op_nodes = [n for n in order if n.op is not None]
+    heads = symbol._heads
+    needs_rng = any(n.op.needs_rng for n in op_nodes)
+    aux_ids = {id(n) for n in aux_nodes}
+
+    n_stages = dict(zip(mesh.axis_names, mesh.devices.shape))[pp_axis]
+    plan = _split_pipeline_stages(symbol, n_stages)
+    pre, stages, post = plan["pre"], plan["stages"], plan["post"]
+    body_seg = stages[1]  # canonical stage (kinds computed against it)
+    final_slot = plan["carry_slots"][-1]
+    out_pos = plan["out_pos"]
+
+    # per-op resolver table for the shared stage body
+    kinds_by, it = [], iter(plan["kinds"])
+    for n in body_seg:
+        kinds_by.append([next(it) for _ in n.inputs])
+
+    def stage_body(param_vals, x, side_vals, key, is_train):
+        import jax
+        local = {}
+        for j, n in enumerate(body_seg):
+            ins = []
+            for kk in kinds_by[j]:
+                if kk[0] == "internal":
+                    ins.append(local[(kk[1], kk[2])])
+                elif kk[0] == "carry":
+                    ins.append(x)
+                elif kk[0] == "param":
+                    ins.append(param_vals[kk[1]])
+                else:
+                    ins.append(side_vals[kk[1]])
+            sub = None
+            if n.op.needs_rng:
+                key, sub = jax.random.split(key)
+            res = n.op.fcompute(n.attrs, ins, OpContext(is_train=is_train,
+                                                        rng=sub))
+            for oi in range(n.op.num_outputs(n.attrs)):
+                local[(j, oi)] = res[oi]
+        return local[out_pos], key
+
+    def eval_fn(arg_vals, aux_vals, rng, is_train, tap=None):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax, shard_map
+        from jax.sharding import PartitionSpec as P
+
+        assert tap is None, "pipelined eval has no monitor taps"
+        env = {}
+        for n, v in zip(arg_nodes, arg_vals):
+            env[(id(n), 0)] = v
+        for n, v in zip(aux_nodes, aux_vals):
+            env[(id(n), 0)] = v
+        aux_out = {id(n): v for n, v in zip(aux_nodes, aux_vals)}
+
+        def sink(aid, v):
+            if aid in aux_ids:
+                aux_out[aid] = v
+
+        def get(i, oi):
+            return env[(i, oi)]
+
+        def put(i, oi, v):
+            env[(i, oi)] = v
+
+        for n in pre:
+            rng, _, _ = _run_op(n, get, put, rng, is_train, aux_sink=sink)
+
+        x0 = env[plan["x0_slot"]]
+        sides = tuple(env[s] for s in plan["side_slots"])
+        stacked = tuple(
+            jnp.stack([env[plan["stage_param_slots"][s][k]]
+                       for s in range(n_stages)])
+            for k in range(len(plan["stage_param_slots"][0])))
+
+        B, M = x0.shape[0], n_microbatch
+        if B % M:
+            raise MXNetError(
+                "pipeline: batch %d not divisible by %d microbatches"
+                % (B, M))
+        x_mb = x0.reshape((M, B // M) + x0.shape[1:])
+        if needs_rng:
+            rng, pipe_key = jax.random.split(rng)
+        else:
+            pipe_key = jnp.zeros((2,), jnp.uint32)
+
+        def sched(stacked_l, x_l, sides_l, key):
+            S = lax.axis_size(pp_axis)
+            idx = lax.axis_index(pp_axis)
+            params_l = tuple(p[0] for p in stacked_l)
+            Ml = x_l.shape[0]
+            zero = jnp.zeros_like(x_l[0])
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            # distinct rng stream per (tick, pp rank, dp shard): without
+            # the rank folds, structurally-identical stages would draw
+            # byte-identical dropout masks at every tick
+            kbase = jax.random.fold_in(key, idx)
+            if dp_axis in mesh.axis_names:
+                kbase = jax.random.fold_in(kbase,
+                                           lax.axis_index(dp_axis))
+
+            def tick(state, t):
+                inject = x_l[jnp.minimum(t, Ml - 1)]
+                cur = jnp.where(idx == 0, inject, state)
+                y, _ = stage_body(params_l, cur, sides_l,
+                                  jax.random.fold_in(kbase, t), is_train)
+                out = jnp.where(idx == S - 1, y, jnp.zeros_like(y))
+                return lax.ppermute(y, pp_axis, perm), out
+
+            _, ys = lax.scan(tick, zero, jnp.arange(Ml + S - 1))
+            # only the last stage wrote non-zeros; psum replicates
+            return lax.psum(ys[S - 1:], pp_axis)
+
+        y_mb = shard_map(
+            sched, mesh=mesh,
+            in_specs=(tuple(P(pp_axis) for _ in stacked),
+                      P(None, dp_axis), tuple(P() for _ in sides), P()),
+            out_specs=P(None, dp_axis), check_vma=False)(
+                stacked, x_mb, sides, pipe_key)
+        env[final_slot] = y_mb.reshape((B,) + y_mb.shape[2:])
+
+        for n in post:
+            rng, _, _ = _run_op(n, get, put, rng, is_train, aux_sink=sink)
+
+        outs = tuple(env[(id(n), oi)] for (n, oi) in heads)
+        new_aux = tuple(aux_out[id(n)] for n in aux_nodes)
+        return outs, new_aux
+
+    return eval_fn, needs_rng
+
+
 class Executor:
     """Runnable binding of a Symbol to argument/gradient/aux NDArrays."""
 

@@ -48,7 +48,9 @@ class MeshExecutorGroup(object):
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", compute_dtype=None, remat=None):
+                 grad_req="write", compute_dtype=None, remat=None,
+                 mesh_axes=None, param_sharding=None,
+                 pipeline_microbatches=None):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -73,10 +75,45 @@ class MeshExecutorGroup(object):
             if for_training and grad_req == "write" else []
 
         devices = [c.jax_device() for c in contexts]
-        self.mesh = Mesh(onp.array(devices), ("dp",))
+        # N-axis named mesh (default: one 'dp' axis over all devices).
+        # GSPMD turns per-param PartitionSpecs over these axes into sliced
+        # matmuls + collectives — the TP/MP story lives entirely in the
+        # sharding annotations, not in the evaluator.
+        if mesh_axes is None:
+            mesh_axes = {"dp": len(devices)}
+        self.mesh_axes = dict(mesh_axes)
+        import math as _math
+        if _math.prod(self.mesh_axes.values()) != len(devices):
+            raise MXNetError(
+                "mesh_axes %r needs %d devices, bind got %d contexts"
+                % (self.mesh_axes, _math.prod(self.mesh_axes.values()),
+                   len(devices)))
+        shape = tuple(self.mesh_axes.values())
+        self.mesh = Mesh(onp.array(devices).reshape(shape),
+                         tuple(self.mesh_axes))
         self._repl = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P("dp"))
         self._platform = devices[0].platform
+
+        # per-param NamedSharding from first-match rules
+        # (parallel.tensor_parallel.shard_params_for_tp rule format)
+        self._param_rules = list(param_sharding or [])
+        axis_names = set(self.mesh_axes)
+
+        def spec_for(name):
+            for pat, s in self._param_rules:
+                if pat in name:
+                    for ax in s:
+                        if ax is not None and ax not in axis_names:
+                            raise MXNetError(
+                                "param_sharding rule %r names mesh axis %r "
+                                "but mesh_axes is %r" % (pat, ax,
+                                                         self.mesh_axes))
+                    return P(*s)
+            return P()
+
+        self._param_shardings = {
+            n: NamedSharding(self.mesh, spec_for(n)) for n in param_names}
 
         self._eval_fn, self._needs_rng = _build_eval(symbol)
         if self.remat:
@@ -86,6 +123,21 @@ class MeshExecutorGroup(object):
                 symbol, remat=self.remat)
         else:
             self._remat_eval_fn = None
+        self.pipeline_microbatches = pipeline_microbatches
+        if pipeline_microbatches:
+            if "pp" not in self.mesh_axes:
+                raise MXNetError(
+                    "pipeline_microbatches needs a 'pp' mesh axis "
+                    "(mesh_axes=%r)" % (self.mesh_axes,))
+            if self.remat:
+                raise MXNetError(
+                    "pipeline_microbatches and remat cannot be combined "
+                    "(checkpoint the stage body instead)")
+            from ..executor import _build_eval_pipelined
+            self._pipe_eval_fn, _ = _build_eval_pipelined(
+                symbol, self.mesh, pipeline_microbatches)
+        else:
+            self._pipe_eval_fn = None
         self._jits = {}
         self._pending = None     # (inputs dict of device arrays, is_train)
         self._outputs_from = None  # "fwd" | "bwd"
@@ -105,15 +157,18 @@ class MeshExecutorGroup(object):
                                 if n not in param_names]
         ctx0 = contexts[0]
 
-        def repl_zeros(shape):
-            arr = jax.device_put(onp.zeros(shape, onp.float32), self._repl)
+        def zeros_with(shape, sharding):
+            arr = jax.device_put(onp.zeros(shape, onp.float32), sharding)
             return nd.NDArray(arr, ctx=ctx0)
 
+        p_sh = self._param_shardings
         if shared_group is not None:
             # shared_module semantics (executor_group.py:560-585): share the
             # parameter/grad/aux buffers with the parent module — trivially
             # memory-shared here since params are name-keyed device dicts
             shared_group._shared_out = True  # parent must not rebind away
+            assert shared_group.mesh_axes == self.mesh_axes, \
+                "shared_module must be bound on the same mesh_axes"
             for n in param_names:
                 src = shared_group._param_dict[n]
                 assert tuple(src.shape) == tuple(shape_of[n]), n
@@ -123,7 +178,7 @@ class MeshExecutorGroup(object):
             self.grad_arrays = [[shared_group._grad_dict[n]]
                                 if n in self._grad_names
                                 and n in shared_group._grad_dict else
-                                ([repl_zeros(shape_of[n])]
+                                ([zeros_with(shape_of[n], p_sh[n])]
                                  if n in self._grad_names else None)
                                 for n in param_names]
             self._grad_dict = {n: b[0] for n, b in zip(param_names,
@@ -132,17 +187,21 @@ class MeshExecutorGroup(object):
             self.aux_arrays = shared_group.aux_arrays
             self._aux_dict = shared_group._aux_dict
         else:
-            self.param_arrays = [[repl_zeros(shape_of[n])]
+            self.param_arrays = [[zeros_with(shape_of[n], p_sh[n])]
                                  for n in param_names]
             self._param_dict = {n: b[0] for n, b in zip(param_names,
                                                         self.param_arrays)}
-            self.grad_arrays = [[repl_zeros(shape_of[n])]
+            # gradients shard exactly like their params: GSPMD reduces them
+            # over 'dp' only, and a tp-sharded weight keeps a tp-sharded
+            # grad — no gather ever materializes the full tensor
+            self.grad_arrays = [[zeros_with(shape_of[n], p_sh[n])]
                                 if n in self._grad_names else None
                                 for n in param_names]
             self._grad_dict = {n: b[0] for n, b in zip(param_names,
                                                        self.grad_arrays)
                                if b is not None}
-            self.aux_arrays = [[repl_zeros(s)] for s in aux_shapes]
+            self.aux_arrays = [[zeros_with(s, self._repl)]
+                               for s in aux_shapes]
             self._aux_dict = {n: b[0] for n, b in zip(self.aux_names,
                                                       self.aux_arrays)}
 
@@ -156,11 +215,18 @@ class MeshExecutorGroup(object):
                   reshape=False):
         assert shared_group is None
         self.batch_size = data_shapes[0][1][0]
-        n_dev = len(self.contexts)
-        if self.batch_size % n_dev:
+        n_dp = self.mesh_axes["dp"] if hasattr(self, "mesh_axes") else \
+            len(self.contexts)
+        if self.batch_size % n_dp:
             raise MXNetError(
-                "fused mesh path needs batch_size %% n_devices == 0 "
-                "(got %d %% %d)" % (self.batch_size, n_dev))
+                "fused mesh path needs batch_size %% dp_axis == 0 "
+                "(got %d %% %d)" % (self.batch_size, n_dp))
+        mb = getattr(self, "pipeline_microbatches", None)
+        if mb and self.batch_size % (n_dp * mb):
+            raise MXNetError(
+                "pipelined fit needs batch_size %% (dp * microbatches) "
+                "== 0 (got %d %% (%d * %d))"
+                % (self.batch_size, n_dp, mb))
         self.data_shapes = [(x[0], tuple(x[1])) for x in data_shapes]
         self.label_shapes = [(x[0], tuple(x[1])) for x in label_shapes] \
             if label_shapes else None
@@ -218,6 +284,12 @@ class MeshExecutorGroup(object):
             # statistics math in f32 and casts its output to the activation
             # dtype, so mixed-precision dtype agreement is the op's job
             auxv = [aux[n] for n in self.aux_names]
+            if self._pipe_eval_fn is not None:
+                # GPipe schedule over the 'pp' axis inside this same
+                # program (shard_map scan; see _build_eval_pipelined)
+                outs, new_aux = self._pipe_eval_fn(vals, auxv, rng,
+                                                   is_train)
+                return outs, dict(zip(self.aux_names, new_aux))
             if self.remat and is_train:
                 # rematerialization trades HBM for recompute in backward
                 # (the reference's external memonger tool). sqrt-N
@@ -233,6 +305,8 @@ class MeshExecutorGroup(object):
             return outs, dict(zip(self.aux_names, new_aux))
 
         repl, batch = self._repl, self._batch_sharding
+        psh = self._param_shardings            # dict pytree over params
+        gsh = {n: psh[n] for n in grad_names}  # grads shard like params
 
         def fwd_bwd_math(params, aux, inputs, rng, heads=None):
             def f(p):
@@ -258,7 +332,7 @@ class MeshExecutorGroup(object):
                 outs = tuple(o.astype(onp.float32) for o in outs)
                 return outs, new_aux
 
-            fn = jax.jit(fwd, in_shardings=(repl, repl, batch, None),
+            fn = jax.jit(fwd, in_shardings=(psh, repl, batch, None),
                          out_shardings=(self._out_shardings, repl))
         elif kind.startswith("train_step:"):
             # whole train step — fwd+bwd+optimizer — as ONE XLA program:
@@ -286,9 +360,11 @@ class MeshExecutorGroup(object):
             # update path gates donation the same way)
             fn = jax.jit(
                 train_step,
-                in_shardings=(repl, repl, repl, batch, None, None, None),
-                out_shardings=(self._out_shardings, repl, repl, repl,
-                               repl),
+                # states: committed per-leaf in step_update (momentum etc.
+                # shard like their param); None = follow the argument
+                in_shardings=(psh, repl, None, batch, None, None, None),
+                out_shardings=(self._out_shardings, repl, gsh, psh,
+                               None),
                 donate_argnums=(0, 2) if self._platform != "cpu" else ())
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
@@ -297,10 +373,10 @@ class MeshExecutorGroup(object):
                 return fwd_bwd_math(params, aux, inputs, rng,
                                     heads if with_heads else None)
 
-            in_sh = (repl, repl, batch, None) + (
+            in_sh = (psh, repl, batch, None) + (
                 (self._out_shardings,) if with_heads else ())
             fn = jax.jit(fwd_bwd, in_shardings=in_sh,
-                         out_shardings=(self._out_shardings, repl, repl))
+                         out_shardings=(self._out_shardings, repl, gsh))
         self._jits[key] = fn
         return fn
 
@@ -313,7 +389,7 @@ class MeshExecutorGroup(object):
         for n, buf in self._param_dict.items():
             if n in arg_params:
                 buf._write(jax.device_put(arg_params[n]._read(),
-                                          self._repl))
+                                          self._param_shardings[n]))
         for n, buf in self._aux_dict.items():
             if aux_params and n in aux_params:
                 buf._write(jax.device_put(aux_params[n]._read(),

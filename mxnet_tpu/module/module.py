@@ -39,14 +39,63 @@ class Module(BaseModule):
     ``remat="dots"`` keeps matmul/conv outputs (checkpoint_policies
     .dots_saveable) — useful for transformer-style nets where elementwise
     chains dominate between matmuls; on conv nets it saves nothing.
+
+    ``mesh_axes`` + ``param_sharding`` make tensor/model parallelism
+    user-reachable through ``fit`` (the TPU-native upgrade of the
+    reference's user-reachable ctx_group placement,
+    graph_executor.cc:318):
+
+    * ``mesh_axes={"dp": 2, "tp": 4}`` factorizes the bound contexts into
+      a named device mesh (dict order = mesh order; sizes must multiply
+      to the context count; a "dp" axis is required and carries the
+      batch).
+    * ``param_sharding=[(pattern, spec), ...]`` shards parameters over
+      mesh axes: first substring match wins, ``spec`` is a
+      PartitionSpec-style tuple over the param's dims, e.g. Megatron
+      column-parallel ``("fc1_weight", ("tp", None))`` / row-parallel
+      ``("fc2_weight", (None, "tp"))`` for mxnet's (out, in) weight
+      layout (rules as in ``parallel.tensor_parallel
+      .shard_params_for_tp``). Unmatched params replicate.
+
+    The partitioner (GSPMD) then slices every matmul/conv touching a
+    sharded param and inserts the Megatron collectives (one psum per
+    column->row pair) automatically — the whole train step stays ONE XLA
+    program, gradients and optimizer states shard like their params, and
+    checkpoints still see full (gathered) arrays.
+
+    ``pipeline_microbatches=M`` (with a ``"pp"`` axis in ``mesh_axes``)
+    runs the symbol's ``ctx_group="stage<i>"`` region — the reference's
+    ctx_group surface — as a GPipe pipeline: each pp rank holds its
+    stage's params and the schedule is a ``lax.scan`` of stage compute +
+    ``ppermute`` ring hops inside the same fused program
+    (``executor._build_eval_pipelined``). Stages must be structurally
+    identical repeated blocks (single carry tensor between stages,
+    batch-polymorphic reshapes, no BatchNorm inside stages — violations
+    raise with precise messages); preamble (embedding) and postamble
+    (head/loss) run outside the pipeline under GSPMD. Numerics are
+    microbatch-exact vs the unpipelined run for rng-free stages (ops
+    with rng, e.g. Dropout, draw independent per-tick/rank streams
+    instead of reproducing the unpipelined mask sequence); the bubble
+    is the standard (S-1)/(M+S-1).
     """
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 compute_dtype=None, remat=None, _allow_fused=True):
+                 compute_dtype=None, remat=None, mesh_axes=None,
+                 param_sharding=None, pipeline_microbatches=None,
+                 _allow_fused=True):
         super().__init__(logger=logger)
         self._compute_dtype = compute_dtype
+        if mesh_axes is not None:
+            mesh_axes = dict(mesh_axes)
+            if "dp" not in mesh_axes:
+                raise ValueError(
+                    "mesh_axes must include a 'dp' (batch) axis; use "
+                    "{'dp': 1, ...} for pure model parallelism")
+        self._mesh_axes = mesh_axes
+        self._param_sharding = list(param_sharding or [])
+        self._pipeline_microbatches = pipeline_microbatches
         if remat is None and os.environ.get(
                 "MXNET_BACKWARD_DO_MIRROR", "0") == "1":
             # the reference's activation-recompute switch
@@ -239,12 +288,25 @@ class Module(BaseModule):
                 self._data_shapes, self._label_shapes, self._param_names,
                 for_training, inputs_need_grad, shared_group, self.logger,
                 self._fixed_param_names, grad_req,
-                compute_dtype=self._compute_dtype, remat=self._remat)
+                compute_dtype=self._compute_dtype, remat=self._remat,
+                mesh_axes=self._mesh_axes,
+                param_sharding=self._param_sharding,
+                pipeline_microbatches=self._pipeline_microbatches)
         elif shared_is_fused:
             raise ValueError(
                 "shared_module uses the fused mesh group but this bind is "
                 "not fused-eligible; bind the shared module with "
                 "MXNET_MODULE_FUSED=0 to share classic executors")
+        elif self._mesh_axes is not None or self._param_sharding or \
+                self._pipeline_microbatches:
+            # sharded model parallelism exists only as the one-program mesh
+            # path; a silent fallback would train an unsharded model
+            raise ValueError(
+                "mesh_axes/param_sharding/pipeline_microbatches require "
+                "the fused mesh path, but this bind is not fused-eligible "
+                "(check MXNET_MODULE_FUSED, batch divisibility by the dp "
+                "axis, grad_req='write', uniform work_load_list, distinct "
+                "same-platform devices)")
         else:
             if self._remat is not None:
                 self.logger.warning(
@@ -282,7 +344,10 @@ class Module(BaseModule):
             return False
         if grad_req != "write":
             return False
-        if self._data_shapes[0][1][0] % len(self._context):
+        # the batch shards over the 'dp' axis only (model axes replicate
+        # or slice params, not the batch)
+        dp_size = (self._mesh_axes or {}).get("dp", len(self._context))
+        if self._data_shapes[0][1][0] % dp_size:
             return False
         # the fused mesh shards the batch evenly; a deliberate non-uniform
         # workload split needs the classic sliced group
@@ -318,7 +383,8 @@ class Module(BaseModule):
         else:
             self._label_shapes = None
         if getattr(self._exec_group, "fused", False) and \
-                self._data_shapes[0][1][0] % len(self._context):
+                self._data_shapes[0][1][0] % \
+                (self._mesh_axes or {}).get("dp", len(self._context)):
             # new batch doesn't divide the mesh: fall back to the classic
             # sliced group, keeping parameters
             self._fallback_to_classic("reshape to a batch size that does "
@@ -342,6 +408,12 @@ class Module(BaseModule):
                 "cannot fall back from the fused mesh group (%s) while "
                 "parameters are shared with another module; bind all "
                 "modules with MXNET_MODULE_FUSED=0 instead" % reason)
+        if self._mesh_axes is not None or self._param_sharding or \
+                self._pipeline_microbatches:
+            raise MXNetError(
+                "cannot fall back from the fused mesh group (%s): "
+                "mesh_axes/param_sharding/pipeline_microbatches have no "
+                "classic-path equivalent" % reason)
         if self._params_dirty:
             self._sync_params_from_devices()
         if self._compute_dtype is not None:

@@ -492,7 +492,16 @@ class Updater(object):
                 return tuple(tree_read(s) for s in state)
             v = state._read()
             if like is not None and v.sharding != like.sharding:
-                v = jax.device_put(v, like.sharding)
+                if getattr(v, "shape", None) == getattr(like, "shape", None):
+                    v = jax.device_put(v, like.sharding)
+                else:
+                    # shape-mismatched leaves (scalar counters etc.) can't
+                    # take a sharded param's spec — replicate on its mesh
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    sh = like.sharding
+                    if isinstance(sh, NamedSharding):
+                        v = jax.device_put(
+                            v, NamedSharding(sh.mesh, PartitionSpec()))
             return v
 
         return tree_read(self.states[index])

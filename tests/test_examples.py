@@ -60,6 +60,20 @@ def test_example_trains(script, args):
                                 proc.stderr[-2000:]))
 
 
+def test_transformer_lm_tp_on_mesh():
+    """Module-reachable tensor parallelism: the transformer LM trains
+    through Module.fit on a dp=2 x tp=4 mesh (example/transformer-lm/)
+    with Megatron-sharded block weights, hitting its accuracy assert."""
+    path = os.path.join(ROOT, "example", "transformer-lm",
+                        "transformer_lm_tp.py")
+    proc = subprocess.run(
+        [sys.executable, "-u", path, "--num-epoch", "10"],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (
+        "transformer_lm_tp failed:\n%s\n%s"
+        % (proc.stdout[-2000:], proc.stderr[-2000:]))
+
+
 def test_ring_attention_lm_on_mesh():
     """Long-context example: ring attention over the suite's 8-device
     virtual mesh — exact-match vs full attention plus the long-range
