@@ -107,6 +107,43 @@ def test_image_record_iter(tmp_path):
     assert len(list(it)) == 3
 
 
+def test_device_augment_matches_host_path(tmp_path):
+    """device_augment=True ships uint8 NHWC and runs mirror/normalize/
+    transpose on device — numerics must equal the host assemble_batch
+    path exactly (VERDICT r2 #3). rand_crop stays off: the host path's
+    crop rng draws race across pool threads, so two iterators are only
+    comparable with deterministic center-crop geometry."""
+    path, _ = _make_rec(tmp_path)
+    kw = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=8,
+              rand_mirror=True, mean_r=123.0, mean_g=117.0, mean_b=104.0,
+              std_r=58.0, std_g=57.0, std_b=57.0, scale=2.0, seed=5)
+    host = mx.io.ImageRecordIter(**kw)
+    dev = mx.io.ImageRecordIter(device_augment=True, **kw)
+    for _ in range(2):
+        a, b = next(host), next(dev)
+        np.testing.assert_allclose(a.data[0].asnumpy(),
+                                   b.data[0].asnumpy(), atol=1e-4)
+        np.testing.assert_array_equal(a.label[0].asnumpy(),
+                                      b.label[0].asnumpy())
+
+
+def test_process_pool_decode_matches_threads(tmp_path):
+    """preprocess_processes=N decodes in worker processes (the reference's
+    decode farm, iter_image_recordio_2.cc); with deterministic center
+    crop it must produce byte-identical batches to the thread path."""
+    path, _ = _make_rec(tmp_path)
+    kw = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=8,
+              seed=5, mean_r=10.0)
+    t = mx.io.ImageRecordIter(**kw)
+    p = mx.io.ImageRecordIter(preprocess_processes=2, **kw)
+    try:
+        for _ in range(2):
+            np.testing.assert_array_equal(next(t).data[0].asnumpy(),
+                                          next(p).data[0].asnumpy())
+    finally:
+        p.pool.shutdown(wait=True)
+
+
 def test_image_iter_imglist(tmp_path):
     from PIL import Image
     rng = np.random.RandomState(0)
