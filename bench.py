@@ -139,17 +139,23 @@ def main():
     pipe_recs = pipe_tmp = None
     pipe_extra = {}
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
-        # never let a pipeline failure block the headline measurement
+        # never let a pipeline failure block the headline measurement,
+        # and never let a clean-phase failure drop the fed-phase metrics
+        # (the rec files survive for _bench_pipeline below)
         try:
             pipe_tmp, pipe_recs = _make_rec_files(mx, img, batch)
-            pipe_extra = _bench_pipeline_clean(mx, pipe_recs, batch,
-                                               steps, img)
         except Exception as e:
-            pipe_extra = {"pipeline_clean_error": str(e)[:120]}
+            pipe_extra = {"pipeline_rec_error": str(e)[:120]}
             if pipe_tmp is not None:
                 import shutil
                 shutil.rmtree(pipe_tmp, ignore_errors=True)
                 pipe_recs = pipe_tmp = None
+        if pipe_recs is not None:
+            try:
+                pipe_extra = _bench_pipeline_clean(mx, pipe_recs, batch,
+                                                   steps, img)
+            except Exception as e:
+                pipe_extra = {"pipeline_clean_error": str(e)[:120]}
 
     barrier = _make_barrier(mod, fused)
 
@@ -219,22 +225,27 @@ def _make_rec_files(mx, img, step_batch):
     rng = np.random.RandomState(1)
     tmp = tempfile.mkdtemp(prefix="bench_io_")
     recs = {"_n_images": n_images}
-    for fmt in ("npy", "jpg"):
-        path = os.path.join(tmp, "train_%s.rec" % fmt)
-        writer = mx.recordio.MXRecordIO(path, "w")
-        for i in range(n_images):
-            arr = (rng.rand(img, img, 3) * 255).astype(np.uint8)
-            writer.write(mx.recordio.pack_img(
-                mx.recordio.IRHeader(0, float(i % 1000), i, 0), arr,
-                img_fmt="." + fmt))
-        writer.close()
-        rdr = mx.recordio.MXRecordIO(path, "r")
-        _, payload = mx.recordio.unpack(rdr.read())
-        rdr.close()
-        if fmt == "jpg" and payload[:6] == b"\x93NUMPY":
-            recs["_jpeg_skipped"] = "no jpeg encoder on host"
-            continue
-        recs[fmt] = path
+    try:
+        for fmt in ("npy", "jpg"):
+            path = os.path.join(tmp, "train_%s.rec" % fmt)
+            writer = mx.recordio.MXRecordIO(path, "w")
+            for i in range(n_images):
+                arr = (rng.rand(img, img, 3) * 255).astype(np.uint8)
+                writer.write(mx.recordio.pack_img(
+                    mx.recordio.IRHeader(0, float(i % 1000), i, 0), arr,
+                    img_fmt="." + fmt))
+            writer.close()
+            rdr = mx.recordio.MXRecordIO(path, "r")
+            _, payload = mx.recordio.unpack(rdr.read())
+            rdr.close()
+            if fmt == "jpg" and payload[:6] == b"\x93NUMPY":
+                recs["_jpeg_skipped"] = "no jpeg encoder on host"
+                continue
+            recs[fmt] = path
+    except Exception:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     return tmp, recs
 
 
@@ -280,25 +291,26 @@ def _bench_pipeline_clean(mx, recs, step_batch, steps, img):
         shuffle=True, preprocess_threads=threads,
         preprocess_processes=procs, device_augment=dev_aug,
         label_name="softmax_label")
+    try:
+        def next_batch():
+            try:
+                return next(it)
+            except StopIteration:
+                it.reset()
+                return next(it)
 
-    def next_batch():
-        try:
-            return next(it)
-        except StopIteration:
-            it.reset()
-            return next(it)
-
-    acc_fn = jax.jit(lambda d, s: s + d.ravel()[0].astype(jnp.float32))
-    b = next_batch()  # compile prep + acc
-    acc = acc_fn(b.data[0]._read(), jnp.float32(0.0))
-    n = max(4, min(steps, recs["_n_images"] // step_batch))
-    t0 = time.time()
-    for _ in range(n):
-        acc = acc_fn(next_batch().data[0]._read(), acc)
-    float(acc)  # the window's ONE readback — orders against every batch
-    out["pipeline_clean_%s_img_per_sec" % fmt] = round(
-        n * step_batch / (time.time() - t0), 2)
-    it.pool.shutdown(wait=False)
+        acc_fn = jax.jit(lambda d, s: s + d.ravel()[0].astype(jnp.float32))
+        b = next_batch()  # compile prep + acc
+        acc = acc_fn(b.data[0]._read(), jnp.float32(0.0))
+        n = max(4, min(steps, recs["_n_images"] // step_batch))
+        t0 = time.time()
+        for _ in range(n):
+            acc = acc_fn(next_batch().data[0]._read(), acc)
+        float(acc)  # the window's ONE readback — orders against all batches
+        out["pipeline_clean_%s_img_per_sec" % fmt] = round(
+            n * step_batch / (time.time() - t0), 2)
+    finally:
+        it.pool.shutdown(wait=False)
     return out
 
 

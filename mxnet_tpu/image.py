@@ -298,6 +298,27 @@ class ImageIter(DataIter):
                          pad=pad)
 
 
+def _decode_resize_crop(img_bytes, resize, th, tw, pick_crop):
+    """Shared record-payload -> cropped uint8 HWC pipeline (thread and
+    process decode paths must never diverge). ``pick_crop(h, w)`` ->
+    (y0, x0) supplies the crop geometry."""
+    if img_bytes[:6] == b"\x93NUMPY":
+        # raw (uncompressed) payload from pack_img's npy fallback /
+        # im2rec --encoding .npy: decode is a buffer view, the mode
+        # for hosts where JPEG decode can't keep up with the chip
+        img = onp.load(_pyio.BytesIO(bytes(img_bytes)), allow_pickle=False)
+    else:
+        img = imdecode(img_bytes)
+    if resize > 0:
+        img = resize_short(img, resize)
+    h, w = img.shape[:2]
+    if h < th or w < tw:
+        img = _resize(img, max(tw, w), max(th, h))
+        h, w = img.shape[:2]
+    y0, x0 = pick_crop(h, w)
+    return img[y0:y0 + th, x0:x0 + tw]
+
+
 def _proc_worker_init(path):
     global _PROC_REC
     _PROC_REC = runtime.RecordFile(path)
@@ -311,24 +332,15 @@ def _proc_decode_one(args):
     and folding the epoch keeps crops varying across epochs."""
     idx, resize, th, tw, rand_crop, seed = args
     header, img_bytes = recordio.unpack(_PROC_REC.read(idx))
-    if img_bytes[:6] == b"\x93NUMPY":
-        img = onp.load(_pyio.BytesIO(bytes(img_bytes)), allow_pickle=False)
-    else:
-        img = imdecode(img_bytes)
-    if resize > 0:
-        img = resize_short(img, resize)
-    h, w = img.shape[:2]
-    if h < th or w < tw:
-        img = _resize(img, max(tw, w), max(th, h))
-        h, w = img.shape[:2]
-    if rand_crop:
+
+    def pick(h, w):
+        if not rand_crop:
+            return (h - th) // 2, (w - tw) // 2
         r = random.Random(seed ^ (idx * 2654435761 & 0xffffffff))
-        y0 = r.randint(0, h - th)
-        x0 = r.randint(0, w - tw)
-    else:
-        y0 = (h - th) // 2
-        x0 = (w - tw) // 2
-    return img[y0:y0 + th, x0:x0 + tw], onp.atleast_1d(header.label)
+        return r.randint(0, h - th), r.randint(0, w - tw)
+
+    img = _decode_resize_crop(img_bytes, resize, th, tw, pick)
+    return img, onp.atleast_1d(header.label)
 
 
 class ImageRecordIter(DataIter):
@@ -374,9 +386,13 @@ class ImageRecordIter(DataIter):
         self.device_augment = device_augment
         self._device_fn = None
         if preprocess_processes > 0:
+            import multiprocessing
             from concurrent.futures import ProcessPoolExecutor
+            # spawn, not fork: the parent typically holds an initialized
+            # JAX/TPU client whose threads/state must not be forked
             self.pool = ProcessPoolExecutor(
                 max_workers=preprocess_processes,
+                mp_context=multiprocessing.get_context("spawn"),
                 initializer=_proc_worker_init, initargs=(path_imgrec,))
             self._proc_mode = True
         else:
@@ -406,30 +422,15 @@ class ImageRecordIter(DataIter):
 
     def _decode_one(self, idx):
         header, img_bytes = recordio.unpack(self.rec.read(idx))
-        if img_bytes[:6] == b"\x93NUMPY":
-            # raw (uncompressed) payload from pack_img's npy fallback /
-            # im2rec --encoding .npy: decode is a buffer view, the mode
-            # for hosts where JPEG decode can't keep up with the chip
-            img = onp.load(_pyio.BytesIO(bytes(img_bytes)),
-                           allow_pickle=False)
-        else:
-            img = imdecode(img_bytes)
         c, th, tw = self.data_shape
-        if self.resize > 0:
-            img = resize_short(img, self.resize)
-        h, w = img.shape[:2]
-        if h < th or w < tw:
-            img = _resize(img, max(tw, w), max(th, h))
-            h, w = img.shape[:2]
-        if self.rand_crop:
-            y0 = self.rng.randint(0, h - th)
-            x0 = self.rng.randint(0, w - tw)
-        else:
-            y0 = (h - th) // 2
-            x0 = (w - tw) // 2
-        img = img[y0:y0 + th, x0:x0 + tw]
-        label = header.label
-        return img, onp.atleast_1d(label)
+
+        def pick(h, w):
+            if not self.rand_crop:
+                return (h - th) // 2, (w - tw) // 2
+            return self.rng.randint(0, h - th), self.rng.randint(0, w - tw)
+
+        img = _decode_resize_crop(img_bytes, self.resize, th, tw, pick)
+        return img, onp.atleast_1d(header.label)
 
     def _device_preprocess(self, imgs_u8, mirror):
         """uint8 NHWC batch -> normalized f32 NCHW, entirely on device.

@@ -1,15 +1,277 @@
 """Torch bridge (python/mxnet/torch.py / plugin/torch in the reference).
 
-The reference bridges Lua-torch modules/criterions into the graph. A
-CPU-only ``torch`` is present in this image, so the bridge maps torch
-callables into the graph via CustomOp semantics (host callback); there is
-no TPU-side torch execution.
+The reference bridges Lua-torch modules/criterions into the symbolic
+graph as the ``TorchModule`` / ``TorchCriterion`` ops
+(plugin/torch/torch_module-inl.h, torch_criterion-inl.h): ``lua_string``
+constructs an ``nn`` module whose parameters become graph arguments.
+Here the same two ops are registered with ``lua_string`` evaluated as a
+PYTORCH constructor expression in a namespace with ``nn``/``torch``/``F``
+bound (``"nn.Linear(4, 3)"`` works verbatim for the many constructors
+Lua-nn and torch.nn share). Execution is a host callback
+(``jax.pure_callback`` + ``jax.custom_vjp`` running torch autograd —
+the CustomOp machinery's pattern, operator.py), so the ops participate
+in jitted graphs, Module.fit, and the C API like any native op; there
+is no TPU-side torch execution.
+
+Matching reference semantics:
+* ``TorchModule(lua_string, num_data, num_params, num_outputs)`` —
+  arguments are ``data_0..`` then the module's parameter names
+  (torch's ``named_parameters()``, dots -> underscores; the reference
+  maps Lua param tensors to their field names the same way,
+  torch_module-inl.h ListArguments).
+* ``TorchCriterion(lua_string, label_shape, grad_scale)`` — inputs
+  (data, label); output shape ``(batch,)`` filled with the scalar
+  ``loss * grad_scale`` (torch_criterion-inl.h Forward); backward
+  feeds ``dloss/dpred * grad_scale`` and ignores head gradients, like
+  the reference (and like SoftmaxOutput's loss-head convention).
 """
 from __future__ import annotations
 
+import numpy as onp
+
 from .base import MXNetError
+from .registry import register as _register
 
 __all__ = ["pytorch_function"]
+
+
+# ---------------------------------------------------------------------------
+# TorchModule / TorchCriterion ops (plugin/torch parity)
+# ---------------------------------------------------------------------------
+_MOD_CACHE = {}
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError:  # pragma: no cover
+        raise MXNetError(
+            "TorchModule/TorchCriterion need pytorch, which is not "
+            "importable in this environment")
+    return torch
+
+
+def _build(lua_string):
+    """Construct (and cache) the torch module from the constructor
+    expression. The namespace binds nn/torch/F so Lua-style strings like
+    'nn.Linear(4, 3)' evaluate directly."""
+    torch = _torch()
+    if lua_string not in _MOD_CACHE:
+        ns = {"nn": torch.nn, "torch": torch, "F": torch.nn.functional}
+        try:
+            m = eval(lua_string, ns)  # noqa: S307 — the reference
+            # executes lua_string in a Lua VM the same way; the string is
+            # the user's own model definition, not untrusted input
+        except Exception as e:
+            raise MXNetError("TorchModule: constructor %r failed: %s"
+                             % (lua_string, e))
+        if not isinstance(m, torch.nn.Module):
+            raise MXNetError("TorchModule: %r did not produce an "
+                             "nn.Module" % (lua_string,))
+        _MOD_CACHE[lua_string] = m.float()
+    return _MOD_CACHE[lua_string]
+
+
+def _param_names(m):
+    return [n.replace(".", "_") for n, _ in m.named_parameters()]
+
+
+def _tm_args(attrs):
+    names = ["data_%d" % i for i in range(int(attrs["num_data"]))]
+    try:
+        names += _param_names(_build(attrs["lua_string"]))
+    except MXNetError:
+        names += ["param_%d" % i for i in range(int(attrs["num_params"]))]
+    return tuple(names)
+
+
+def _tm_infer(attrs, in_shapes, aux):
+    n_data = int(attrs["num_data"])
+    m = _build(attrs["lua_string"])
+    params = list(m.parameters())
+    if len(params) != int(attrs["num_params"]):
+        raise MXNetError(
+            "TorchModule: num_params=%s but %r has %d parameters"
+            % (attrs["num_params"], attrs["lua_string"], len(params)))
+    for i, p in enumerate(params):
+        in_shapes[n_data + i] = tuple(p.shape)
+    if any(in_shapes[i] is None for i in range(n_data)):
+        return in_shapes, None, aux
+    torch = _torch()
+    with torch.no_grad():
+        outs = m(*[torch.zeros(*in_shapes[i]) for i in range(n_data)])
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    if len(outs) != int(attrs["num_outputs"]):
+        raise MXNetError(
+            "TorchModule: num_outputs=%s but %r produced %d outputs"
+            % (attrs["num_outputs"], attrs["lua_string"], len(outs)))
+    return in_shapes, [tuple(o.shape) for o in outs], aux
+
+
+@_register("TorchModule", arg_names=_tm_args,
+           num_outputs=lambda attrs: int(attrs["num_outputs"]),
+           infer_shape=_tm_infer, needs_rng=True,
+           attr_types={"lua_string": str, "num_data": int,
+                       "num_params": int, "num_outputs": int},
+           required_attrs=("lua_string", "num_data", "num_params",
+                          "num_outputs"))
+def _torch_module(attrs, ins, octx):
+    """Forward/backward both re-run the torch module on the host; the
+    op's rng key seeds torch's RNG identically in both callbacks, so
+    stochastic layers (Dropout) draw the SAME mask in the backward
+    recompute as in the emitted forward. The reference instead keeps one
+    live Lua module between forward() and backward() calls — that
+    stateful contract can't survive a jitted graph, the seeded-recompute
+    one can. Caveat: torch-side stateful BUFFERS (BatchNorm running
+    stats) live in the cached module, not the mxnet graph; they advance
+    on every (re)run and are not checkpointed — use the native BatchNorm
+    op for stats-bearing layers."""
+    import jax
+
+    n_data = int(attrs["num_data"])
+    n_out = int(attrs["num_outputs"])
+    lua = attrs["lua_string"]
+    is_train = bool(octx.is_train)
+    in_shapes = [tuple(x.shape) for x in ins]
+    in_dtypes = [onp.dtype(x.dtype) for x in ins]
+    m0 = _build(lua)
+    torch = _torch()
+    was_training = m0.training
+    m0.train(False)
+    with torch.no_grad():
+        probe = m0(*[torch.zeros(*s) for s in in_shapes[:n_data]])
+    m0.train(was_training)
+    probe = probe if isinstance(probe, (tuple, list)) else (probe,)
+    out_struct = tuple(jax.ShapeDtypeStruct(tuple(o.shape), onp.float32)
+                       for o in probe)
+    if octx.rng is not None:
+        seed = jax.random.randint(octx.rng, (), 0, 2 ** 31 - 1,
+                                  dtype=onp.int32)
+    else:
+        seed = onp.int32(0)
+
+    def _load(arrays, requires_grad):
+        torch = _torch()
+        m = _build(lua)
+        m.train(is_train)
+        params = list(m.parameters())
+        with torch.no_grad():
+            for p, a in zip(params, arrays[n_data:]):
+                p.copy_(torch.from_numpy(onp.array(a, onp.float32)))
+        for p in params:
+            p.requires_grad_(requires_grad)
+        data = [torch.from_numpy(onp.array(a, onp.float32))
+                for a in arrays[:n_data]]
+        return m, params, data
+
+    def host_forward(seed_v, *arrays):
+        torch = _torch()
+        m, _, data = _load(arrays, False)
+        torch.manual_seed(int(seed_v))
+        with torch.no_grad():
+            outs = m(*data)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        return tuple(onp.asarray(o.detach(), onp.float32) for o in outs)
+
+    @jax.custom_vjp
+    def f(seed_v, *xs):
+        return jax.pure_callback(host_forward, out_struct, seed_v, *xs)
+
+    def f_fwd(seed_v, *xs):
+        return jax.pure_callback(host_forward, out_struct, seed_v,
+                                 *xs), (seed_v, xs)
+
+    def f_bwd(res, gs):
+        seed_v, xs = res
+
+        def host_backward(seed_b, *args):
+            torch = _torch()
+            cot = [torch.from_numpy(onp.array(a, onp.float32))
+                   for a in args[:n_out]]
+            m, params, data = _load(args[n_out:], True)
+            for d in data:
+                d.requires_grad_(True)
+            torch.manual_seed(int(seed_b))  # same masks as the forward
+            outs = m(*data)
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+            leaves = data + params
+            grads = torch.autograd.grad(outs, leaves, grad_outputs=cot,
+                                        allow_unused=True)
+            return tuple(
+                onp.zeros(s, dt) if g is None else
+                onp.asarray(g.detach(), onp.float32).astype(dt)
+                for g, s, dt in zip(grads, in_shapes, in_dtypes))
+
+        in_struct = tuple(jax.ShapeDtypeStruct(s, dt)
+                          for s, dt in zip(in_shapes, in_dtypes))
+        grads = jax.pure_callback(host_backward, in_struct, seed_v,
+                                  *(tuple(gs) + tuple(xs)))
+        return (None,) + tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    return list(f(seed, *ins))
+
+
+def _tc_infer(attrs, in_shapes, aux):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, None, aux
+    lshape = tuple(attrs.get("label_shape", ()) or ())
+    in_shapes[1] = (dshape[0],) + lshape
+    return in_shapes, [(dshape[0],)], aux
+
+
+@_register("TorchCriterion", arg_names=("data", "label"),
+           infer_shape=_tc_infer,
+           attr_types={"lua_string": str, "label_shape": tuple,
+                       "grad_scale": float},
+           required_attrs=("lua_string",))
+def _torch_criterion(attrs, ins, octx):
+    import jax
+
+    lua = attrs["lua_string"]
+    scale = float(attrs.get("grad_scale", 1.0))
+    dshape = tuple(ins[0].shape)
+    lshape = tuple(ins[1].shape)
+    out_struct = (jax.ShapeDtypeStruct((dshape[0],), onp.float32),)
+
+    def host_forward(pred, label):
+        torch = _torch()
+        crit = _build(lua)
+        with torch.no_grad():
+            loss = crit(torch.from_numpy(onp.array(pred, onp.float32)),
+                        torch.from_numpy(onp.array(label, onp.float32)))
+        return (onp.full((dshape[0],), float(loss) * scale, onp.float32),)
+
+    @jax.custom_vjp
+    def f(pred, label):
+        return jax.pure_callback(host_forward, out_struct, pred, label)
+
+    def f_fwd(pred, label):
+        return jax.pure_callback(host_forward, out_struct, pred, label), \
+            (pred, label)
+
+    def f_bwd(res, gs):
+        pred, label = res
+
+        def host_backward(p, lab):
+            torch = _torch()
+            crit = _build(lua)
+            pt = torch.from_numpy(onp.array(p, onp.float32))
+            pt.requires_grad_(True)
+            loss = crit(pt, torch.from_numpy(onp.array(lab, onp.float32)))
+            (g,) = torch.autograd.grad(loss, (pt,))
+            return onp.asarray(g, onp.float32) * scale
+
+        in_struct = jax.ShapeDtypeStruct(dshape, onp.float32)
+        # loss head: out_grad is ignored, like the reference's Backward
+        gp = jax.pure_callback(host_backward, in_struct, pred, label)
+        import jax.numpy as jnp
+        return gp, jnp.zeros(lshape, onp.float32)
+
+    f.defvjp(f_fwd, f_bwd)
+    return list(f(*ins))
 
 
 def pytorch_function(fn, name="torch_fn"):
