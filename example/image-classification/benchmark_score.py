@@ -78,7 +78,9 @@ def score(network, dev, batch_size, num_batches, batch_group=1):
         out = dispatch()
     # single-queue device: the last forward completes after all others
     barrier(out)
-    return num_batches * batch_size / (time.time() - tic)
+    # effective group: 1 when the non-fused fallback ran per-batch
+    return (num_batches * batch_size / (time.time() - tic),
+            batch_group if grouped else 1)
 
 
 if __name__ == "__main__":
@@ -93,7 +95,7 @@ if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO)
     dev = mx.tpu(0) if args.tpus is not None else mx.cpu()
     for net in args.networks.split(","):
-        speed = score(net, dev, args.batch_size, args.num_batches,
-                      args.batch_group)
+        speed, eff_group = score(net, dev, args.batch_size,
+                                 args.num_batches, args.batch_group)
         logging.info("network: %s, batch %d, group %d: %.1f images/sec",
-                     net, args.batch_size, args.batch_group, speed)
+                     net, args.batch_size, eff_group, speed)
