@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-command CI gate mirroring the reference Jenkinsfile stages
+# (Sanity lint :31-41 -> Unit tests :207-258 -> Integration): lint,
+# full test suite, bench-contract smoke, multi-chip dryrun. Nonzero
+# exit on any gate. Runs pure-CPU (the suite's conftest provisions an
+# 8-device virtual mesh; the bench smoke builds its own 1-device env).
+set -u
+cd "$(dirname "$0")"
+FAILED=0
+
+stage() {
+    echo
+    echo "=== CI stage: $1 ==="
+}
+
+stage "lint (tools/lint.py)"
+python tools/lint.py || FAILED=1
+
+stage "unit + integration suite (pytest tests/, bench smoke deferred)"
+python -m pytest tests/ -q --ignore=tests/test_bench_smoke.py || FAILED=1
+
+stage "bench contract smoke (tests/test_bench_smoke.py)"
+python -m pytest tests/test_bench_smoke.py -q || FAILED=1
+
+stage "multi-chip dryrun (8 virtual devices)"
+python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
+    || FAILED=1
+
+echo
+if [ "$FAILED" -ne 0 ]; then
+    echo "CI: FAILED"
+    exit 1
+fi
+echo "CI: all gates passed"

@@ -62,16 +62,17 @@ def main():
         return x.astype(np.int32)
 
     key = jax.random.PRNGKey(0)
-    k1, k2, k3, k4 = jax.random.split(key, 4)
+    # one key per parameter: sharing keys correlates initial weights
+    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
     params = {
         "embed": jax.random.normal(k1, (V, D)) * 0.1,
         # learned absolute positions: the fixed-offset copy head keys on
         # position, content-only attention cannot express "gap back"
         "pos": jax.random.normal(k2, (1, S, D)) * 0.1,
-        "wq": jax.random.normal(k2, (D, D)) * 0.1,
-        "wk": jax.random.normal(k3, (D, D)) * 0.1,
-        "wv": jax.random.normal(k4, (D, D)) * 0.1,
-        "head": jax.random.normal(k1, (D, V)) * 0.1,
+        "wq": jax.random.normal(k3, (D, D)) * 0.1,
+        "wk": jax.random.normal(k4, (D, D)) * 0.1,
+        "wv": jax.random.normal(k5, (D, D)) * 0.1,
+        "head": jax.random.normal(k6, (D, V)) * 0.1,
     }
 
     seq_sharding = NamedSharding(mesh, P(None, "sp"))

@@ -344,7 +344,8 @@ def _svm_output(attrs, ins, octx):
 
 @register("MakeLoss", attr_types={"grad_scale": float, "normalization": str,
                                   "valid_thresh": float},
-          backward_ignores_head_grads=True)
+          backward_ignores_head_grads=True,
+          alias=("make_loss",))
 def _make_loss(attrs, ins, octx):
     """Forward identity; backward seeds grad_scale (src/operator/make_loss-inl.h)."""
     import jax

@@ -61,7 +61,8 @@ def _sgd_mom_update(attrs, ins, octx):
 
 
 @register("adam_update", arg_names=("weight", "grad", "mean", "var"),
-          out_names=("weight", "mean", "var"), attr_types={"lr": float, "beta1": float, "beta2": float,
+          out_names=("weight", "mean", "var"),
+          attr_types={"lr": float, "beta1": float, "beta2": float,
                       "epsilon": float, "wd": float, "rescale_grad": float,
                       "clip_gradient": float})
 def _adam_update(attrs, ins, octx):
@@ -101,7 +102,8 @@ def _rmsprop_update(attrs, ins, octx):
 
 @register("rmspropalex_update",
           arg_names=("weight", "grad", "n", "g", "delta"),
-          out_names=("weight", "n", "g", "delta"), attr_types={"lr": float, "gamma1": float, "gamma2": float,
+          out_names=("weight", "n", "g", "delta"),
+          attr_types={"lr": float, "gamma1": float, "gamma2": float,
                       "epsilon": float, "wd": float, "rescale_grad": float,
                       "clip_gradient": float, "clip_weights": float})
 def _rmspropalex_update(attrs, ins, octx):

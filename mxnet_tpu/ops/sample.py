@@ -77,6 +77,14 @@ _sample("_random_negative_binomial",
         {"k": int, "p": float},
         alias=("random_negative_binomial", "_sample_negbinomial"))
 
+_sample("_random_generalized_negative_binomial",
+        lambda jax, key, shape, dt, a: _gen_neg_binomial(
+            jax, key, shape, dt, float(a.get("mu", 1.0)),
+            float(a.get("alpha", 1.0))),
+        {"mu": float, "alpha": float},
+        alias=("random_generalized_negative_binomial",
+               "_sample_gennegbinomial"))
+
 _sample("random_randint",
         lambda jax, key, shape, dt, a: jax.random.randint(
             key, shape, int(a.get("low", 0)), int(a.get("high", 2))).astype(dt),
@@ -86,4 +94,15 @@ _sample("random_randint",
 def _neg_binomial(jax, key, shape, dt, k, p):
     k1, k2 = jax.random.split(key)
     lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+def _gen_neg_binomial(jax, key, shape, dt, mu, alpha):
+    """Generalized (Polya) negative binomial: gamma-Poisson mixture with
+    mean mu and dispersion alpha (sample_op.h GeneralizedNegativeBinomial
+    — real-valued k = 1/alpha, scale mu*alpha)."""
+    if alpha <= 0:  # degenerate: plain Poisson(mu)
+        return jax.random.poisson(key, mu, shape).astype(dt)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, 1.0 / alpha, shape) * (mu * alpha)
     return jax.random.poisson(k2, lam, shape).astype(dt)

@@ -236,12 +236,36 @@ def _torch_criterion(attrs, ins, octx):
     lshape = tuple(ins[1].shape)
     out_struct = (jax.ShapeDtypeStruct((dshape[0],), onp.float32),)
 
+    def _apply(crit, pred_t, label):
+        # class-index criterions (NLLLoss, CrossEntropyLoss) want Long
+        # targets; regression criterions want Float. Decide ONCE per
+        # criterion (cached on the module) so the hot path never pays a
+        # failed forward, and only a dtype complaint triggers the Long
+        # retry — other RuntimeErrors (shape mismatches) propagate.
+        torch = _torch()
+        lab_t = torch.from_numpy(onp.array(label, onp.float32))
+        wants_long = getattr(crit, "_mxtpu_wants_long", None)
+        if wants_long:
+            return crit(pred_t, lab_t.long())
+        try:
+            out = crit(pred_t, lab_t)
+            crit._mxtpu_wants_long = False
+            return out
+        except RuntimeError as e:
+            if wants_long is None and ("Long" in str(e)
+                                       or "dtype" in str(e)):
+                out = crit(pred_t, lab_t.long())
+                crit._mxtpu_wants_long = True
+                return out
+            raise
+
     def host_forward(pred, label):
         torch = _torch()
         crit = _build(lua)
         with torch.no_grad():
-            loss = crit(torch.from_numpy(onp.array(pred, onp.float32)),
-                        torch.from_numpy(onp.array(label, onp.float32)))
+            loss = _apply(crit,
+                          torch.from_numpy(onp.array(pred, onp.float32)),
+                          label)
         return (onp.full((dshape[0],), float(loss) * scale, onp.float32),)
 
     @jax.custom_vjp
@@ -260,7 +284,7 @@ def _torch_criterion(attrs, ins, octx):
             crit = _build(lua)
             pt = torch.from_numpy(onp.array(p, onp.float32))
             pt.requires_grad_(True)
-            loss = crit(pt, torch.from_numpy(onp.array(lab, onp.float32)))
+            loss = _apply(crit, pt, lab)
             (g,) = torch.autograd.grad(loss, (pt,))
             return onp.asarray(g, onp.float32) * scale
 

@@ -103,6 +103,49 @@ def run_crash(kv):
     os._exit(0)
 
 
+def run_fit(kv):
+    """Reference-style distributed training script: Module.fit with a
+    dist kvstore, each rank on ITS shard of the data. Prints a bitwise
+    parameter checksum — the test pins that dist_async produces the
+    SAME checksum on every rank AND the same checksum as dist_sync
+    (the documented sync-collapse, kvstore.py create(): every dist
+    mode synchronizes through the collective, so the reference's async
+    non-determinism is replaced by dist_sync's exact semantics)."""
+    import hashlib
+
+    rank, nworker = kv.rank, kv.num_workers
+    onp.random.seed(7)  # same base dataset everywhere
+    X = onp.random.rand(96, 8).astype(onp.float32)
+    y = onp.random.randint(0, 4, 96).astype(onp.float32)
+    # rank's shard, reference data-parallel convention
+    Xr = X[rank::nworker]
+    yr = y[rank::nworker]
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(Xr, yr, batch_size=8,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    onp.random.seed(11)  # deterministic init on every rank
+    optimizer_params = {"learning_rate": 0.1}
+    if os.environ.get("DIST_FIT_RESCALE"):
+        optimizer_params["rescale_grad"] = float(
+            os.environ["DIST_FIT_RESCALE"])
+    mod.fit(it, num_epoch=3, kvstore=kv, optimizer="sgd",
+            optimizer_params=optimizer_params,
+            initializer=mx.initializer.Xavier())
+    args, _ = mod.get_params()
+    h = hashlib.sha1()
+    for name in sorted(args):
+        h.update(args[name].asnumpy().tobytes())
+    kv.barrier()
+    print("DIST_FIT_CHECKSUM rank=%d type=%s sum=%s"
+          % (rank, kv.type, h.hexdigest()), flush=True)
+
+
 def main():
     mode = sys.argv[1]
     kv = mx.kv.create(os.environ.get("DIST_KV_TYPE", "dist_sync"))
@@ -110,6 +153,8 @@ def main():
         run_sync(kv)
     elif mode == "crash":
         run_crash(kv)
+    elif mode == "fit":
+        run_fit(kv)
     else:
         raise SystemExit("unknown mode %s" % mode)
 

@@ -38,6 +38,10 @@ CASES = [
     ("dec/dec.py", ["--pretrain-epochs", "8"]),
     ("memcost/memcost.py",
      ["--width", "16", "--img", "32", "--batch-size", "32"]),
+    ("rnn-time-major/rnn_cell_demo.py", ["--num-epoch", "6"]),
+    ("torch/torch_module.py", ["--num-epoch", "12"]),
+    ("torch/torch_module.py",
+     ["--num-epoch", "12", "--use-torch-criterion"]),
 ]
 
 

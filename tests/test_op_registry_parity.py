@@ -1,0 +1,92 @@
+"""Op-name parity vs the reference registry (the round-2 audit, made a
+durable gate). Extracts every registered operator name from the
+reference sources and asserts each has a counterpart here — as a
+registry op, a documented alias, or a plugin symbol. ``_backward_*``
+names are excluded by design: the reference registers explicit backward
+ops because nnvm's Gradient pass rewires graphs; here every gradient is
+``jax.vjp`` of the forward (executor.py), so backward ops do not exist
+as names.
+
+Skips when /root/reference is not present (the repo is standalone)."""
+import os
+import re
+
+import pytest
+
+import mxnet_tpu as mx
+
+REF = "/root/reference"
+
+_PATTERNS = [
+    r'MXNET_REGISTER_OP_PROPERTY\((\w+)',
+    r'NNVM_REGISTER_OP\((\w+)\)',
+    r'MXNET_OPERATOR_REGISTER_UNARY\((\w+)\)',
+    r'MXNET_OPERATOR_REGISTER_BINARY\((\w+)\)',
+    r'MXNET_OPERATOR_REGISTER_BINARY_SCALAR\((\w+)\)',
+    r'MXNET_OPERATOR_REGISTER_BINARY_BROADCAST\((\w+)\)',
+    r'MXNET_OPERATOR_REGISTER_REDUCE\((\w+)\)',
+    r'MXNET_OPERATOR_REGISTER_REDUCE_AXIS\((\w+)\)',
+    r'MXNET_OPERATOR_REGISTER_SAMPLE\((\w+)',
+    r'MXNET_REGISTER_SIMPLE_OP\((\w+)',
+]
+
+# reference name -> where its behavior lives here (documented mappings,
+# VERDICT r2 row 13)
+_ADJUDICATED = {
+    "_NDArray": "Custom",    # python-callback ops collapse into CustomOp
+    "_Native": "Custom",
+    "CaffeOp": "plugin",     # mx.sym.CaffeOp via mxnet_tpu/plugin/caffe.py
+    "CaffeLoss": "plugin",
+    # opencv plugin imperative kernels: registered as NDArray functions
+    # (mxnet_tpu/plugin/opencv.py), not graph ops
+    "_cvimdecode": "ndarray-fn",
+    "_cvimresize": "ndarray-fn",
+    "_cvcopyMakeBorder": "ndarray-fn",
+    # gradient machinery: nnvm's Gradient pass needs a registered
+    # backward op; jax.vjp doesn't
+    "_broadcast_backward": "gradient-machinery",
+    # extraction artifact: the macro definition's formal parameter
+    # (NNVM_REGISTER_OP(name) inside #define)
+    "name": "artifact",
+}
+
+
+def _reference_names():
+    names = set()
+    for base in ("src", "plugin"):
+        for dirpath, _, files in os.walk(os.path.join(REF, base)):
+            for f in files:
+                if f.endswith((".cc", ".cu", ".h")):
+                    txt = open(os.path.join(dirpath, f),
+                               errors="ignore").read()
+                    for pat in _PATTERNS:
+                        for m in re.finditer(pat, txt):
+                            names.add(m.group(1))
+    return names
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference checkout not present")
+def test_every_reference_op_name_has_a_counterpart():
+    ref = {n for n in _reference_names()
+           if not n.startswith("_backward_")}
+    ours = set(mx.registry.list_ops())
+    from mxnet_tpu import ndarray as nd
+    missing = []
+    for n in sorted(ref):
+        if n in ours:
+            continue
+        where = _ADJUDICATED.get(n)
+        if where == "plugin":
+            assert hasattr(mx.sym, n), "plugin symbol %s missing" % n
+        elif where == "ndarray-fn":
+            assert hasattr(nd, n), "ndarray function %s missing" % n
+        elif where in ("gradient-machinery", "artifact"):
+            pass
+        elif where is not None:
+            assert where in ours, where
+        else:
+            missing.append(n)
+    assert not missing, "reference ops with no counterpart: %s" % missing
+    # and the two names round 2 flagged are REAL registry ops now
+    assert "TorchModule" in ours and "TorchCriterion" in ours

@@ -10,8 +10,9 @@ def _ctc_ref(logits, labels, blank=0):
     """Brute-force CTC loss by enumerating alignments (tiny T only)."""
     import itertools
     T, C = logits.shape
-    lp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
-    lp = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    mx_ = logits.max(-1, keepdims=True)
+    lp = logits - np.log(
+        np.exp(logits - mx_).sum(-1, keepdims=True)) - mx_
     target = [l for l in labels if l > 0]
 
     def collapse(path):
