@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as onp
 
-from ..registry import register
+from ..registry import register, f32_precision
 
 
 def _jnp():
@@ -85,7 +85,7 @@ def _convolution(attrs, ins, octx):
         x, w, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=ng)
+        feature_group_count=ng, precision=f32_precision(x))
     if not attrs.get("no_bias", False):
         b = ins[2]
         y = y + b.reshape((1, -1) + (1,) * nd)
@@ -154,7 +154,8 @@ def _deconvolution(attrs, ins, octx):
         x, w_t, window_strides=(1,) * nd,
         padding=[(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
                  for i in range(nd)],
-        lhs_dilation=stride, dimension_numbers=dn, feature_group_count=ng)
+        lhs_dilation=stride, dimension_numbers=dn, feature_group_count=ng,
+        precision=f32_precision(x))
     if not attrs.get("no_bias", True) and len(ins) > 2:
         y = y + ins[2].reshape((1, -1) + (1,) * nd)
     return [y]
@@ -383,7 +384,8 @@ def _grid_generator(attrs, ins, octx):
         ones = jnp.ones_like(gx)
         coords = jnp.stack([gx.reshape(-1), gy.reshape(-1),
                             ones.reshape(-1)], axis=0)  # (3, h*w)
-        out = jnp.einsum("nij,jk->nik", theta, coords)  # (n, 2, h*w)
+        out = jnp.einsum("nij,jk->nik", theta, coords,
+                     precision=f32_precision(theta))  # (n, 2, h*w)
         return [out.reshape((-1, 2, h, w))]
     # warp: input is flow (n, 2, h, w) added to identity grid
     flow = ins[0]
@@ -459,5 +461,6 @@ def _spatial_transformer(attrs, ins, octx):
     gx, gy = jnp.meshgrid(xs, ys)
     coords = jnp.stack([gx.reshape(-1), gy.reshape(-1),
                         jnp.ones_like(gx).reshape(-1)], axis=0)
-    grid = jnp.einsum("nij,jk->nik", theta, coords).reshape((-1, 2, h, w))
+    grid = jnp.einsum("nij,jk->nik", theta, coords,
+                      precision=f32_precision(theta)).reshape((-1, 2, h, w))
     return [_bilinear_sample(jnp, data, grid)]

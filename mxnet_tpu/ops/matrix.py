@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as onp
 
-from ..registry import register
+from ..registry import register, f32_precision
 
 
 def _jnp():
@@ -29,7 +29,7 @@ def _dot(attrs, ins, octx):
         a = a.T
     if attrs.get("transpose_b", False):
         b = b.T
-    return [jnp.dot(a, b)]
+    return [jnp.dot(a, b, precision=f32_precision(a))]
 
 
 @register("batch_dot", arg_names=("lhs", "rhs"),
@@ -41,7 +41,7 @@ def _batch_dot(attrs, ins, octx):
         a = jnp.swapaxes(a, -1, -2)
     if attrs.get("transpose_b", False):
         b = jnp.swapaxes(b, -1, -2)
-    return [jnp.matmul(a, b)]
+    return [jnp.matmul(a, b, precision=f32_precision(a))]
 
 
 @register("linalg_gemm2", arg_names=("A", "B"),
@@ -53,7 +53,8 @@ def _linalg_gemm2(attrs, ins, octx):
         a = jnp.swapaxes(a, -1, -2)
     if attrs.get("transpose_b", False):
         b = jnp.swapaxes(b, -1, -2)
-    return [float(attrs.get("alpha", 1.0)) * jnp.matmul(a, b)]
+    return [float(attrs.get("alpha", 1.0))
+            * jnp.matmul(a, b, precision=f32_precision(a))]
 
 
 @register("transpose", attr_types={"axes": tuple})
@@ -366,7 +367,15 @@ def _argsort(attrs, ins, octx):
 # ---------------------------------------------------------------------------
 # sequence ops (src/operator/sequence_{last,mask,reverse}-inl.h); layout TNC
 # ---------------------------------------------------------------------------
-@register("SequenceLast", arg_names=("data", "sequence_length"),
+def _seq_args(attrs):
+    # sequence_length is an argument only when use_sequence_length=True
+    # (reference ListArguments, sequence_op_common.h)
+    if attrs.get("use_sequence_length", False):
+        return ("data", "sequence_length")
+    return ("data",)
+
+
+@register("SequenceLast", arg_names=_seq_args,
           attr_types={"use_sequence_length": bool})
 def _sequence_last(attrs, ins, octx):
     jnp = _jnp()
@@ -378,7 +387,7 @@ def _sequence_last(attrs, ins, octx):
     return [x[idx, jnp.arange(x.shape[1])]]
 
 
-@register("SequenceMask", arg_names=("data", "sequence_length"),
+@register("SequenceMask", arg_names=_seq_args,
           attr_types={"use_sequence_length": bool, "value": float})
 def _sequence_mask(attrs, ins, octx):
     jnp = _jnp()
@@ -393,7 +402,7 @@ def _sequence_mask(attrs, ins, octx):
     return [jnp.where(mask, x, onp.asarray(val, x.dtype))]
 
 
-@register("SequenceReverse", arg_names=("data", "sequence_length"),
+@register("SequenceReverse", arg_names=_seq_args,
           attr_types={"use_sequence_length": bool})
 def _sequence_reverse(attrs, ins, octx):
     jnp = _jnp()

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import numpy as onp
 
-from ..registry import register
+from ..registry import register, f32_precision
 
 
 def _jnp():
@@ -56,7 +56,7 @@ def _fully_connected(attrs, ins, octx):
     x = ins[0]
     w = ins[1]
     x2 = x.reshape((x.shape[0], -1))
-    y = jnp.dot(x2, w.T)
+    y = jnp.dot(x2, w.T, precision=f32_precision(x2))
     if not attrs.get("no_bias", False):
         y = y + ins[2][None, :]
     return [y]
@@ -452,8 +452,17 @@ def _batch_norm(attrs, ins, octx):
     return [out.astype(xdt), new_mmean, new_mvar]
 
 
+def _in_infer(attrs, in_shapes, aux):
+    d = in_shapes[0]
+    if d is not None:
+        in_shapes[1] = (d[1],)
+        in_shapes[2] = (d[1],)
+        return in_shapes, [tuple(d)], aux
+    return in_shapes, None, aux
+
+
 @register("InstanceNorm", arg_names=("data", "gamma", "beta"),
-          attr_types={"eps": float})
+          attr_types={"eps": float}, infer_shape=_in_infer)
 def _instance_norm(attrs, ins, octx):
     jnp = _jnp()
     x, gamma, beta = ins

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as onp
 
-from ..registry import register
+from ..registry import register, f32_precision
 
 
 def _gates(mode):
@@ -114,12 +114,15 @@ def _cell_step(jnp, mode, h_prev, c_prev, pre, state_size):
 
 def _scan_layer(jax, jnp, mode, x, h0, c0, w, r, bw, br, state_size, reverse):
     """Scan one direction of one layer. x: (T, N, in). Returns (T,N,H), hT, cT."""
-    xw = jnp.einsum("tni,gi->tng", x, w) + bw[None, None, :]
+    prec = f32_precision(x)
+    xw = jnp.einsum("tni,gi->tng", x, w,
+                    precision=prec) + bw[None, None, :]
 
     if mode == "gru":
         def step(carry, xt):
             h_prev, _ = carry
-            hr = jnp.dot(h_prev, r.T) + br[None, :]
+            hr = jnp.dot(h_prev, r.T,
+                         precision=prec) + br[None, :]
             rg = 1 / (1 + jnp.exp(-(xt[:, :state_size] + hr[:, :state_size])))
             zg = 1 / (1 + jnp.exp(-(xt[:, state_size:2 * state_size]
                                     + hr[:, state_size:2 * state_size])))
@@ -129,7 +132,8 @@ def _scan_layer(jax, jnp, mode, x, h0, c0, w, r, bw, br, state_size, reverse):
     else:
         def step(carry, xt):
             h_prev, c_prev = carry
-            pre = xt + jnp.dot(h_prev, r.T) + br[None, :]
+            pre = xt + jnp.dot(h_prev, r.T,
+                               precision=prec) + br[None, :]
             h, c = _cell_step(jnp, mode, h_prev, c_prev, pre, state_size)
             return (h, c), h
 

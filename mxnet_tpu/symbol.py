@@ -581,6 +581,20 @@ def _create(op_name, input_syms, attrs, name=None, named_inputs=None):
                           attr_dict=dict(user_attrs) if user_attrs else {},
                           auto_named=True)
             inputs.append((vnode, 0))
+    if pos:
+        # surplus positional inputs must error, not vanish — e.g.
+        # SequenceMask(x, l) without use_sequence_length=True takes only
+        # (data,); the reference's compose rejects surplus args too
+        raise MXNetError(
+            "%s takes %d input(s) %s for these attributes; %d extra "
+            "positional input(s) given" % (op.name, len(arg_names),
+                                           arg_names, len(pos)))
+    unknown = [k for k in named_inputs
+               if k not in arg_names and k not in op.aux_names]
+    if unknown:
+        raise MXNetError(
+            "%s got unexpected input(s) %s (arguments for these "
+            "attributes: %s)" % (op.name, unknown, arg_names))
     # aux states appended after args, auto-created (BatchNorm moving stats)
     for nm in op.aux_names:
         if nm in named_inputs:

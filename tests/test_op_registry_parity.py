@@ -57,8 +57,9 @@ def _reference_names():
         for dirpath, _, files in os.walk(os.path.join(REF, base)):
             for f in files:
                 if f.endswith((".cc", ".cu", ".h")):
-                    txt = open(os.path.join(dirpath, f),
-                               errors="ignore").read()
+                    with open(os.path.join(dirpath, f),
+                              errors="ignore") as fh:
+                        txt = fh.read()
                     for pat in _PATTERNS:
                         for m in re.finditer(pat, txt):
                             names.add(m.group(1))

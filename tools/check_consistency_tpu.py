@@ -1,0 +1,126 @@
+"""CPU <-> TPU operator consistency sweep — the reference's
+device-correctness oracle run against the accelerator
+(tests/python/gpu/test_operator_gpu.py: every op checked cpu-vs-gpu via
+test_utils.check_consistency; here cpu-vs-tpu).
+
+Needs a host with a real accelerator attached (the CI image's virtual
+CPU mesh cannot exercise this); run manually or from the driver:
+
+    python tools/check_consistency_tpu.py
+
+Exit 1 if any op's outputs/gradients diverge beyond the dtype
+tolerance.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.test_utils import check_consistency
+
+
+def cases():
+    B = 4
+    out = []
+
+    def add(name, s, **shapes):
+        out.append((name, s, shapes))
+
+    add("Convolution",
+        sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=8,
+                        pad=(1, 1), stride=(2, 2)), data=(B, 3, 16, 16))
+    add("Convolution_grouped",
+        sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=8,
+                        num_group=4, pad=(1, 1)), data=(B, 8, 8, 8))
+    add("Deconvolution",
+        sym.Deconvolution(sym.Variable("data"), kernel=(2, 2),
+                          num_filter=4, stride=(2, 2)), data=(B, 3, 8, 8))
+    add("FullyConnected",
+        sym.FullyConnected(sym.Variable("data"), num_hidden=16),
+        data=(B, 32))
+    add("BatchNorm",
+        sym.BatchNorm(sym.Variable("data"), fix_gamma=False),
+        data=(B, 8, 6, 6))
+    add("Pooling_max",
+        sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2),
+                    pool_type="max"), data=(B, 4, 8, 8))
+    add("Pooling_avg_global",
+        sym.Pooling(sym.Variable("data"), kernel=(1, 1), global_pool=True,
+                    pool_type="avg"), data=(B, 4, 8, 8))
+    add("Activation_tanh",
+        sym.Activation(sym.Variable("data"), act_type="tanh"),
+        data=(B, 20))
+    add("LeakyReLU_elu",
+        sym.LeakyReLU(sym.Variable("data"), act_type="elu", slope=0.3),
+        data=(B, 20))
+    add("softmax",
+        sym.softmax(sym.Variable("data"), axis=-1), data=(B, 11))
+    add("SoftmaxActivation_channel",
+        sym.SoftmaxActivation(sym.Variable("data"), mode="channel"),
+        data=(B, 5, 3, 3))
+    add("Embedding",
+        sym.Embedding(sym.Variable("data"), input_dim=20, output_dim=8),
+        data=(B, 6))
+    add("batch_dot",
+        sym.batch_dot(sym.Variable("lhs"), sym.Variable("rhs"),
+                      transpose_b=True), lhs=(B, 5, 7), rhs=(B, 6, 7)),
+    add("broadcast_add",
+        sym.broadcast_add(sym.Variable("lhs"), sym.Variable("rhs")),
+        lhs=(B, 1, 6), rhs=(1, 5, 6))
+    add("sum_axis",
+        sym.sum(sym.Variable("data"), axis=1), data=(B, 5, 6))
+    add("transpose",
+        sym.transpose(sym.Variable("data"), axes=(0, 2, 1)),
+        data=(B, 5, 6))
+    add("L2Normalization",
+        sym.L2Normalization(sym.Variable("data")), data=(B, 12))
+    add("InstanceNorm",
+        sym.InstanceNorm(sym.Variable("data")), data=(B, 4, 6, 6))
+    add("LRN",
+        sym.LRN(sym.Variable("data"), nsize=3), data=(B, 6, 5, 5))
+    add("SequenceReverse",
+        sym.SequenceReverse(sym.Variable("data")), data=(6, B, 5))
+    add("RNN_lstm",
+        sym.RNN(data=sym.Variable("data"),
+                parameters=sym.Variable("params"),
+                state=sym.Variable("state"),
+                state_cell=sym.Variable("state_cell"),
+                state_size=8, num_layers=1, mode="lstm"),
+        data=(5, B, 6), state=(1, B, 8), state_cell=(1, B, 8))
+    add("topk_value",
+        sym.topk(sym.Variable("data"), k=3, ret_typ="value"),
+        data=(B, 9))
+    add("UpSampling",
+        sym.UpSampling(sym.Variable("data"), scale=2,
+                       sample_type="nearest"), data=(B, 3, 5, 5))
+    return out
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        print("no accelerator attached — nothing to cross-check")
+        return 0
+    ctx_list_of = lambda shapes: [dict(ctx=mx.cpu(), **shapes),
+                                  dict(ctx=mx.tpu(), **shapes)]
+    failures = []
+    for name, s, shapes in cases():
+        try:
+            check_consistency(s, ctx_list_of(shapes))
+            print("OK   %s" % name, flush=True)
+        except Exception as e:
+            failures.append((name, str(e)[:200]))
+            print("FAIL %s: %s" % (name, str(e)[:200]), flush=True)
+    print("\n%d/%d ops consistent cpu<->%s"
+          % (len(cases()) - len(failures), len(cases()), platform))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

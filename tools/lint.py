@@ -7,7 +7,8 @@ Checks, per Python file under the given roots:
   * no tab indentation, no trailing whitespace;
   * lines <= 100 chars (the repo style is ~79 but generated wrappers
     and test tables run long; 100 is the hard wall);
-  * no stray debugger invocations left behind.
+  * no stray debugger invocations left behind;
+  * file ends with a newline.
 Exit code 1 on any finding.
 """
 import ast
@@ -44,6 +45,8 @@ def lint_file(path):
                             % (path, i, len(stripped), MAX_LEN))
         if _PDB in stripped or _BP in stripped:
             problems.append("%s:%d: debugger left in" % (path, i))
+    if src and not src.endswith("\n"):
+        problems.append("%s: missing final newline" % path)
     return problems
 
 
