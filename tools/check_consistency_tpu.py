@@ -97,6 +97,45 @@ def cases():
     add("UpSampling",
         sym.UpSampling(sym.Variable("data"), scale=2,
                        sample_type="nearest"), data=(B, 3, 5, 5))
+    add("Pad",
+        sym.Pad(sym.Variable("data"), mode="edge",
+                pad_width=(0, 0, 0, 0, 1, 1, 2, 2)), data=(B, 2, 5, 5))
+    add("Crop",
+        sym.Crop(sym.Variable("data"), offset=(1, 1), h_w=(4, 4),
+                 num_args=1), data=(B, 2, 7, 7))
+    add("SwapAxis",
+        sym.SwapAxis(sym.Variable("data"), dim1=1, dim2=2),
+        data=(B, 3, 5))
+    # (Dropout is excluded: check_consistency runs train-mode forwards,
+    # where dropout is stochastic per executor by design)
+    add("ROIPooling",
+        sym.ROIPooling(sym.Variable("data"), sym.Variable("rois"),
+                       pooled_size=(2, 2), spatial_scale=1.0),
+        data=(1, 3, 8, 8), rois=(2, 5))
+    add("GridGenerator_affine",
+        sym.GridGenerator(sym.Variable("data"), transform_type="affine",
+                          target_shape=(6, 6)), data=(B, 6))
+    add("BilinearSampler",
+        sym.BilinearSampler(sym.Variable("data"), sym.Variable("grid")),
+        data=(B, 2, 6, 6), grid=(B, 2, 4, 4))
+    add("MultiBoxPrior",
+        getattr(sym, "_contrib_MultiBoxPrior")(
+            sym.Variable("data"), sizes=(0.5, 0.2), ratios=(1.0, 2.0)),
+        data=(1, 3, 8, 8))
+    add("fft",
+        sym.fft(sym.Variable("data")), data=(B, 16))
+    add("one_hot",
+        sym.one_hot(sym.Variable("data"), depth=7), data=(B,))
+    add("take",
+        sym.take(sym.Variable("a"), sym.Variable("indices")),
+        a=(10, 4), indices=(B,))
+    add("argsort",
+        sym.argsort(sym.Variable("data")), data=(B, 8))
+    add("Correlation",
+        sym.Correlation(sym.Variable("data1"), sym.Variable("data2"),
+                        kernel_size=1, max_displacement=2, stride1=1,
+                        stride2=1, pad_size=2),
+        data1=(1, 2, 6, 6), data2=(1, 2, 6, 6))
     return out
 
 
