@@ -42,6 +42,7 @@ CASES = [
     ("torch/torch_module.py", ["--num-epoch", "12"]),
     ("torch/torch_module.py",
      ["--num-epoch", "12", "--use-torch-criterion"]),
+    ("speech_recognition/deepspeech_mini.py", ["--num-epoch", "25"]),
 ]
 
 
