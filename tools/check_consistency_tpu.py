@@ -142,14 +142,22 @@ def cases():
 def main():
     import jax
 
+    # build the case symbols FIRST: even without an accelerator this
+    # validates the tool against the live op surface (rot guard,
+    # exercised by tests/test_tools.py)
+    case_list = cases()
     platform = jax.devices()[0].platform
     if platform == "cpu":
-        print("no accelerator attached — nothing to cross-check")
+        print("%d cases built; no accelerator attached — nothing to "
+              "cross-check" % len(case_list))
         return 0
-    ctx_list_of = lambda shapes: [dict(ctx=mx.cpu(), **shapes),
-                                  dict(ctx=mx.tpu(), **shapes)]
+
+    def ctx_list_of(shapes):
+        return [dict(ctx=mx.cpu(), **shapes),
+                dict(ctx=mx.tpu(), **shapes)]
+
     failures = []
-    for name, s, shapes in cases():
+    for name, s, shapes in case_list:
         try:
             check_consistency(s, ctx_list_of(shapes))
             print("OK   %s" % name, flush=True)
@@ -157,7 +165,7 @@ def main():
             failures.append((name, str(e)[:200]))
             print("FAIL %s: %s" % (name, str(e)[:200]), flush=True)
     print("\n%d/%d ops consistent cpu<->%s"
-          % (len(cases()) - len(failures), len(cases()), platform))
+          % (len(case_list) - len(failures), len(case_list), platform))
     return 1 if failures else 0
 
 
