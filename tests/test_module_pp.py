@@ -19,14 +19,16 @@ from mxnet_tpu.base import MXNetError
 D = 16
 
 
-def _pp_net(n_stages=4):
+def _pp_net(n_stages=4, width=None, dropout=None):
     x = sym.Variable("data")
     x = sym.FullyConnected(x, num_hidden=D, name="inproj")   # preamble
     for i in range(n_stages):
         with mx.AttrScope(ctx_group="stage%d" % i):
-            h = sym.FullyConnected(x, num_hidden=4 * D,
+            h = sym.FullyConnected(x, num_hidden=width or 4 * D,
                                    name="s%d_fc1" % i)
             h = sym.Activation(h, act_type="relu")
+            if dropout:
+                h = sym.Dropout(h, p=dropout, name="s%d_do" % i)
             h = sym.FullyConnected(h, num_hidden=D, name="s%d_fc2" % i)
             x = x + h
     out = sym.FullyConnected(x, num_hidden=10, name="head")  # postamble
@@ -90,6 +92,30 @@ def test_pp_schedule_really_pipelined():
     txt = fn.lower(*structs).compile().as_text()
     assert "collective-permute" in txt, "no ppermute ring in the program"
     assert "while" in txt, "no scan schedule in the program"
+
+
+def test_pp_dropout_stages_train_and_eval():
+    """rng ops inside pipelined stages: train-mode forwards draw fresh
+    per-(tick, pp-rank, dp-shard) streams (two train forwards differ),
+    eval-mode is deterministic, and training runs loss-finite."""
+    mod = _train([mx.cpu(i) for i in range(4)],
+                 net=_pp_net(2, width=2 * D, dropout=0.5), steps=1,
+                 mesh_axes={"dp": 2, "pp": 2}, pipeline_microbatches=2)
+    from mxnet_tpu.io import DataBatch
+    X = mx.nd.array(np.random.RandomState(3).rand(32, 8)
+                    .astype(np.float32))
+    b = DataBatch(data=[X], label=[mx.nd.zeros((32,))])
+    mod.forward(b, is_train=True)
+    o1 = mod.get_outputs()[0].asnumpy()
+    mod.forward(b, is_train=True)
+    o2 = mod.get_outputs()[0].asnumpy()
+    assert not np.allclose(o1, o2), "train dropout masks did not vary"
+    mod.forward(b, is_train=False)
+    e1 = mod.get_outputs()[0].asnumpy()
+    mod.forward(b, is_train=False)
+    e2 = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_array_equal(e1, e2)
+    assert np.isfinite(o1).all() and np.isfinite(e1).all()
 
 
 def test_pp_error_surface():

@@ -77,10 +77,9 @@ def test_check_consistency_tool_builds_and_skips_on_cpu():
     job; on the CPU suite it must still construct every case symbol
     (guarding the tool against op-surface rot) and exit 0 with the
     no-accelerator message."""
-    import subprocess
-    import sys
     tool = os.path.join(ROOT, "tools", "check_consistency_tpu.py")
     proc = subprocess.run([sys.executable, tool], capture_output=True,
-                          text=True, timeout=600)
+                          text=True, timeout=600,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
     assert "no accelerator attached" in proc.stdout
