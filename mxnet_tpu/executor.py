@@ -411,22 +411,41 @@ def _split_pipeline_stages(symbol, n_stages):
     if not carry_pos:
         raise MXNetError("pipeline: stages do not consume the carry")
     k0 = list(kinds_of[0])
-    x0_slots = {sides_of[0][k0[i][1]] if k0[i][0] == "side" else None
-                for i in carry_pos}
+    # stage0's carry positions name the pipeline input x0. Two legal
+    # shapes: a preamble product / shared Variable (classified "side"),
+    # or a bare data Variable read only by stage0 — no preamble op —
+    # which the scan above classified as a stage-private "param".
+    x0_slots = set()
+    for i in carry_pos:
+        if k0[i][0] == "side":
+            x0_slots.add(sides_of[0][k0[i][1]])
+        elif k0[i][0] == "param":
+            x0_slots.add(stage_param_slots[0][k0[i][1]])
+        else:
+            x0_slots.add(None)
     if len(x0_slots) != 1 or None in x0_slots:
         raise MXNetError(
             "pipeline: stage0 must read one preamble/arg tensor at the "
             "positions where later stages read the carry")
     x0_slot = next(iter(x0_slots))
-    # re-key stage0: x0 becomes the carry; drop it from stage0's sides
-    x0_side_idx = sides_of[0].index(x0_slot)
-    sides0 = [sl for sl in sides_of[0] if sl != x0_slot]
-    remap = {}
-    for i, sl in enumerate(sides_of[0]):
-        if sl != x0_slot:
-            remap[i] = sides0.index(sl)
-    k0 = [("carry",) if k[0] == "side" and k[1] == x0_side_idx else
-          (("side", remap[k[1]]) if k[0] == "side" else k) for k in k0]
+
+    # re-key stage0: x0 becomes the carry; drop it from whichever slot
+    # list (sides or stage params) it was classified into
+    def rekey(slots, tag):
+        x0_idx = slots.index(x0_slot)
+        kept = [sl for sl in slots if sl != x0_slot]
+        remap = {i: kept.index(sl) for i, sl in enumerate(slots)
+                 if sl != x0_slot}
+        new_k0 = [("carry",) if k[0] == tag and k[1] == x0_idx else
+                  ((tag, remap[k[1]]) if k[0] == tag else k)
+                  for k in k0]
+        return kept, new_k0
+
+    if x0_slot in sides_of[0]:
+        sides0, k0 = rekey(sides_of[0], "side")
+    else:
+        stage_param_slots[0], k0 = rekey(stage_param_slots[0], "param")
+        sides0 = list(sides_of[0])
     if k0 != ref:
         raise MXNetError(
             "pipeline: stage0 wires its inputs differently from stage1")
@@ -616,7 +635,14 @@ def _build_eval_pipelined(symbol, mesh, n_microbatch, pp_axis="pp",
         new_aux = tuple(aux_out[id(n)] for n in aux_nodes)
         return outs, new_aux
 
-    return eval_fn, needs_rng
+    # names of stage-private parameters: these get stacked with a leading
+    # stage axis sharded on 'pp' inside shard_map, so caller-supplied
+    # param_sharding rules cannot apply to them (MeshExecutorGroup checks)
+    id2name = {id(n): n.name for n in arg_nodes}
+    stage_param_names = {id2name[sid]
+                         for slots in plan["stage_param_slots"]
+                         for (sid, _oi) in slots}
+    return eval_fn, needs_rng, stage_param_names
 
 
 class Executor:

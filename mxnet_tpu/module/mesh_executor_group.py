@@ -134,8 +134,21 @@ class MeshExecutorGroup(object):
                     "pipeline_microbatches and remat cannot be combined "
                     "(checkpoint the stage body instead)")
             from ..executor import _build_eval_pipelined
-            self._pipe_eval_fn, _ = _build_eval_pipelined(
+            self._pipe_eval_fn, _, stage_pnames = _build_eval_pipelined(
                 symbol, self.mesh, pipeline_microbatches)
+            # stage params are stacked and sharded on 'pp' inside the
+            # shard_map schedule — a param_sharding rule resolving one to
+            # a non-replicated spec would be silently dropped, so reject
+            # it loudly instead (first-match semantics, like spec_for)
+            hit = sorted(n for n in stage_pnames
+                         if any(ax is not None for ax in spec_for(n)))
+            if hit:
+                raise MXNetError(
+                    "param_sharding resolves pipeline-stage parameter(s) "
+                    "%s to a non-replicated spec: stage parameters are "
+                    "stacked on the 'pp' axis and cannot take a "
+                    "tensor-parallel sharding — scope the rule to "
+                    "preamble/postamble parameters" % (hit,))
         else:
             self._pipe_eval_fn = None
         self._jits = {}

@@ -554,3 +554,23 @@ def test_remat_trivial_symbol_no_ops():
     x = np.ones((2, 3), np.float32)
     outs, _ = ev([x], [], jax.random.PRNGKey(0), True)
     np.testing.assert_array_equal(np.asarray(outs[0]), x)
+
+
+def test_predict_batch_group_warns_on_classic_group(caplog):
+    """batch_group on a non-fused exec group falls back to per-batch
+    scoring and must say so (ADVICE r3 #2) — silence hid a 6x perf cliff."""
+    import logging
+    net = _conv_bn_net()
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 1, 8, 8).astype(np.float32)
+    mod = mx.mod.Module(net, context=[mx.cpu(0)], _allow_fused=False)
+    it = NDArrayIter(X, None, batch_size=8)
+    mod.bind(data_shapes=it.provide_data, for_training=False)
+    mx.random.seed(11)
+    np.random.seed(11)
+    mod.init_params(mx.initializer.Xavier())
+    with caplog.at_level(logging.WARNING):
+        out = mod.predict(it, batch_group=4).asnumpy()
+    assert out.shape[0] == 16
+    assert any("batch_group" in r.message for r in caplog.records), \
+        caplog.records

@@ -184,3 +184,63 @@ def test_pp_error_surface():
     with pytest.raises(MXNetError, match="stage"):
         mod.bind(data_shapes=it.provide_data,
                  label_shapes=it.provide_label)
+
+
+def test_pp_bare_data_input_stage0():
+    """stage0 may read the bare data Variable directly (no preamble op):
+    the planner reclassifies the arg from a stage-private param to the
+    pipeline input (ADVICE r3 #1), and numerics still match 1-device."""
+    def bare_net(n_stages):
+        x = sym.Variable("data")          # consumed only by stage0
+        for i in range(n_stages):
+            with mx.AttrScope(ctx_group="stage%d" % i):
+                h = sym.FullyConnected(x, num_hidden=2 * D,
+                                       name="s%d_fc1" % i)
+                h = sym.Activation(h, act_type="relu")
+                x = sym.FullyConnected(h, num_hidden=D, name="s%d_fc2" % i)
+        out = sym.FullyConnected(x, num_hidden=10, name="head")
+        return sym.SoftmaxOutput(out, name="softmax")
+
+    np.random.seed(0)
+    X = np.random.rand(64, D).astype(np.float32)
+    y = np.random.randint(0, 10, 64).astype(np.float32)
+
+    def run(ctxs, **kw):
+        it = mx.io.NDArrayIter(X, y, batch_size=32,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(bare_net(2), context=ctxs, **kw)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mx.random.seed(7)
+        np.random.seed(7)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        it.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    a = run([mx.cpu(0)])
+    b = run([mx.cpu(i) for i in range(4)],
+            mesh_axes={"dp": 2, "pp": 2}, pipeline_microbatches=2)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=2e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_pp_param_sharding_rule_on_stage_param_rejected():
+    """A param_sharding rule matching a pipeline-stage parameter would be
+    silently overridden by the 'pp' stacking; bind must reject it
+    (ADVICE r3 #3)."""
+    it = mx.io.NDArrayIter(np.zeros((32, 8), np.float32),
+                           np.zeros((32,), np.float32), batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_pp_net(2), context=[mx.cpu(i) for i in range(8)],
+                        mesh_axes={"dp": 2, "pp": 2, "tp": 2},
+                        pipeline_microbatches=2,
+                        param_sharding=[("s0_fc1", (None, "tp"))])
+    with pytest.raises(MXNetError, match="pipeline-stage"):
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
