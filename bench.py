@@ -335,6 +335,27 @@ def _make_barrier(mod, fused):
     return barrier
 
 
+def compiled_step(eg):
+    """The jax ``Compiled`` for the group's train-step program: the
+    one-program ``_last_step`` (fwd+bwd+optimizer) when present, else
+    the ``fwd_bwd`` jit lowered on the live param/aux buffers.  Shared
+    protocol for _xla_cost here and tools/hlo_byte_audit.py — keep the
+    two consumers on this one helper so a change to the group's jit
+    bookkeeping cannot silently split their numbers."""
+    import numpy as np
+    step = getattr(eg, "_last_step", None)
+    if step is not None:
+        fn, structs = step
+        return fn.lower(*structs).compile()
+    fn = eg._jits.get("fwd_bwd")
+    if fn is None:
+        return None
+    params = {n: b._read() for n, b in eg._param_dict.items()}
+    aux = {n: b._read() for n, b in eg._aux_dict.items()}
+    rngk = np.zeros((2,), np.uint32)
+    return fn.lower(params, aux, eg._last[0], rngk).compile()
+
+
 def _xla_cost(mod, fused, sec_per_step, peak_bw, n_dev):
     """XLA's own cost analysis of the train-step programs: true flops and
     bytes-accessed, plus the HBM roofline utilization they imply.
@@ -351,26 +372,16 @@ def _xla_cost(mod, fused, sec_per_step, peak_bw, n_dev):
         import numpy as np
         eg = mod._exec_group
         upd_fl = upd_by = 0.0
-        step = getattr(eg, "_last_step", None)
-        if step is not None:
-            # one-program path: fwd+bwd+optimizer in a single program —
-            # its cost analysis covers the whole step
-            fn, structs = step
-            comp = fn.lower(*structs).compile()
-        else:
-            fn = eg._jits.get("fwd_bwd")
-            if fn is None:
-                return out
+        if getattr(eg, "_last_step", None) is None:
             # separate optimizer-update program: account its traffic
             # analytically (read w/g/m + write w/m on f32 sgd-momentum)
             n_par = sum(int(np.prod(b.shape))
                         for b in eg._param_dict.values())
             upd_by = 5.0 * 4 * n_par
             upd_fl = 4.0 * n_par
-            params = {n: b._read() for n, b in eg._param_dict.items()}
-            aux = {n: b._read() for n, b in eg._aux_dict.items()}
-            rngk = np.zeros((2,), np.uint32)
-            comp = fn.lower(params, aux, eg._last[0], rngk).compile()
+        comp = compiled_step(eg)
+        if comp is None:
+            return out
         ca = comp.cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca
         fl = float(ca.get("flops", 0.0)) * n_dev

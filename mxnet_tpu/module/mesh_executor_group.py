@@ -56,6 +56,12 @@ class MeshExecutorGroup(object):
 
         assert shared_group is None or shared_group.fused
         assert not inputs_need_grad
+        # graph fusion: BatchNorm→ReLU pairs collapse into the hand-VJP
+        # BN core (HBM-traffic win, executor.fuse_bn_relu).  arg/aux
+        # lists and head wiring are invariant under the rewrite.  The
+        # monitor path is unaffected: this group rejects monitors.
+        from ..executor import fuse_bn_relu
+        symbol = fuse_bn_relu(symbol)
         self.symbol = symbol
         self.contexts = contexts
         self.param_names = param_names

@@ -97,7 +97,19 @@ def test_fused_group_selected():
 
 
 def test_fit_8dev_matches_single_device():
-    """Global-batch BN + psum grads: 8-device fused == 1-device fused."""
+    """Global-batch BN + psum grads: 8-device fused == 1-device fused.
+
+    Two assertions: per-step GRADIENT equality while the trajectories
+    run (the direct statement of the semantic claim — one global-batch
+    program regardless of mesh width), and endpoint parameter equality
+    after 6 steps.  The horizon is 6, not more, because the net has a
+    max-pool: once f32 reduction-order noise (~1e-6 after a few
+    momentum steps) crosses a pooling near-tie, the argmax routing
+    flips and the gradient jumps discontinuously — measured on this
+    exact net, a 1e-7 parameter perturbation of the UNCHANGED 1-device
+    path reproduces the same ~2.5e-3 step-7 divergence that an 8-device
+    run shows.  That is trajectory chaos, not a semantics difference;
+    asserting through it would pin luck, not the program."""
     net = _conv_bn_net()
     X, y = _data(batch=32)
     mod = mx.mod.Module(net, context=[mx.cpu(0)])
@@ -108,9 +120,45 @@ def test_fit_8dev_matches_single_device():
     p0, a0 = mod.get_params()
     seed = ({k: v for k, v in p0.items()}, {k: v for k, v in a0.items()})
 
-    args1, auxs1 = _train(net, [mx.cpu(0)], X, y, 32, seed_params=seed)
-    args8, auxs8 = _train(net, [mx.cpu(i) for i in range(8)], X, y, 32,
-                          seed_params=seed)
+    def mk(ctxs):
+        m = mx.mod.Module(net, context=ctxs)
+        m.bind(data_shapes=[("data", (32, 1, 8, 8))],
+               label_shapes=[("softmax_label", (32,))])
+        m.init_params(arg_params=seed[0], aux_params=seed[1])
+        m.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9,
+                                           "rescale_grad": 1.0 / 32})
+        return m
+
+    m1 = mk([mx.cpu(0)])
+    m8 = mk([mx.cpu(i) for i in range(8)])
+    from mxnet_tpu.io import DataBatch
+    for step in range(6):
+        i = (step % 4) * 32
+        b = DataBatch(data=[mx.nd.array(X[i:i + 32])],
+                      label=[mx.nd.array(y[i:i + 32])])
+        m1.forward_backward(b)
+        m8.forward_backward(b)
+        g1 = {n: m1._exec_group._grad_dict[n].asnumpy()
+              for n in m1._exec_group._grad_names}
+        g8 = {n: m8._exec_group._grad_dict[n].asnumpy()
+              for n in m8._exec_group._grad_names}
+        # atol 5e-4: a conv bias feeding a BatchNorm has an analytically
+        # ZERO gradient — what remains is f32 cancellation noise (up to
+        # ~2e-4 on step 0, before the BN running-mean center warms up),
+        # where rtol is meaningless.  Real gradients here are 1e-2..1e0
+        # and are pinned by rtol.  (Reference nets set no_bias=True on
+        # convs feeding BN; this net keeps the bias deliberately to
+        # exercise the degenerate path.)
+        for k in g1:
+            np.testing.assert_allclose(g1[k], g8[k], rtol=2e-3, atol=5e-4,
+                                       err_msg="step%d %s" % (step, k))
+        m1.update()
+        m8.update()
+
+    args1, auxs1 = m1.get_params()
+    args8, auxs8 = m8.get_params()
     for k in args1:
         np.testing.assert_allclose(args1[k].asnumpy(), args8[k].asnumpy(),
                                    rtol=2e-4, atol=2e-5, err_msg=k)
@@ -464,7 +512,9 @@ def test_remat_module_program_identical_to_direct_jit():
     mod_low = eg._get_jit("fwd_bwd").lower(P, AUX, INP, RNG)
 
     # standalone mimic: fresh evaluator, same shardings, direct jax.jit
-    ev, _ = _build_eval_segmented(net, "full")
+    # (through the same BN→ReLU graph fusion the mesh group applies)
+    from mxnet_tpu.executor import fuse_bn_relu
+    ev, _ = _build_eval_segmented(fuse_bn_relu(net), "full")
     grad_names = list(eg._grad_names)
 
     def fwd_bwd(params, aux, inputs, rng):

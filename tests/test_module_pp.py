@@ -244,3 +244,51 @@ def test_pp_param_sharding_rule_on_stage_param_rejected():
     with pytest.raises(MXNetError, match="pipeline-stage"):
         mod.bind(data_shapes=it.provide_data,
                  label_shapes=it.provide_label)
+
+
+def test_pp_preamble_bn_feeding_stage_relu_not_fused():
+    """A preamble BatchNorm feeding a stage-tagged Activation(relu) must
+    NOT be fused across the placement boundary (the fused node would
+    carry the Activation's ctx_group and drag the BN's aux state inside
+    the stage, breaking the pipeline split).  The net must still bind
+    and match the 1-device run."""
+    def net_fn():
+        x = sym.Variable("data")
+        x = sym.FullyConnected(x, num_hidden=D, name="inproj")
+        x = sym.BatchNorm(x, name="pre_bn")          # preamble, no tag
+        for i in range(2):
+            with mx.AttrScope(ctx_group="stage%d" % i):
+                h = sym.Activation(x, act_type="relu",
+                                   name="s%d_relu" % i)
+                x = sym.FullyConnected(h, num_hidden=D,
+                                       name="s%d_fc" % i)
+        out = sym.FullyConnected(x, num_hidden=10, name="head")
+        return sym.SoftmaxOutput(out, name="softmax")
+
+    np.random.seed(0)
+    X = np.random.rand(64, 8).astype(np.float32)
+    y = np.random.randint(0, 10, 64).astype(np.float32)
+
+    def run(ctxs, **kw):
+        it = mx.io.NDArrayIter(X, y, batch_size=32,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(net_fn(), context=ctxs, **kw)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mx.random.seed(7)
+        np.random.seed(7)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        it.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    a = run([mx.cpu(0)])
+    b = run([mx.cpu(i) for i in range(4)],
+            mesh_axes={"dp": 2, "pp": 2}, pipeline_microbatches=2)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=2e-4, atol=1e-5,
+                                   err_msg=k)

@@ -549,3 +549,38 @@ def test_cv_image_functions():
     Image.fromarray(img).save(buf, format="PNG")
     d = nd._cvimdecode(buf.getvalue())
     assert d.shape == (8, 10, 3)
+
+
+def test_batchnorm_cold_center_high_offset():
+    """MXNET_BN_EXACT_STATS=1 routes train-mode BN through the exact
+    two-pass statistics: with a COLD running mean (0) and high-offset
+    low-variance channels (x = 1e4 + N(0,1)), the default one-pass
+    sweep loses the variance to f32 cancellation (measured var {0,16}
+    vs true 1; documented hazard, docs/how_to/env_var.md) — the exact
+    mode must come out ~1."""
+    import os
+    prior = os.environ.get("MXNET_BN_EXACT_STATS")
+    os.environ["MXNET_BN_EXACT_STATS"] = "1"
+    try:
+        _check_batchnorm_cold_center()
+    finally:
+        if prior is None:
+            del os.environ["MXNET_BN_EXACT_STATS"]
+        else:
+            os.environ["MXNET_BN_EXACT_STATS"] = prior
+
+
+def _check_batchnorm_cold_center():
+    rng = np.random.RandomState(0)
+    x = (1e4 + rng.randn(16, 4, 8, 8)).astype(np.float32)
+    bn = sym.BatchNorm(sym.Variable("data"), fix_gamma=False, name="bn")
+    e = bn.simple_bind(default_context(), data=x.shape)
+    e.arg_dict["data"][:] = x
+    e.arg_dict["bn_gamma"][:] = 1
+    e.arg_dict["bn_beta"][:] = 0
+    e.aux_dict["bn_moving_var"][:] = 1
+    e.forward(is_train=True)
+    out = e.outputs[0].asnumpy()
+    assert np.abs(out.mean(axis=(0, 2, 3))).max() < 1e-2
+    assert np.abs(out.std(axis=(0, 2, 3)) - 1).max() < 0.05, \
+        out.std(axis=(0, 2, 3))
