@@ -35,29 +35,95 @@ def _conv(x, w, stride=1, compute_dtype=None):
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
-def _bn(x, p, training, momentum=0.9, eps=2e-5):
-    """BatchNorm with f32 statistics (bf16 EMA increments underflow)."""
+_BNR_CORE = None
+
+
+def _bnr_core():
+    """Hand-VJP fused BatchNorm(+ReLU) core, NHWC — the same
+    minimal-HBM-traffic schedule the framework's ops/nn.py
+    _bn_train_core uses (independent implementation, same math):
+    centered one-pass f32 statistics in the forward; a backward that
+    reads (dout, x) twice total, recomputing x_hat and the ReLU mask
+    in-register.  This keeps the witness honest: it must carry the same
+    algorithm the Module path runs, or the 'framework overhead ~ 0'
+    cross-check compares different programs."""
+    global _BNR_CORE
+    if _BNR_CORE is not None:
+        return _BNR_CORE
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    def _fwd(x, gamma, beta, c, eps, relu):
+        f32 = jnp.float32
+        xf = x.astype(f32)
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        xc = xf - c
+        m1 = jnp.sum(xc, axis=(0, 1, 2)) / n
+        m2 = jnp.sum(xc * xc, axis=(0, 1, 2)) / n
+        mean = c + m1
+        var = jnp.maximum(m2 - m1 * m1, 0.0)
+        rstd = jax.lax.rsqrt(var + eps)
+        scale = gamma * rstd
+        shift = beta - mean * scale
+        y = xf * scale + shift
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        return ((y.astype(x.dtype), mean, var),
+                (x, gamma, beta, mean, rstd, c))
+
+    def _bwd(eps, relu, res, cots):
+        dout = cots[0]
+        x, gamma, beta, mean, rstd, c = res
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        xf = x.astype(jnp.float32)
+        xhat = (xf - mean) * rstd
+        du = dout.astype(jnp.float32)
+        if relu:
+            scale = gamma * rstd
+            shift = beta - mean * scale
+            du = jnp.where(xf * scale + shift > 0, du, 0.0)
+        dbeta = jnp.sum(du, axis=(0, 1, 2))
+        dgamma = jnp.sum(du * xhat, axis=(0, 1, 2))
+        dx = (du - dbeta / n - xhat * (dgamma / n)) * (gamma * rstd)
+        return (dx.astype(x.dtype), dgamma, dbeta, jnp.zeros_like(c))
+
+    @partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+    def core(x, gamma, beta, c, eps, relu):
+        return _fwd(x, gamma, beta, c, eps, relu)[0]
+
+    core.defvjp(_fwd, _bwd)
+    _BNR_CORE = core
+    return core
+
+
+def _bn(x, p, training, momentum=0.9, eps=2e-5, relu=False):
+    """BatchNorm with f32 statistics (bf16 EMA increments underflow);
+    train mode runs the hand-VJP fused core, optionally with ReLU."""
+    import jax
     import jax.numpy as jnp
     gamma, beta, mean, var = p
-    xf = x.astype(jnp.float32)
     if training:
-        m = jnp.mean(xf, axis=(0, 1, 2))
-        v = jnp.var(xf, axis=(0, 1, 2))
+        c = jax.lax.stop_gradient(mean)
+        y, m, v = _bnr_core()(x, gamma, beta, c, eps, relu)
+        m = jax.lax.stop_gradient(m)
+        v = jax.lax.stop_gradient(v)
         new_mean = momentum * mean + (1 - momentum) * m
         new_var = momentum * var + (1 - momentum) * v
-    else:
-        m, v = mean, var
-        new_mean, new_var = mean, var
-    y = (xf - m) * (gamma / jnp.sqrt(v + eps)) + beta
-    return y.astype(x.dtype), (gamma, beta, new_mean, new_var)
+        return y, (gamma, beta, new_mean, new_var)
+    xf = x.astype(jnp.float32)
+    y = (xf - mean) * (gamma / jnp.sqrt(var + eps)) + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype), (gamma, beta, mean, var)
 
 
 def _bottleneck(x, blk, stride, training, cdt):
     import jax.numpy as jnp
-    y, bn1 = _bn(_conv(x, blk["w1"], 1, cdt), blk["bn1"], training)
-    y = jnp.maximum(y, 0)
-    y, bn2 = _bn(_conv(y, blk["w2"], stride, cdt), blk["bn2"], training)
-    y = jnp.maximum(y, 0)
+    y, bn1 = _bn(_conv(x, blk["w1"], 1, cdt), blk["bn1"], training,
+                 relu=True)
+    y, bn2 = _bn(_conv(y, blk["w2"], stride, cdt), blk["bn2"], training,
+                 relu=True)
     y, bn3 = _bn(_conv(y, blk["w3"], 1, cdt), blk["bn3"], training)
     if "wproj" in blk:
         sc, bnp = _bn(_conv(x, blk["wproj"], stride, cdt), blk["bnp"],
@@ -78,8 +144,8 @@ def forward(params, x, training, cdt):
         x.astype(cdt or x.dtype), params["stem_w"].astype(cdt or x.dtype),
         window_strides=(2, 2), padding=[(3, 3), (3, 3)],
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    y, new_stats["stem_bn"] = _bn(y, params["stem_bn"], training)
-    y = jnp.maximum(y, 0)
+    y, new_stats["stem_bn"] = _bn(y, params["stem_bn"], training,
+                                  relu=True)
     y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
                           [(0, 0), (1, 1), (1, 1), (0, 0)])
     for si, n_blocks in enumerate(STAGES):
