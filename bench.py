@@ -70,8 +70,54 @@ def _watchdog(seconds):
     signal.alarm(seconds)
 
 
+def _cached_feed_child(rec_path, step_batch, img, n, dev_aug):
+    """Subprocess body for the cached clean-window feed measurement:
+    fresh process = fresh clean transport window (each window permits
+    ONE completion-ordering readback).  Decode fills the RAM cache
+    untimed; the timed region feeds n batches through
+    ImageRecordIter(cache_decoded=True) and stops the clock only after
+    the window's single data-dependent readback, so the rate includes
+    device completion — enqueue-rate artifacts excluded.  dev_aug
+    selects the route: uint8-NHWC transfer + on-chip augment program
+    (the PCIe-host shape), or host assemble + f32 transfer (the route
+    that avoids this tunnel's put+compute interleave pathology)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.image import ImageRecordIter
+
+    it = ImageRecordIter(
+        rec_path, data_shape=(3, img, img), batch_size=step_batch,
+        shuffle=True, device_augment=dev_aug, cache_decoded=True,
+        label_name="softmax_label")
+
+    def next_batch():
+        try:
+            return next(it)
+        except StopIteration:
+            it.reset()
+            return next(it)
+
+    acc_fn = jax.jit(lambda d, s: s + d.ravel()[0].astype(jnp.float32))
+    # sacrificial slot: fills the cache, compiles augment + acc, and
+    # absorbs session init (first timed window in a process is garbage)
+    acc = acc_fn(next_batch().data[0]._read(), jnp.float32(0.0))
+    t0 = time.time()
+    for _ in range(n):
+        acc = acc_fn(next_batch().data[0]._read(), acc)
+    float(acc)  # the window's one readback, INSIDE the timed region
+    rate = n * step_batch / (time.time() - t0)
+    key = ("pipeline_cached_u8_img_per_sec" if dev_aug
+           else "pipeline_cached_f32_img_per_sec")
+    print(json.dumps({key: round(rate, 2)}))
+
+
 def main():
     _watchdog(int(os.environ.get("BENCH_INIT_TIMEOUT", "600")))
+    if len(sys.argv) >= 7 and sys.argv[1] == "--cached-feed":
+        _cached_feed_child(sys.argv[2], int(sys.argv[3]),
+                           int(sys.argv[4]), int(sys.argv[5]),
+                           sys.argv[6] == "dev")
+        return
 
     import numpy as np
     import jax
@@ -286,6 +332,36 @@ def _bench_pipeline_clean(mx, recs, step_batch, steps, img):
     fmt = "jpg" if "jpg" in recs else "npy"
     if fmt not in recs:
         return out
+    n = max(4, min(steps, recs["_n_images"] // step_batch))
+
+    # RAM-cached decoded-uint8 feed (VERDICT r3 #2): decode once
+    # (outside the timed window), then every batch is gather + uint8
+    # transfer (+ one on-chip augment program) — the feed rate a host
+    # sustains once decode is no longer per-epoch work.  Runs in a
+    # FRESH SUBPROCESS: a clean window permits exactly one
+    # completion-ordering readback, and this process's window is spent
+    # on the streaming measurement below.  The child ends its timed
+    # region AFTER its own data-dependent readback, so the number
+    # includes device completion (enqueue-rate artifacts excluded).
+    import subprocess
+    for mode in ("host", "dev"):
+        try:
+            cp = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--cached-feed", recs[fmt], str(step_batch), str(img),
+                 str(n), mode],
+                capture_output=True, text=True, timeout=420)
+            for ln in (cp.stdout or "").splitlines():
+                if ln.startswith("{"):
+                    out.update(json.loads(ln))
+                    break
+            else:
+                out["pipeline_cached_%s_error" % mode] = \
+                    (cp.stderr or "no output")[-160:]
+        except Exception as e:
+            out["pipeline_cached_%s_error" % mode] = str(e)[:160]
+
+    # streaming decode feed (per-epoch decode on this host's cores)
     it = ImageRecordIter(
         recs[fmt], data_shape=(3, img, img), batch_size=step_batch,
         shuffle=True, preprocess_threads=threads,
@@ -302,15 +378,75 @@ def _bench_pipeline_clean(mx, recs, step_batch, steps, img):
         acc_fn = jax.jit(lambda d, s: s + d.ravel()[0].astype(jnp.float32))
         b = next_batch()  # compile prep + acc
         acc = acc_fn(b.data[0]._read(), jnp.float32(0.0))
-        n = max(4, min(steps, recs["_n_images"] // step_batch))
         t0 = time.time()
         for _ in range(n):
             acc = acc_fn(next_batch().data[0]._read(), acc)
-        float(acc)  # the window's ONE readback — orders against all batches
+        float(acc)  # ONE readback — orders against all batches, and the
+        # clock stops only after it: the rate includes completion
         out["pipeline_clean_%s_img_per_sec" % fmt] = round(
             n * step_batch / (time.time() - t0), 2)
     finally:
         it.pool.shutdown(wait=False)
+
+    # decode-farm scaling curve (host-only, no device involvement so it
+    # can run after the readback): images/sec of the bare decode stage
+    # at 1..K workers.  On a 1-core host this is flat — the curve IS
+    # the evidence for what feeds scale with on real hosts.
+    cores = os.cpu_count() or 1
+    curve = {}
+    n_dec = min(recs["_n_images"], 2 * step_batch)
+    for nw in sorted({1, 2, min(4, max(1, cores)), cores}):
+        itd = ImageRecordIter(
+            recs[fmt], data_shape=(3, img, img), batch_size=step_batch,
+            shuffle=False, preprocess_threads=nw,
+            label_name="softmax_label")
+        try:
+            # decode stage ONLY (no assembly, no device transfer — the
+            # transport is readback-poisoned by now and is measured
+            # separately above)
+            list(itd.pool.map(itd._decode_one, range(min(8, n_dec))))
+            t0 = time.time()
+            list(itd.pool.map(itd._decode_one, range(n_dec)))
+            curve["t%d" % nw] = round(n_dec / (time.time() - t0), 1)
+        finally:
+            itd.pool.shutdown(wait=False)
+    out["io_decode_scaling"] = curve
+
+    # host-only stage rates for the cached mode (no device, so safe
+    # after the readback): the uint8 gather and the full host assemble
+    # (normalize/mirror/HWC->CHW in the native OpenMP loop).  Together
+    # with the decode curve these bound every pipeline stage ABOVE the
+    # transport on this host.
+    try:
+        import numpy as np
+        itg = ImageRecordIter(
+            recs[fmt], data_shape=(3, img, img), batch_size=step_batch,
+            shuffle=True, cache_decoded=True, preprocess_threads=threads,
+            label_name="softmax_label")
+        next(itg)  # fill cache
+        cache, _cl = itg._cache
+        rngi = np.random.RandomState(0)
+        from mxnet_tpu import runtime as rt
+        mean = np.zeros(3, np.float32)
+        std = np.ones(3, np.float32)
+        nb = 8
+        # fresh random indices per draw: a repeated index set goes
+        # LLC-resident after the first gather and overstates the rate
+        idxs = [rngi.randint(0, cache.shape[0], size=step_batch)
+                for _ in range(nb)]
+        t0 = time.time()
+        for ix in idxs:
+            g = cache[ix]
+        out["io_gather_u8_img_per_sec"] = round(
+            nb * step_batch / (time.time() - t0), 1)
+        t0 = time.time()
+        for ix in idxs:
+            a = rt.assemble_batch(cache[ix], mean=mean, std=std,
+                                  mirror=None)
+        out["io_assemble_host_img_per_sec"] = round(
+            nb * step_batch / (time.time() - t0), 1)
+    except Exception as e:
+        out["io_host_stage_error"] = str(e)[:120]
     return out
 
 

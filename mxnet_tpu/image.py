@@ -366,7 +366,7 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, scale=1.0, resize=-1, preprocess_threads=4,
                  preprocess_processes=0, device_augment=False,
-                 round_batch=True, data_name="data",
+                 cache_decoded=False, round_batch=True, data_name="data",
                  label_name="softmax_label", seed=0, **kwargs):
         super().__init__(batch_size)
         self.rec = runtime.RecordFile(path_imgrec)
@@ -398,6 +398,24 @@ class ImageRecordIter(DataIter):
         else:
             self.pool = ThreadPoolExecutor(max_workers=preprocess_threads)
             self._proc_mode = False
+        # RAM-cached decoded mode: JPEG decode is the host's bottleneck
+        # (it runs once per image per EPOCH on the streaming path), but
+        # the decoded geometry is deterministic when rand_crop is off —
+        # so decode each image exactly ONCE into a uint8 NHWC cache and
+        # serve every later batch as a fancy-index gather (memcpy-rate)
+        # + uint8 transfer.  This is the iterator shape that feeds a
+        # chip at compute rate from a modest host: per-epoch cost drops
+        # from decode (~ms/img/core) to gather+DMA (~µs/img).  Memory:
+        # N*H*W*C bytes host RAM (caller's tradeoff).  rand_mirror still
+        # applies per draw (it acts on the gathered batch); rand_crop
+        # needs fresh geometry per epoch and is rejected.
+        self.cache_decoded = cache_decoded
+        self._cache = None
+        if cache_decoded and rand_crop:
+            raise ValueError(
+                "cache_decoded caches one deterministic decode per "
+                "image; rand_crop needs fresh geometry every epoch — "
+                "use the streaming path for random-crop training")
         self.seq = list(range(len(self.rec)))
         self.cur = 0
         # NOTE on staging: each batch gets a FRESH host buffer. A pooled
@@ -466,6 +484,29 @@ class ImageRecordIter(DataIter):
         return self._device_fn(jax.device_put(imgs_u8),
                                jax.device_put(mirror))
 
+    def _fill_cache(self):
+        """Decode every record once (thread/process pool) into a uint8
+        NHWC array + label array."""
+        c, th, tw = self.data_shape
+        n = len(self.rec)
+        cache = onp.empty((n, th, tw, c), onp.uint8)
+        lw = self.label_width
+        labels = onp.empty((n, lw), onp.float32)
+        all_idx = list(range(n))
+        if self._proc_mode:
+            ep_seed = self.seed
+            work = [(i, self.resize, th, tw, False, ep_seed)
+                    for i in all_idx]
+            results = self.pool.map(_proc_decode_one, work, chunksize=16)
+        else:
+            results = self.pool.map(self._decode_one, all_idx)
+        for i, (img, lab) in zip(all_idx, results):
+            cache[i] = img
+            labels[i] = lab[:lw]
+        self._cache = (cache, labels)
+        # the decode pool is never used again on this path
+        self.pool.shutdown(wait=True)
+
     def next(self):
         if self.cur >= len(self.seq):
             raise StopIteration
@@ -477,7 +518,13 @@ class ImageRecordIter(DataIter):
                 idxs = idxs + self.seq[:pad]
             else:
                 pass
-        if self._proc_mode:
+        if self.cache_decoded:
+            if self._cache is None:
+                self._fill_cache()
+            cache, cl = self._cache
+            imgs = cache[idxs]            # fancy-index gather: memcpy-rate
+            labels = cl[idxs]
+        elif self._proc_mode:
             c, th, tw = self.data_shape
             ep_seed = self.seed ^ (self._epoch * 0x9e3779b1 & 0xffffffff)
             work = [(i, self.resize, th, tw, self.rand_crop, ep_seed)
@@ -486,8 +533,9 @@ class ImageRecordIter(DataIter):
                                          chunksize=4))
         else:
             results = list(self.pool.map(self._decode_one, idxs))
-        imgs = onp.stack([r[0] for r in results])
-        labels = onp.stack([r[1] for r in results])
+        if not self.cache_decoded:
+            imgs = onp.stack([r[0] for r in results])
+            labels = onp.stack([r[1] for r in results])
         mirror = None
         if self.rand_mirror:
             mirror = onp.array(

@@ -187,3 +187,34 @@ def test_prefetching_image_iter(tmp_path):
                                  batch_size=8)
     pre = mx.io.PrefetchingIter(base)
     assert len(list(pre)) == 2
+
+
+def test_cache_decoded_matches_streaming(tmp_path):
+    """cache_decoded=True decodes once into a uint8 NHWC RAM cache and
+    serves batches by gather — every batch must equal the streaming
+    path bit-for-bit (same seed, same shuffle/mirror draws), on both
+    the host-assemble and device_augment routes."""
+    path, _ = _make_rec(tmp_path, n=20, hw=(40, 40))
+    kw = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=8,
+              shuffle=True, rand_mirror=True, mean_r=10.0, std_b=2.0,
+              scale=0.5, seed=3)
+    for dev_aug in (False, True):
+        ref = mx.io.ImageRecordIter(device_augment=dev_aug, **kw)
+        cac = mx.io.ImageRecordIter(device_augment=dev_aug,
+                                    cache_decoded=True, **kw)
+        for epoch in range(2):
+            for a, b in zip(ref, cac):
+                np.testing.assert_array_equal(a.data[0].asnumpy(),
+                                              b.data[0].asnumpy())
+                np.testing.assert_array_equal(a.label[0].asnumpy(),
+                                              b.label[0].asnumpy())
+            ref.reset()
+            cac.reset()
+
+
+def test_cache_decoded_rejects_rand_crop(tmp_path):
+    path, _ = _make_rec(tmp_path, n=4, hw=(40, 40))
+    with pytest.raises(ValueError, match="rand_crop"):
+        mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                              batch_size=2, rand_crop=True,
+                              cache_decoded=True)
