@@ -1,0 +1,126 @@
+// AlexNet trained through the C++ API (reference:
+// cpp-package/example/alexnet.cpp — the conv/relu/LRN/pool stem x2,
+// three 3x3 conv blocks, two dropout+fc blocks, softmax; spatial sizes
+// scaled to 3x32x32 so the CI run stays seconds).  Synthetic data:
+// class = dominant color channel with noise.  Prints CPP_ALEXNET_PASS.
+#include <MxNetTpuCpp.hpp>
+
+#include <cstdio>
+#include <memory>
+#include <random>
+#include <vector>
+
+using namespace mxnet_tpu::cpp;  // NOLINT
+
+static Symbol AlexnetSymbol(int n_classes) {
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("label");
+  auto W = [](const std::string& n) { return Symbol::Variable(n); };
+
+  // stage 1: conv-relu-lrn-pool (reference stage at 1/4 the filters)
+  Symbol conv1 = op::Convolution("conv1", data, W("c1w"), W("c1b"),
+                                 {{"kernel", "(3,3)"}, {"num_filter", "16"},
+                                  {"pad", "(1,1)"}});
+  Symbol relu1 = op::Activation("relu1", conv1, {{"act_type", "relu"}});
+  Symbol lrn1 = op::LRN("lrn1", relu1, {{"nsize", "5"}});
+  Symbol pool1 = op::Pooling("pool1", lrn1,
+                             {{"kernel", "(2,2)"}, {"stride", "(2,2)"},
+                              {"pool_type", "max"}});
+  // stage 2
+  Symbol conv2 = op::Convolution("conv2", pool1, W("c2w"), W("c2b"),
+                                 {{"kernel", "(3,3)"}, {"num_filter", "32"},
+                                  {"pad", "(1,1)"}});
+  Symbol relu2 = op::Activation("relu2", conv2, {{"act_type", "relu"}});
+  Symbol lrn2 = op::LRN("lrn2", relu2, {{"nsize", "5"}});
+  Symbol pool2 = op::Pooling("pool2", lrn2,
+                             {{"kernel", "(2,2)"}, {"stride", "(2,2)"},
+                              {"pool_type", "max"}});
+  // stage 3: the 3-conv block
+  Symbol conv3 = op::Convolution("conv3", pool2, W("c3w"), W("c3b"),
+                                 {{"kernel", "(3,3)"}, {"num_filter", "32"},
+                                  {"pad", "(1,1)"}});
+  Symbol relu3 = op::Activation("relu3", conv3, {{"act_type", "relu"}});
+  Symbol conv4 = op::Convolution("conv4", relu3, W("c4w"), W("c4b"),
+                                 {{"kernel", "(3,3)"}, {"num_filter", "32"},
+                                  {"pad", "(1,1)"}});
+  Symbol relu4 = op::Activation("relu4", conv4, {{"act_type", "relu"}});
+  Symbol pool3 = op::Pooling("pool3", relu4,
+                             {{"kernel", "(2,2)"}, {"stride", "(2,2)"},
+                              {"pool_type", "max"}});
+  // classifier: fc-relu-dropout x2 + fc
+  Symbol flat = op::Flatten("flatten", pool3);
+  Symbol fc1 = op::FullyConnected("fc1", flat, W("f1w"), W("f1b"),
+                                  {{"num_hidden", "64"}});
+  Symbol relu5 = op::Activation("relu5", fc1, {{"act_type", "relu"}});
+  Symbol drop1 = op::Dropout("drop1", relu5, {{"p", "0.25"}});
+  Symbol fc2 = op::FullyConnected("fc2", drop1, W("f2w"), W("f2b"),
+                                  {{"num_hidden", "32"}});
+  Symbol relu6 = op::Activation("relu6", fc2, {{"act_type", "relu"}});
+  Symbol fc3 = op::FullyConnected("fc3", relu6, W("f3w"), W("f3b"),
+                                  {{"num_hidden",
+                                    std::to_string(n_classes)}});
+  return op::SoftmaxOutput("softmax", fc3, label,
+                           {{"normalization", "batch"}});
+}
+
+int main() {
+  const int kBatch = 32, kImg = 32, kClasses = 3, kTrain = 96;
+  Context ctx = Context::cpu();
+
+  std::mt19937 rng(11);
+  std::normal_distribution<float> noise(0.0f, 0.4f);
+  std::vector<float> images(kTrain * 3 * kImg * kImg);
+  std::vector<float> labels(kTrain);
+  for (int i = 0; i < kTrain; ++i) {
+    int cls = i % kClasses;
+    labels[i] = static_cast<float>(cls);
+    for (int c = 0; c < 3; ++c) {
+      for (int p = 0; p < kImg * kImg; ++p) {
+        images[(i * 3 + c) * kImg * kImg + p] =
+            noise(rng) + (c == cls ? 1.0f : 0.0f);
+      }
+    }
+  }
+
+  Symbol net = AlexnetSymbol(kClasses);
+  NDArray data({kBatch, 3, kImg, kImg}, ctx);
+  NDArray label({kBatch}, ctx);
+  Executor exec(net, ctx, {{"data", &data}, {"label", &label}});
+
+  Xavier init(Xavier::gaussian, Xavier::in, 2.0f, 3);
+  for (const auto& name : exec.ParamNames()) init(name, exec.Arg(name));
+
+  std::unique_ptr<Optimizer> opt(OptimizerRegistry::Find("sgd"));
+  opt->SetParam("lr", 0.05f)
+      ->SetParam("momentum", 0.9f)
+      ->SetParam("rescale_grad", 1.0f / kBatch);
+
+  Accuracy acc;
+  for (int epoch = 0; epoch < 10; ++epoch) {
+    acc.Reset();
+    for (int start = 0; start + kBatch <= kTrain; start += kBatch) {
+      std::vector<float> xb(kBatch * 3 * kImg * kImg), yb(kBatch);
+      std::copy(images.begin() + start * 3 * kImg * kImg,
+                images.begin() + (start + kBatch) * 3 * kImg * kImg,
+                xb.begin());
+      std::copy(labels.begin() + start, labels.begin() + start + kBatch,
+                yb.begin());
+      data.CopyFrom(xb);
+      label.CopyFrom(yb);
+      exec.Forward(true);
+      exec.Backward();
+      int idx = 0;
+      for (const auto& name : exec.ParamNames()) {
+        opt->Update(idx++, exec.Arg(name), *exec.Grad(name));
+      }
+      acc.Update(label, exec.Outputs()[0]);
+    }
+  }
+  std::printf("final train accuracy %.3f\n", acc.Get());
+  if (acc.Get() < 0.9f) {
+    std::fprintf(stderr, "accuracy too low\n");
+    return 1;
+  }
+  std::printf("CPP_ALEXNET_PASS\n");
+  return 0;
+}

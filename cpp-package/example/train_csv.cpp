@@ -3,13 +3,14 @@
 // feature_extract, train examples). Trains logistic regression on a CSV
 // whose label is linearly separable; asserts accuracy and prints
 // CPP_TRAIN_CSV_PASS.
-#include <mxnet_tpu.hpp>
+#include <MxNetTpuCpp.hpp>
 #include <mxnet_tpu_ops.hpp>
 
 #include <cstdio>
 #include <cstdlib>
 #include <map>
 #include <string>
+#include <memory>
 #include <vector>
 
 using mxnet_tpu::cpp::Context;
@@ -18,6 +19,7 @@ using mxnet_tpu::cpp::Executor;
 using mxnet_tpu::cpp::KVStore;
 using mxnet_tpu::cpp::NDArray;
 using mxnet_tpu::cpp::Optimizer;
+using mxnet_tpu::cpp::OptimizerRegistry;
 using mxnet_tpu::cpp::Symbol;
 
 int main() {
@@ -80,7 +82,8 @@ int main() {
                                       bgrad.handle(), nullptr};
   std::vector<mx_uint> reqs = {0, 1, 1, 0};
   Executor exec(net, ctx, bind_args, grads, reqs);
-  Optimizer opt("sgd", 0.5f);
+  std::unique_ptr<Optimizer> opt(OptimizerRegistry::Find("sgd"));
+  opt->SetParam("lr", 0.5f);
 
   DataIter it("CSVIter", {{"data_csv", csv_path},
                           {"data_shape", "(4,)"},
@@ -95,8 +98,8 @@ int main() {
       lin.CopyFrom(l.CopyTo());
       exec.Forward(true);
       exec.Backward();
-      opt.Update(&win, wgrad);
-      opt.Update(&bin, bgrad);
+      opt->Update(0, &win, wgrad);
+      opt->Update(1, &bin, bgrad);
     }
   }
   // master copy round-trip through the kvstore

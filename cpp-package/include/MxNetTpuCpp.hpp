@@ -1,0 +1,14 @@
+// Convenience umbrella header (reference: cpp-package/include/mxnet-cpp/
+// MxNetCpp.h) — pulls in the whole C++ API surface.
+#ifndef MXNET_TPU_CPP_PACKAGE_MXNETTPUCPP_HPP_
+#define MXNET_TPU_CPP_PACKAGE_MXNETTPUCPP_HPP_
+
+#include "mxnet_tpu.hpp"
+#include "mxnet_tpu_shape.hpp"
+#include "mxnet_tpu_initializer.hpp"
+#include "mxnet_tpu_metric.hpp"
+#include "mxnet_tpu_lr_scheduler.hpp"
+#include "mxnet_tpu_optimizer.hpp"
+#include "mxnet_tpu_ops.hpp"
+
+#endif  // MXNET_TPU_CPP_PACKAGE_MXNETTPUCPP_HPP_

@@ -180,10 +180,62 @@ class Symbol {
     Check(MXSymbolListArguments(h_, &n, &arr));
     return std::vector<std::string>(arr, arr + n);
   }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    mx_uint n;
+    const char** arr;
+    Check(MXSymbolListAuxiliaryStates(h_, &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  std::vector<std::string> ListOutputs() const {
+    mx_uint n;
+    const char** arr;
+    Check(MXSymbolListOutputs(h_, &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  // shape inference from known input shapes (name-keyed, CSR-encoded
+  // over the C ABI); fills per-argument and per-aux-state shapes
+  void InferShape(
+      const std::map<std::string, std::vector<mx_uint>>& known,
+      std::vector<std::vector<mx_uint>>* arg_shapes,
+      std::vector<std::vector<mx_uint>>* aux_shapes) const {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> ind_ptr{0};
+    std::vector<mx_uint> shape_data;
+    for (auto& kv : known) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shape_data.push_back(d);
+      ind_ptr.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    mx_uint in_n, out_n, aux_n;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_d, **out_d, **aux_d;
+    int complete;
+    Check(MXSymbolInferShape(
+        h_, static_cast<mx_uint>(keys.size()), keys.data(),
+        ind_ptr.data(), shape_data.data(), &in_n, &in_nd, &in_d,
+        &out_n, &out_nd, &out_d, &aux_n, &aux_nd, &aux_d, &complete));
+    if (!complete) {
+      throw std::runtime_error("InferShape: incomplete shape inference");
+    }
+    arg_shapes->clear();
+    for (mx_uint i = 0; i < in_n; ++i) {
+      arg_shapes->emplace_back(in_d[i], in_d[i] + in_nd[i]);
+    }
+    aux_shapes->clear();
+    for (mx_uint i = 0; i < aux_n; ++i) {
+      aux_shapes->emplace_back(aux_d[i], aux_d[i] + aux_nd[i]);
+    }
+  }
   std::string ToJSON() const {
     const char* json;
     Check(MXSymbolSaveToJSON(h_, &json));
     return json;
+  }
+  // select one output of a multi-output symbol (SliceChannel etc.)
+  Symbol operator[](mx_uint index) const {
+    SymbolHandle out;
+    Check(MXSymbolGetOutput(h_, index, &out));
+    return Symbol(out);
   }
   SymbolHandle handle() const { return h_; }
   ~Symbol() = default;  // symbols share handles freely; freed by runtime
@@ -205,6 +257,76 @@ class Executor {
                          const_cast<mx_uint*>(reqs.data()), 0, nullptr,
                          &h_));
   }
+
+  // simple-bind (reference Symbol::SimpleBind): caller provides input
+  // arrays by name (data/label — bound with grad_req null); parameter
+  // and aux-state shapes are inferred and their arrays allocated here,
+  // with a gradient array per parameter (grad_req write).  The caller
+  // keeps ownership of the input arrays; the executor owns the rest.
+  Executor(const Symbol& sym, const Context& ctx,
+           const std::map<std::string, NDArray*>& inputs) {
+    std::map<std::string, std::vector<mx_uint>> known;
+    for (auto& kv : inputs) known[kv.first] = kv.second->Shape();
+    std::vector<std::vector<mx_uint>> arg_shapes, aux_shapes;
+    sym.InferShape(known, &arg_shapes, &aux_shapes);
+    arg_names_ = sym.ListArguments();
+    std::vector<NDArrayHandle> arg_h, grad_h;
+    std::vector<mx_uint> reqs;
+    for (size_t i = 0; i < arg_names_.size(); ++i) {
+      auto it = inputs.find(arg_names_[i]);
+      if (it != inputs.end()) {
+        arg_h.push_back(it->second->handle());
+        grad_h.push_back(nullptr);
+        reqs.push_back(0);
+        arg_index_[arg_names_[i]] = -1;
+      } else {
+        owned_args_.emplace_back(arg_shapes[i], ctx);
+        owned_grads_.emplace_back(arg_shapes[i], ctx);
+        arg_h.push_back(owned_args_.back().handle());
+        grad_h.push_back(owned_grads_.back().handle());
+        reqs.push_back(1);
+        param_names_.push_back(arg_names_[i]);
+        arg_index_[arg_names_[i]] =
+            static_cast<int>(owned_args_.size()) - 1;
+      }
+    }
+    std::vector<NDArrayHandle> aux_h;
+    aux_names_ = sym.ListAuxiliaryStates();
+    for (size_t i = 0; i < aux_names_.size(); ++i) {
+      owned_aux_.emplace_back(aux_shapes[i], ctx);
+      // reference aux defaults: moving_mean 0, moving_var 1 — give the
+      // initializer the chance to overwrite, but never bind garbage
+      const std::string& an = aux_names_[i];
+      bool is_var = an.size() >= 3 &&
+                    an.compare(an.size() - 3, 3, "var") == 0;
+      std::vector<float> fill(owned_aux_.back().Size(),
+                              is_var ? 1.0f : 0.0f);
+      owned_aux_.back().CopyFrom(fill);
+      aux_h.push_back(owned_aux_.back().handle());
+    }
+    Check(MXExecutorBind(sym.handle(), ctx.type(), ctx.id(),
+                         static_cast<mx_uint>(arg_h.size()), arg_h.data(),
+                         grad_h.data(), reqs.data(),
+                         static_cast<mx_uint>(aux_h.size()), aux_h.data(),
+                         &h_));
+  }
+
+  // simple-bind accessors: parameters owned by this executor
+  const std::vector<std::string>& ParamNames() const { return param_names_; }
+  NDArray* Arg(const std::string& name) {
+    int i = arg_index_.at(name);
+    return i < 0 ? nullptr : &owned_args_[i];
+  }
+  NDArray* Grad(const std::string& name) {
+    int i = arg_index_.at(name);
+    return i < 0 ? nullptr : &owned_grads_[i];
+  }
+  NDArray* Aux(const std::string& name) {
+    for (size_t i = 0; i < aux_names_.size(); ++i) {
+      if (aux_names_[i] == name) return &owned_aux_[i];
+    }
+    return nullptr;
+  }
   ~Executor() {
     if (h_) MXExecutorFree(h_);
   }
@@ -221,6 +343,9 @@ class Executor {
 
  private:
   ExecutorHandle h_;
+  std::vector<std::string> arg_names_, aux_names_, param_names_;
+  std::map<std::string, int> arg_index_;
+  std::vector<NDArray> owned_args_, owned_grads_, owned_aux_;
 };
 
 // key-value store over the C ABI (reference cpp-package kvstore.h)
@@ -325,27 +450,6 @@ class DataIter {
 
  private:
   DataIterHandle h_;
-};
-
-// SGD over the fused update ops (reference cpp-package optimizer.h; the
-// update math itself is the framework's registered optimizer op, so the
-// C++ layer stays a thin dispatcher)
-class Optimizer {
- public:
-  explicit Optimizer(const std::string& type = "sgd", float lr = 0.01f,
-                     float wd = 0.0f)
-      : op_(type == "sgd" ? "sgd_update" : type) {
-    op_.SetParam("lr", std::to_string(lr));
-    op_.SetParam("wd", std::to_string(wd));
-  }
-  // weight <- update(weight, grad)
-  void Update(NDArray* weight, const NDArray& grad) {
-    NDArrayHandle w = weight->handle();
-    op_.InvokeInto({w, grad.handle()}, {w});
-  }
-
- private:
-  Op op_;
 };
 
 }  // namespace cpp

@@ -1,0 +1,153 @@
+// Parameter initializers (reference: cpp-package/include/mxnet-cpp/
+// initializer.h).  Values are produced host-side with a deterministic
+// std::mt19937 and copied into the target NDArray — matching the python
+// frontend's host-numpy initializer contract (initializer.py), not a
+// device-side RNG.
+#ifndef MXNET_TPU_CPP_PACKAGE_INITIALIZER_HPP_
+#define MXNET_TPU_CPP_PACKAGE_INITIALIZER_HPP_
+
+#include <cmath>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Initializer {
+ public:
+  explicit Initializer(unsigned seed = 0) : rng_(seed) {}
+  virtual ~Initializer() {}
+
+  // dispatch on the parameter name, mirroring initializer.py __call__:
+  // *_bias/*_beta/*_gamma/moving stats get their fixed defaults, weights
+  // get the subclass distribution
+  virtual void operator()(const std::string& name, NDArray* arr) {
+    if (EndsWith(name, "bias") || EndsWith(name, "beta") ||
+        EndsWith(name, "moving_mean")) {
+      Fill(arr, 0.0f);
+    } else if (EndsWith(name, "gamma") || EndsWith(name, "moving_var")) {
+      Fill(arr, 1.0f);
+    } else {
+      InitWeight(arr);
+    }
+  }
+
+ protected:
+  virtual void InitWeight(NDArray* arr) = 0;
+
+  void Fill(NDArray* arr, float v) {
+    std::vector<float> data(arr->Size(), v);
+    arr->CopyFrom(data);
+  }
+  void FillUniform(NDArray* arr, float scale) {
+    std::uniform_real_distribution<float> d(-scale, scale);
+    std::vector<float> data(arr->Size());
+    for (auto& x : data) x = d(rng_);
+    arr->CopyFrom(data);
+  }
+  void FillNormal(NDArray* arr, float sigma) {
+    std::normal_distribution<float> d(0.0f, sigma);
+    std::vector<float> data(arr->Size());
+    for (auto& x : data) x = d(rng_);
+    arr->CopyFrom(data);
+  }
+  static bool EndsWith(const std::string& s, const std::string& suf) {
+    return s.size() >= suf.size() &&
+           s.compare(s.size() - suf.size(), suf.size(), suf) == 0;
+  }
+  // fan_in/fan_out per initializer.py Xavier: dim0 = out, rest = in
+  static void Fans(const std::vector<mx_uint>& shape, float* fan_in,
+                   float* fan_out) {
+    float hw = 1;
+    for (size_t i = 2; i < shape.size(); ++i) hw *= shape[i];
+    *fan_out = shape.empty() ? 1.0f : shape[0] * hw;
+    *fan_in = shape.size() > 1 ? shape[1] * hw : *fan_out;
+  }
+
+  std::mt19937 rng_;
+};
+
+class Zero : public Initializer {
+ protected:
+  void InitWeight(NDArray* arr) override { Fill(arr, 0.0f); }
+};
+
+class One : public Initializer {
+ protected:
+  void InitWeight(NDArray* arr) override { Fill(arr, 1.0f); }
+};
+
+class Constant : public Initializer {
+ public:
+  explicit Constant(float value) : value_(value) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override { Fill(arr, value_); }
+  float value_;
+};
+
+class Uniform : public Initializer {
+ public:
+  explicit Uniform(float scale = 0.07f, unsigned seed = 0)
+      : Initializer(seed), scale_(scale) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override { FillUniform(arr, scale_); }
+  float scale_;
+};
+
+class Normal : public Initializer {
+ public:
+  explicit Normal(float sigma = 0.01f, unsigned seed = 0)
+      : Initializer(seed), sigma_(sigma) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override { FillNormal(arr, sigma_); }
+  float sigma_;
+};
+
+// Xavier/Glorot (initializer.py Xavier): rnd_type gaussian|uniform,
+// factor_type avg|in|out
+class Xavier : public Initializer {
+ public:
+  enum RandType { gaussian, uniform };
+  enum FactorType { avg, in, out };
+  explicit Xavier(RandType rt = uniform, FactorType ft = avg,
+                  float magnitude = 3.0f, unsigned seed = 0)
+      : Initializer(seed), rt_(rt), ft_(ft), magnitude_(magnitude) {}
+
+ protected:
+  void InitWeight(NDArray* arr) override {
+    float fan_in, fan_out;
+    Fans(arr->Shape(), &fan_in, &fan_out);
+    float factor = ft_ == avg ? (fan_in + fan_out) / 2.0f
+                              : (ft_ == in ? fan_in : fan_out);
+    float scale = std::sqrt(magnitude_ / (factor > 0 ? factor : 1.0f));
+    if (rt_ == uniform) {
+      FillUniform(arr, scale);
+    } else {
+      FillNormal(arr, scale);
+    }
+  }
+
+ private:
+  RandType rt_;
+  FactorType ft_;
+  float magnitude_;
+};
+
+// MSRA / He init (initializer.py MSRAPrelu): gaussian Xavier with
+// factor (1 + slope^2) * fan_in
+class MSRAPrelu : public Xavier {
+ public:
+  explicit MSRAPrelu(float slope = 0.25f, unsigned seed = 0)
+      : Xavier(gaussian, in, 2.0f / (1.0f + slope * slope), seed) {}
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PACKAGE_INITIALIZER_HPP_

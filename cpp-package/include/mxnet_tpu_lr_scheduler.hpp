@@ -1,0 +1,48 @@
+// Learning-rate schedulers (reference: cpp-package/include/mxnet-cpp/
+// lr_scheduler.h — LRScheduler base + FactorScheduler).
+#ifndef MXNET_TPU_CPP_PACKAGE_LR_SCHEDULER_HPP_
+#define MXNET_TPU_CPP_PACKAGE_LR_SCHEDULER_HPP_
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class LRScheduler {
+ public:
+  explicit LRScheduler(float base_lr = 0.01f) : base_lr_(base_lr) {}
+  virtual ~LRScheduler() {}
+  void SetLR(float lr) { base_lr_ = lr; }
+  virtual float GetLR(unsigned num_update) = 0;
+
+ protected:
+  float base_lr_;
+};
+
+// lr = base * factor^(floor(num_update / step)), clamped at stop_factor
+class FactorScheduler : public LRScheduler {
+ public:
+  explicit FactorScheduler(int step, float factor = 1.0f,
+                           float stop_factor_lr = 1e-8f)
+      : step_(step), factor_(factor), stop_factor_lr_(stop_factor_lr) {}
+
+  float GetLR(unsigned num_update) override {
+    while (num_update > unsigned(count_ + step_)) {
+      count_ += step_;
+      base_lr_ *= factor_;
+      if (base_lr_ < stop_factor_lr_) {
+        base_lr_ = stop_factor_lr_;
+      }
+    }
+    return base_lr_;
+  }
+
+ private:
+  int count_ = 0;
+  int step_;
+  float factor_;
+  float stop_factor_lr_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PACKAGE_LR_SCHEDULER_HPP_

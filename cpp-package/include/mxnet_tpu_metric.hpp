@@ -1,0 +1,107 @@
+// Evaluation metrics (reference: cpp-package/include/mxnet-cpp/metric.h:
+// EvalMetric base + Accuracy/LogLoss/MAE/MSE/RMSE/PSNR).  Updates read
+// the device arrays to host (CopyTo) and accumulate in double — the same
+// host-side accounting the reference uses.
+#ifndef MXNET_TPU_CPP_PACKAGE_METRIC_HPP_
+#define MXNET_TPU_CPP_PACKAGE_METRIC_HPP_
+
+#include <cmath>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class EvalMetric {
+ public:
+  explicit EvalMetric(const std::string& name) : name_(name) {}
+  virtual ~EvalMetric() {}
+  virtual void Update(const NDArray& labels, const NDArray& preds) = 0;
+  void Reset() {
+    sum_ = 0;
+    num_ = 0;
+  }
+  float Get() const { return num_ > 0 ? static_cast<float>(sum_ / num_) : 0; }
+  const std::string& GetName() const { return name_; }
+
+ protected:
+  std::string name_;
+  double sum_ = 0;
+  double num_ = 0;
+};
+
+// preds: (batch, classes) probabilities/scores; labels: (batch,)
+class Accuracy : public EvalMetric {
+ public:
+  Accuracy() : EvalMetric("accuracy") {}
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> y = labels.CopyTo();
+    std::vector<float> p = preds.CopyTo();
+    size_t batch = y.size();
+    size_t classes = batch ? p.size() / batch : 0;
+    for (size_t i = 0; i < batch; ++i) {
+      size_t best = 0;
+      for (size_t c = 1; c < classes; ++c) {
+        if (p[i * classes + c] > p[i * classes + best]) best = c;
+      }
+      sum_ += best == static_cast<size_t>(y[i]) ? 1 : 0;
+      num_ += 1;
+    }
+  }
+};
+
+class LogLoss : public EvalMetric {
+ public:
+  LogLoss() : EvalMetric("logloss") {}
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> y = labels.CopyTo();
+    std::vector<float> p = preds.CopyTo();
+    size_t batch = y.size();
+    size_t classes = batch ? p.size() / batch : 0;
+    for (size_t i = 0; i < batch; ++i) {
+      float prob = p[i * classes + static_cast<size_t>(y[i])];
+      sum_ += -std::log(prob > 1e-15f ? prob : 1e-15f);
+      num_ += 1;
+    }
+  }
+};
+
+class MAE : public EvalMetric {
+ public:
+  MAE() : EvalMetric("mae") {}
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> y = labels.CopyTo();
+    std::vector<float> p = preds.CopyTo();
+    for (size_t i = 0; i < y.size() && i < p.size(); ++i) {
+      sum_ += std::fabs(y[i] - p[i]);
+      num_ += 1;
+    }
+  }
+};
+
+class MSE : public EvalMetric {
+ public:
+  MSE() : EvalMetric("mse") {}
+  void Update(const NDArray& labels, const NDArray& preds) override {
+    std::vector<float> y = labels.CopyTo();
+    std::vector<float> p = preds.CopyTo();
+    for (size_t i = 0; i < y.size() && i < p.size(); ++i) {
+      double d = y[i] - p[i];
+      sum_ += d * d;
+      num_ += 1;
+    }
+  }
+};
+
+class RMSE : public MSE {
+ public:
+  RMSE() { name_ = "rmse"; }
+  float GetRoot() const { return std::sqrt(Get()); }
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PACKAGE_METRIC_HPP_

@@ -1,0 +1,132 @@
+// Optimizers over the framework's fused update ops (reference:
+// cpp-package/include/mxnet-cpp/optimizer.h — Optimizer base keyed by
+// parameter index with lazily-created state, OptimizerRegistry::Find).
+// The update math itself is the registered fused op (sgd_update /
+// sgd_mom_update / adam_update ...), invoked in-place through the C ABI,
+// so this layer holds only hyper-parameters, per-index state arrays and
+// the update counter.
+#ifndef MXNET_TPU_CPP_PACKAGE_OPTIMIZER_HPP_
+#define MXNET_TPU_CPP_PACKAGE_OPTIMIZER_HPP_
+
+#include <cmath>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu.hpp"
+#include "mxnet_tpu_lr_scheduler.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Optimizer {
+ public:
+  virtual ~Optimizer() {}
+
+  Optimizer* SetParam(const std::string& name, float value) {
+    params_[name] = value;
+    return this;
+  }
+  Optimizer* SetLRScheduler(std::unique_ptr<LRScheduler> sched) {
+    sched_ = std::move(sched);
+    return this;
+  }
+  virtual void Update(int index, NDArray* weight, const NDArray& grad) = 0;
+
+ protected:
+  float Param(const std::string& name, float dflt) const {
+    auto it = params_.find(name);
+    return it == params_.end() ? dflt : it->second;
+  }
+  float LR(int index) {
+    unsigned n = ++count_[index];
+    if (sched_) return sched_->GetLR(n);
+    return Param("lr", 0.01f);
+  }
+  // state array shaped like the weight — in the WEIGHT's context
+  // (reference CreateState contract) — zero-filled on first use
+  NDArray* State(const std::string& kind, int index, const NDArray& like) {
+    auto key = kind + std::to_string(index);
+    auto it = states_.find(key);
+    if (it == states_.end()) {
+      int dev_type = 1, dev_id = 0;
+      Check(MXNDArrayGetContext(like.handle(), &dev_type, &dev_id));
+      auto arr = std::unique_ptr<NDArray>(
+          new NDArray(like.Shape(), Context(dev_type, dev_id)));
+      std::vector<float> zeros(arr->Size(), 0.0f);
+      arr->CopyFrom(zeros);
+      it = states_.emplace(key, std::move(arr)).first;
+    }
+    return it->second.get();
+  }
+
+  std::map<std::string, float> params_;
+  std::map<std::string, std::unique_ptr<NDArray>> states_;
+  std::map<int, unsigned> count_;
+  std::unique_ptr<LRScheduler> sched_;
+};
+
+class SGDOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray* weight, const NDArray& grad) override {
+    float lr = LR(index);
+    float mom = Param("momentum", 0.0f);
+    Op op(mom == 0.0f ? "sgd_update" : "sgd_mom_update");
+    op.SetParam("lr", std::to_string(lr));
+    op.SetParam("wd", std::to_string(Param("wd", 0.0f)));
+    op.SetParam("rescale_grad", std::to_string(Param("rescale_grad", 1.0f)));
+    float clip = Param("clip_gradient", -1.0f);
+    if (clip > 0) op.SetParam("clip_gradient", std::to_string(clip));
+    NDArrayHandle w = weight->handle();
+    if (mom == 0.0f) {
+      op.InvokeInto({w, grad.handle()}, {w});
+    } else {
+      op.SetParam("momentum", std::to_string(mom));
+      NDArray* m = State("mom", index, *weight);
+      // the fused op emits (weight, mom); both write back in place
+      op.InvokeInto({w, grad.handle(), m->handle()}, {w, m->handle()});
+    }
+  }
+};
+
+class AdamOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray* weight, const NDArray& grad) override {
+    float lr = LR(index);
+    // bias correction (optimizer.py Adam._fused_lr): the fused op
+    // applies none, so pre-scale lr by sqrt(1-b2^t)/(1-b1^t)
+    float b1 = Param("beta1", 0.9f), b2 = Param("beta2", 0.999f);
+    unsigned t = count_[index];
+    lr *= std::sqrt(1.0f - std::pow(b2, static_cast<float>(t))) /
+          (1.0f - std::pow(b1, static_cast<float>(t)));
+    Op op("adam_update");
+    op.SetParam("lr", std::to_string(lr));
+    op.SetParam("beta1", std::to_string(b1));
+    op.SetParam("beta2", std::to_string(b2));
+    op.SetParam("epsilon", std::to_string(Param("epsilon", 1e-8f)));
+    op.SetParam("wd", std::to_string(Param("wd", 0.0f)));
+    op.SetParam("rescale_grad", std::to_string(Param("rescale_grad", 1.0f)));
+    NDArrayHandle w = weight->handle();
+    NDArray* mean = State("mean", index, *weight);
+    NDArray* var = State("var", index, *weight);
+    // the fused op emits (weight, mean, var); all write back in place
+    op.InvokeInto({w, grad.handle(), mean->handle(), var->handle()},
+                  {w, mean->handle(), var->handle()});
+  }
+};
+
+class OptimizerRegistry {
+ public:
+  // caller owns the returned optimizer (reference Find() contract)
+  static Optimizer* Find(const std::string& name) {
+    if (name == "sgd") return new SGDOptimizer();
+    if (name == "adam") return new AdamOptimizer();
+    throw std::runtime_error("unknown optimizer: " + name);
+  }
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PACKAGE_OPTIMIZER_HPP_

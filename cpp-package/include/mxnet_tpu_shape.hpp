@@ -1,0 +1,48 @@
+// Shape — dimension vector with initializer-list construction and
+// stream printing (reference: cpp-package/include/mxnet-cpp/shape.h).
+#ifndef MXNET_TPU_CPP_PACKAGE_SHAPE_HPP_
+#define MXNET_TPU_CPP_PACKAGE_SHAPE_HPP_
+
+#include <mxnet_tpu/c_api.h>
+
+#include <initializer_list>
+#include <ostream>
+#include <vector>
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Shape {
+ public:
+  Shape() {}
+  Shape(std::initializer_list<mx_uint> dims) : dims_(dims) {}
+  explicit Shape(const std::vector<mx_uint>& dims) : dims_(dims) {}
+
+  mx_uint operator[](size_t i) const { return dims_[i]; }
+  size_t ndim() const { return dims_.size(); }
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : dims_) n *= d;
+    return n;
+  }
+  const std::vector<mx_uint>& data() const { return dims_; }
+  bool operator==(const Shape& o) const { return dims_ == o.dims_; }
+  bool operator!=(const Shape& o) const { return dims_ != o.dims_; }
+
+  friend std::ostream& operator<<(std::ostream& os, const Shape& s) {
+    os << "(";
+    for (size_t i = 0; i < s.ndim(); ++i) {
+      if (i) os << ",";
+      os << s[i];
+    }
+    return os << ")";
+  }
+
+ private:
+  std::vector<mx_uint> dims_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PACKAGE_SHAPE_HPP_

@@ -564,6 +564,13 @@ def _create(op_name, input_syms, attrs, name=None, named_inputs=None):
     name = NameManager.current().get(name, hint)
     user_attrs = AttrScope.current().get(None)
 
+    # dmlc::Parameter parity: attribute values may arrive as their wire
+    # strings ("(3,3)", "8", "True") — the reference stringifies every
+    # param and re-parses by declared type, so kernel="(3,3)" is as
+    # valid as kernel=(3,3).  The C API symbol path (and any frontend
+    # binding) depends on this coercion.
+    attrs = _registry.parse_attrs(op, attrs)
+
     if op.variable_args is not None and op.variable_args not in attrs:
         attrs[op.variable_args] = len(input_syms)
 

@@ -75,3 +75,35 @@ def test_cpp_package_train_csv():
     _compile_and_run_cpp(
         os.path.join(ROOT, "cpp-package", "example", "train_csv.cpp"),
         "CPP_TRAIN_CSV_PASS")
+
+
+def test_cpp_package_lenet():
+    """SimpleBind executor + Xavier initializer + SGD momentum +
+    Accuracy, all C++-side (reference cpp-package/example/lenet.cpp)."""
+    _compile_and_run_cpp(
+        os.path.join(ROOT, "cpp-package", "example", "lenet.cpp"),
+        "CPP_LENET_PASS")
+
+
+def test_cpp_package_alexnet():
+    """conv/relu/LRN/pool stem + dropout classifier trained to accuracy
+    (reference cpp-package/example/alexnet.cpp)."""
+    _compile_and_run_cpp(
+        os.path.join(ROOT, "cpp-package", "example", "alexnet.cpp"),
+        "CPP_ALEXNET_PASS")
+
+
+def test_cpp_package_resnet():
+    """Residual units with BatchNorm aux states through SimpleBind
+    (reference cpp-package/example/resnet.cpp)."""
+    _compile_and_run_cpp(
+        os.path.join(ROOT, "cpp-package", "example", "resnet.cpp"),
+        "CPP_RESNET_PASS")
+
+
+def test_cpp_package_char_rnn():
+    """Hand-unrolled LSTM cell (i2h/h2h + SliceChannel gates) + Adam
+    (reference cpp-package/example/charRNN.cpp)."""
+    _compile_and_run_cpp(
+        os.path.join(ROOT, "cpp-package", "example", "charRNN.cpp"),
+        "CPP_CHARRNN_PASS")
