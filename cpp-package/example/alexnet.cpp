@@ -18,7 +18,7 @@ static Symbol AlexnetSymbol(int n_classes) {
   auto W = [](const std::string& n) { return Symbol::Variable(n); };
 
   // stage 1: conv-relu-lrn-pool (reference stage at 1/4 the filters)
-  Symbol conv1 = op::Convolution("conv1", data, W("c1w"), W("c1b"),
+  Symbol conv1 = op::Convolution("conv1", data, W("c1w"), W("c1_bias"),
                                  {{"kernel", "(3,3)"}, {"num_filter", "16"},
                                   {"pad", "(1,1)"}});
   Symbol relu1 = op::Activation("relu1", conv1, {{"act_type", "relu"}});
@@ -27,7 +27,7 @@ static Symbol AlexnetSymbol(int n_classes) {
                              {{"kernel", "(2,2)"}, {"stride", "(2,2)"},
                               {"pool_type", "max"}});
   // stage 2
-  Symbol conv2 = op::Convolution("conv2", pool1, W("c2w"), W("c2b"),
+  Symbol conv2 = op::Convolution("conv2", pool1, W("c2w"), W("c2_bias"),
                                  {{"kernel", "(3,3)"}, {"num_filter", "32"},
                                   {"pad", "(1,1)"}});
   Symbol relu2 = op::Activation("relu2", conv2, {{"act_type", "relu"}});
@@ -36,11 +36,11 @@ static Symbol AlexnetSymbol(int n_classes) {
                              {{"kernel", "(2,2)"}, {"stride", "(2,2)"},
                               {"pool_type", "max"}});
   // stage 3: the 3-conv block
-  Symbol conv3 = op::Convolution("conv3", pool2, W("c3w"), W("c3b"),
+  Symbol conv3 = op::Convolution("conv3", pool2, W("c3w"), W("c3_bias"),
                                  {{"kernel", "(3,3)"}, {"num_filter", "32"},
                                   {"pad", "(1,1)"}});
   Symbol relu3 = op::Activation("relu3", conv3, {{"act_type", "relu"}});
-  Symbol conv4 = op::Convolution("conv4", relu3, W("c4w"), W("c4b"),
+  Symbol conv4 = op::Convolution("conv4", relu3, W("c4w"), W("c4_bias"),
                                  {{"kernel", "(3,3)"}, {"num_filter", "32"},
                                   {"pad", "(1,1)"}});
   Symbol relu4 = op::Activation("relu4", conv4, {{"act_type", "relu"}});
@@ -49,18 +49,17 @@ static Symbol AlexnetSymbol(int n_classes) {
                               {"pool_type", "max"}});
   // classifier: fc-relu-dropout x2 + fc
   Symbol flat = op::Flatten("flatten", pool3);
-  Symbol fc1 = op::FullyConnected("fc1", flat, W("f1w"), W("f1b"),
+  Symbol fc1 = op::FullyConnected("fc1", flat, W("f1w"), W("f1_bias"),
                                   {{"num_hidden", "64"}});
   Symbol relu5 = op::Activation("relu5", fc1, {{"act_type", "relu"}});
   Symbol drop1 = op::Dropout("drop1", relu5, {{"p", "0.25"}});
-  Symbol fc2 = op::FullyConnected("fc2", drop1, W("f2w"), W("f2b"),
+  Symbol fc2 = op::FullyConnected("fc2", drop1, W("f2w"), W("f2_bias"),
                                   {{"num_hidden", "32"}});
   Symbol relu6 = op::Activation("relu6", fc2, {{"act_type", "relu"}});
-  Symbol fc3 = op::FullyConnected("fc3", relu6, W("f3w"), W("f3b"),
+  Symbol fc3 = op::FullyConnected("fc3", relu6, W("f3w"), W("f3_bias"),
                                   {{"num_hidden",
                                     std::to_string(n_classes)}});
-  return op::SoftmaxOutput("softmax", fc3, label,
-                           {{"normalization", "batch"}});
+  return op::SoftmaxOutput("softmax", fc3, label);
 }
 
 int main() {
@@ -91,7 +90,7 @@ int main() {
   for (const auto& name : exec.ParamNames()) init(name, exec.Arg(name));
 
   std::unique_ptr<Optimizer> opt(OptimizerRegistry::Find("sgd"));
-  opt->SetParam("lr", 0.05f)
+  opt->SetParam("lr", 0.01f)
       ->SetParam("momentum", 0.9f)
       ->SetParam("rescale_grad", 1.0f / kBatch);
 

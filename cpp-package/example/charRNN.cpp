@@ -66,18 +66,17 @@ int main() {
       "steps", embed, {{"num_outputs", std::to_string(kSeq)},
                        {"axis", "1"}, {"squeeze_axis", "True"}});
 
-  LSTMParams p{Symbol::Variable("i2h_w"), Symbol::Variable("i2h_b"),
-               Symbol::Variable("h2h_w"), Symbol::Variable("h2h_b")};
+  LSTMParams p{Symbol::Variable("i2h_w"), Symbol::Variable("i2h_bias"),
+               Symbol::Variable("h2h_w"), Symbol::Variable("h2h_bias")};
   Symbol h = Symbol::Variable("init_h");
   Symbol c = Symbol::Variable("init_c");
   for (int t = 0; t < kSeq; ++t) {
     LSTMCell("t" + std::to_string(t), p, steps[t], kHidden, &h, &c);
   }
   Symbol fc = op::FullyConnected(
-      "fc", h, Symbol::Variable("fc_w"), Symbol::Variable("fc_b"),
+      "fc", h, Symbol::Variable("fc_w"), Symbol::Variable("fc_bias"),
       {{"num_hidden", std::to_string(kVocab)}});
-  Symbol net = op::SoftmaxOutput("softmax", fc, label,
-                                 {{"normalization", "batch"}});
+  Symbol net = op::SoftmaxOutput("softmax", fc, label);
 
   // cyclic-alphabet batches: sequence [s, s+1, ...], label s+kSeq
   NDArray data_arr({kBatch, kSeq}, ctx);

@@ -17,10 +17,10 @@ using namespace mxnet_tpu::cpp;  // NOLINT
 static Symbol LenetSymbol() {
   Symbol data = Symbol::Variable("data");
   Symbol label = Symbol::Variable("label");
-  Symbol c1_w = Symbol::Variable("c1_w"), c1_b = Symbol::Variable("c1_b");
-  Symbol c2_w = Symbol::Variable("c2_w"), c2_b = Symbol::Variable("c2_b");
-  Symbol f1_w = Symbol::Variable("f1_w"), f1_b = Symbol::Variable("f1_b");
-  Symbol f2_w = Symbol::Variable("f2_w"), f2_b = Symbol::Variable("f2_b");
+  Symbol c1_w = Symbol::Variable("c1_w"), c1_b = Symbol::Variable("c1_bias");
+  Symbol c2_w = Symbol::Variable("c2_w"), c2_b = Symbol::Variable("c2_bias");
+  Symbol f1_w = Symbol::Variable("f1_w"), f1_b = Symbol::Variable("f1_bias");
+  Symbol f2_w = Symbol::Variable("f2_w"), f2_b = Symbol::Variable("f2_bias");
 
   Symbol conv1 = op::Convolution("conv1", data, c1_w, c1_b,
                                  {{"kernel", "(3,3)"}, {"num_filter", "8"},
@@ -42,8 +42,10 @@ static Symbol LenetSymbol() {
   Symbol tanh3 = op::Activation("tanh3", fc1, {{"act_type", "tanh"}});
   Symbol fc2 = op::FullyConnected("fc2", tanh3, f2_w, f2_b,
                                   {{"num_hidden", "4"}});
-  return op::SoftmaxOutput("softmax", fc2, label,
-                           {{"normalization", "batch"}});
+  // plain SoftmaxOutput + optimizer rescale_grad = 1/batch (the
+  // reference example pattern); normalization="batch" here as well
+  // would divide gradients by batch twice
+  return op::SoftmaxOutput("softmax", fc2, label);
 }
 
 int main() {

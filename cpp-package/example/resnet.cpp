@@ -75,11 +75,10 @@ static Symbol ResnetSymbol(int n_classes) {
                              {"pool_type", "avg"}});
   Symbol flat = op::Flatten("flatten", pool);
   Symbol fc = op::FullyConnected("fc", flat, Symbol::Variable("fc_w"),
-                                 Symbol::Variable("fc_b"),
+                                 Symbol::Variable("fc_bias"),
                                  {{"num_hidden",
                                    std::to_string(n_classes)}});
-  return op::SoftmaxOutput("softmax", fc, label,
-                           {{"normalization", "batch"}});
+  return op::SoftmaxOutput("softmax", fc, label);
 }
 
 int main() {

@@ -1,0 +1,26 @@
+package org.mxnettpu
+
+/** Scoped user attributes attached to symbols created inside the scope
+  * (reference AttrScope.scala; the python frontend's AttrScope — e.g.
+  * ctx_group placement tags consumed by the pipeline planner).
+  */
+class AttrScope(attr: Map[String, String] = Map.empty) {
+  def get(userAttr: Map[String, String]): Map[String, String] = {
+    if (userAttr == null) attr else attr ++ userAttr
+  }
+
+  def withScope[T](body: => T): T = {
+    val old = AttrScope.current
+    AttrScope.current = new AttrScope(old.get(null) ++ attr)
+    try body finally {
+      AttrScope.current = old
+    }
+  }
+}
+
+object AttrScope {
+  private var current: AttrScope = new AttrScope()
+  def apply(attrs: (String, String)*): AttrScope =
+    new AttrScope(attrs.toMap)
+  def currentAttrs: Map[String, String] = current.get(null)
+}

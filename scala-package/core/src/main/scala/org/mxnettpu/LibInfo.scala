@@ -46,6 +46,8 @@ private[mxnettpu] class LibInfo {
   @native def mxSymbolListArguments(handle: Long): Array[String]
   @native def mxSymbolListOutputs(handle: Long): Array[String]
   @native def mxSymbolListAuxiliaryStates(handle: Long): Array[String]
+  @native def mxSymbolSetAttr(handle: Long, key: String,
+                              value: String): Int
   @native def mxSymbolInferShape(handle: Long, keys: Array[String],
                                  indPtr: Array[Int],
                                  shapeData: Array[Int],
@@ -75,6 +77,32 @@ private[mxnettpu] class LibInfo {
   @native def mxPredGetOutput(handle: Long, idx: Int,
                               size: Int): Array[Float]
   @native def mxPredFree(handle: Long): Int
+
+  // profiler
+  @native def mxSetProfilerConfig(mode: Int, fileName: String): Int
+  @native def mxSetProfilerState(state: Int): Int
+
+  // recordio
+  @native def mxRecordIOWriterCreate(uri: String): Long
+  @native def mxRecordIOWriterWriteRecord(handle: Long,
+                                          record: Array[Byte]): Int
+  @native def mxRecordIOWriterFree(handle: Long): Int
+  @native def mxRecordIOReaderCreate(uri: String): Long
+  @native def mxRecordIOReaderReadRecord(handle: Long,
+                                         out: Array[AnyRef]): Int
+  @native def mxRecordIOReaderSeek(handle: Long, pos: Long): Int
+  @native def mxRecordIOReaderFree(handle: Long): Int
+
+  // rtc
+  @native def mxRtcCreate(name: String, inputNames: Array[String],
+                          outputNames: Array[String],
+                          inputHandles: Array[Long],
+                          outputHandles: Array[Long],
+                          kernel: String): Long
+  @native def mxRtcPush(handle: Long, ins: Array[Long],
+                        outs: Array[Long], gx: Int, gy: Int, gz: Int,
+                        bx: Int, by: Int, bz: Int): Int
+  @native def mxRtcFree(handle: Long): Int
 
   // kvstore
   @native def mxKVStoreCreate(kvType: String): Long

@@ -1,0 +1,71 @@
+package org.mxnettpu
+
+/** Output-statistics monitor (reference Monitor.scala: install on an
+  * executor, collect a statistic of every matched array each `interval`
+  * batches, print sorted on toc()).
+  *
+  * TPU-native note: per-op intermediate taps require the python
+  * frontend's per-node evaluator; through the C ABI the observable
+  * surface is the executor's outputs + bound arrays, which is what this
+  * monitor samples — the reference's default "stat every output" usage.
+  */
+class Monitor(interval: Int,
+              statFunc: Array[Float] => Float = Monitor.absMean) {
+  private var exec: Executor = null
+  private var step = 0
+  private var activated = false
+  private val queue =
+    scala.collection.mutable.ArrayBuffer.empty[(Int, String, Float)]
+
+  def install(executor: Executor): Unit = {
+    exec = executor
+  }
+
+  /** Call before forward: activates collection for this batch when the
+    * interval has elapsed.
+    */
+  def tic(): Unit = {
+    if (step % interval == 0) {
+      activated = true
+      queue.clear()
+    }
+    step += 1
+  }
+
+  /** Call after forward: collects (step, name, stat) for every output
+    * and every bound parameter array, returning the batch's entries.
+    */
+  def toc(): IndexedSeq[(Int, String, Float)] = {
+    if (!activated || exec == null) {
+      return IndexedSeq.empty
+    }
+    activated = false
+    val outs = exec.outputs
+    val outNames = exec.symbol.listOutputs()
+    for ((n, a) <- outNames.zip(outs)) {
+      queue += ((step, n, statFunc(a.toArray)))
+    }
+    for ((n, a) <- exec.argDict) {
+      queue += ((step, n, statFunc(a.toArray)))
+    }
+    queue.toIndexedSeq
+  }
+
+  def tocPrint(): Unit = {
+    for ((s, n, v) <- toc()) {
+      println(f"Batch: $s%7d $n%30s $v%.5f")
+    }
+  }
+}
+
+object Monitor {
+  /** Default statistic: mean(|x|) (reference Monitor default). */
+  def absMean(arr: Array[Float]): Float = {
+    if (arr.isEmpty) 0f
+    else {
+      var s = 0.0
+      for (v <- arr) s += math.abs(v)
+      (s / arr.length).toFloat
+    }
+  }
+}

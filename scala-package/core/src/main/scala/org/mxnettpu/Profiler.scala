@@ -1,0 +1,33 @@
+package org.mxnettpu
+
+import Base._
+
+/** Engine profiler controls (reference Profiler.scala over
+  * MXSetProfilerConfig/MXSetProfilerState): per-op timestamps stream
+  * into a Chrome-trace JSON file (the python frontend's profiler.py
+  * format — chrome://tracing loadable).
+  */
+object Profiler {
+  val ProfilerModeSymbolic = 0
+  val ProfilerModeAll = 1
+  val StateStop = 0
+  val StateRun = 1
+
+  def profilerSetConfig(mode: Int, fileName: String): Unit = {
+    checkCall(_LIB.mxSetProfilerConfig(mode, fileName))
+  }
+
+  def profilerSetState(state: Int): Unit = {
+    checkCall(_LIB.mxSetProfilerState(state))
+  }
+
+  /** Convenience bracket: profile `body`, dump to fileName. */
+  def profile[T](fileName: String,
+                 mode: Int = ProfilerModeSymbolic)(body: => T): T = {
+    profilerSetConfig(mode, fileName)
+    profilerSetState(StateRun)
+    try body finally {
+      profilerSetState(StateStop)
+    }
+  }
+}

@@ -1,0 +1,34 @@
+package org.mxnettpu
+
+import Base._
+
+/** Device random sampling (reference Random.scala): seeds the global
+  * key-chain (random.py) and draws via the registered sampling ops, so
+  * JVM-side draws are reproducible with every other frontend at the
+  * same seed.
+  */
+object Random {
+  def seed(seedState: Int): Unit = {
+    checkCall(_LIB.mxRandomSeed(seedState))
+  }
+
+  def uniform(low: Float, high: Float, shape: Shape,
+              ctx: Context = Context.defaultCtx): NDArray = {
+    val out = NDArray.empty(shape, ctx)
+    NDArray.invoke("_random_uniform", Seq.empty,
+                   Map("low" -> low.toString, "high" -> high.toString,
+                       "shape" -> shape.dims.mkString("(", ",", ")")),
+                   Seq(out))
+    out
+  }
+
+  def normal(loc: Float, scale: Float, shape: Shape,
+             ctx: Context = Context.defaultCtx): NDArray = {
+    val out = NDArray.empty(shape, ctx)
+    NDArray.invoke("_random_normal", Seq.empty,
+                   Map("loc" -> loc.toString, "scale" -> scale.toString,
+                       "shape" -> shape.dims.mkString("(", ",", ")")),
+                   Seq(out))
+    out
+  }
+}

@@ -1,0 +1,48 @@
+package org.mxnettpu
+
+import Base._
+
+/** RecordIO writer/reader over the native pack format (reference
+  * RecordIO.scala → src/recordio.cc): magic-framed records, mmap-scanned
+  * on read (runtime/recordio.cpp), byte-compatible with the python
+  * recordio.py and tools/im2rec.py files.
+  */
+class MXRecordIOWriter(uri: String) extends AutoCloseable {
+  private var handle: Long = checkHandle(_LIB.mxRecordIOWriterCreate(uri))
+
+  def write(record: Array[Byte]): Unit = {
+    checkCall(_LIB.mxRecordIOWriterWriteRecord(handle, record))
+  }
+
+  override def close(): Unit = {
+    if (handle != 0) {
+      checkCall(_LIB.mxRecordIOWriterFree(handle))
+      handle = 0
+    }
+  }
+}
+
+class MXRecordIOReader(uri: String) extends AutoCloseable {
+  private var handle: Long = checkHandle(_LIB.mxRecordIOReaderCreate(uri))
+
+  /** Next record, or null at clean end of file; a corrupt/failed read
+    * raises (rc != 0 with the native error message) instead of being
+    * silently mistaken for EOF.
+    */
+  def read(): Array[Byte] = {
+    val out = new Array[AnyRef](1)
+    checkCall(_LIB.mxRecordIOReaderReadRecord(handle, out))
+    out(0).asInstanceOf[Array[Byte]]
+  }
+
+  def seek(pos: Long): Unit = {
+    checkCall(_LIB.mxRecordIOReaderSeek(handle, pos))
+  }
+
+  override def close(): Unit = {
+    if (handle != 0) {
+      checkCall(_LIB.mxRecordIOReaderFree(handle))
+      handle = 0
+    }
+  }
+}

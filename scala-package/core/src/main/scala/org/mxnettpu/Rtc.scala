@@ -1,0 +1,37 @@
+package org.mxnettpu
+
+import Base._
+
+/** Runtime-compiled kernels (reference Rtc.scala → CUDA RTC; here the
+  * kernel text is a Pallas/JAX program compiled by the runtime — rtc.py
+  * semantics — with the source-text API preserved).
+  */
+class Rtc(name: String, inputs: IndexedSeq[(String, NDArray)],
+          outputs: IndexedSeq[(String, NDArray)], kernel: String)
+    extends AutoCloseable {
+  private var handle: Long =
+    checkHandle(_LIB.mxRtcCreate(name, inputs.map(_._1).toArray,
+                                 outputs.map(_._1).toArray,
+                                 inputs.map(_._2.handle).toArray,
+                                 outputs.map(_._2.handle).toArray,
+                                 kernel))
+
+  /** Launch on the given arrays (grid/block dims kept for reference API
+    * compatibility; the TPU runtime derives its own tiling).
+    */
+  def push(ins: Seq[NDArray], outs: Seq[NDArray],
+           gridDims: (Int, Int, Int) = (1, 1, 1),
+           blockDims: (Int, Int, Int) = (1, 1, 1)): Unit = {
+    checkCall(_LIB.mxRtcPush(handle, ins.map(_.handle).toArray,
+                             outs.map(_.handle).toArray,
+                             gridDims._1, gridDims._2, gridDims._3,
+                             blockDims._1, blockDims._2, blockDims._3))
+  }
+
+  override def close(): Unit = {
+    if (handle != 0) {
+      checkCall(_LIB.mxRtcFree(handle))
+      handle = 0
+    }
+  }
+}

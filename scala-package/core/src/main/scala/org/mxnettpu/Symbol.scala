@@ -99,6 +99,11 @@ object Symbol {
     val h = checkHandle(_LIB.mxSymbolCreate(
       opName, params.keys.toArray, params.values.toArray, name,
       args.keys.toArray, args.values.map(_.handle).toArray))
+    // attach any in-scope user attributes (ctx_group etc. —
+    // AttrScope.withScope), the python frontend's AttrScope contract
+    for ((k, v) <- AttrScope.currentAttrs) {
+      checkCall(_LIB.mxSymbolSetAttr(h, k, v))
+    }
     new Symbol(h)
   }
 

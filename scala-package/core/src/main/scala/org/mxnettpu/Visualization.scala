@@ -1,0 +1,103 @@
+package org.mxnettpu
+
+/** Network visualization (reference Visualization.scala print_summary /
+  * plot_network): renders the symbol graph from its JSON serialization —
+  * a table summary and a Graphviz dot document, matching the python
+  * frontend's visualization.py output shape.
+  */
+object Visualization {
+
+  private case class Node(name: String, op: String,
+                          inputs: IndexedSeq[Int])
+
+  // minimal JSON walk over the symbol's {nodes:[{op,name,attrs,inputs}]}
+  // serialization: split the top-level "nodes" array into per-node
+  // bodies by brace depth (node entries nest an attrs object, so a
+  // flat regex cannot delimit them), then pull fields per body
+  private def parseNodes(json: String): IndexedSeq[Node] = {
+    val start = json.indexOf("\"nodes\"")
+    if (start < 0) return IndexedSeq.empty
+    val open = json.indexOf('[', start)
+    val bodies = scala.collection.mutable.ArrayBuffer.empty[String]
+    var depth = 0
+    var objDepth = 0
+    var objStart = -1
+    var i = open
+    var inStr = false
+    var done = false
+    while (i < json.length && !done) {
+      val c = json(i)
+      if (inStr) {
+        if (c == '\\') i += 1
+        else if (c == '"') inStr = false
+      } else {
+        c match {
+          case '"' => inStr = true
+          case '[' => depth += 1
+          case ']' =>
+            depth -= 1
+            if (depth == 0) done = true
+          case '{' =>
+            if (objDepth == 0) objStart = i
+            objDepth += 1
+          case '}' =>
+            objDepth -= 1
+            if (objDepth == 0) bodies += json.substring(objStart, i + 1)
+          case _ =>
+        }
+      }
+      i += 1
+    }
+    val opRe = """"op"\s*:\s*"([^"]*)"""".r
+    val nameRe = """"name"\s*:\s*"([^"]*)"""".r
+    val inputsRe = """"inputs"\s*:\s*\[(.*)\]""".r
+    val idxRe = """\[\s*(\d+)""".r
+    bodies.map { body =>
+      val op = opRe.findFirstMatchIn(body).map(_.group(1))
+        .getOrElse("null")
+      val name = nameRe.findFirstMatchIn(body).map(_.group(1))
+        .getOrElse("")
+      val ins = inputsRe.findFirstMatchIn(body) match {
+        case Some(im) =>
+          idxRe.findAllMatchIn(im.group(1)).map(_.group(1).toInt)
+            .toIndexedSeq
+        case None => IndexedSeq.empty[Int]
+      }
+      Node(name, op, ins)
+    }.toIndexedSeq
+  }
+
+  /** Layer-per-row summary table (reference print_summary). */
+  def printSummary(symbol: Symbol): String = {
+    val nodes = parseNodes(symbol.toJson)
+    val sb = new StringBuilder
+    sb.append(f"${"Layer (type)"}%-40s ${"Inputs"}%s%n")
+    sb.append("=" * 60).append("\n")
+    for (n <- nodes if n.op != "null") {
+      val ins = n.inputs.flatMap(i => nodes.lift(i)).map(_.name)
+        .mkString(", ")
+      sb.append(f"${n.name + " (" + n.op + ")"}%-40s $ins%s%n")
+    }
+    val out = sb.toString
+    print(out)
+    out
+  }
+
+  /** Graphviz dot text (reference plot_network returns a Digraph). */
+  def plotNetwork(symbol: Symbol,
+                  title: String = "plot"): String = {
+    val nodes = parseNodes(symbol.toJson)
+    val sb = new StringBuilder
+    sb.append(s"digraph $title {\n")
+    for ((n, i) <- nodes.zipWithIndex) {
+      val shape = if (n.op == "null") "oval" else "box"
+      sb.append(
+        s"""  n$i [label="${n.name}\\n${n.op}", shape=$shape];\n""")
+    }
+    for ((n, i) <- nodes.zipWithIndex; src <- n.inputs) {
+      sb.append(s"  n$src -> n$i;\n")
+    }
+    sb.append("}\n")
+    sb.toString
+  }
+}

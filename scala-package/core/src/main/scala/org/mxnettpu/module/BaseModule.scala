@@ -1,0 +1,115 @@
+package org.mxnettpu.module
+
+import org.mxnettpu._
+
+/** Abstract training/inference module (reference module/BaseModule.scala:
+  * the computation-as-machine contract — bind → initParams →
+  * initOptimizer → forward/backward/update — with fit/predict/score
+  * driving loops layered on the primitive five).
+  *
+  * Concrete subclasses: [[Module]] (one symbol, one executor group) and
+  * [[SequentialModule]] (a chain of modules).
+  */
+abstract class BaseModule {
+  protected var binded: Boolean = false
+  protected var paramsInitialized: Boolean = false
+  protected var optimizerInitialized: Boolean = false
+
+  // ---- symbol/shape surface -------------------------------------------
+  def dataNames: IndexedSeq[String]
+  def outputShapes: IndexedSeq[Shape]
+
+  // ---- parameter surface ----------------------------------------------
+  def getParams: (Map[String, NDArray], Map[String, NDArray])
+  def initParams(initializer: Initializer = new Uniform(0.01f),
+                 argParams: Map[String, NDArray] = null,
+                 auxParams: Map[String, NDArray] = null,
+                 allowMissing: Boolean = false,
+                 forceInit: Boolean = false): Unit
+  def setParams(argParams: Map[String, NDArray],
+                auxParams: Map[String, NDArray],
+                allowMissing: Boolean = false,
+                forceInit: Boolean = true): Unit = {
+    initParams(initializer = null, argParams = argParams,
+               auxParams = auxParams, allowMissing = allowMissing,
+               forceInit = forceInit)
+  }
+
+  // ---- computation surface --------------------------------------------
+  def bind(dataShapes: Map[String, Shape],
+           labelShapes: Map[String, Shape] = Map.empty,
+           forTraining: Boolean = true, forceRebind: Boolean = false): Unit
+  def forward(dataBatch: Map[String, Array[Float]],
+              isTrain: Boolean): Unit
+  def backward(): Unit
+  def update(): Unit
+  def getOutputs: IndexedSeq[Array[Float]]
+  def initOptimizer(optimizer: Optimizer): Unit
+
+  def forwardBackward(dataBatch: Map[String, Array[Float]]): Unit = {
+    forward(dataBatch, isTrain = true)
+    backward()
+  }
+
+  // ---- high-level driving loops (reference BaseModule.fit) ------------
+  /** One-batch metric update from the current outputs (output 0 is the
+    * softmax probability block by module convention); the trailing
+    * `pad` wrap-around rows of the batch are trimmed, not the batch.
+    */
+  def updateMetric(metric: EvalMetric, labels: Array[Float],
+                   pad: Int = 0): Unit = {
+    val out = getOutputs.head
+    val numClasses = if (labels.length == 0) 1 else out.length / labels.length
+    val keep = labels.length - pad
+    metric.update(labels.take(keep), out.take(keep * numClasses),
+                  numClasses)
+  }
+
+  /** Train numEpoch epochs over iter (reference BaseModule.fit:383). The
+    * iterator yields host batches; upload happens inside forward().
+    */
+  def fit(iter: NDArrayIter, dataName: String, labelName: String,
+          numEpoch: Int, metric: EvalMetric = new Accuracy()): Unit = {
+    require(binded && paramsInitialized && optimizerInitialized,
+            "fit needs bind + initParams + initOptimizer first")
+    for (epoch <- 0 until numEpoch) {
+      metric.reset()
+      iter.reset()
+      while (iter.hasNext) {
+        val (dbuf, lbuf, pad) = iter.nextHost()
+        forwardBackward(Map(dataName -> dbuf, labelName -> lbuf))
+        update()
+        updateMetric(metric, lbuf, pad)
+      }
+    }
+  }
+
+  /** Score iter with metric; returns (name, value). */
+  def score(iter: NDArrayIter, dataName: String, labelName: String,
+            metric: EvalMetric): (String, Float) = {
+    require(binded && paramsInitialized)
+    metric.reset()
+    iter.reset()
+    while (iter.hasNext) {
+      val (dbuf, lbuf, pad) = iter.nextHost()
+      forward(Map(dataName -> dbuf, labelName -> lbuf), isTrain = false)
+      updateMetric(metric, lbuf, pad)
+    }
+    metric.get
+  }
+
+  /** Forward every batch, concatenating output 0 rows (predict). */
+  def predict(iter: NDArrayIter, dataName: String): Array[Float] = {
+    require(binded && paramsInitialized)
+    iter.reset()
+    val chunks = scala.collection.mutable.ArrayBuffer.empty[Array[Float]]
+    while (iter.hasNext) {
+      val (dbuf, lbuf, pad) = iter.nextHost()
+      forward(Map(dataName -> dbuf), isTrain = false)
+      val out = getOutputs.head
+      val rowWidth = out.length / lbuf.length
+      chunks += out.take((lbuf.length - pad) * rowWidth)
+    }
+    chunks.flatten.toArray
+  }
+}

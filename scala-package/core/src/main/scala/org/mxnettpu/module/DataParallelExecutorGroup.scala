@@ -1,0 +1,93 @@
+package org.mxnettpu.module
+
+import org.mxnettpu._
+import org.mxnettpu.Base._
+
+/** Executor group behind [[Module]] (reference
+  * module/DataParallelExecutorGroup.scala).
+  *
+  * TPU-native redesign note: the reference slices each batch across K
+  * per-GPU executors and reduces gradients through a comm engine.  Here
+  * device parallelism is the runtime's job — the bound program is ONE
+  * whole-graph XLA executable (mesh-sharded on the python frontend;
+  * single-device through the C ABI this JVM layer rides) — so the group
+  * manages exactly one executor and keeps the reference's *interface*:
+  * shape bookkeeping, shared parameter arrays, grad-req handling,
+  * forward/backward dispatch, output collection.
+  */
+class DataParallelExecutorGroup private[module] (
+    symbol: Symbol, ctx: Context,
+    inputShapes: Map[String, Shape], forTraining: Boolean,
+    inputsNeedGrad: Boolean = false) {
+
+  val argNames: IndexedSeq[String] = symbol.listArguments()
+  val auxNames: IndexedSeq[String] = symbol.listAuxiliaryStates()
+  val paramNames: IndexedSeq[String] =
+    argNames.filterNot(inputShapes.contains)
+
+  private val inferred = symbol.inferShape(inputShapes).getOrElse(
+    throw new MXNetError(s"cannot infer shapes from $inputShapes"))
+  val (argShapes, outShapes, auxShapes) = inferred
+
+  val argArrays: IndexedSeq[NDArray] =
+    argNames.zip(argShapes).map { case (n, s) => NDArray.zeros(s, ctx) }
+  val gradArrays: IndexedSeq[NDArray] =
+    argNames.zip(argShapes).map { case (n, s) =>
+      val isInput = inputShapes.contains(n)
+      if (!forTraining || (isInput && !inputsNeedGrad)) null
+      else if (isInput && n.endsWith("label")) null
+      else NDArray.zeros(s, ctx)
+    }
+  val auxArrays: IndexedSeq[NDArray] =
+    auxNames.zip(auxShapes).map { case (n, s) =>
+      // reference aux defaults: moving_var = 1 (a zero variance would
+      // normalize eval-mode activations by 1/sqrt(eps)), others 0
+      if (n.endsWith("var")) NDArray.ones(s, ctx)
+      else NDArray.zeros(s, ctx)
+    }
+
+  lazy val argDict: Map[String, NDArray] = argNames.zip(argArrays).toMap
+  lazy val gradDict: Map[String, NDArray] =
+    argNames.zip(gradArrays).filter(_._2 != null).toMap
+  lazy val auxDict: Map[String, NDArray] = auxNames.zip(auxArrays).toMap
+
+  private val reqs: IndexedSeq[Int] =
+    argNames.zip(gradArrays).map { case (_, g) => if (g == null) 0 else 1 }
+
+  val executor: Executor = symbol.bind(ctx, argArrays, gradArrays, reqs,
+                                       auxArrays)
+
+  /** Upload host batches into the bound input arrays and run forward. */
+  def forward(dataBatch: Map[String, Array[Float]],
+              isTrain: Boolean): Unit = {
+    for ((name, buf) <- dataBatch) {
+      argDict.get(name) match {
+        case Some(arr) => arr.set(buf)
+        case None => // a label absent at predict time — skip
+      }
+    }
+    executor.forward(isTrain)
+  }
+
+  def backward(headGrads: Seq[NDArray] = Seq.empty): Unit =
+    executor.backward(headGrads)
+
+  /** Gradients of the DATA inputs (chained-module head grads). */
+  def inputGradients(dataNames: Seq[String]): IndexedSeq[NDArray] =
+    dataNames.flatMap(n => gradDict.get(n)).toIndexedSeq
+
+  def getOutputs: IndexedSeq[Array[Float]] =
+    executor.outputs.map(_.toArray)
+
+  def setParams(argParams: Map[String, NDArray],
+                auxParams: Map[String, NDArray]): Unit = {
+    for ((n, v) <- argParams; dst <- argDict.get(n)) dst.set(v.toArray)
+    for ((n, v) <- auxParams; dst <- auxDict.get(n)) dst.set(v.toArray)
+  }
+
+  def dispose(): Unit = {
+    executor.close()
+    (argArrays ++ auxArrays ++ gradArrays.filter(_ != null))
+      .foreach(_.close())
+  }
+}

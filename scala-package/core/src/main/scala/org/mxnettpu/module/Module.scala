@@ -1,0 +1,188 @@
+package org.mxnettpu.module
+
+import org.mxnettpu._
+import org.mxnettpu.Base._
+
+/** Concrete single-symbol module (reference module/Module.scala): owns
+  * one [[DataParallelExecutorGroup]], parameter init, an optimizer with
+  * per-parameter state, and two-file checkpoints
+  * (<prefix>-symbol.json + <prefix>-NNNN.params) interchangeable with
+  * every other frontend.
+  */
+class Module(val symbol: Symbol,
+             override val dataNames: IndexedSeq[String] =
+               IndexedSeq("data"),
+             val labelNames: IndexedSeq[String] =
+               IndexedSeq("softmax_label"),
+             val ctx: Context = Context.defaultCtx) extends BaseModule {
+
+  private var group: DataParallelExecutorGroup = null
+  private var optimizer: Optimizer = null
+  private var optStates: Map[String, AnyRef] = Map.empty
+  private var boundShapes: Map[String, Shape] = Map.empty
+
+  override def outputShapes: IndexedSeq[Shape] = {
+    require(binded, "outputShapes needs bind first")
+    group.outShapes
+  }
+
+  private var inputsNeedGrad: Boolean = false
+
+  override def bind(dataShapes: Map[String, Shape],
+                    labelShapes: Map[String, Shape] = Map.empty,
+                    forTraining: Boolean = true,
+                    forceRebind: Boolean = false): Unit =
+    // a rebind through the BaseModule-typed surface keeps the module's
+    // existing input-gradient setting (false only on the first bind)
+    bind(dataShapes, labelShapes, forTraining, forceRebind,
+         inputsNeedGrad = this.inputsNeedGrad)
+
+  /** inputsNeedGrad allocates gradient arrays for the data inputs too —
+    * the chained-module contract [[SequentialModule]] rides on
+    * (reference BaseModule.bind inputs_need_grad).
+    */
+  def bind(dataShapes: Map[String, Shape],
+           labelShapes: Map[String, Shape],
+           forTraining: Boolean, forceRebind: Boolean,
+           inputsNeedGrad: Boolean): Unit = {
+    if (binded && !forceRebind) {
+      return
+    }
+    // rebinding must not lose trained parameters (reference Module
+    // preserves them across force_rebind): stage values host-side,
+    // rebuild, restore
+    val saved: (Map[String, Array[Float]], Map[String, Array[Float]]) =
+      if (binded && paramsInitialized) {
+        val (a, x) = getParams
+        (a.map { case (k, v) => (k, v.toArray) },
+         x.map { case (k, v) => (k, v.toArray) })
+      } else {
+        (Map.empty, Map.empty)
+      }
+    if (group != null) group.dispose()
+    boundShapes = dataShapes ++ labelShapes
+    this.inputsNeedGrad = inputsNeedGrad
+    group = new DataParallelExecutorGroup(symbol, ctx, boundShapes,
+                                          forTraining, inputsNeedGrad)
+    binded = true
+    for ((n, v) <- saved._1; dst <- group.argDict.get(n)) dst.set(v)
+    for ((n, v) <- saved._2; dst <- group.auxDict.get(n)) dst.set(v)
+  }
+
+  override def getParams: (Map[String, NDArray], Map[String, NDArray]) = {
+    require(binded)
+    (group.paramNames.map(n => n -> group.argDict(n)).toMap,
+     group.auxDict)
+  }
+
+  override def initParams(initializer: Initializer = new Uniform(0.01f),
+                          argParams: Map[String, NDArray] = null,
+                          auxParams: Map[String, NDArray] = null,
+                          allowMissing: Boolean = false,
+                          forceInit: Boolean = false): Unit = {
+    require(binded, "initParams needs bind first")
+    if (paramsInitialized && !forceInit && initializer != null) {
+      return
+    }
+    for (n <- group.paramNames) {
+      val given = if (argParams != null) argParams.get(n) else None
+      given match {
+        case Some(v) => group.argDict(n).set(v.toArray)
+        case None =>
+          if (initializer != null) {
+            val shape = group.argDict(n).shape
+            group.argDict(n).set(initializer(n, shape))
+          } else if (!allowMissing) {
+            throw new MXNetError(s"no value for parameter $n")
+          }
+      }
+    }
+    for (n <- group.auxNames) {
+      val given = if (auxParams != null) auxParams.get(n) else None
+      given match {
+        case Some(v) => group.auxDict(n).set(v.toArray)
+        case None => // group already bound reference defaults (var=1)
+      }
+    }
+    paramsInitialized = true
+  }
+
+  override def initOptimizer(opt: Optimizer): Unit = {
+    require(binded && paramsInitialized)
+    optimizer = opt
+    optStates = Map.empty
+    optimizerInitialized = true
+  }
+
+  override def forward(dataBatch: Map[String, Array[Float]],
+                       isTrain: Boolean): Unit = {
+    require(binded && paramsInitialized)
+    group.forward(dataBatch, isTrain)
+  }
+
+  override def backward(): Unit = backward(Seq.empty)
+
+  /** Backward with explicit head gradients (chained modules). */
+  def backward(headGrads: Seq[NDArray]): Unit = {
+    require(binded)
+    group.backward(headGrads)
+  }
+
+  /** Gradients w.r.t. the data inputs (needs bind(inputsNeedGrad)). */
+  def inputGradients: IndexedSeq[NDArray] = {
+    require(binded)
+    group.inputGradients(dataNames)
+  }
+
+  override def update(): Unit = {
+    require(optimizerInitialized, "update needs initOptimizer first")
+    for (n <- group.paramNames) {
+      val grad = group.gradDict.getOrElse(n, null)
+      if (grad != null) {
+        val next = optimizer.update(group.argDict(n), grad,
+                                    optStates.getOrElse(n, null))
+        optStates += (n -> next)
+      }
+    }
+  }
+
+  override def getOutputs: IndexedSeq[Array[Float]] = {
+    require(binded)
+    group.getOutputs
+  }
+
+  /** Two-file checkpoint (reference Module.saveCheckpoint): symbol JSON
+    * + epoch-stamped params with the arg:/aux: key prefixes.
+    */
+  def saveCheckpoint(prefix: String, epoch: Int): Unit = {
+    require(binded && paramsInitialized)
+    val json = symbol.toJson
+    val w = new java.io.PrintWriter(s"$prefix-symbol.json")
+    try w.write(json) finally w.close()
+    val (args, auxs) = getParams
+    val tagged = args.map { case (k, v) => (s"arg:$k", v) } ++
+      auxs.map { case (k, v) => (s"aux:$k", v) }
+    NDArray.save(f"$prefix%s-$epoch%04d.params", tagged)
+  }
+}
+
+object Module {
+  /** Load a checkpoint saved by any frontend and return a bound-ready
+    * module plus its parameters (reference Module.loadCheckpoint).
+    */
+  def loadCheckpoint(prefix: String, epoch: Int,
+                     ctx: Context = Context.defaultCtx)
+      : (Module, Map[String, NDArray], Map[String, NDArray]) = {
+    val src = scala.io.Source.fromFile(s"$prefix-symbol.json")
+    val json = try src.mkString finally src.close()
+    val sym = Symbol.loadJson(json)
+    val loaded = NDArray.load(f"$prefix%s-$epoch%04d.params")
+    val args = loaded.collect {
+      case (k, v) if k.startsWith("arg:") => (k.drop(4), v)
+    }
+    val auxs = loaded.collect {
+      case (k, v) if k.startsWith("aux:") => (k.drop(4), v)
+    }
+    (new Module(sym, ctx = ctx), args, auxs)
+  }
+}

@@ -1,0 +1,126 @@
+package org.mxnettpu.module
+
+import org.mxnettpu._
+
+/** Chain of modules executed in order (reference
+  * module/SequentialModule.scala): module k's outputs feed module k+1's
+  * data inputs; backward runs the chain in reverse with each stage's
+  * input gradients becoming the previous stage's head gradients.
+  *
+  * TPU-native note: the single-symbol [[Module]] already compiles the
+  * whole graph into one XLA program, so the chain exists for the
+  * reference's modularity contract (mixing separately-built modules),
+  * not for performance — compose symbols instead when you can.
+  */
+class SequentialModule(override val dataNames: IndexedSeq[String] =
+                         IndexedSeq("data")) extends BaseModule {
+
+  private val modules =
+    scala.collection.mutable.ArrayBuffer.empty[Module]
+  private var metaTakeLabels: Int = -1
+
+  /** Append a module; takeLabels marks the (single) stage that consumes
+    * the label input (the loss head, normally the last).
+    */
+  def add(module: Module, takeLabels: Boolean = false): this.type = {
+    modules += module
+    if (takeLabels) metaTakeLabels = modules.length - 1
+    this
+  }
+
+  def size: Int = modules.length
+
+  override def outputShapes: IndexedSeq[Shape] = {
+    require(binded)
+    modules.last.outputShapes
+  }
+
+  override def bind(dataShapes: Map[String, Shape],
+                    labelShapes: Map[String, Shape] = Map.empty,
+                    forTraining: Boolean = true,
+                    forceRebind: Boolean = false): Unit = {
+    require(modules.nonEmpty, "add modules before bind")
+    if (binded && !forceRebind) {
+      return
+    }
+    var shapes = dataShapes
+    for ((m, i) <- modules.zipWithIndex) {
+      val labels = if (i == metaTakeLabels ||
+                       (metaTakeLabels < 0 && i == modules.length - 1)) {
+        labelShapes
+      } else {
+        Map.empty[String, Shape]
+      }
+      // every stage after the first needs data-input gradients so the
+      // chain can hand them back as the previous stage's head grads
+      m.bind(shapes, labels, forTraining, forceRebind,
+             inputsNeedGrad = i > 0)
+      // next stage's data inputs take this stage's output shapes
+      shapes = if (i + 1 < modules.length) {
+        modules(i + 1).dataNames.zip(m.outputShapes).toMap
+      } else {
+        Map.empty[String, Shape]
+      }
+    }
+    binded = true
+  }
+
+  override def getParams: (Map[String, NDArray], Map[String, NDArray]) = {
+    require(binded)
+    val parts = modules.map(_.getParams)
+    (parts.map(_._1).reduce(_ ++ _), parts.map(_._2).reduce(_ ++ _))
+  }
+
+  override def initParams(initializer: Initializer = new Uniform(0.01f),
+                          argParams: Map[String, NDArray] = null,
+                          auxParams: Map[String, NDArray] = null,
+                          allowMissing: Boolean = false,
+                          forceInit: Boolean = false): Unit = {
+    require(binded)
+    modules.foreach(_.initParams(initializer, argParams, auxParams,
+                                 allowMissing, forceInit))
+    paramsInitialized = true
+  }
+
+  override def initOptimizer(optimizer: Optimizer): Unit = {
+    require(binded && paramsInitialized)
+    modules.foreach(_.initOptimizer(optimizer))
+    optimizerInitialized = true
+  }
+
+  override def forward(dataBatch: Map[String, Array[Float]],
+                       isTrain: Boolean): Unit = {
+    require(binded && paramsInitialized)
+    var batch = dataBatch
+    for ((m, i) <- modules.zipWithIndex) {
+      m.forward(batch, isTrain)
+      if (i + 1 < modules.length) {
+        // next stage: its data inputs are this stage's outputs; label
+        // inputs ride through untouched to whichever stage takes them
+        batch = modules(i + 1).dataNames.zip(m.getOutputs).toMap ++
+          dataBatch.filter { case (k, _) => k.endsWith("label") }
+      }
+    }
+  }
+
+  override def backward(): Unit = {
+    require(binded)
+    // chain rule across stages: stage k+1's data-input gradients are
+    // stage k's head gradients (reference SequentialModule.backward)
+    var heads: Seq[NDArray] = Seq.empty
+    for (m <- modules.reverse) {
+      m.backward(heads)
+      heads = m.inputGradients
+    }
+  }
+
+  override def update(): Unit = {
+    require(optimizerInitialized)
+    modules.foreach(_.update())
+  }
+
+  override def getOutputs: IndexedSeq[Array[Float]] = {
+    require(binded)
+    modules.last.getOutputs
+  }
+}

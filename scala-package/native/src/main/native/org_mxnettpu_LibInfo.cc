@@ -560,4 +560,129 @@ Java_org_mxnettpu_LibInfo_mxKVStoreFree(JNIEnv*, jobject, jlong h) {
   return MXKVStoreFree(reinterpret_cast<KVStoreHandle>(h));
 }
 
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxSymbolSetAttr(JNIEnv* env, jobject, jlong h,
+                                          jstring key, jstring value) {
+  return MXSymbolSetAttr(reinterpret_cast<SymbolHandle>(h),
+                         str(env, key).c_str(), str(env, value).c_str());
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxSetProfilerConfig(JNIEnv* env, jobject,
+                                              jint mode, jstring fname) {
+  return MXSetProfilerConfig(mode, str(env, fname).c_str());
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxSetProfilerState(JNIEnv*, jobject,
+                                             jint state) {
+  return MXSetProfilerState(state);
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxRecordIOWriterCreate(JNIEnv* env, jobject,
+                                                 jstring uri) {
+  RecordIOHandle h = nullptr;
+  if (MXRecordIOWriterCreate(str(env, uri).c_str(), &h) != 0) return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRecordIOWriterWriteRecord(JNIEnv* env,
+                                                      jobject, jlong h,
+                                                      jbyteArray rec) {
+  jsize n = (rec == nullptr) ? 0 : env->GetArrayLength(rec);
+  std::vector<jbyte> buf(n);
+  if (n) env->GetByteArrayRegion(rec, 0, n, buf.data());
+  return MXRecordIOWriterWriteRecord(
+      reinterpret_cast<RecordIOHandle>(h),
+      reinterpret_cast<const char*>(buf.data()), (size_t)n);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRecordIOWriterFree(JNIEnv*, jobject,
+                                               jlong h) {
+  return MXRecordIOWriterFree(reinterpret_cast<RecordIOHandle>(h));
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxRecordIOReaderCreate(JNIEnv* env, jobject,
+                                                 jstring uri) {
+  RecordIOHandle h = nullptr;
+  if (MXRecordIOReaderCreate(str(env, uri).c_str(), &h) != 0) return 0;
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRecordIOReaderReadRecord(JNIEnv* env,
+                                                     jobject, jlong h,
+                                                     jobjectArray out) {
+  const char* buf = nullptr;
+  size_t size = 0;
+  int rc = MXRecordIOReaderReadRecord(
+      reinterpret_cast<RecordIOHandle>(h), &buf, &size);
+  if (rc != 0) return rc;  // error — distinct from EOF (rc 0, null out)
+  if (buf == nullptr) {
+    env->SetObjectArrayElement(out, 0, nullptr);  // end of file
+    return 0;
+  }
+  jbyteArray rec = env->NewByteArray((jsize)size);
+  env->SetByteArrayRegion(rec, 0, (jsize)size,
+                          reinterpret_cast<const jbyte*>(buf));
+  env->SetObjectArrayElement(out, 0, rec);
+  return 0;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRecordIOReaderSeek(JNIEnv*, jobject, jlong h,
+                                               jlong pos) {
+  return MXRecordIOReaderSeek(reinterpret_cast<RecordIOHandle>(h),
+                              (size_t)pos);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRecordIOReaderFree(JNIEnv*, jobject,
+                                               jlong h) {
+  return MXRecordIOReaderFree(reinterpret_cast<RecordIOHandle>(h));
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_mxnettpu_LibInfo_mxRtcCreate(JNIEnv* env, jobject, jstring name,
+                                      jobjectArray inputNames,
+                                      jobjectArray outputNames,
+                                      jlongArray inputHandles,
+                                      jlongArray outputHandles,
+                                      jstring kernel) {
+  StrArr ins(env, inputNames), outs(env, outputNames);
+  std::vector<void*> ih = handles(env, inputHandles);
+  std::vector<void*> oh = handles(env, outputHandles);
+  std::string nm = str(env, name), krn = str(env, kernel);
+  RtcHandle h = nullptr;
+  if (MXRtcCreate(const_cast<char*>(nm.c_str()), ins.size(), outs.size(),
+                  const_cast<char**>(ins.data()),
+                  const_cast<char**>(outs.data()), ih.data(), oh.data(),
+                  const_cast<char*>(krn.c_str()), &h) != 0) {
+    return 0;
+  }
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRtcPush(JNIEnv* env, jobject, jlong h,
+                                    jlongArray ins, jlongArray outs,
+                                    jint gx, jint gy, jint gz, jint bx,
+                                    jint by, jint bz) {
+  std::vector<void*> vi = handles(env, ins);
+  std::vector<void*> vo = handles(env, outs);
+  return MXRtcPush(reinterpret_cast<RtcHandle>(h), (mx_uint)vi.size(),
+                   (mx_uint)vo.size(), vi.data(), vo.data(), (mx_uint)gx,
+                   (mx_uint)gy, (mx_uint)gz, (mx_uint)bx, (mx_uint)by,
+                   (mx_uint)bz);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_mxnettpu_LibInfo_mxRtcFree(JNIEnv*, jobject, jlong h) {
+  return MXRtcFree(reinterpret_cast<RtcHandle>(h));
+}
+
 }  // extern "C"

@@ -83,6 +83,35 @@ jint Java_org_mxnettpu_LibInfo_mxKVStorePush(JNIEnv*, jobject, jlong,
                                              jintArray, jlongArray, jint);
 jint Java_org_mxnettpu_LibInfo_mxKVStorePull(JNIEnv*, jobject, jlong,
                                              jintArray, jlongArray, jint);
+jint Java_org_mxnettpu_LibInfo_mxSymbolSetAttr(JNIEnv*, jobject, jlong,
+                                               jstring, jstring);
+jint Java_org_mxnettpu_LibInfo_mxSetProfilerConfig(JNIEnv*, jobject, jint,
+                                                   jstring);
+jint Java_org_mxnettpu_LibInfo_mxSetProfilerState(JNIEnv*, jobject, jint);
+jlong Java_org_mxnettpu_LibInfo_mxRecordIOWriterCreate(JNIEnv*, jobject,
+                                                       jstring);
+jint Java_org_mxnettpu_LibInfo_mxRecordIOWriterWriteRecord(JNIEnv*,
+                                                           jobject, jlong,
+                                                           jbyteArray);
+jint Java_org_mxnettpu_LibInfo_mxRecordIOWriterFree(JNIEnv*, jobject,
+                                                    jlong);
+jlong Java_org_mxnettpu_LibInfo_mxRecordIOReaderCreate(JNIEnv*, jobject,
+                                                       jstring);
+jint Java_org_mxnettpu_LibInfo_mxRecordIOReaderReadRecord(JNIEnv*,
+                                                          jobject, jlong,
+                                                          jobjectArray);
+jint Java_org_mxnettpu_LibInfo_mxRecordIOReaderSeek(JNIEnv*, jobject,
+                                                    jlong, jlong);
+jint Java_org_mxnettpu_LibInfo_mxRecordIOReaderFree(JNIEnv*, jobject,
+                                                    jlong);
+jlong Java_org_mxnettpu_LibInfo_mxRtcCreate(JNIEnv*, jobject, jstring,
+                                            jobjectArray, jobjectArray,
+                                            jlongArray, jlongArray,
+                                            jstring);
+jint Java_org_mxnettpu_LibInfo_mxRtcPush(JNIEnv*, jobject, jlong,
+                                         jlongArray, jlongArray, jint,
+                                         jint, jint, jint, jint, jint);
+jint Java_org_mxnettpu_LibInfo_mxRtcFree(JNIEnv*, jobject, jlong);
 }
 
 static JNIEnv genv;
@@ -299,6 +328,224 @@ int main() {
   env->GetFloatArrayRegion(pf, 0, 6, pfv);
   // push without updater replaces the stored value with the merged grads
   for (int i = 0; i < 6; ++i) ASSERT(std::fabs(pfv[i] - 2 * xv[i]) < 1e-5);
+
+  // --- Module.fit-shaped flow (module/Module.scala call sequence) ----
+  // symbol: FC(8) -> relu -> FC(2) -> SoftmaxOutput; infer, allocate
+  // params+grads, bind for training, then loop forward/backward +
+  // sgd_update exactly as Module.fit drives the shim.
+  {
+    jlong mdata = Java_org_mxnettpu_LibInfo_mxSymbolCreateVariable(
+        env, nullptr, env->NewStringUTF("data"));
+    jlong mlabel = Java_org_mxnettpu_LibInfo_mxSymbolCreateVariable(
+        env, nullptr, env->NewStringUTF("label"));
+    const char* hk[1] = {"num_hidden"};
+    const char* hv8[1] = {"8"};
+    const char* dk[1] = {"data"};
+    jlong fc1s[1] = {mdata};
+    jlong fc1 = Java_org_mxnettpu_LibInfo_mxSymbolCreate(
+        env, nullptr, env->NewStringUTF("FullyConnected"), strs(hk, 1),
+        strs(hv8, 1), env->NewStringUTF("fc1"), strs(dk, 1),
+        longs(fc1s, 1));
+    ASSERT(fc1 != 0);
+    const char* actk[1] = {"act_type"};
+    const char* actv[1] = {"relu"};
+    jlong relus[1] = {fc1};
+    jlong relu = Java_org_mxnettpu_LibInfo_mxSymbolCreate(
+        env, nullptr, env->NewStringUTF("Activation"), strs(actk, 1),
+        strs(actv, 1), env->NewStringUTF("relu1"), strs(dk, 1),
+        longs(relus, 1));
+    const char* hv2[1] = {"2"};
+    jlong fc2s[1] = {relu};
+    jlong fc2 = Java_org_mxnettpu_LibInfo_mxSymbolCreate(
+        env, nullptr, env->NewStringUTF("FullyConnected"), strs(hk, 1),
+        strs(hv2, 1), env->NewStringUTF("fc2"), strs(dk, 1),
+        longs(fc2s, 1));
+    const char* smk[1] = {"normalization"};
+    const char* smv[1] = {"batch"};
+    const char* smin[2] = {"data", "label"};
+    jlong smis[2] = {fc2, mlabel};
+    jlong net = Java_org_mxnettpu_LibInfo_mxSymbolCreate(
+        env, nullptr, env->NewStringUTF("SoftmaxOutput"), strs(smk, 1),
+        strs(smv, 1), env->NewStringUTF("sm"), strs(smin, 2),
+        longs(smis, 2));
+    ASSERT(net != 0);
+
+    // infer shapes from data/label (CSR keyed)
+    const char* keys2[2] = {"data", "label"};
+    jint indptr[3] = {0, 2, 3};
+    jint sdata[3] = {16, 6, 16};
+    jobjectArray infout = env->NewObjectArray(6, nullptr, nullptr);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxSymbolInferShape(
+               env, nullptr, net, strs(keys2, 2), ints(indptr, 3),
+               ints(sdata, 3), infout) == 1);  // 1 = complete
+
+    // args in listArguments order: data, fc1_w, fc1_b, fc2_w, fc2_b,
+    // label — allocate per inferred shapes
+    jobjectArray margs = Java_org_mxnettpu_LibInfo_mxSymbolListArguments(
+        env, nullptr, net);
+    int n_args = env->GetArrayLength(margs);
+    ASSERT(n_args == 6);
+    jint ashape[6][2] = {{16, 6}, {8, 6}, {8, 0}, {2, 8}, {2, 0}, {16, 0}};
+    int andim[6] = {2, 2, 1, 2, 1, 1};
+    jlong argh[6], gradh[6];
+    jint reqs[6];
+    unsigned seed = 99;
+    for (int i = 0; i < 6; ++i) {
+      argh[i] = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(
+          env, nullptr, ints(ashape[i], andim[i]), 1, 0);
+      gradh[i] = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(
+          env, nullptr, ints(ashape[i], andim[i]), 1, 0);
+      reqs[i] = (i == 0 || i == 5) ? 0 : 1;
+      int n = 1;
+      for (int d = 0; d < andim[i]; ++d) n *= ashape[i][d];
+      jfloat* buf = new jfloat[n];
+      for (int j = 0; j < n; ++j) {
+        seed = seed * 1103515245u + 12345u;
+        buf[j] = (((seed >> 16) % 1000) / 1000.0f - 0.5f) * 0.4f;
+      }
+      ASSERT(Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(
+                 env, nullptr, argh[i], floats(buf, n)) == 0);
+      delete[] buf;
+    }
+    jlong mexec = Java_org_mxnettpu_LibInfo_mxExecutorBind(
+        env, nullptr, net, 1, 0, longs(argh, 6), longs(gradh, 6),
+        ints(reqs, 6), longs(nullptr, 0));
+    ASSERT(mexec != 0);
+
+    // deterministic learnable batch: label = (sum of row > 0)
+    jfloat xb[16 * 6], yb[16];
+    for (int i = 0; i < 16; ++i) {
+      float srow = 0;
+      for (int j = 0; j < 6; ++j) {
+        seed = seed * 1103515245u + 12345u;
+        xb[i * 6 + j] = ((seed >> 16) % 1000) / 1000.0f - 0.5f;
+        srow += xb[i * 6 + j];
+      }
+      yb[i] = srow > 0 ? 1.0f : 0.0f;
+    }
+    ASSERT(Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(
+               env, nullptr, argh[0], floats(xb, 16 * 6)) == 0);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(
+               env, nullptr, argh[5], floats(yb, 16)) == 0);
+
+    const char* lrk[1] = {"lr"};
+    const char* lrv[1] = {"0.5"};
+    float first_loss = -1, last_loss = -1;
+    for (int step = 0; step < 120; ++step) {
+      ASSERT(Java_org_mxnettpu_LibInfo_mxExecutorForward(env, nullptr,
+                                                         mexec, 1) == 0);
+      ASSERT(Java_org_mxnettpu_LibInfo_mxExecutorBackward(
+                 env, nullptr, mexec, longs(nullptr, 0)) == 0);
+      jlongArray mouts = Java_org_mxnettpu_LibInfo_mxExecutorOutputs(
+          env, nullptr, mexec);
+      jlong oh;
+      env->GetLongArrayRegion(mouts, 0, 1, &oh);
+      jfloatArray probs = Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(
+          env, nullptr, oh, 32);
+      jfloat pv[32];
+      env->GetFloatArrayRegion(probs, 0, 32, pv);
+      float loss = 0;
+      for (int i = 0; i < 16; ++i) {
+        float p = pv[i * 2 + (int)yb[i]];
+        loss += -std::log(p > 1e-9f ? p : 1e-9f);
+      }
+      loss /= 16;
+      if (step == 0) first_loss = loss;
+      last_loss = loss;
+      for (int i = 1; i <= 4; ++i) {  // the sgd_update Module.update does
+        jlong uin[2] = {argh[i], gradh[i]};
+        jlong uout[1] = {argh[i]};
+        jlongArray r = Java_org_mxnettpu_LibInfo_mxImperativeInvoke(
+            env, nullptr, env->NewStringUTF("sgd_update"), longs(uin, 2),
+            strs(lrk, 1), strs(lrv, 1), longs(uout, 1));
+        ASSERT(r != nullptr);
+      }
+    }
+    ASSERT(last_loss < first_loss * 0.7f);
+  }
+
+  // --- symbol user attrs (AttrScope path) --------------------------------
+  {
+    jlong av = Java_org_mxnettpu_LibInfo_mxSymbolCreateVariable(
+        env, nullptr, env->NewStringUTF("attr_var"));
+    ASSERT(Java_org_mxnettpu_LibInfo_mxSymbolSetAttr(
+               env, nullptr, av, env->NewStringUTF("ctx_group"),
+               env->NewStringUTF("stage0")) == 0);
+  }
+
+  // --- profiler natives --------------------------------------------------
+  ASSERT(Java_org_mxnettpu_LibInfo_mxSetProfilerConfig(
+             env, nullptr, 0,
+             env->NewStringUTF("/tmp/scala_jni_profile.json")) == 0);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxSetProfilerState(env, nullptr, 1)
+         == 0);
+  ASSERT(Java_org_mxnettpu_LibInfo_mxSetProfilerState(env, nullptr, 0)
+         == 0);
+  remove("/tmp/scala_jni_profile.json");
+
+  // --- recordio natives --------------------------------------------------
+  {
+    jlong w = Java_org_mxnettpu_LibInfo_mxRecordIOWriterCreate(
+        env, nullptr, env->NewStringUTF("/tmp/scala_jni.rec"));
+    ASSERT(w != 0);
+    jbyte rec[5] = {'h', 'e', 'l', 'l', 'o'};
+    jbyteArray jrec = env->NewByteArray(5);
+    env->SetByteArrayRegion(jrec, 0, 5, rec);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxRecordIOWriterWriteRecord(
+               env, nullptr, w, jrec) == 0);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxRecordIOWriterFree(env, nullptr, w)
+           == 0);
+    jlong r = Java_org_mxnettpu_LibInfo_mxRecordIOReaderCreate(
+        env, nullptr, env->NewStringUTF("/tmp/scala_jni.rec"));
+    ASSERT(r != 0);
+    jobjectArray rout = env->NewObjectArray(1, nullptr, nullptr);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxRecordIOReaderReadRecord(
+               env, nullptr, r, rout) == 0);
+    jbyteArray got = (jbyteArray)env->GetObjectArrayElement(rout, 0);
+    ASSERT(got != nullptr && env->GetArrayLength(got) == 5);
+    jbyte gv[5];
+    env->GetByteArrayRegion(got, 0, 5, gv);
+    ASSERT(memcmp(gv, rec, 5) == 0);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxRecordIOReaderReadRecord(
+               env, nullptr, r, rout) == 0);  // rc 0 + null out = EOF
+    ASSERT(env->GetObjectArrayElement(rout, 0) == nullptr);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxRecordIOReaderFree(env, nullptr, r)
+           == 0);
+    remove("/tmp/scala_jni.rec");
+  }
+
+  // --- rtc natives -------------------------------------------------------
+  {
+    jint rshape[2] = {2, 2};
+    jlong rx = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(
+        env, nullptr, ints(rshape, 2), 1, 0);
+    jlong rz = Java_org_mxnettpu_LibInfo_mxNDArrayCreate(
+        env, nullptr, ints(rshape, 2), 1, 0);
+    jfloat rxv[4] = {1, 2, 3, 4};
+    ASSERT(Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyFromCPU(
+               env, nullptr, rx, floats(rxv, 4)) == 0);
+    const char* rin[1] = {"x"};
+    const char* rout[1] = {"z"};
+    jlong rihc[1] = {rx};
+    jlong rohc[1] = {rz};
+    jlong rtc = Java_org_mxnettpu_LibInfo_mxRtcCreate(
+        env, nullptr, env->NewStringUTF("dbl"), strs(rin, 1),
+        strs(rout, 1), longs(rihc, 1), longs(rohc, 1),
+        env->NewStringUTF("z_ref[...] = x_ref[...] * 2.0"));
+    ASSERT(rtc != 0);
+    jlong rih[1] = {rx};
+    jlong roh[1] = {rz};
+    ASSERT(Java_org_mxnettpu_LibInfo_mxRtcPush(env, nullptr, rtc,
+                                               longs(rih, 1),
+                                               longs(roh, 1), 1, 1, 1, 1,
+                                               1, 1) == 0);
+    jfloatArray rres = Java_org_mxnettpu_LibInfo_mxNDArraySyncCopyToCPU(
+        env, nullptr, rz, 4);
+    jfloat rrv[4];
+    env->GetFloatArrayRegion(rres, 0, 4, rrv);
+    ASSERT(rrv[0] == 2.0f && rrv[3] == 8.0f);
+    ASSERT(Java_org_mxnettpu_LibInfo_mxRtcFree(env, nullptr, rtc) == 0);
+  }
 
   printf("SCALA_JNI_TEST_PASS\n");
   return 0;
