@@ -100,10 +100,17 @@ class CompositeEvalMetric(EvalMetric):
 
 
 class Accuracy(EvalMetric):
-    def __init__(self):
+    """Classification accuracy; ``pred_index`` scores one output of a
+    multi-output (Grouped) symbol — e.g. ``Accuracy(pred_index=0)`` for
+    a (softmax, aux_loss) group where only output 0 has a label."""
+
+    def __init__(self, pred_index=None):
         super().__init__("accuracy")
+        self.pred_index = pred_index
 
     def update(self, labels, preds):
+        if self.pred_index is not None:
+            preds = preds[self.pred_index:self.pred_index + 1]
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
             p = pred_label.asnumpy()

@@ -121,12 +121,27 @@ class MeshExecutorGroup(object):
         self._param_shardings = {
             n: NamedSharding(self.mesh, spec_for(n)) for n in param_names}
 
+        # mesh-aware ops (MoE / RingAttention) read the current mesh at
+        # trace time; wrapping the evaluator closures pins it for every
+        # jit/vjp trace this group triggers (registry.use_mesh)
+        def _with_mesh(fn):
+            if fn is None:
+                return None
+            from ..registry import use_mesh
+
+            def wrapped(*a, **k):
+                with use_mesh(self.mesh):
+                    return fn(*a, **k)
+            return wrapped
+
         self._eval_fn, self._needs_rng = _build_eval(symbol)
+        self._eval_fn = _with_mesh(self._eval_fn)
         if self.remat:
             # sqrt-N segmented checkpoints (training only): a single
             # checkpoint around the whole forward saves no memory
             self._remat_eval_fn, _ = _build_eval_segmented(
                 symbol, remat=self.remat)
+            self._remat_eval_fn = _with_mesh(self._remat_eval_fn)
         else:
             self._remat_eval_fn = None
         self.pipeline_microbatches = pipeline_microbatches
@@ -142,6 +157,7 @@ class MeshExecutorGroup(object):
             from ..executor import _build_eval_pipelined
             self._pipe_eval_fn, _, stage_pnames = _build_eval_pipelined(
                 symbol, self.mesh, pipeline_microbatches)
+            self._pipe_eval_fn = _with_mesh(self._pipe_eval_fn)
             # stage params are stacked and sharded on 'pp' inside the
             # shard_map schedule — a param_sharding rule resolving one to
             # a non-replicated spec would be silently dropped, so reject

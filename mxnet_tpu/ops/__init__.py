@@ -17,4 +17,5 @@ from . import rnn_op  # noqa: F401
 from . import contrib  # noqa: F401
 from . import detection  # noqa: F401
 from . import sequence_loss  # noqa: F401
+from . import parallel_ops  # noqa: F401
 from .. import operator  # noqa: F401  (registers the Custom op)

@@ -1,0 +1,150 @@
+"""Mesh-aware parallel layer ops — the Module-reachable surface for
+expert parallelism and sequence parallelism (VERDICT r3 #5; new design
+per SURVEY §2.3, no reference counterpart: the reference scales MoE/
+long-context by hand-written device placement, this framework by
+sharding annotations).
+
+Both ops read :func:`registry.current_mesh` at trace time (set by
+MeshExecutorGroup around its evaluator closures):
+
+* ``MoE`` — Switch-style top-1 router + capacity-bucketed expert FFN in
+  the GSPMD formulation: dispatch/combine are einsums over an
+  expert-major buffer whose expert dim carries a sharding constraint on
+  the ``ep`` mesh axis, and the expert weights arrive ``ep``-sharded via
+  ``Module(param_sharding=...)`` rules — XLA inserts the all-to-alls.
+  Routing math is GLOBAL (same tokens, same cumsum order) regardless of
+  the mesh, so the sharded program is numerically the 1-device program.
+* ``RingAttention`` — blockwise ring attention over the ``sp`` axis
+  (parallel/ring_attention.py): GSPMD cannot express the ppermute ring
+  schedule, so the op drops into ``shard_map`` for the staged region;
+  without an ``sp`` axis it runs the exact single-device attention the
+  ring is equality-tested against.
+"""
+from __future__ import annotations
+
+from ..registry import register, current_mesh
+from ..parallel.expert_parallel import top1_routing, moe_ffn_block
+from ..parallel.ring_attention import ring_attention, local_attention
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _moe_infer(attrs, in_shapes, aux):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None, aux
+    E = int(attrs["num_experts"])
+    f = int(attrs["hidden_size"])
+    d = data[-1]
+    in_shapes[1] = (d, E)
+    in_shapes[2] = (E, d, f)
+    in_shapes[3] = (E, f)
+    in_shapes[4] = (E, f, d)
+    in_shapes[5] = (E, d)
+    return in_shapes, [tuple(data), ()], aux
+
+
+@register("MoE", arg_names=("data", "gate_weight", "expert1_weight",
+                            "expert1_bias", "expert2_weight",
+                            "expert2_bias"),
+          attr_types={"num_experts": int, "hidden_size": int,
+                      "capacity_factor": float},
+          required_attrs=("num_experts", "hidden_size"),
+          infer_shape=_moe_infer, num_outputs=2,
+          out_names=("output", "aux_loss"))
+def _moe(attrs, ins, octx):
+    """Switch-style top-1 mixture-of-experts block, ep-shardable.
+
+    Outputs: the routed expert output (same shape as data) and the
+    scalar load-balance aux loss (add it into the objective via
+    MakeLoss)."""
+    import math
+
+    jnp = _jnp()
+    x, wg, w1, b1, w2, b2 = ins
+    E = int(attrs["num_experts"])
+    cf = float(attrs.get("capacity_factor", 1.25))
+
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)
+    T = xt.shape[0]
+    cap = max(1, int(math.ceil(T * cf / E)))
+
+    f32 = jnp.float32
+    logits = xt.astype(f32) @ wg.astype(f32)
+    dispatch, combine, aux = top1_routing(logits, cap)
+
+    # expert-major buffer (E, C, d); constrain its expert dim onto the
+    # 'ep' axis when one exists — GSPMD turns the einsums around it into
+    # the dispatch/collect all-to-alls
+    sendbuf = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    sendbuf = _constrain_leading_ep(sendbuf)
+    expert_out = moe_ffn_block(sendbuf, w1.astype(x.dtype),
+                               b1.astype(x.dtype), w2.astype(x.dtype),
+                               b2.astype(x.dtype))
+    expert_out = _constrain_leading_ep(expert_out)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return [y.reshape(lead + (d,)), aux.astype(f32)]
+
+
+def _constrain_leading_ep(t):
+    mesh = current_mesh()
+    if mesh is None or "ep" not in mesh.axis_names:
+        return t
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(*(("ep",) + (None,) * (t.ndim - 1)))
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+
+
+def _ring_infer(attrs, in_shapes, aux):
+    q = in_shapes[0]
+    if q is None:
+        return in_shapes, None, aux
+    in_shapes[1] = tuple(q)
+    in_shapes[2] = tuple(q)
+    return in_shapes, [tuple(q)], aux
+
+
+@register("RingAttention", arg_names=("query", "key", "value"),
+          attr_types={"causal": bool, "scale": float},
+          infer_shape=_ring_infer)
+def _ring_attention_op(attrs, ins, octx):
+    """Sequence-parallel self-attention over (B, H, T, D) inputs.
+
+    With an 'sp' mesh axis the sequence dim is ring-scheduled over it
+    (shard_map + ppermute); otherwise exact single-device attention —
+    the ring's tests pin the two equal up to the blockwise
+    log-sum-exp accumulation."""
+    q, k, v = ins
+    causal = bool(attrs.get("causal", False))
+    scale = attrs.get("scale")
+    scale = float(scale) if scale is not None else None
+
+    mesh = current_mesh()
+    if mesh is None or "sp" not in mesh.axis_names:
+        return [local_attention(q, k, v, causal=causal, scale=scale)]
+
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sp = axes["sp"]
+    if q.shape[2] % sp:
+        raise ValueError(
+            "RingAttention: sequence length %d not divisible by the "
+            "sp axis (%d)" % (q.shape[2], sp))
+    bdim = "dp" if "dp" in mesh.axis_names else None
+    spec = P(bdim, None, "sp", None)
+    fn = shard_map(
+        partial(ring_attention, axis_name="sp", causal=causal,
+                scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return [fn(q, k, v)]

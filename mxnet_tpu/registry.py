@@ -237,3 +237,33 @@ def parse_attrs(op, attrs):
     for k, v in attrs.items():
         out[k] = _parse_value(v, op.attr_types.get(k))
     return out
+
+
+# ---------------------------------------------------------------------------
+# current device mesh — how mesh-aware ops (MoE, RingAttention) learn the
+# sharding context they trace under.  MeshExecutorGroup wraps its
+# evaluator closures in use_mesh(mesh), so the contextvar is set exactly
+# while the op fcomputes trace (and harmlessly during execution); the
+# classic per-device executor leaves it None and the ops take their
+# single-device paths.  Thread-local by contextvar semantics, so
+# concurrently-bound groups on different threads cannot cross-talk.
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+import contextvars as _contextvars
+
+_CURRENT_MESH = _contextvars.ContextVar("mxnet_tpu_current_mesh",
+                                        default=None)
+
+
+def current_mesh():
+    """The Mesh the enclosing evaluator traces under, or None."""
+    return _CURRENT_MESH.get()
+
+
+@_contextlib.contextmanager
+def use_mesh(mesh):
+    tok = _CURRENT_MESH.set(mesh)
+    try:
+        yield
+    finally:
+        _CURRENT_MESH.reset(tok)

@@ -83,3 +83,26 @@ def test_doc_snippets_run(path):
     assert proc.returncode == 0, (
         "%s snippets failed:\n%s\n%s"
         % (path, proc.stdout[-1500:], proc.stderr[-2000:]))
+
+
+def test_module_api_reference_is_fresh():
+    """Per-module API pages (docs/api/*.md beyond ops.md) regenerate
+    byte-identically from the live docstrings."""
+    sys.path.insert(0, os.path.join(ROOT, "docs"))
+    import gen_module_ref
+    for slug, text in gen_module_ref.generate_all().items():
+        path = os.path.join(DOCS, "api", slug + ".md")
+        assert os.path.exists(path), "missing docs/api/%s.md" % slug
+        committed = open(path).read()
+        assert committed == text, (
+            "docs/api/%s.md is stale — run python docs/gen_module_ref.py"
+            % slug)
+
+
+def test_architecture_notes_exist():
+    """The TPU-native redesign rationale (reference
+    docs/architecture/note_*.md counterparts)."""
+    arch = os.path.join(DOCS, "architecture")
+    for f in ("note_engine.md", "note_memory.md",
+              "note_data_loading.md", "program_model.md"):
+        assert os.path.exists(os.path.join(arch, f)), f
