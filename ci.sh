@@ -22,6 +22,14 @@ python -m pytest tests/ -q --ignore=tests/test_bench_smoke.py || FAILED=1
 stage "bench contract smoke (tests/test_bench_smoke.py)"
 python -m pytest tests/test_bench_smoke.py -q || FAILED=1
 
+stage "convergence gate (train_cifar10 to fixed accuracy)"
+# reference Jenkinsfile integration stage (test_score.py): train a small
+# resnet on the CIFAR-shaped set and FAIL on accuracy regression
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 5 --batch-size 128 \
+    --min-accuracy 0.95 || FAILED=1
+
 stage "multi-chip dryrun (8 virtual devices)"
 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
     || FAILED=1

@@ -55,6 +55,10 @@ def main():
     parser.add_argument("--num-epochs", type=int, default=10)
     parser.add_argument("--kv-store", default="local")
     parser.add_argument("--model-prefix", default=None)
+    parser.add_argument("--min-accuracy", type=float, default=None,
+                        help="exit nonzero if final validation accuracy "
+                             "lands below this (the CI convergence gate, "
+                             "reference Jenkinsfile test_score stage)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -88,7 +92,13 @@ def main():
             batch_end_callback=mx.callback.Speedometer(args.batch_size,
                                                        20),
             epoch_end_callback=checkpoint)
-    print("final validation:", mod.score(val, "acc"))
+    score = mod.score(val, "acc")
+    print("final validation:", score)
+    if args.min_accuracy is not None:
+        acc = dict(score)["accuracy"]
+        assert acc >= args.min_accuracy, (
+            "convergence regression: accuracy %.3f < required %.3f"
+            % (acc, args.min_accuracy))
 
 
 if __name__ == "__main__":

@@ -10,8 +10,12 @@ original TPU-native code.
 from .. import symbol as sym
 
 
-def get_symbol(num_classes=1000, **kwargs):
+def get_symbol(num_classes=1000, dtype=None, **kwargs):
     input_data = sym.Variable(name="data")
+    if dtype:
+        # reduced-precision variant (reference alexnet_fp16.py shape,
+        # fp16 -> bf16 on TPU)
+        input_data = sym.Cast(input_data, dtype=dtype, name="cast_data")
     # stage 1
     conv1 = sym.Convolution(data=input_data, kernel=(11, 11), stride=(4, 4),
                             num_filter=96, name="conv1")
@@ -50,4 +54,6 @@ def get_symbol(num_classes=1000, **kwargs):
     # stage 6
     fc3 = sym.FullyConnected(data=dropout2, num_hidden=num_classes,
                              name="fc3")
+    if dtype:
+        fc3 = sym.Cast(fc3, dtype="float32", name="cast_out")
     return sym.SoftmaxOutput(data=fc3, name="softmax")

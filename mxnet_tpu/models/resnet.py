@@ -70,10 +70,15 @@ def residual_unit(data, num_filter, stride, dim_match, name,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, workspace=256):
+           bottle_neck=True, bn_mom=0.9, workspace=256, dtype=None):
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable(name="data")
+    if dtype:
+        # reduced-precision variant (reference resnet_fp16.py shape,
+        # fp16 -> bf16 on TPU): cast the input down, cast the logits
+        # back to f32 for a stable softmax
+        data = sym.Cast(data, dtype=dtype, name="cast_data")
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5,
                          momentum=bn_mom, name="bn_data")
     (nchannel, height, width) = image_shape
@@ -111,11 +116,13 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
                         pool_type="avg", name="pool1")
     flat = sym.Flatten(data=pool1)
     fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
+    if dtype:
+        fc1 = sym.Cast(fc1, dtype="float32", name="cast_out")
     return sym.SoftmaxOutput(data=fc1, name="softmax")
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               conv_workspace=256, **kwargs):
+               conv_workspace=256, dtype=None, **kwargs):
     """Depth -> unit configuration, following the reference table."""
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
@@ -153,4 +160,4 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
                   image_shape=image_shape, bottle_neck=bottle_neck,
-                  workspace=conv_workspace)
+                  workspace=conv_workspace, dtype=dtype)

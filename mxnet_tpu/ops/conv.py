@@ -67,6 +67,10 @@ def _conv_infer(attrs, in_shapes, aux):
 def _convolution(attrs, ins, octx):
     lax = _lax()
     x, w = ins[0], ins[1]
+    if w.dtype != x.dtype:
+        # dtype propagation (reference infer_type): reduced-precision
+        # activations pull the f32 parameters down to the compute dtype
+        w = w.astype(x.dtype)
     nd = x.ndim - 2
     stride = _tup(attrs.get("stride", 1), nd)
     pad = _tup(attrs.get("pad", 0), nd)
@@ -88,7 +92,10 @@ def _convolution(attrs, ins, octx):
         feature_group_count=ng, precision=f32_precision(x))
     if not attrs.get("no_bias", False):
         b = ins[2]
-        y = y + b.reshape((1, -1) + (1,) * nd)
+        # keep the compute dtype: a f32 bias would silently promote a
+        # bf16 activation stream back to f32 (dtype propagation, as for
+        # the weight above)
+        y = y + b.astype(y.dtype).reshape((1, -1) + (1,) * nd)
     return [y]
 
 
@@ -129,6 +136,10 @@ def _deconvolution(attrs, ins, octx):
     lax = _lax()
     jnp = _jnp()
     x, w = ins[0], ins[1]
+    if w.dtype != x.dtype:
+        # dtype propagation (reference infer_type): reduced-precision
+        # activations pull the f32 parameters down to the compute dtype
+        w = w.astype(x.dtype)
     nd = x.ndim - 2
     stride = _tup(attrs.get("stride", 1), nd)
     pad = _tup(attrs.get("pad", 0), nd)
@@ -157,7 +168,7 @@ def _deconvolution(attrs, ins, octx):
         lhs_dilation=stride, dimension_numbers=dn, feature_group_count=ng,
         precision=f32_precision(x))
     if not attrs.get("no_bias", True) and len(ins) > 2:
-        y = y + ins[2].reshape((1, -1) + (1,) * nd)
+        y = y + ins[2].astype(y.dtype).reshape((1, -1) + (1,) * nd)
     return [y]
 
 

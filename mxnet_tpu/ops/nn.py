@@ -55,10 +55,14 @@ def _fully_connected(attrs, ins, octx):
     jnp = _jnp()
     x = ins[0]
     w = ins[1]
+    if w.dtype != x.dtype:
+        # dtype propagation (reference infer_type): reduced-precision
+        # activations pull the f32 parameters down to the compute dtype
+        w = w.astype(x.dtype)
     x2 = x.reshape((x.shape[0], -1))
     y = jnp.dot(x2, w.T, precision=f32_precision(x2))
     if not attrs.get("no_bias", False):
-        y = y + ins[2][None, :]
+        y = y + ins[2].astype(y.dtype)[None, :]
     return [y]
 
 

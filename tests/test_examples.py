@@ -43,6 +43,22 @@ CASES = [
     ("torch/torch_module.py",
      ["--num-epoch", "12", "--use-torch-criterion"]),
     ("speech_recognition/deepspeech_mini.py", ["--num-epoch", "25"]),
+    ("rcnn/train_rcnn.py",
+     ["--num-epochs", "2", "--num-examples", "64", "--batch-size", "8"]),
+    ("caffe/train_caffe_net.py", ["--num-epoch", "4"]),
+    ("model-parallel-lstm/lstm.py",
+     ["--num-epoch", "3", "--seq-len", "8", "--num-hidden", "32"]),
+    ("rnn/char_lstm.py",
+     ["--num-epoch", "3", "--seq-len", "16", "--num-hidden", "64"]),
+    ("rnn/bucketing_lstm.py", ["--num-epoch", "3", "--num-hidden", "32"]),
+    ("profiler/profiler_demo.py",
+     ["--iter-num", "5", "--size", "128",
+      "--output", "/tmp/profiler_demo_ci.json"]),
+    ("moe/train_moe.py", ["--epochs", "10"]),
+    ("image-classification/train_imagenet.py",
+     ["--network", "resnet-18", "--image-shape", "3,64,64",
+      "--batch-size", "16", "--synthetic-images", "64",
+      "--num-epochs", "2"]),
 ]
 
 

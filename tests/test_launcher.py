@@ -1,0 +1,138 @@
+"""tools/launch.py tracker-mode matrix (reference tools/launch.py:13-30
+fronting the dmlc-tracker launchers).  The cluster schedulers are not in
+this image, so each mode runs against a FAKE scheduler executable that
+implements just enough of the real one's contract (mpirun spawns the
+ranks with OMPI_COMM_WORLD_RANK; qsub runs the array job with
+SGE_TASK_ID; yarn records its submission) — validating the command
+construction, env plumbing, and the rank-mapping exec shim end to end.
+"""
+import json
+import os
+import stat
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+
+# worker payload: dump the DMLC env as one JSON line per rank
+# one atomic write per rank: three ranks share the pipe, so a buffered
+# print could interleave bytes mid-line
+WORKER = ("import json, os; os.write(1, (json.dumps({k: v for k, v in "
+          "os.environ.items() if k.startswith('DMLC_')}) + chr(10))"
+          ".encode())")
+
+
+def _fake(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text("#!/bin/bash\n" + body)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(tmp_path)
+
+
+def _run(tmp_path, launcher, extra=()):
+    env = dict(os.environ)
+    env["PATH"] = str(tmp_path) + os.pathsep + env["PATH"]
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "3", "--launcher", launcher,
+         *extra, sys.executable, "-c", WORKER],
+        capture_output=True, text=True, timeout=120, env=env, cwd=ROOT)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    return proc
+
+
+def _ranks(stdout):
+    envs = [json.loads(ln) for ln in stdout.splitlines()
+            if ln.startswith("{")]
+    assert len(envs) == 3, stdout
+    assert {e["DMLC_WORKER_ID"] for e in envs} == {"0", "1", "2"}
+    for e in envs:
+        assert e["DMLC_NUM_WORKER"] == "3"
+        assert e["DMLC_ROLE"] == "worker"
+        assert e["DMLC_PS_ROOT_PORT"]
+    return envs
+
+
+def test_local_mode():
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "3", sys.executable, "-c", WORKER],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr
+    _ranks(proc.stdout)
+
+
+def test_mpi_mode(tmp_path):
+    # fake mpirun: parse -n and -x exports, spawn the command once per
+    # rank with OMPI_COMM_WORLD_RANK set (the OpenMPI contract)
+    _fake(tmp_path, "mpirun", '''
+n=0
+args=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -n) n=$2; shift 2 ;;
+    -x) shift 2 ;;          # env already exported by the launcher
+    *) args+=("$1"); shift ;;
+  esac
+done
+for ((r=0; r<n; r++)); do
+  OMPI_COMM_WORLD_RANK=$r "${args[@]}"
+done
+''')
+    proc = _run(tmp_path, "mpi")
+    _ranks(proc.stdout)
+
+
+def test_sge_mode(tmp_path):
+    # fake qsub: run the submitted array job script once per task with
+    # SGE_TASK_ID set (1-based, the SGE contract)
+    _fake(tmp_path, "qsub", '''
+script="${@: -1}"
+ntasks=$(grep -oP '(?<=#\\$ -t 1-)\\d+' "$script")
+for ((t=1; t<=ntasks; t++)); do
+  SGE_TASK_ID=$t bash "$script"
+done
+''')
+    proc = _run(tmp_path, "sge")
+    _ranks(proc.stdout)
+
+
+def test_yarn_mode(tmp_path):
+    # fake yarn: record the submission, then emulate n worker containers
+    # with REAL distributed-shell container ids (container 1 is the
+    # ApplicationMaster, shells start at _000002)
+    _fake(tmp_path, "yarn", '''
+echo "YARN_SUBMIT $@" >&2
+shell_cmd=""
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -shell_command) shell_cmd=$2; shift 2 ;;
+    -num_containers) n=$2; shift 2 ;;
+    *) shift ;;
+  esac
+done
+for ((r=0; r<n; r++)); do
+  CONTAINER_ID=$(printf 'container_1700000000001_0001_01_%06d' $((r+2))) \
+    bash -c "$shell_cmd"
+done
+''')
+    proc = _run(tmp_path, "yarn")
+    _ranks(proc.stdout)
+    assert "distributedshell" in proc.stderr
+
+
+def test_ssh_mode(tmp_path):
+    # fake ssh: run the remote command locally (the round-2 smoke shape)
+    _fake(tmp_path, "ssh", '''
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -o) shift 2 ;;
+    *) break ;;
+  esac
+done
+host=$1; shift
+bash -c "$*"
+''')
+    hosts = tmp_path / "hosts"
+    hosts.write_text("hostA\nhostB\n")
+    proc = _run(tmp_path, "ssh", extra=("-H", str(hosts)))
+    _ranks(proc.stdout)

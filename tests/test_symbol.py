@@ -242,3 +242,41 @@ def test_none_kwargs_dropped_on_both_wrappers():
     e.forward(is_train=False)
     np.testing.assert_allclose(e.outputs[0].asnumpy().sum(axis=-1),
                                np.ones(2), rtol=1e-5)
+
+
+def test_model_zoo_new_symbols_infer():
+    """Round-4 zoo additions: inception-resnet-v2 and the -bf16 variants
+    (the reference's *_fp16 scripts, bf16 on TPU) build and infer."""
+    from mxnet_tpu import models
+    s = models.get_symbol("inception-resnet-v2", num_classes=7,
+                          n_a=1, n_b=1, n_c=1)
+    _, out, _ = s.infer_shape(data=(2, 3, 299, 299),
+                              softmax_label=(2,))
+    assert out == [(2, 7)]
+    for name in ("resnet-18-bf16", "alexnet-bf16"):
+        s = models.get_symbol(name, num_classes=5)
+        args = s.list_arguments()
+        _, out, _ = s.infer_shape(data=(2, 3, 224, 224),
+                                  softmax_label=(2,))
+        assert out == [(2, 5)], name
+        assert "cast_data" in s.tojson(), name
+
+
+def test_model_zoo_bf16_variant_forward():
+    """The bf16 zoo variant really computes in bfloat16: bind + forward
+    a tiny resnet, logits come back finite (and the graph carries the
+    down/up casts)."""
+    import numpy as np
+    from mxnet_tpu import models
+    s = models.get_symbol("resnet-18-bf16", num_classes=4,
+                          image_shape=(3, 32, 32))
+    e = s.simple_bind(mx.cpu(), data=(2, 3, 32, 32))
+    for name, arr in e.arg_dict.items():
+        if name != "data":
+            arr[:] = np.random.RandomState(0).rand(*arr.shape) * 0.1
+    for name, arr in e.aux_dict.items():
+        arr[:] = 1.0 if name.endswith("var") else 0.0
+    e.arg_dict["data"][:] = np.random.RandomState(1).rand(2, 3, 32, 32)
+    e.forward(is_train=False)
+    out = e.outputs[0].asnumpy()
+    assert out.shape == (2, 4) and np.isfinite(out).all()

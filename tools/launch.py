@@ -7,12 +7,19 @@ preserved so reference commands keep working:
 
     python tools/launch.py -n 4 python train_mnist.py --kv-store dist_sync
 
-Local cluster = N forked processes (the reference's "local" launcher);
-multi-host via -H hostfile uses ssh like dmlc-tracker's ssh mode.
+Launcher modes mirror the reference's dmlc-tracker matrix
+(tools/launch.py:13-30): local (forked processes), ssh (hostfile),
+mpi (one mpirun, ranks mapped from OMPI_COMM_WORLD_RANK via the
+--exec-shim), sge (qsub array job, ranks from SGE_TASK_ID), yarn
+(distributed-shell submission). The cluster schedulers only place
+processes; the DMLC_* env contract (and jax.distributed underneath)
+is identical in every mode.
 """
 import argparse
+import json
 import os
 import random
+import shlex
 import subprocess
 import sys
 
@@ -47,13 +54,92 @@ def launch_ssh(hosts, n, cmd, port):
                 "DMLC_PS_ROOT_URI=%s DMLC_PS_ROOT_PORT=%d"
                 % (n, rank, root, port))
         full = ["ssh", "-o", "StrictHostKeyChecking=no", host,
-                "cd %s; %s %s" % (os.getcwd(), envs, " ".join(cmd))]
+                "cd %s; %s %s" % (shlex.quote(os.getcwd()), envs,
+                                  " ".join(shlex.quote(c) for c in cmd))]
         procs.append(subprocess.Popen(full))
     code = 0
     for p in procs:
         p.wait()
         code = code or p.returncode
     return code
+
+
+def _shim_env_args(n, port, root="127.0.0.1"):
+    return {
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_NUM_SERVER": "0",
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": root,
+        "DMLC_PS_ROOT_PORT": str(port),
+    }
+
+
+def exec_shim(env_json, cmd):
+    """Internal re-exec target inside scheduler-spawned processes: set
+    the DMLC env carried on the command line (scheduler-portable — no
+    reliance on mpirun -x / qsub -v export mechanics), map the
+    scheduler's rank variable onto DMLC_WORKER_ID, then exec the user
+    command (the dmlc-tracker per-rank bootstrap)."""
+    os.environ.update(json.loads(env_json))
+    rank = os.environ.get("OMPI_COMM_WORLD_RANK")       # OpenMPI
+    if rank is None:
+        rank = os.environ.get("PMI_RANK")               # MPICH/Hydra
+    if rank is None and os.environ.get("SGE_TASK_ID"):
+        rank = str(int(os.environ["SGE_TASK_ID"]) - 1)  # SGE arrays: 1-based
+    if rank is None and os.environ.get("CONTAINER_ID"):
+        # YARN distributed shell: container_<epoch>_<app>_<attempt>_NNNNNN,
+        # container 1 is the ApplicationMaster so shells start at 2
+        suffix = os.environ["CONTAINER_ID"].rsplit("_", 1)[1]
+        rank = str(max(0, int(suffix) - 2))
+    if rank is None:
+        rank = "0"
+    os.environ["DMLC_WORKER_ID"] = rank
+    os.execvp(cmd[0], cmd)
+
+
+def _with_shim(envs, cmd):
+    return [sys.executable, os.path.abspath(__file__), "--exec-shim",
+            json.dumps(envs)] + cmd
+
+
+def launch_mpi(n, cmd, port, mpirun="mpirun"):
+    """One mpirun spawns all ranks; the DMLC env rides the shim command
+    line (portable across OpenMPI/MPICH) and the per-rank id comes from
+    the MPI rank via the exec shim."""
+    envs = _shim_env_args(n, port, root=os.uname()[1])
+    full = [mpirun, "-n", str(n)] + _with_shim(envs, cmd)
+    return subprocess.call(full)
+
+
+def launch_sge(n, cmd, port, queue=None, qsub="qsub"):
+    """Submit an array job of n tasks; SGE_TASK_ID -> rank in the shim.
+    The generated script is the reference sge tracker's shape."""
+    import tempfile
+    envs = _shim_env_args(n, port, root=os.uname()[1])
+    lines = ["#!/bin/bash", "#$ -S /bin/bash", "#$ -cwd",
+             "#$ -t 1-%d" % n]
+    if queue:
+        lines.append("#$ -q %s" % queue)
+    lines.append(" ".join(shlex.quote(c)
+                           for c in _with_shim(envs, cmd)))
+    fd, path = tempfile.mkstemp(suffix=".sh", prefix="mxnet_sge_")
+    with os.fdopen(fd, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.chmod(path, 0o755)
+    return subprocess.call([qsub, "-sync", "y", path])
+
+
+def launch_yarn(n, cmd, port, yarn="yarn"):
+    """Submit via the YARN distributed shell (the reference yarn
+    tracker's submission surface): n containers, each re-execing the
+    shim with its container rank."""
+    envs = _shim_env_args(n, port, root=os.uname()[1])
+    shell = " ".join(shlex.quote(c) for c in _with_shim(envs, cmd))
+    full = [yarn, "org.apache.hadoop.yarn.applications.distributedshell"
+                  ".Client",
+            "-num_containers", str(n),
+            "-shell_command", shell]
+    return subprocess.call(full)
 
 
 def main():
@@ -64,11 +150,21 @@ def main():
                              "collectives (kept for compat)")
     parser.add_argument("-H", "--hostfile", default=None)
     parser.add_argument("--launcher", default="local",
-                        choices=["local", "ssh"])
+                        choices=["local", "ssh", "mpi", "sge", "yarn"])
+    parser.add_argument("--sge-queue", default=None)
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     port = random.randint(9100, 9899)
-    if args.hostfile:
+    if args.launcher == "mpi":
+        sys.exit(launch_mpi(args.num_workers, args.command, port))
+    if args.launcher == "sge":
+        sys.exit(launch_sge(args.num_workers, args.command, port,
+                            queue=args.sge_queue))
+    if args.launcher == "yarn":
+        sys.exit(launch_yarn(args.num_workers, args.command, port))
+    if args.hostfile or args.launcher == "ssh":
+        if not args.hostfile:
+            parser.error("--launcher ssh needs -H hostfile")
         with open(args.hostfile) as f:
             hosts = [h.strip() for h in f if h.strip()]
         sys.exit(launch_ssh(hosts, args.num_workers, args.command, port))
@@ -76,4 +172,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--exec-shim":
+        exec_shim(sys.argv[2], sys.argv[3:])
     main()
