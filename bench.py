@@ -210,24 +210,54 @@ def main():
         step(i)
     barrier()
 
-    t0 = time.time()
-    for i in range(steps):
-        step(i)
-    barrier()
-    dt = time.time() - t0
+    # Two-window slope measurement (round 4).  The window-ending
+    # readback is NOT free on this transport: a bare scalar round-trip
+    # measures ~100ms with ±20ms spread, so a single 20-step window
+    # overstates ms/step by ~5ms (round 3's 2518 img/s at bs128 was
+    # really ~2790).  Timing two window lengths and differencing
+    # cancels the fixed cost exactly — the slope IS the steady-state
+    # step time; min-of-reps suppresses the fixed cost's variance.
+    # Raw single-window numbers are still emitted for continuity.
+    steps_short = max(3, steps // 5)
 
-    img_per_sec = steps * batch / dt
+    def _window(n):
+        t0 = time.time()
+        for i in range(n):
+            step(i)
+        barrier()
+        return time.time() - t0
+
+    # matched rep counts: min-of-k samples a lower fixed cost as k
+    # grows, so unequal counts would leave a residual bias in the slope
+    t_long = min(_window(steps) for _ in range(3))
+    t_short = min(_window(steps_short) for _ in range(3))
+    dt = t_long - t_short
+    n_slope = steps - steps_short
+    timing = "two_window_slope"
+    if n_slope <= 0 or dt <= 0:
+        # degenerate (BENCH_STEPS <= 3) or noise swamped the slope:
+        # fall back to the raw window and SAY so in the record
+        dt, n_slope, timing = t_long, steps, "raw_window"
+
+    img_per_sec = n_slope * batch / dt
     achieved_tflops = img_per_sec * FLOPS_PER_IMG_TRAIN / 1e12
     peak_tf, peak_bw = _peaks(devices[0].device_kind, n_dev)
     extra = {"platform": platform, "devices": n_dev, "batch": batch,
              "steps": steps, "dtype": dtype_env, "path": "module",
-             "fused_group": fused, "ms_per_step": round(dt * 1000 / steps, 2),
+             "fused_group": fused,
+             "ms_per_step": round(dt * 1000 / n_slope, 2),
+             "timing": timing,
+             "raw_window_img_per_sec": round(steps * batch / t_long, 2),
              "achieved_tflops": round(achieved_tflops, 2),
              "device_kind": devices[0].device_kind}
+    if timing == "two_window_slope":
+        extra["window_fixed_cost_ms"] = round(
+            (t_short - t_long * steps_short / steps) * 1000 /
+            max(1e-9, 1 - steps_short / steps), 1)
     if peak_tf:
         extra["peak_tflops"] = peak_tf
         extra["mfu"] = round(achieved_tflops / peak_tf, 4)
-    extra.update(_xla_cost(mod, fused, dt / steps, peak_bw, n_dev))
+    extra.update(_xla_cost(mod, fused, dt / n_slope, peak_bw, n_dev))
 
     if os.environ.get("BENCH_HANDWRITTEN", "1") != "0":
         # independent roofline witness: framework-free NHWC ResNet-50

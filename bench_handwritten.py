@@ -276,12 +276,25 @@ def measure(batch=128, steps=20, compute_dtype="bfloat16", img=224):
     for _ in range(3):
         params, moms, loss = step(params, moms, Xd, yd)
     barrier()
-    t0 = time.time()
-    for _ in range(steps):
-        params, moms, loss = step(params, moms, Xd, yd)
-    barrier()
-    dt = time.time() - t0
-    return steps * batch / dt
+
+    # two-window slope, mirroring bench.py: the window-ending readback
+    # costs ~100ms±20 on this transport; differencing two window
+    # lengths cancels it so the slope is the steady-state step time
+    def _window(n):
+        nonlocal params, moms
+        t0 = time.time()
+        for _ in range(n):
+            params, moms, loss = step(params, moms, Xd, yd)
+        barrier()
+        return time.time() - t0
+
+    steps_short = max(3, steps // 5)
+    t_long = min(_window(steps) for _ in range(3))
+    t_short = min(_window(steps_short) for _ in range(3))
+    dt, n_slope = t_long - t_short, steps - steps_short
+    if n_slope <= 0 or dt <= 0:
+        dt, n_slope = t_long, steps
+    return n_slope * batch / dt
 
 
 if __name__ == "__main__":
