@@ -182,3 +182,492 @@ mxtpu_ndlist_load(SV *bytes_sv)
       mXPUSHs(newRV_noinc((SV *)entry));
     }
     MXNDListFree(handle);
+
+void
+mxtpu_seed(int s)
+  CODE:
+    croak_on_fail(aTHX_ MXRandomSeed(s), "MXRandomSeed");
+
+IV
+mxtpu_nd_create(SV *shape_ref, int dev_type, int dev_id)
+  PREINIT:
+    AV *shape_av;
+    mx_uint ndim, i;
+    mx_uint *shape;
+    NDArrayHandle out;
+    int rc;
+  CODE:
+    shape_av = (AV *)SvRV(shape_ref);
+    ndim = (mx_uint)(av_len(shape_av) + 1);
+    shape = (mx_uint *)malloc(ndim * sizeof(mx_uint));
+    for (i = 0; i < ndim; ++i) {
+      shape[i] = (mx_uint)SvUV(*av_fetch(shape_av, i, 0));
+    }
+    rc = MXNDArrayCreate(shape, ndim, dev_type, dev_id, 0, &out);
+    free(shape);
+    croak_on_fail(aTHX_ rc, "MXNDArrayCreate");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_nd_free(IV handle)
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, handle));
+
+void
+mxtpu_nd_shape(IV handle)
+  PREINIT:
+    mx_uint ndim, i;
+    const mx_uint *pdata;
+  PPCODE:
+    croak_on_fail(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, handle),
+                                          &ndim, &pdata),
+                  "MXNDArrayGetShape");
+    EXTEND(SP, ndim);
+    for (i = 0; i < ndim; ++i) {
+      mPUSHu(pdata[i]);
+    }
+
+void
+mxtpu_nd_copy_from(IV handle, SV *data_ref)
+  PREINIT:
+    AV *data_av;
+    mx_uint n, i;
+    mx_float *buf;
+    int rc;
+  CODE:
+    data_av = (AV *)SvRV(data_ref);
+    n = (mx_uint)(av_len(data_av) + 1);
+    buf = (mx_float *)malloc(n * sizeof(mx_float));
+    for (i = 0; i < n; ++i) {
+      buf[i] = (mx_float)SvNV(*av_fetch(data_av, i, 0));
+    }
+    rc = MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, handle), buf,
+                                  (size_t)n);
+    free(buf);
+    croak_on_fail(aTHX_ rc, "MXNDArraySyncCopyFromCPU");
+
+void
+mxtpu_nd_to_array(IV handle)
+  PREINIT:
+    mx_uint ndim, i;
+    const mx_uint *pdata;
+    mx_uint size;
+    mx_float *buf;
+    int rc;
+  PPCODE:
+    croak_on_fail(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, handle),
+                                          &ndim, &pdata),
+                  "MXNDArrayGetShape");
+    size = 1;
+    for (i = 0; i < ndim; ++i) {
+      size *= pdata[i];
+    }
+    buf = (mx_float *)malloc(size * sizeof(mx_float));
+    rc = MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, handle), buf,
+                                (size_t)size);
+    if (rc != 0) {
+      free(buf);
+      croak("MXNDArraySyncCopyToCPU failed: %s", MXGetLastError());
+    }
+    EXTEND(SP, size);
+    for (i = 0; i < size; ++i) {
+      mPUSHn((double)buf[i]);
+    }
+    free(buf);
+
+void
+mxtpu_nd_wait_all()
+  CODE:
+    croak_on_fail(aTHX_ MXNDArrayWaitAll(), "MXNDArrayWaitAll");
+
+IV
+mxtpu_op_handle(const char *name)
+  PREINIT:
+    FunctionHandle out;
+  CODE:
+    croak_on_fail(aTHX_ MXGetFunction(name, &out), "MXGetFunction");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_imperative_invoke(IV creator, SV *in_ref, SV *out_ref, SV *key_ref, SV *val_ref)
+  PREINIT:
+    AV *in_av;
+    AV *out_av;
+    AV *key_av;
+    AV *val_av;
+    int num_in, num_out, i;
+    NDArrayHandle *ins;
+    NDArrayHandle *outs;
+    NDArrayHandle *outp;
+    int num_params;
+    const char **keys;
+    const char **vals;
+    int rc;
+  PPCODE:
+    in_av = (AV *)SvRV(in_ref);
+    out_av = (AV *)SvRV(out_ref);
+    key_av = (AV *)SvRV(key_ref);
+    val_av = (AV *)SvRV(val_ref);
+    num_in = (int)(av_len(in_av) + 1);
+    num_out = (int)(av_len(out_av) + 1);
+    num_params = (int)(av_len(key_av) + 1);
+    ins = (NDArrayHandle *)malloc((num_in > 0 ? num_in : 1)
+                                  * sizeof(NDArrayHandle));
+    for (i = 0; i < num_in; ++i) {
+      ins[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(in_av, i, 0)));
+    }
+    keys = (const char **)malloc((num_params > 0 ? num_params : 1)
+                                 * sizeof(char *));
+    vals = (const char **)malloc((num_params > 0 ? num_params : 1)
+                                 * sizeof(char *));
+    for (i = 0; i < num_params; ++i) {
+      keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
+      vals[i] = SvPV_nolen(*av_fetch(val_av, i, 0));
+    }
+    if (num_out > 0) {
+      outs = (NDArrayHandle *)malloc(num_out * sizeof(NDArrayHandle));
+      for (i = 0; i < num_out; ++i) {
+        outs[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(out_av, i, 0)));
+      }
+      outp = outs;
+    } else {
+      outs = NULL;
+      outp = NULL;
+    }
+    rc = MXImperativeInvoke(INT2PTR(AtomicSymbolCreator, creator), num_in,
+                            ins, &num_out, &outp, num_params, keys, vals);
+    free(ins);
+    free(keys);
+    free(vals);
+    if (rc != 0) {
+      if (outs) free(outs);
+      croak("MXImperativeInvoke failed: %s", MXGetLastError());
+    }
+    EXTEND(SP, num_out);
+    for (i = 0; i < num_out; ++i) {
+      mPUSHi(PTR2IV(outp[i]));
+    }
+    if (outs) free(outs);
+
+IV
+mxtpu_sym_variable(const char *name)
+  PREINIT:
+    SymbolHandle out;
+  CODE:
+    croak_on_fail(aTHX_ MXSymbolCreateVariable(name, &out),
+                  "MXSymbolCreateVariable");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_sym_from_json(const char *json)
+  PREINIT:
+    SymbolHandle out;
+  CODE:
+    croak_on_fail(aTHX_ MXSymbolCreateFromJSON(json, &out),
+                  "MXSymbolCreateFromJSON");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+const char *
+mxtpu_sym_to_json(IV handle)
+  CODE:
+    croak_on_fail(aTHX_ MXSymbolSaveToJSON(INT2PTR(SymbolHandle, handle),
+                                           &RETVAL),
+                  "MXSymbolSaveToJSON");
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_sym_atomic(const char *op, SV *key_ref, SV *val_ref)
+  PREINIT:
+    AV *key_av;
+    AV *val_av;
+    mx_uint n, i;
+    const char **keys;
+    const char **vals;
+    AtomicSymbolCreator creator;
+    SymbolHandle out;
+    int rc;
+  CODE:
+    croak_on_fail(aTHX_ MXGetFunction(op, (FunctionHandle *)&creator),
+                  "MXGetFunction");
+    key_av = (AV *)SvRV(key_ref);
+    val_av = (AV *)SvRV(val_ref);
+    n = (mx_uint)(av_len(key_av) + 1);
+    keys = (const char **)malloc((n > 0 ? n : 1) * sizeof(char *));
+    vals = (const char **)malloc((n > 0 ? n : 1) * sizeof(char *));
+    for (i = 0; i < n; ++i) {
+      keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
+      vals[i] = SvPV_nolen(*av_fetch(val_av, i, 0));
+    }
+    rc = MXSymbolCreateAtomicSymbol(creator, n, keys, vals, &out);
+    free(keys);
+    free(vals);
+    croak_on_fail(aTHX_ rc, "MXSymbolCreateAtomicSymbol");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_sym_compose(IV handle, const char *name, SV *key_ref, SV *arg_ref)
+  PREINIT:
+    AV *key_av;
+    AV *arg_av;
+    mx_uint n, nk, i;
+    const char **keys;
+    SymbolHandle *args;
+    int rc;
+  CODE:
+    key_av = (AV *)SvRV(key_ref);
+    arg_av = (AV *)SvRV(arg_ref);
+    nk = (mx_uint)(av_len(key_av) + 1);
+    n = (mx_uint)(av_len(arg_av) + 1);
+    keys = NULL;
+    if (nk > 0) {
+      if (nk != n) {
+        croak("sym_compose: %u keys for %u args", nk, n);
+      }
+      keys = (const char **)malloc(n * sizeof(char *));
+      for (i = 0; i < n; ++i) {
+        keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
+      }
+    }
+    args = (SymbolHandle *)malloc((n > 0 ? n : 1) * sizeof(SymbolHandle));
+    for (i = 0; i < n; ++i) {
+      args[i] = INT2PTR(SymbolHandle, SvIV(*av_fetch(arg_av, i, 0)));
+    }
+    rc = MXSymbolCompose(INT2PTR(SymbolHandle, handle), name, n, keys,
+                         args);
+    if (keys) free(keys);
+    free(args);
+    croak_on_fail(aTHX_ rc, "MXSymbolCompose");
+
+void
+mxtpu_sym_list_arguments(IV handle)
+  PREINIT:
+    mx_uint n, i;
+    const char **arr;
+  PPCODE:
+    croak_on_fail(aTHX_ MXSymbolListArguments(
+        INT2PTR(SymbolHandle, handle), &n, &arr),
+        "MXSymbolListArguments");
+    EXTEND(SP, n);
+    for (i = 0; i < n; ++i) {
+      mPUSHp(arr[i], strlen(arr[i]));
+    }
+
+void
+mxtpu_sym_list_outputs(IV handle)
+  PREINIT:
+    mx_uint n, i;
+    const char **arr;
+  PPCODE:
+    croak_on_fail(aTHX_ MXSymbolListOutputs(
+        INT2PTR(SymbolHandle, handle), &n, &arr),
+        "MXSymbolListOutputs");
+    EXTEND(SP, n);
+    for (i = 0; i < n; ++i) {
+      mPUSHp(arr[i], strlen(arr[i]));
+    }
+
+void
+mxtpu_sym_list_aux(IV handle)
+  PREINIT:
+    mx_uint n, i;
+    const char **arr;
+  PPCODE:
+    croak_on_fail(aTHX_ MXSymbolListAuxiliaryStates(
+        INT2PTR(SymbolHandle, handle), &n, &arr),
+        "MXSymbolListAuxiliaryStates");
+    EXTEND(SP, n);
+    for (i = 0; i < n; ++i) {
+      mPUSHp(arr[i], strlen(arr[i]));
+    }
+
+void
+mxtpu_sym_infer_shape(IV handle, SV *name_ref, SV *shape_ref)
+  PREINIT:
+    AV *name_av;
+    AV *shape_av;
+    mx_uint n, i, j, total;
+    const char **keys;
+    mx_uint *indptr;
+    mx_uint *shape_data;
+    mx_uint in_size, out_size, aux_size;
+    const mx_uint *in_ndim;
+    const mx_uint **in_data;
+    const mx_uint *out_ndim;
+    const mx_uint **out_data;
+    const mx_uint *aux_ndim;
+    const mx_uint **aux_data;
+    int complete;
+    int rc;
+    AV *res_in;
+    AV *res_out;
+    AV *res_aux;
+  PPCODE:
+    name_av = (AV *)SvRV(name_ref);
+    shape_av = (AV *)SvRV(shape_ref);
+    n = (mx_uint)(av_len(name_av) + 1);
+    keys = (const char **)malloc((n > 0 ? n : 1) * sizeof(char *));
+    indptr = (mx_uint *)malloc((n + 1) * sizeof(mx_uint));
+    total = 0;
+    for (i = 0; i < n; ++i) {
+      AV *shape = (AV *)SvRV(*av_fetch(shape_av, i, 0));
+      total += (mx_uint)(av_len(shape) + 1);
+    }
+    shape_data = (mx_uint *)malloc((total > 0 ? total : 1)
+                                   * sizeof(mx_uint));
+    indptr[0] = 0;
+    total = 0;
+    for (i = 0; i < n; ++i) {
+      AV *shape = (AV *)SvRV(*av_fetch(shape_av, i, 0));
+      mx_uint ndim = (mx_uint)(av_len(shape) + 1);
+      keys[i] = SvPV_nolen(*av_fetch(name_av, i, 0));
+      for (j = 0; j < ndim; ++j) {
+        shape_data[total + j] = (mx_uint)SvUV(*av_fetch(shape, j, 0));
+      }
+      total += ndim;
+      indptr[i + 1] = total;
+    }
+    rc = MXSymbolInferShape(INT2PTR(SymbolHandle, handle), n, keys, indptr,
+                            shape_data, &in_size, &in_ndim, &in_data,
+                            &out_size, &out_ndim, &out_data, &aux_size,
+                            &aux_ndim, &aux_data, &complete);
+    free(keys);
+    free(indptr);
+    free(shape_data);
+    croak_on_fail(aTHX_ rc, "MXSymbolInferShape");
+    if (!complete) {
+      croak("MXSymbolInferShape: incomplete (missing input shapes)");
+    }
+    res_in = newAV();
+    for (i = 0; i < in_size; ++i) {
+      AV *s = newAV();
+      for (j = 0; j < in_ndim[i]; ++j) {
+        av_push(s, newSVuv(in_data[i][j]));
+      }
+      av_push(res_in, newRV_noinc((SV *)s));
+    }
+    res_out = newAV();
+    for (i = 0; i < out_size; ++i) {
+      AV *s = newAV();
+      for (j = 0; j < out_ndim[i]; ++j) {
+        av_push(s, newSVuv(out_data[i][j]));
+      }
+      av_push(res_out, newRV_noinc((SV *)s));
+    }
+    res_aux = newAV();
+    for (i = 0; i < aux_size; ++i) {
+      AV *s = newAV();
+      for (j = 0; j < aux_ndim[i]; ++j) {
+        av_push(s, newSVuv(aux_data[i][j]));
+      }
+      av_push(res_aux, newRV_noinc((SV *)s));
+    }
+    EXTEND(SP, 3);
+    mXPUSHs(newRV_noinc((SV *)res_in));
+    mXPUSHs(newRV_noinc((SV *)res_out));
+    mXPUSHs(newRV_noinc((SV *)res_aux));
+
+IV
+mxtpu_executor_bind(IV sym, int dev_type, int dev_id, SV *arg_ref, SV *grad_ref, SV *req_ref, SV *aux_ref)
+  PREINIT:
+    AV *arg_av;
+    AV *grad_av;
+    AV *req_av;
+    AV *aux_av;
+    mx_uint n, naux, i;
+    NDArrayHandle *args;
+    NDArrayHandle *grads;
+    mx_uint *reqs;
+    NDArrayHandle *aux;
+    ExecutorHandle out;
+    int rc;
+  CODE:
+    arg_av = (AV *)SvRV(arg_ref);
+    grad_av = (AV *)SvRV(grad_ref);
+    req_av = (AV *)SvRV(req_ref);
+    aux_av = (AV *)SvRV(aux_ref);
+    n = (mx_uint)(av_len(arg_av) + 1);
+    naux = (mx_uint)(av_len(aux_av) + 1);
+    args = (NDArrayHandle *)malloc((n > 0 ? n : 1) * sizeof(NDArrayHandle));
+    grads = (NDArrayHandle *)malloc((n > 0 ? n : 1)
+                                    * sizeof(NDArrayHandle));
+    reqs = (mx_uint *)malloc((n > 0 ? n : 1) * sizeof(mx_uint));
+    for (i = 0; i < n; ++i) {
+      IV g = SvIV(*av_fetch(grad_av, i, 0));
+      args[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(arg_av, i, 0)));
+      grads[i] = g ? INT2PTR(NDArrayHandle, g) : NULL;
+      reqs[i] = (mx_uint)SvUV(*av_fetch(req_av, i, 0));
+    }
+    aux = (NDArrayHandle *)malloc((naux > 0 ? naux : 1)
+                                  * sizeof(NDArrayHandle));
+    for (i = 0; i < naux; ++i) {
+      aux[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(aux_av, i, 0)));
+    }
+    rc = MXExecutorBind(INT2PTR(SymbolHandle, sym), dev_type, dev_id, n,
+                        args, grads, reqs, naux, aux, &out);
+    free(args);
+    free(grads);
+    free(reqs);
+    free(aux);
+    croak_on_fail(aTHX_ rc, "MXExecutorBind");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_executor_forward(IV handle, int is_train)
+  CODE:
+    croak_on_fail(aTHX_ MXExecutorForward(
+        INT2PTR(ExecutorHandle, handle), is_train), "MXExecutorForward");
+
+void
+mxtpu_executor_backward(IV handle, SV *grads_ref)
+  PREINIT:
+    AV *grads_av;
+    mx_uint n, i;
+    NDArrayHandle *grads;
+    int rc;
+  CODE:
+    grads_av = (AV *)SvRV(grads_ref);
+    n = (mx_uint)(av_len(grads_av) + 1);
+    grads = (NDArrayHandle *)malloc((n > 0 ? n : 1)
+                                    * sizeof(NDArrayHandle));
+    for (i = 0; i < n; ++i) {
+      grads[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(grads_av, i, 0)));
+    }
+    rc = MXExecutorBackward(INT2PTR(ExecutorHandle, handle), n, grads);
+    free(grads);
+    croak_on_fail(aTHX_ rc, "MXExecutorBackward");
+
+void
+mxtpu_executor_outputs(IV handle)
+  PREINIT:
+    mx_uint n, i;
+    NDArrayHandle *outs;
+  PPCODE:
+    croak_on_fail(aTHX_ MXExecutorOutputs(
+        INT2PTR(ExecutorHandle, handle), &n, &outs), "MXExecutorOutputs");
+    EXTEND(SP, n);
+    for (i = 0; i < n; ++i) {
+      mPUSHi(PTR2IV(outs[i]));
+    }
+
+void
+mxtpu_executor_free(IV handle)
+  CODE:
+    MXExecutorFree(INT2PTR(ExecutorHandle, handle));
+
+void
+mxtpu_sym_free(IV handle)
+  CODE:
+    MXSymbolFree(INT2PTR(SymbolHandle, handle));

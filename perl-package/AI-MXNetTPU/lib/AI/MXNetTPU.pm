@@ -2,11 +2,14 @@ package AI::MXNetTPU;
 # AI::MXNetTPU — Perl frontend over the mxnet_tpu C ABI.
 #
 # Reference counterpart: perl-package/AI-MXNet (full trainer API over a
-# SWIG-generated CAPI layer). This package binds the deployment surface
-# — Predictor + parameter loading — through hand-written XS
-# (MXNetTPU.xs) against libmxnet_tpu.so; training lives in the Python
-# frontend, which the reference's Perl users also ultimately drive
-# through the same flat C API.
+# SWIG-generated CAPI layer). This package binds the C ABI through
+# hand-written XS (MXNetTPU.xs) against libmxnet_tpu.so, in two tiers:
+# the deployment surface (Predictor + NDList, below) and the training
+# surface (AI::MXNetTPU::NDArray / Symbol / Executor / Model — device
+# tensors with generic operator invoke, symbol composition with shape
+# inference, gradient executors, and a FeedForward-style fit/score
+# loop over the fused sgd(_mom)_update ops; see t/train.t for the
+# end-to-end learning test).
 use strict;
 use warnings;
 
@@ -14,6 +17,13 @@ our $VERSION = '0.01';
 
 require XSLoader;
 XSLoader::load('AI::MXNetTPU', $VERSION);
+
+# one device-name map for every tier (Predictor/NDArray/Executor)
+my %DEV_CODE = (cpu => 1, gpu => 2, tpu => 2);
+sub dev_code {
+    my ($name) = @_;
+    return $DEV_CODE{ $name // 'cpu' } // 1;
+}
 
 package AI::MXNetTPU::Predictor;
 use strict;
@@ -23,12 +33,11 @@ use warnings;
 #     dev_type => 'cpu'|'tpu', dev_id => 0)
 sub new {
     my ($class, %args) = @_;
-    my %dev = (cpu => 1, gpu => 2, tpu => 2);
     my @names = sort keys %{ $args{input_shapes} };
     my @shapes = map { $args{input_shapes}{$_} } @names;
     my $handle = AI::MXNetTPU::pred_create(
         $args{symbol_json}, $args{params},
-        $dev{ $args{dev_type} // 'cpu' } // 1, $args{dev_id} // 0,
+        AI::MXNetTPU::dev_code($args{dev_type}), $args{dev_id} // 0,
         \@names, \@shapes);
     return bless { handle => $handle }, $class;
 }

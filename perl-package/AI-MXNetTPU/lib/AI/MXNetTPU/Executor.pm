@@ -1,0 +1,59 @@
+package AI::MXNetTPU::Executor;
+# Executor over the C ABI — reference counterpart AI::MXNet::Executor:
+# bind a Symbol with argument/gradient/aux NDArrays, then
+# forward/backward/outputs. grad_req: 0=null, 1=write, 3=add
+# (include/mxnet_tpu/c_api.h MXExecutorBind contract).
+use strict;
+use warnings;
+use AI::MXNetTPU ();
+use AI::MXNetTPU::NDArray ();
+
+my %REQ = (null => 0, write => 1, add => 3);
+
+# bind($symbol, args => [NDArray...], grads => [NDArray|undef...],
+#      reqs => ['write'|'null'|'add'...], aux => [NDArray...],
+#      dev_type => 'cpu', dev_id => 0)
+sub bind {
+    my ($class, $symbol, %spec) = @_;
+    my $args = $spec{args};
+    my $grads = $spec{grads} // [map { undef } @$args];
+    my $reqs = $spec{reqs} // [map { $_ ? 'write' : 'null' } @$grads];
+    my $aux = $spec{aux} // [];
+    my $handle = AI::MXNetTPU::executor_bind(
+        $symbol->{handle},
+        AI::MXNetTPU::dev_code($spec{dev_type}), $spec{dev_id} // 0,
+        [map { $_->{handle} } @$args],
+        [map { defined $_ ? $_->{handle} : 0 } @$grads],
+        [map { $REQ{$_} // $_ } @$reqs],
+        [map { $_->{handle} } @$aux]);
+    return bless { handle => $handle, args => $args, grads => $grads,
+                   aux => $aux, symbol => $symbol }, $class;
+}
+
+sub forward {
+    my ($self, $is_train) = @_;
+    AI::MXNetTPU::executor_forward($self->{handle}, $is_train ? 1 : 0);
+    return $self;   # fetch results via ->outputs (an ABI round-trip)
+}
+
+sub backward {
+    my ($self, $head_grads) = @_;
+    AI::MXNetTPU::executor_backward(
+        $self->{handle},
+        [map { $_->{handle} } @{ $head_grads // [] }]);
+    return $self;
+}
+
+sub outputs {
+    my ($self) = @_;
+    return [map { AI::MXNetTPU::NDArray::_wrap($_) }
+            AI::MXNetTPU::executor_outputs($self->{handle})];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::executor_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

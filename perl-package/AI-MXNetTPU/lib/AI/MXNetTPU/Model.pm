@@ -1,0 +1,155 @@
+package AI::MXNetTPU::Model;
+# FeedForward-style trainer — reference counterpart AI::MXNet::Module /
+# mx.model.FeedForward: infer shapes, init params, bind one executor,
+# loop forward/backward + fused sgd(_mom)_update, score accuracy.
+use strict;
+use warnings;
+use AI::MXNetTPU ();
+use AI::MXNetTPU::NDArray ();
+use AI::MXNetTPU::Symbol ();
+use AI::MXNetTPU::Executor ();
+
+# new(symbol => $sym, data_name => 'data', label_name => 'softmax_label',
+#     dev_type => 'cpu', dev_id => 0)
+sub new {
+    my ($class, %spec) = @_;
+    return bless {
+        symbol     => $spec{symbol},
+        data_name  => $spec{data_name}  // 'data',
+        label_name => $spec{label_name} // 'softmax_label',
+        dev_type   => $spec{dev_type}   // 'cpu',
+        dev_id     => $spec{dev_id}     // 0,
+    }, $class;
+}
+
+sub _bind {
+    my ($self, $batch, $dims) = @_;
+    my $sym = $self->{symbol};
+    my ($arg_shapes, undef, $aux_shapes) = $sym->infer_shape(
+        $self->{data_name} => [$batch, @$dims],
+        $self->{label_name} => [$batch]);
+    my %dev = (dev_type => $self->{dev_type}, dev_id => $self->{dev_id});
+    my (@args, @grads, @reqs, %params, %grads_of);
+    for my $name (@{ $sym->list_arguments }) {
+        my $shape = $arg_shapes->{$name};
+        my $is_input = $name eq $self->{data_name}
+            || $name eq $self->{label_name};
+        my $arr = $is_input
+            ? AI::MXNetTPU::NDArray->zeros($shape, %dev)
+            : AI::MXNetTPU::NDArray->uniform(-0.07, 0.07, $shape, %dev);
+        push @args, $arr;
+        if ($is_input) {
+            push @grads, undef;
+            push @reqs, 'null';
+            $self->{$name eq $self->{data_name} ? 'data_arr'
+                                                : 'label_arr'} = $arr;
+        } else {
+            my $g = AI::MXNetTPU::NDArray->zeros($shape, %dev);
+            push @grads, $g;
+            push @reqs, 'write';
+            $params{$name} = $arr;
+            $grads_of{$name} = $g;
+        }
+    }
+    my @aux = map { AI::MXNetTPU::NDArray->zeros($aux_shapes->{$_}, %dev) }
+        @{ $sym->list_auxiliary_states };
+    $self->{params} = \%params;
+    $self->{grads} = \%grads_of;
+    $self->{moms} = { map {
+        $_ => AI::MXNetTPU::NDArray->zeros($params{$_}->shape, %dev)
+    } keys %params };
+    $self->{exec} = AI::MXNetTPU::Executor->bind(
+        $sym, args => \@args, grads => \@grads, reqs => \@reqs,
+        aux => \@aux, %dev);
+    return $self;
+}
+
+# load batch b into the bound data/label arrays; a short tail batch is
+# padded by wrapping around the dataset (reference NDArrayIter 'roll
+# over' behavior). Returns the labels loaded and the real-row count.
+sub _load_batch {
+    my ($self, $X, $y, $b, $bs) = @_;
+    my (@xb, @yb);
+    my $real = 0;
+    for my $k (0 .. $bs - 1) {
+        my $i = $b * $bs + $k;
+        ++$real if $i < @$X;
+        $i %= @$X;
+        push @xb, @{ $X->[$i] };
+        push @yb, $y->[$i];
+    }
+    $self->{data_arr}->set(\@xb);
+    $self->{label_arr}->set(\@yb);
+    return (\@yb, $real);
+}
+
+sub _nbatches {
+    my ($n, $bs) = @_;
+    return int(($n + $bs - 1) / $bs);
+}
+
+# fit(data => \@rows (each a flat feature list), label => \@labels,
+#     batch_size => N, lr => 0.1, momentum => 0.9, epochs => E)
+sub fit {
+    my ($self, %spec) = @_;
+    my ($X, $y) = @spec{qw(data label)};
+    my $bs = $spec{batch_size} // 32;
+    my $lr = $spec{lr} // 0.1;
+    my $mom = $spec{momentum} // 0.9;
+    my $dims = $spec{dims} // [scalar @{ $X->[0] }];
+    if ($self->{exec}) {
+        my $bound = $self->{data_arr}->shape;
+        my @want = ($bs, @$dims);
+        if ("@$bound" ne "@want") {
+            die "fit: already bound for batch shape [@$bound], "
+              . "got batch_size/dims [@want] — create a new Model "
+              . "to change shapes\n";
+        }
+    } else {
+        $self->_bind($bs, $dims);
+    }
+    for my $epoch (1 .. ($spec{epochs} // 5)) {
+        for my $b (0 .. _nbatches(scalar @$X, $bs) - 1) {
+            $self->_load_batch($X, $y, $b, $bs);
+            $self->{exec}->forward(1);
+            $self->{exec}->backward([]);
+            for my $name (sort keys %{ $self->{params} }) {
+                # fused optimizer op, in-place on (weight, mom) — the
+                # same sgd_mom_update kernel the python frontend calls
+                AI::MXNetTPU::NDArray::invoke(
+                    'sgd_mom_update',
+                    [$self->{params}{$name}, $self->{grads}{$name},
+                     $self->{moms}{$name}],
+                    { lr => $lr, momentum => $mom },
+                    [$self->{params}{$name}, $self->{moms}{$name}]);
+            }
+        }
+    }
+    return $self;
+}
+
+# score(data => ..., label => ...): accuracy of output 0's argmax over
+# ALL samples (tail batch padded by wraparound, padding rows uncounted)
+sub score {
+    my ($self, %spec) = @_;
+    my ($X, $y) = @spec{qw(data label)};
+    my $bs = $self->{data_arr}->shape->[0];
+    my ($correct, $total) = (0, 0);
+    for my $b (0 .. _nbatches(scalar @$X, $bs) - 1) {
+        my ($yb, $real) = $self->_load_batch($X, $y, $b, $bs);
+        my $probs = $self->{exec}->forward(0)->outputs->[0]->aslist;
+        my $ncls = @$probs / $bs;
+        for my $i (0 .. $real - 1) {
+            my ($best, $besti) = (-1e30, 0);
+            for my $c (0 .. $ncls - 1) {
+                my $v = $probs->[$i * $ncls + $c];
+                ($best, $besti) = ($v, $c) if $v > $best;
+            }
+            ++$correct if $besti == $yb->[$i];
+            ++$total;
+        }
+    }
+    return $total ? $correct / $total : 0;
+}
+
+1;

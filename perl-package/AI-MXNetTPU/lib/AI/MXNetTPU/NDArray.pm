@@ -1,0 +1,123 @@
+package AI::MXNetTPU::NDArray;
+# NDArray over the C ABI — reference counterpart AI::MXNet::NDArray
+# (perl-package/AI-MXNet/lib/AI/MXNet/NDArray.pm): device tensors with
+# construction from perl arrays, readback, and every registry operator
+# reachable through one generic invoke (MXImperativeInvoke).
+use strict;
+use warnings;
+use AI::MXNetTPU ();
+
+use overload
+    '+' => sub { _binop('broadcast_add',   @_) },
+    '-' => sub { _binop('broadcast_sub',   @_) },
+    '*' => sub { _binop('broadcast_mul',   @_) },
+    '/' => sub { _binop('broadcast_div',   @_) },
+    '""' => sub { 'AI::MXNetTPU::NDArray' . '@' . $_[0]->{handle} };
+
+my %OP_CACHE;
+
+sub _op {
+    my ($name) = @_;
+    $OP_CACHE{$name} //= AI::MXNetTPU::op_handle($name);
+    return $OP_CACHE{$name};
+}
+
+sub _wrap {
+    my ($handle) = @_;
+    return bless { handle => $handle, owned => 1 }, __PACKAGE__;
+}
+
+# new(shape => [..], dev_type => 'cpu'|'tpu', dev_id => 0)
+sub new {
+    my ($class, %args) = @_;
+    my $handle = AI::MXNetTPU::nd_create(
+        $args{shape}, AI::MXNetTPU::dev_code($args{dev_type}),
+        $args{dev_id} // 0);
+    return _wrap($handle);
+}
+
+sub from_array {
+    my ($class, $data, $shape, %args) = @_;
+    my $self = $class->new(shape => $shape, %args);
+    AI::MXNetTPU::nd_copy_from($self->{handle}, $data);
+    return $self;
+}
+
+sub zeros {
+    my ($class, $shape, %args) = @_;
+    my $n = 1;
+    $n *= $_ for @$shape;
+    return $class->from_array([(0) x $n], $shape, %args);
+}
+
+sub ones {
+    my ($class, $shape, %args) = @_;
+    my $n = 1;
+    $n *= $_ for @$shape;
+    return $class->from_array([(1) x $n], $shape, %args);
+}
+
+# uniform(low, high, shape): host-side RNG (perl rand), device storage —
+# initialization-grade randomness, seeded via `srand` by the caller
+sub uniform {
+    my ($class, $low, $high, $shape, %args) = @_;
+    my $n = 1;
+    $n *= $_ for @$shape;
+    my @data = map { $low + rand() * ($high - $low) } 1 .. $n;
+    return $class->from_array(\@data, $shape, %args);
+}
+
+sub shape  { my ($self) = @_; return [AI::MXNetTPU::nd_shape($self->{handle})]; }
+sub size   { my $n = 1; $n *= $_ for @{ $_[0]->shape }; return $n; }
+sub aslist { my ($self) = @_; return [AI::MXNetTPU::nd_to_array($self->{handle})]; }
+sub set    { my ($self, $data) = @_; AI::MXNetTPU::nd_copy_from($self->{handle}, $data); return $self; }
+
+# invoke('op_name', [in NDArrays], {str params}, [out NDArrays]?) — every
+# registered operator, by name; with outs given the op writes in place
+# (the fused sgd_update pattern), else it allocates and returns wrappers
+sub invoke {
+    my ($name, $ins, $params, $outs) = @_;
+    $params //= {};
+    $outs   //= [];
+    my @keys = sort keys %$params;
+    my @vals = map { "" . $params->{$_} } @keys;
+    my @out_handles = AI::MXNetTPU::imperative_invoke(
+        _op($name),
+        [map { $_->{handle} } @$ins],
+        [map { $_->{handle} } @$outs],
+        \@keys, \@vals);
+    if (@$outs) {
+        # in-place path: results live in the provided arrays; the ABI
+        # still INCREFs every returned handle (caller-owns contract,
+        # capi/c_api.cpp MXImperativeInvoke), so drop those refs here
+        AI::MXNetTPU::nd_free($_) for @out_handles;
+        return @$outs;
+    }
+    return map { _wrap($_) } @out_handles;
+}
+
+sub _binop {
+    my ($op, $self, $other, $swap) = @_;
+    if (!ref $other) {
+        my %sc = (broadcast_add => '_plus_scalar',
+                  broadcast_sub => $swap ? '_rminus_scalar' : '_minus_scalar',
+                  broadcast_mul => '_mul_scalar',
+                  broadcast_div => $swap ? '_rdiv_scalar' : '_div_scalar');
+        my ($out) = invoke($sc{$op}, [$self], { scalar => $other });
+        return $out;
+    }
+    my @args = $swap ? ($other, $self) : ($self, $other);
+    my ($out) = invoke($op, \@args, {});
+    return $out;
+}
+
+sub wait_all { AI::MXNetTPU::nd_wait_all(); }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::nd_free($self->{handle})
+        if $self->{owned} && $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

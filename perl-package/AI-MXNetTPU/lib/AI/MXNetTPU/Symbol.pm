@@ -1,0 +1,73 @@
+package AI::MXNetTPU::Symbol;
+# Symbol graph construction over the C ABI — reference counterpart
+# AI::MXNet::Symbol: Variables, operator application by name
+# (CreateAtomicSymbol + Compose), JSON save/load, shape inference.
+use strict;
+use warnings;
+use AI::MXNetTPU ();
+
+sub _wrap { my ($h) = @_; return bless { handle => $h }, __PACKAGE__; }
+
+sub Variable {
+    my ($class, $name) = @_;
+    return _wrap(AI::MXNetTPU::sym_variable($name));
+}
+
+sub load_json {
+    my ($class, $json) = @_;
+    return _wrap(AI::MXNetTPU::sym_from_json($json));
+}
+
+sub tojson { my ($self) = @_; return AI::MXNetTPU::sym_to_json($self->{handle}); }
+
+# create('FullyConnected', name => 'fc1', args => {data=>$sym,...} | [..],
+#        attrs => {num_hidden => 8, ...})
+sub create {
+    my ($class, $op, %spec) = @_;
+    my $attrs = $spec{attrs} // {};
+    my @keys = sort keys %$attrs;
+    my @vals = map { "" . $attrs->{$_} } @keys;
+    my $sym = _wrap(AI::MXNetTPU::sym_atomic($op, \@keys, \@vals));
+    my $args = $spec{args} // {};
+    my (@arg_keys, @arg_handles);
+    if (ref $args eq 'HASH') {
+        for my $k (sort keys %$args) {
+            push @arg_keys, $k;
+            push @arg_handles, $args->{$k}{handle};
+        }
+    } else {
+        @arg_handles = map { $_->{handle} } @$args;
+    }
+    AI::MXNetTPU::sym_compose($sym->{handle}, $spec{name} // $op,
+                              \@arg_keys, \@arg_handles);
+    return $sym;
+}
+
+sub list_arguments { my ($s) = @_; return [AI::MXNetTPU::sym_list_arguments($s->{handle})]; }
+sub list_outputs   { my ($s) = @_; return [AI::MXNetTPU::sym_list_outputs($s->{handle})]; }
+sub list_auxiliary_states { my ($s) = @_; return [AI::MXNetTPU::sym_list_aux($s->{handle})]; }
+
+# infer_shape(data => [batch, dims...], ...) ->
+#   ({arg_name=>shape}, [out shapes], {aux_name=>shape})
+sub infer_shape {
+    my ($self, %shapes) = @_;
+    my @names = sort keys %shapes;
+    my @dims = map { $shapes{$_} } @names;
+    my ($in, $out, $aux) = AI::MXNetTPU::sym_infer_shape(
+        $self->{handle}, \@names, \@dims);
+    my $argn = $self->list_arguments;
+    my $auxn = $self->list_auxiliary_states;
+    my %arg_shapes;
+    @arg_shapes{@$argn} = @$in;
+    my %aux_shapes;
+    @aux_shapes{@$auxn} = @$aux;
+    return (\%arg_shapes, $out, \%aux_shapes);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::sym_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

