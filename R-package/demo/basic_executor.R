@@ -1,0 +1,19 @@
+# Hand-driven executor: bind with gradients, forward, backward, read
+# the gradient. Reference counterpart: demo/basic_executor.R.
+require(mxnet.tpu)
+
+data <- mx.symbol.Variable("data")
+fc <- mx.symbol.FullyConnected(data, num_hidden = 4, name = "fc")
+net <- mx.symbol.SoftmaxOutput(fc, name = "softmax")
+
+# R dim order, batch last: 6 features, batch 8
+exec <- mx.simple.bind(net, ctx = mx.cpu(), data = c(6, 8),
+                       softmax_label = c(8))
+mx.exec.update.arg.arrays(exec, list(
+  data = mx.nd.array(array(runif(48), dim = c(6, 8))),
+  softmax_label = mx.nd.array(rep(0, 8))))
+
+mx.exec.forward(exec, is.train = TRUE)
+out <- mx.exec.outputs(exec)[[1]]
+print(dim(out))
+mx.exec.backward(exec)

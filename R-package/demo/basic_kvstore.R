@@ -1,0 +1,17 @@
+# KVStore: init/push/pull and a custom R updater closure driven from the
+# store. Reference counterpart: demo/basic_kvstore.R.
+require(mxnet.tpu)
+
+kv <- mx.kv.create("local")
+mx.kv.init(kv, 3, list(mx.nd.ones(c(2, 2))))
+mx.kv.push(kv, 3, list(mx.nd.ones(c(2, 2))))
+out <- mx.nd.zeros(c(2, 2))
+mx.kv.pull(kv, 3, list(out))
+print(as.array(out))
+
+mx.kv.set.updater(kv, function(key, recv, local) {
+  local + recv * 0.5
+})
+mx.kv.push(kv, 3, list(mx.nd.ones(c(2, 2))))
+mx.kv.pull(kv, 3, list(out))
+print(as.array(out))

@@ -1,0 +1,26 @@
+# FeedForward end to end: train a small MLP on a separable task and
+# score it. Reference counterpart: demo/basic_model.R.
+# R dim convention (as in the reference R package): batch on the LAST
+# R dimension — X is features x n.
+require(mxnet.tpu)
+
+mx.set.seed(0)
+n <- 128
+X <- array(runif(6 * n), dim = c(6, n))
+y <- as.numeric(X[1, ] > 0.5)
+
+data <- mx.symbol.Variable("data")
+fc1 <- mx.symbol.FullyConnected(data, num_hidden = 16, name = "fc1")
+act <- mx.symbol.Activation(fc1, act_type = "relu", name = "relu1")
+fc2 <- mx.symbol.FullyConnected(act, num_hidden = 2, name = "fc2")
+net <- mx.symbol.SoftmaxOutput(fc2, name = "softmax")
+
+model <- mx.model.FeedForward.create(
+  net, X = X, y = y, ctx = mx.cpu(), num.round = 10,
+  array.batch.size = 32, learning.rate = 0.05, momentum = 0.9,
+  initializer = mx.init.Xavier(), verbose = FALSE)
+
+pred <- predict(model, X)      # classes x n
+acc <- mean(max.col(t(pred)) - 1 == y)
+cat("train accuracy:", acc, "\n")
+stopifnot(acc > 0.85)

@@ -1,0 +1,17 @@
+# Device tensors from R: creation, arithmetic through the op registry,
+# host readback. Reference counterpart: demo/basic_ndarray.R.
+require(mxnet.tpu)
+
+a <- mx.nd.array(array(1:6, dim = c(2, 3)))
+b <- mx.nd.ones(c(2, 3))
+print(dim(a))
+
+c <- a + b * 2
+print(as.array(c))
+
+d <- mx.nd.internal.invoke("transpose", list(a), list())[[1]]
+print(dim(d))
+
+s <- mx.nd.internal.invoke("sum", list(a), list())[[1]]
+print(as.array(s))
+mx.nd.waitall()

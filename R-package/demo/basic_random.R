@@ -1,0 +1,11 @@
+# Seeded device RNG. Reference counterpart: demo/basic_random.R.
+require(mxnet.tpu)
+
+mx.set.seed(42)
+a <- mx.runif(c(2, 3), min = 0, max = 1)
+mx.set.seed(42)
+b <- mx.runif(c(2, 3), min = 0, max = 1)
+stopifnot(identical(as.array(a), as.array(b)))
+
+n <- mx.rnorm(c(1000), mean = 0, sd = 1)
+cat("sample mean:", mean(as.array(n)), "\n")

