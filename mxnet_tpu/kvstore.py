@@ -7,10 +7,13 @@ ZMQ parameter server for multi-host (kvstore_dist.h); here
 * ``local``/``device``: per-device gradients are summed with jnp adds (XLA
   emits the all-reduce; on one chip it's a fused sum) and broadcast back by
   device_put — no staging buffers, no P2P management;
-* ``dist_sync``/``dist_device_sync``/``dist_async``: multi-process sums ride
+* ``dist_sync``/``dist_device_sync``: multi-process sums ride
   ``parallel.dist`` (jax.distributed + psum over ICI/DCN); on a single
   process they degrade to ``local`` with rank 0 / size 1 — exactly how the
   reference's tests exercise dist semantics locally (SURVEY.md §4);
+* ``dist_async``: same collectives, staleness-1 — each push dispatches the
+  current reduction and applies the previous one, so no rank stalls on a
+  straggler (see ``create()``'s design note);
 * the server processes, heartbeats and barrier of ps-lite disappear; the
   KVStore *API* (init/push/pull/set_optimizer/rank/num_workers/barrier)
   stays for compatibility (include/mxnet/kvstore.h:26-303).
@@ -52,6 +55,9 @@ class KVStore(object):
         self._optimizer = None
         self._barrier_before_exit = True
         self._compress = "none"
+        # dist_async: per-key in-flight reduction from the PREVIOUS push
+        # (staleness-1 delayed application; see push())
+        self._pending = {}
         if kind.startswith("dist"):
             from .parallel import dist as _dist
             self._dist = _dist.get_runtime()
@@ -93,10 +99,34 @@ class KVStore(object):
                     merged += other.as_in_context(merged.context)
             else:
                 merged = v.copy()
-            if self._dist is not None:
-                merged = self._dist.allreduce(merged)
             if k not in self._store:
                 raise MXNetError("please init key %s first" % str(k))
+            if self._kind == "dist_async" and self._dist is not None:
+                # staleness-1 delayed application — the TPU-native form
+                # of the reference's async mode (kvstore_dist_server.h
+                # applies pushes on arrival, unordered; SPMD collectives
+                # are inherently barriers, so instead of dropping the
+                # barrier we move it one step back): DISPATCH this
+                # step's cross-worker reduction (allreduce_async — the
+                # enqueue returns immediately) and apply the PREVIOUS
+                # step's, whose materialization has had a whole step of
+                # compute to complete — so no rank stalls in push() on a
+                # straggler's in-flight gradient. Deterministic (fixed
+                # staleness, fixed reduction order), unlike the
+                # reference's async. Cold start: the first push applies
+                # a zero gradient; the final reduction is applied at the
+                # closing barrier() (flush below), so every gradient is
+                # eventually applied exactly once.
+                pending = self._pending.get(k)
+                self._pending[k] = self._dist.allreduce_async(merged)
+                effective = pending() if pending is not None else merged * 0
+                if self._updater is not None:
+                    self._updater(k, effective, self._store[k])
+                else:
+                    self._store[k] = effective
+                continue
+            if self._dist is not None:
+                merged = self._dist.allreduce(merged)
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
@@ -134,6 +164,16 @@ class KVStore(object):
 
     # -------------------------------------------------------- dist compat
     def barrier(self):
+        # dist_async: a barrier is the quiesce point — flush the in-flight
+        # staleness-1 reductions so no trailing gradient is ever lost
+        # (push() comment; the exit barrier drains end-of-training state)
+        if self._pending:
+            for k in sorted(self._pending, key=str):
+                effective = self._pending.pop(k)()
+                if self._updater is not None:
+                    self._updater(k, effective, self._store[k])
+                else:
+                    self._store[k] = effective
         if self._dist is not None:
             self._dist.barrier()
 
@@ -169,14 +209,22 @@ def create(name="local"):
     """Create a KVStore: local | device | dist_sync | dist_device_sync |
     dist_async (KVStore::Create, src/kvstore/kvstore.cc:17-45).
 
-    Design note: the reference's ``dist_async`` lets each worker's update
-    land on the parameter server unsynchronized (straggler tolerance at
-    the price of non-determinism, kvstore_dist.h). Here EVERY dist mode
-    synchronizes through XLA collectives over ICI/DCN — the collective is
-    the native TPU mechanism and is itself a sync point — so dist_async
-    provides the same deterministic bitwise-reproducible semantics as
-    dist_sync. Code written for the reference's async mode runs
-    unchanged; it simply gets the stronger guarantee."""
+    Design note on ``dist_async``: the reference's async mode lets each
+    worker's update land on the parameter server unsynchronized —
+    straggler tolerance bought with non-determinism
+    (kvstore_dist_server.h:136-229). SPMD collectives are inherently
+    barriers, so the TPU-native equivalent moves the barrier one step
+    back instead of dropping it: each ``push`` *dispatches* the current
+    gradient's cross-worker reduction and *applies the previous one*
+    (staleness-1 delayed SGD). No rank ever waits on a straggler's
+    in-flight gradient — the async mode's purpose — while results stay
+    bitwise deterministic and rank-identical, which the reference's
+    async never was. Cold start: the first push applies a zero
+    gradient; the final in-flight reduction is flushed at ``barrier()``
+    (the exit barrier drains end-of-training state), so every gradient
+    is applied exactly once, one step late. Convergence behavior is
+    that of one-step-delayed SGD.
+    ``dist_sync``/``dist_device_sync`` are the exact synchronous path."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "device", "local_allreduce_device",

@@ -34,13 +34,27 @@ class DistRuntime:
 
     def allreduce(self, ndarray):
         """Sum an NDArray across all processes (== dist_sync push+pull)."""
+        return self.allreduce_async(ndarray)()
+
+    def allreduce_async(self, ndarray):
+        """Dispatch the cross-process sum and return a zero-arg thunk
+        that materializes it.
+
+        The dispatch enqueues the collective and returns immediately;
+        only the MATERIALIZATION (reading the result) blocks on the
+        slowest rank. dist_async's staleness-1 schedule exploits
+        exactly this: it materializes each reduction one push later, so
+        the intervening step's compute overlaps the collective and no
+        rank stalls in push() on a straggler's in-flight gradient."""
         if self.size == 1:
-            return ndarray
+            return lambda: ndarray
+
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = self._global_mesh()
         val = ndarray._read()
+        ctx = ndarray.context
         # replicate local value onto the global mesh, psum across hosts
         arr = jax.make_array_from_process_local_data(
             NamedSharding(mesh, P("hosts")),
@@ -50,15 +64,20 @@ class DistRuntime:
         def _sum(x):
             return jnp.sum(x, axis=0)
 
-        out = _sum(arr)  # global array, replicated across processes
-        # hand back a PROCESS-LOCAL array (the kvstore mixes it with
-        # local weights in updaters); our shard of the replicated result
-        # is the full value
-        import numpy as onp
-        local = jax.device_put(onp.asarray(out.addressable_shards[0].data),
-                               ndarray.context.jax_device())
-        from ..ndarray import NDArray
-        return NDArray(local, ctx=ndarray.context)
+        out = _sum(arr)  # global array, replicated; execution async
+
+        def materialize():
+            # hand back a PROCESS-LOCAL array (the kvstore mixes it
+            # with local weights in updaters); our shard of the
+            # replicated result is the full value
+            import numpy as onp
+            local = jax.device_put(
+                onp.asarray(out.addressable_shards[0].data),
+                ctx.jax_device())
+            from ..ndarray import NDArray
+            return NDArray(local, ctx=ctx)
+
+        return materialize
 
     @property
     def _client(self):
