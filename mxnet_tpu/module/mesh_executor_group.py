@@ -176,6 +176,13 @@ class MeshExecutorGroup(object):
         self._jits = {}
         self._pending = None     # (inputs dict of device arrays, is_train)
         self._outputs_from = None  # "fwd" | "bwd"
+        # device-side metric tally (enable_device_metric): the fused train
+        # step accumulates (sum, count) rows on device; metric.get() drains
+        # them with ONE readback instead of one per batch
+        self._metric_stat = None
+        self._metric_live = None
+        self._metric_acc = None
+        self._metric_step_done = False
 
         self.bind_exec(data_shapes, label_shapes)
 
@@ -410,8 +417,14 @@ class MeshExecutorGroup(object):
             # ~5 ms on remote-attached chips). fa is the optimizer's pure
             # per-param apply; params/states donate for in-place HBM.
             fa = self._step_fa
+            # ':m<token>' kinds fold the metric statistic into the same
+            # program: macc rides along as a donated (n_slots, 2) tally,
+            # so a real fit(eval_metric=...) loop costs zero extra
+            # launches and zero per-batch readbacks (VERDICT r4 #1)
+            mstat = self._metric_stat if ":m" in kind else None
+            mlabels = list(self._label_names)
 
-            def train_step(params, aux, states, inputs, rng, lrs, wds):
+            def step_math(params, aux, states, inputs, rng, lrs, wds):
                 import jax.numpy as jnp
                 outs, new_aux, grads = fwd_bwd_math(params, aux, inputs,
                                                     rng)
@@ -427,14 +440,37 @@ class MeshExecutorGroup(object):
             # no donation on cpu: device_put is zero-copy there, so user-
             # visible host arrays can alias the param buffers (the classic
             # update path gates donation the same way)
-            fn = jax.jit(
-                train_step,
-                # states: committed per-leaf in step_update (momentum etc.
-                # shard like their param); None = follow the argument
-                in_shardings=(psh, repl, None, batch, None, None, None),
-                out_shardings=(self._out_shardings, repl, gsh, psh,
-                               None),
-                donate_argnums=(0, 2) if self._platform != "cpu" else ())
+            donate = (0, 2) if self._platform != "cpu" else ()
+            if mstat is None:
+                fn = jax.jit(
+                    step_math,
+                    # states: committed per-leaf in step_update (momentum
+                    # etc. shard like their param); None = follow the arg
+                    in_shardings=(psh, repl, None, batch, None, None,
+                                  None),
+                    out_shardings=(self._out_shardings, repl, gsh, psh,
+                                   None),
+                    donate_argnums=donate)
+            else:
+                def train_step(params, aux, states, inputs, rng, lrs,
+                               wds, macc):
+                    import jax.numpy as jnp
+                    outs, new_aux, grads, new_params, new_states = \
+                        step_math(params, aux, states, inputs, rng, lrs,
+                                  wds)
+                    rows = mstat(jnp, [inputs[n] for n in mlabels], outs)
+                    if isinstance(rows, tuple):
+                        rows = jnp.stack(rows)[None, :]
+                    return (outs, new_aux, grads, new_params, new_states,
+                            macc + rows)
+
+                fn = jax.jit(
+                    train_step,
+                    in_shardings=(psh, repl, None, batch, None, None,
+                                  None, repl),
+                    out_shardings=(self._out_shardings, repl, gsh, psh,
+                                   None, repl),
+                    donate_argnums=donate + ((7,) if donate else ()))
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
 
@@ -665,7 +701,10 @@ class MeshExecutorGroup(object):
         token = getattr(opt, "_mxtpu_step_token", None)
         if token is None:
             token = opt._mxtpu_step_token = next(_STEP_TOKENS)
-        fn = self._get_jit("train_step:%s:%d" % (type(opt).__name__, token))
+        kind = "train_step:%s:%d" % (type(opt).__name__, token)
+        if self._metric_stat is not None:
+            kind += ":m%d" % self._metric_token
+        fn = self._get_jit(kind)
         params = {n: b._read() for n, b in self._param_dict.items()}
         # pre-forward aux snapshot (same contract as _run_fwd_bwd): if the
         # forward already materialized, _aux_dict holds post-EMA stats —
@@ -674,12 +713,23 @@ class MeshExecutorGroup(object):
             else {n: b._read() for n, b in self._aux_dict.items()}
         args = (params, aux, tuple(states), inputs, rng,
                 np.asarray(lrs, np.float32), np.asarray(wds, np.float32))
+        if self._metric_stat is not None:
+            if self._metric_acc is None:
+                self._metric_acc = jax.device_put(
+                    onp.zeros((self._metric_slots, 2), onp.float32),
+                    self._repl)
+            args = args + (self._metric_acc,)
         # aval skeleton for diagnostics (bench cost analysis) — the real
         # buffers are donated below and unusable afterwards
         self._last_step = (fn, jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
             if hasattr(a, "shape") else a, args))
-        outs, new_aux, grads, new_params, new_states = fn(*args)
+        if self._metric_stat is not None:
+            (outs, new_aux, grads, new_params, new_states,
+             self._metric_acc) = fn(*args)
+            self._metric_step_done = True
+        else:
+            outs, new_aux, grads, new_params, new_states = fn(*args)
         self._write_outs(outs)
         self._write_aux(new_aux)
         for n, g in grads.items():
@@ -712,7 +762,53 @@ class MeshExecutorGroup(object):
         raise MXNetError("inputs_need_grad is not supported on the fused "
                          "mesh path; set MXNET_MODULE_FUSED=0")
 
+    # -- device-side metric tally --------------------------------------
+    def enable_device_metric(self, eval_metric):
+        """Fold ``eval_metric``'s statistic into the one-program train step.
+
+        TPU-first redesign of the reference's per-batch metric feed
+        (executor_group.py:510 + base_module.py fit loop): there every
+        batch pays an ``asnumpy`` device->host readback, which costs
+        ~100ms on this transport (note_measurement.md) and would collapse
+        ``fit`` throughput ~25x. Here the jitted step accumulates
+        ``(sum, count)`` rows in a donated device tally; ``get()`` drains
+        it with one readback at epoch end / Speedometer tick. Installed by
+        ``Module.fit`` only — raw-loop users keep exact host semantics.
+        Returns True when installed (metric decomposable + fused step on).
+        """
+        if not getattr(self, "_step_enabled", False) or \
+                not self.for_training or not self._label_names:
+            return False
+        stat = eval_metric.fused_stat()
+        if stat is None:
+            return False
+        if self._metric_live is not None and \
+                self._metric_live is not eval_metric:
+            self._metric_live._unbind_device_tally()
+        self._metric_stat = stat
+        self._metric_slots = getattr(stat, "n_slots", 1)
+        self._metric_live = eval_metric
+        self._metric_token = next(_STEP_TOKENS)
+        self._metric_step_done = False
+        self._metric_acc = None  # zeroed lazily at the next step
+        eval_metric._bind_device_tally(self._read_metric_tally,
+                                       self._zero_metric_tally)
+        return True
+
+    def _read_metric_tally(self):
+        if self._metric_acc is None:
+            return onp.zeros((self._metric_slots, 2), onp.float32)
+        return onp.asarray(self._metric_acc)
+
+    def _zero_metric_tally(self):
+        self._metric_acc = None
+
     def update_metric(self, eval_metric, labels):
+        if eval_metric is self._metric_live and self._metric_step_done:
+            # this batch's statistic was accumulated on device inside the
+            # fused train step — nothing to do host-side
+            self._metric_step_done = False
+            return
         eval_metric.update(labels, self.get_outputs())
 
     def install_monitor(self, mon):

@@ -580,6 +580,14 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
+    def _install_device_metric(self, eval_metric):
+        import os
+        if os.environ.get("MXNET_DEVICE_METRIC", "1") == "0":
+            return
+        grp = self._exec_group
+        if getattr(grp, "fused", False):
+            grp.enable_device_metric(eval_metric)
+
     def _sync_params_from_devices(self):
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
