@@ -34,6 +34,25 @@ from .ndarray import NDArray
 __all__ = ["KVStore", "create"]
 
 
+def _drain_pending(ctx):
+    """Finalizer body for dist_async stores (no ref to the store itself):
+    apply still-in-flight reductions, best-effort — the dist backend may
+    already be torn down at interpreter exit."""
+    if not ctx["enabled"]:
+        return
+    pending, store = ctx["pending"], ctx["store"]
+    for k in sorted(list(pending), key=str):
+        thunk = pending.pop(k)
+        try:
+            effective = thunk()
+            if ctx["updater"] is not None:
+                ctx["updater"](k, effective, store[k])
+            else:
+                store[k] = effective
+        except Exception:  # pragma: no cover - teardown race
+            return
+
+
 def _key_list(key):
     return key if isinstance(key, (list, tuple)) else [key]
 
@@ -63,6 +82,18 @@ class KVStore(object):
             self._dist = _dist.get_runtime()
         else:
             self._dist = None
+        if kind == "dist_async":
+            # exit safety net for the staleness-1 schedule: drain any
+            # still-in-flight reduction when the store is collected or the
+            # interpreter exits, honoring set_barrier_before_exit — so the
+            # 'every gradient applied exactly once' contract holds even
+            # for loops that never call barrier() themselves
+            import weakref
+            self._flush_ctx = {"pending": self._pending,
+                               "store": self._store,
+                               "updater": None, "enabled": True}
+            self._flush_finalizer = weakref.finalize(
+                self, _drain_pending, self._flush_ctx)
 
     # ------------------------------------------------------------- basics
     @property
@@ -113,13 +144,18 @@ class KVStore(object):
                 # compute to complete — so no rank stalls in push() on a
                 # straggler's in-flight gradient. Deterministic (fixed
                 # staleness, fixed reduction order), unlike the
-                # reference's async. Cold start: the first push applies
-                # a zero gradient; the final reduction is applied at the
-                # closing barrier() (flush below), so every gradient is
-                # eventually applied exactly once.
+                # reference's async. Cold start: the first push only
+                # dispatches (no update runs before the first gradient
+                # lands, matching the reference's apply-on-arrival); the
+                # final reduction is applied at the closing barrier() —
+                # reached via Module.fit's end-of-training drain or the
+                # exit finalizer (set_barrier_before_exit) — so every
+                # gradient is applied exactly once.
                 pending = self._pending.get(k)
                 self._pending[k] = self._dist.allreduce_async(merged)
-                effective = pending() if pending is not None else merged * 0
+                if pending is None:
+                    continue
+                effective = pending()
                 if self._updater is not None:
                     self._updater(k, effective, self._store[k])
                 else:
@@ -153,6 +189,8 @@ class KVStore(object):
 
     def _set_updater(self, updater):
         self._updater = updater
+        if hasattr(self, "_flush_ctx"):
+            self._flush_ctx["updater"] = updater
 
     def _send_command_to_servers(self, head, body):
         """With no server processes, commands loop back to a controller
@@ -182,6 +220,8 @@ class KVStore(object):
 
     def set_barrier_before_exit(self, barrier_before_exit):
         self._barrier_before_exit = barrier_before_exit
+        if hasattr(self, "_flush_ctx"):
+            self._flush_ctx["enabled"] = bool(barrier_before_exit)
 
     @property
     def num_dead_node(self):

@@ -171,14 +171,14 @@ class CompositeEvalMetric(EvalMetric):
 
     def fused_stat(self):
         # flattened leaf rows so nested composites line up with the
-        # recursive _fold_tally / _n_slots row layout
+        # recursive _fold_tally / _n_slots row layout; returns a LIST of
+        # per-leaf (sum, count) pairs
         stats = self._leaf_stats()
         if not stats or any(s is None for s in stats):
             return None
 
         def stat(jnp, labels, preds):
-            rows = [jnp.stack(s(jnp, labels, preds)) for s in stats]
-            return jnp.stack(rows)
+            return [s(jnp, labels, preds) for s in stats]
 
         stat.n_slots = len(stats)
         return stat

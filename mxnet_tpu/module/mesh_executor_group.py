@@ -460,16 +460,23 @@ class MeshExecutorGroup(object):
                                   wds)
                     rows = mstat(jnp, [inputs[n] for n in mlabels], outs)
                     if isinstance(rows, tuple):
-                        rows = jnp.stack(rows)[None, :]
+                        rows = [rows]
+                    sums, counts = macc
+                    # counts ride int32, not f32: a float tally would stop
+                    # incrementing past 2^24 samples between drains
+                    sums = sums + jnp.stack([jnp.asarray(s, jnp.float32)
+                                             for s, _ in rows])
+                    counts = counts + jnp.stack(
+                        [jnp.asarray(c, jnp.int32) for _, c in rows])
                     return (outs, new_aux, grads, new_params, new_states,
-                            macc + rows)
+                            (sums, counts))
 
                 fn = jax.jit(
                     train_step,
                     in_shardings=(psh, repl, None, batch, None, None,
-                                  None, repl),
+                                  None, (repl, repl)),
                     out_shardings=(self._out_shardings, repl, gsh, psh,
-                                   None, repl),
+                                   None, (repl, repl)),
                     donate_argnums=donate + ((7,) if donate else ()))
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
@@ -715,9 +722,11 @@ class MeshExecutorGroup(object):
                 np.asarray(lrs, np.float32), np.asarray(wds, np.float32))
         if self._metric_stat is not None:
             if self._metric_acc is None:
-                self._metric_acc = jax.device_put(
-                    onp.zeros((self._metric_slots, 2), onp.float32),
-                    self._repl)
+                self._metric_acc = (
+                    jax.device_put(onp.zeros(self._metric_slots,
+                                             onp.float32), self._repl),
+                    jax.device_put(onp.zeros(self._metric_slots,
+                                             onp.int32), self._repl))
             args = args + (self._metric_acc,)
         # aval skeleton for diagnostics (bench cost analysis) — the real
         # buffers are donated below and unusable afterwards
@@ -776,15 +785,15 @@ class MeshExecutorGroup(object):
         ``Module.fit`` only — raw-loop users keep exact host semantics.
         Returns True when installed (metric decomposable + fused step on).
         """
+        # always clear first: a non-fusable metric must not leave a
+        # previous fit's tally live absorbing this fit's statistics
+        self.disable_device_metric()
         if not getattr(self, "_step_enabled", False) or \
                 not self.for_training or not self._label_names:
             return False
         stat = eval_metric.fused_stat()
         if stat is None:
             return False
-        if self._metric_live is not None and \
-                self._metric_live is not eval_metric:
-            self._metric_live._unbind_device_tally()
         self._metric_stat = stat
         self._metric_slots = getattr(stat, "n_slots", 1)
         self._metric_live = eval_metric
@@ -795,10 +804,24 @@ class MeshExecutorGroup(object):
                                        self._zero_metric_tally)
         return True
 
+    def disable_device_metric(self):
+        """Detach any live tally (new fit with a host-only metric, or
+        MXNET_DEVICE_METRIC=0): drain-pending state is folded by the old
+        metric's next get(); new steps stop accumulating."""
+        if self._metric_live is not None:
+            self._metric_live._drain_device()
+            self._metric_live._unbind_device_tally()
+        self._metric_stat = None
+        self._metric_live = None
+        self._metric_acc = None
+        self._metric_step_done = False
+
     def _read_metric_tally(self):
         if self._metric_acc is None:
-            return onp.zeros((self._metric_slots, 2), onp.float32)
-        return onp.asarray(self._metric_acc)
+            return onp.zeros((self._metric_slots, 2), onp.float64)
+        sums, counts = self._metric_acc
+        return onp.stack([onp.asarray(sums, onp.float64),
+                          onp.asarray(counts, onp.float64)], axis=1)
 
     def _zero_metric_tally(self):
         self._metric_acc = None

@@ -582,11 +582,13 @@ class Module(BaseModule):
 
     def _install_device_metric(self, eval_metric):
         import os
-        if os.environ.get("MXNET_DEVICE_METRIC", "1") == "0":
-            return
         grp = self._exec_group
-        if getattr(grp, "fused", False):
-            grp.enable_device_metric(eval_metric)
+        if not getattr(grp, "fused", False):
+            return
+        if os.environ.get("MXNET_DEVICE_METRIC", "1") == "0":
+            grp.disable_device_metric()
+            return
+        grp.enable_device_metric(eval_metric)
 
     def _sync_params_from_devices(self):
         self._exec_group.get_params(self._arg_params, self._aux_params)

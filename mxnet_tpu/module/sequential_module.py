@@ -166,7 +166,12 @@ class SequentialModule(BaseModule):
                              force_init=force_init)
         self.optimizer_initialized = True
 
+    def _drain_async_kvstore(self):
+        for m in self._modules:
+            m._drain_async_kvstore()
+
     # ---------------------------------------------------------- execution
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         batch = copy.copy(data_batch)

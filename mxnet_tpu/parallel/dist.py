@@ -60,11 +60,13 @@ class DistRuntime:
             NamedSharding(mesh, P("hosts")),
             jnp.broadcast_to(val[None], (1,) + val.shape))
 
-        @jax.jit
-        def _sum(x):
-            return jnp.sum(x, axis=0)
-
-        out = _sum(arr)  # global array, replicated; execution async
+        # one runtime-lifetime jit wrapper: a fresh closure per call would
+        # defeat jit's identity-keyed cache and retrace every push
+        summed = getattr(self, "_allreduce_sum_jit", None)
+        if summed is None:
+            summed = self._allreduce_sum_jit = jax.jit(
+                lambda x: jnp.sum(x, axis=0))
+        out = summed(arr)  # global array, replicated; execution async
 
         def materialize():
             # hand back a PROCESS-LOCAL array (the kvstore mixes it

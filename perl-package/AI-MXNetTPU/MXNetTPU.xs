@@ -16,6 +16,24 @@ static void croak_on_fail(pTHX_ int rc, const char *what) {
   }
 }
 
+/* Validate-and-deref: a plain scalar (or a non-ARRAY ref) from Perl must
+ * croak, not segfault the interpreter (SvRV on a non-ref is undefined). */
+static AV *want_av(pTHX_ SV *sv, const char *what) {
+  if (sv == NULL || !SvROK(sv) || SvTYPE(SvRV(sv)) != SVt_PVAV) {
+    croak("%s: expected an ARRAY reference", what);
+  }
+  return (AV *)SvRV(sv);
+}
+
+/* malloc that croaks on OOM instead of handing NULL to the C ABI */
+static void *xs_alloc(pTHX_ size_t n) {
+  void *p = malloc(n ? n : 1);
+  if (p == NULL) {
+    croak("AI::MXNetTPU: out of memory (%lu bytes)", (unsigned long)n);
+  }
+  return p;
+}
+
 MODULE = AI::MXNetTPU    PACKAGE = AI::MXNetTPU   PREFIX = mxtpu_
 
 PROTOTYPES: DISABLE
@@ -41,21 +59,23 @@ mxtpu_pred_create(const char *symbol_json, SV *param_sv, int dev_type, int dev_i
     PredictorHandle handle;
     int rc;
   CODE:
-    names_av = (AV *)SvRV(names_ref);
-    shapes_av = (AV *)SvRV(shapes_ref);
+    names_av = want_av(aTHX_ names_ref, "names_ref");
+    shapes_av = want_av(aTHX_ shapes_ref, "shapes_ref");
     n = (mx_uint)(av_len(names_av) + 1);
-    keys = (const char **)malloc(n * sizeof(char *));
-    indptr = (mx_uint *)malloc((n + 1) * sizeof(mx_uint));
+    /* validate every nested AV BEFORE allocating — croak longjmps past
+     * the free() calls below, so no allocation may precede a croak */
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = (AV *)SvRV(*av_fetch(shapes_av, i, 0));
+      AV *shape = want_av(aTHX_ *av_fetch(shapes_av, i, 0), "shapes_av[i]");
       total += (mx_uint)(av_len(shape) + 1);
     }
-    shape_data = (mx_uint *)malloc(total * sizeof(mx_uint));
+    keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
+    indptr = (mx_uint *)xs_alloc(aTHX_ (n + 1) * sizeof(mx_uint));
+    shape_data = (mx_uint *)xs_alloc(aTHX_ total * sizeof(mx_uint));
     indptr[0] = 0;
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = (AV *)SvRV(*av_fetch(shapes_av, i, 0));
+      AV *shape = want_av(aTHX_ *av_fetch(shapes_av, i, 0), "shapes_av[i]");
       mx_uint ndim = (mx_uint)(av_len(shape) + 1);
       keys[i] = SvPV_nolen(*av_fetch(names_av, i, 0));
       for (j = 0; j < ndim; ++j) {
@@ -83,9 +103,9 @@ mxtpu_pred_set_input(IV handle, const char *key, SV *data_ref)
     mx_float *buf;
     int rc;
   CODE:
-    data_av = (AV *)SvRV(data_ref);
+    data_av = want_av(aTHX_ data_ref, "data_ref");
     n = (mx_uint)(av_len(data_av) + 1);
-    buf = (mx_float *)malloc(n * sizeof(mx_float));
+    buf = (mx_float *)xs_alloc(aTHX_ n * sizeof(mx_float));
     for (i = 0; i < n; ++i) {
       buf[i] = (mx_float)SvNV(*av_fetch(data_av, i, 0));
     }
@@ -119,7 +139,7 @@ mxtpu_pred_get_output(IV handle, unsigned index, unsigned size)
     mx_float *buf;
     mx_uint i;
   PPCODE:
-    buf = (mx_float *)malloc(size * sizeof(mx_float));
+    buf = (mx_float *)xs_alloc(aTHX_ size * sizeof(mx_float));
     {
       int rc = MXPredGetOutput(INT2PTR(PredictorHandle, handle),
                                (mx_uint)index, buf, (mx_uint)size);
@@ -197,9 +217,9 @@ mxtpu_nd_create(SV *shape_ref, int dev_type, int dev_id)
     NDArrayHandle out;
     int rc;
   CODE:
-    shape_av = (AV *)SvRV(shape_ref);
+    shape_av = want_av(aTHX_ shape_ref, "shape_ref");
     ndim = (mx_uint)(av_len(shape_av) + 1);
-    shape = (mx_uint *)malloc(ndim * sizeof(mx_uint));
+    shape = (mx_uint *)xs_alloc(aTHX_ ndim * sizeof(mx_uint));
     for (i = 0; i < ndim; ++i) {
       shape[i] = (mx_uint)SvUV(*av_fetch(shape_av, i, 0));
     }
@@ -237,9 +257,9 @@ mxtpu_nd_copy_from(IV handle, SV *data_ref)
     mx_float *buf;
     int rc;
   CODE:
-    data_av = (AV *)SvRV(data_ref);
+    data_av = want_av(aTHX_ data_ref, "data_ref");
     n = (mx_uint)(av_len(data_av) + 1);
-    buf = (mx_float *)malloc(n * sizeof(mx_float));
+    buf = (mx_float *)xs_alloc(aTHX_ n * sizeof(mx_float));
     for (i = 0; i < n; ++i) {
       buf[i] = (mx_float)SvNV(*av_fetch(data_av, i, 0));
     }
@@ -264,7 +284,7 @@ mxtpu_nd_to_array(IV handle)
     for (i = 0; i < ndim; ++i) {
       size *= pdata[i];
     }
-    buf = (mx_float *)malloc(size * sizeof(mx_float));
+    buf = (mx_float *)xs_alloc(aTHX_ size * sizeof(mx_float));
     rc = MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, handle), buf,
                                 (size_t)size);
     if (rc != 0) {
@@ -308,28 +328,25 @@ mxtpu_imperative_invoke(IV creator, SV *in_ref, SV *out_ref, SV *key_ref, SV *va
     const char **vals;
     int rc;
   PPCODE:
-    in_av = (AV *)SvRV(in_ref);
-    out_av = (AV *)SvRV(out_ref);
-    key_av = (AV *)SvRV(key_ref);
-    val_av = (AV *)SvRV(val_ref);
+    in_av = want_av(aTHX_ in_ref, "in_ref");
+    out_av = want_av(aTHX_ out_ref, "out_ref");
+    key_av = want_av(aTHX_ key_ref, "key_ref");
+    val_av = want_av(aTHX_ val_ref, "val_ref");
     num_in = (int)(av_len(in_av) + 1);
     num_out = (int)(av_len(out_av) + 1);
     num_params = (int)(av_len(key_av) + 1);
-    ins = (NDArrayHandle *)malloc((num_in > 0 ? num_in : 1)
-                                  * sizeof(NDArrayHandle));
+    ins = (NDArrayHandle *)xs_alloc(aTHX_ num_in * sizeof(NDArrayHandle));
     for (i = 0; i < num_in; ++i) {
       ins[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(in_av, i, 0)));
     }
-    keys = (const char **)malloc((num_params > 0 ? num_params : 1)
-                                 * sizeof(char *));
-    vals = (const char **)malloc((num_params > 0 ? num_params : 1)
-                                 * sizeof(char *));
+    keys = (const char **)xs_alloc(aTHX_ num_params * sizeof(char *));
+    vals = (const char **)xs_alloc(aTHX_ num_params * sizeof(char *));
     for (i = 0; i < num_params; ++i) {
       keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
       vals[i] = SvPV_nolen(*av_fetch(val_av, i, 0));
     }
     if (num_out > 0) {
-      outs = (NDArrayHandle *)malloc(num_out * sizeof(NDArrayHandle));
+      outs = (NDArrayHandle *)xs_alloc(aTHX_ num_out * sizeof(NDArrayHandle));
       for (i = 0; i < num_out; ++i) {
         outs[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(out_av, i, 0)));
       }
@@ -398,11 +415,11 @@ mxtpu_sym_atomic(const char *op, SV *key_ref, SV *val_ref)
   CODE:
     croak_on_fail(aTHX_ MXGetFunction(op, (FunctionHandle *)&creator),
                   "MXGetFunction");
-    key_av = (AV *)SvRV(key_ref);
-    val_av = (AV *)SvRV(val_ref);
+    key_av = want_av(aTHX_ key_ref, "key_ref");
+    val_av = want_av(aTHX_ val_ref, "val_ref");
     n = (mx_uint)(av_len(key_av) + 1);
-    keys = (const char **)malloc((n > 0 ? n : 1) * sizeof(char *));
-    vals = (const char **)malloc((n > 0 ? n : 1) * sizeof(char *));
+    keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
+    vals = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
     for (i = 0; i < n; ++i) {
       keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
       vals[i] = SvPV_nolen(*av_fetch(val_av, i, 0));
@@ -425,8 +442,8 @@ mxtpu_sym_compose(IV handle, const char *name, SV *key_ref, SV *arg_ref)
     SymbolHandle *args;
     int rc;
   CODE:
-    key_av = (AV *)SvRV(key_ref);
-    arg_av = (AV *)SvRV(arg_ref);
+    key_av = want_av(aTHX_ key_ref, "key_ref");
+    arg_av = want_av(aTHX_ arg_ref, "arg_ref");
     nk = (mx_uint)(av_len(key_av) + 1);
     n = (mx_uint)(av_len(arg_av) + 1);
     keys = NULL;
@@ -434,12 +451,12 @@ mxtpu_sym_compose(IV handle, const char *name, SV *key_ref, SV *arg_ref)
       if (nk != n) {
         croak("sym_compose: %u keys for %u args", nk, n);
       }
-      keys = (const char **)malloc(n * sizeof(char *));
+      keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
       for (i = 0; i < n; ++i) {
         keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
       }
     }
-    args = (SymbolHandle *)malloc((n > 0 ? n : 1) * sizeof(SymbolHandle));
+    args = (SymbolHandle *)xs_alloc(aTHX_ n * sizeof(SymbolHandle));
     for (i = 0; i < n; ++i) {
       args[i] = INT2PTR(SymbolHandle, SvIV(*av_fetch(arg_av, i, 0)));
     }
@@ -513,22 +530,22 @@ mxtpu_sym_infer_shape(IV handle, SV *name_ref, SV *shape_ref)
     AV *res_out;
     AV *res_aux;
   PPCODE:
-    name_av = (AV *)SvRV(name_ref);
-    shape_av = (AV *)SvRV(shape_ref);
+    name_av = want_av(aTHX_ name_ref, "name_ref");
+    shape_av = want_av(aTHX_ shape_ref, "shape_ref");
     n = (mx_uint)(av_len(name_av) + 1);
-    keys = (const char **)malloc((n > 0 ? n : 1) * sizeof(char *));
-    indptr = (mx_uint *)malloc((n + 1) * sizeof(mx_uint));
+    /* validate before allocating (croak would leak; see pred_create) */
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = (AV *)SvRV(*av_fetch(shape_av, i, 0));
+      AV *shape = want_av(aTHX_ *av_fetch(shape_av, i, 0), "shape_av[i]");
       total += (mx_uint)(av_len(shape) + 1);
     }
-    shape_data = (mx_uint *)malloc((total > 0 ? total : 1)
-                                   * sizeof(mx_uint));
+    keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
+    indptr = (mx_uint *)xs_alloc(aTHX_ (n + 1) * sizeof(mx_uint));
+    shape_data = (mx_uint *)xs_alloc(aTHX_ total * sizeof(mx_uint));
     indptr[0] = 0;
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = (AV *)SvRV(*av_fetch(shape_av, i, 0));
+      AV *shape = want_av(aTHX_ *av_fetch(shape_av, i, 0), "shape_av[i]");
       mx_uint ndim = (mx_uint)(av_len(shape) + 1);
       keys[i] = SvPV_nolen(*av_fetch(name_av, i, 0));
       for (j = 0; j < ndim; ++j) {
@@ -592,24 +609,22 @@ mxtpu_executor_bind(IV sym, int dev_type, int dev_id, SV *arg_ref, SV *grad_ref,
     ExecutorHandle out;
     int rc;
   CODE:
-    arg_av = (AV *)SvRV(arg_ref);
-    grad_av = (AV *)SvRV(grad_ref);
-    req_av = (AV *)SvRV(req_ref);
-    aux_av = (AV *)SvRV(aux_ref);
+    arg_av = want_av(aTHX_ arg_ref, "arg_ref");
+    grad_av = want_av(aTHX_ grad_ref, "grad_ref");
+    req_av = want_av(aTHX_ req_ref, "req_ref");
+    aux_av = want_av(aTHX_ aux_ref, "aux_ref");
     n = (mx_uint)(av_len(arg_av) + 1);
     naux = (mx_uint)(av_len(aux_av) + 1);
-    args = (NDArrayHandle *)malloc((n > 0 ? n : 1) * sizeof(NDArrayHandle));
-    grads = (NDArrayHandle *)malloc((n > 0 ? n : 1)
-                                    * sizeof(NDArrayHandle));
-    reqs = (mx_uint *)malloc((n > 0 ? n : 1) * sizeof(mx_uint));
+    args = (NDArrayHandle *)xs_alloc(aTHX_ n * sizeof(NDArrayHandle));
+    grads = (NDArrayHandle *)xs_alloc(aTHX_ n * sizeof(NDArrayHandle));
+    reqs = (mx_uint *)xs_alloc(aTHX_ n * sizeof(mx_uint));
     for (i = 0; i < n; ++i) {
       IV g = SvIV(*av_fetch(grad_av, i, 0));
       args[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(arg_av, i, 0)));
       grads[i] = g ? INT2PTR(NDArrayHandle, g) : NULL;
       reqs[i] = (mx_uint)SvUV(*av_fetch(req_av, i, 0));
     }
-    aux = (NDArrayHandle *)malloc((naux > 0 ? naux : 1)
-                                  * sizeof(NDArrayHandle));
+    aux = (NDArrayHandle *)xs_alloc(aTHX_ naux * sizeof(NDArrayHandle));
     for (i = 0; i < naux; ++i) {
       aux[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(aux_av, i, 0)));
     }
@@ -638,10 +653,9 @@ mxtpu_executor_backward(IV handle, SV *grads_ref)
     NDArrayHandle *grads;
     int rc;
   CODE:
-    grads_av = (AV *)SvRV(grads_ref);
+    grads_av = want_av(aTHX_ grads_ref, "grads_ref");
     n = (mx_uint)(av_len(grads_av) + 1);
-    grads = (NDArrayHandle *)malloc((n > 0 ? n : 1)
-                                    * sizeof(NDArrayHandle));
+    grads = (NDArrayHandle *)xs_alloc(aTHX_ n * sizeof(NDArrayHandle));
     for (i = 0; i < n; ++i) {
       grads[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(grads_av, i, 0)));
     }

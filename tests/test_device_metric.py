@@ -191,3 +191,28 @@ def test_custom_metric_keeps_host_path():
     mod, _ = _fit(metric, None, True, epochs=1)
     assert mod._exec_group._metric_live is None
     assert len(calls) == 4  # one host update per batch
+
+
+def test_refit_with_host_metric_detaches_old_tally():
+    """A second fit with a non-fusable metric must disable the previous
+    fit's device tally — not keep accumulating into the old metric."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(128, 8).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+    acc = mx.metric.Accuracy()
+    mod.fit(NDArrayIter(X, y, batch_size=32), eval_metric=acc, num_epoch=1,
+            optimizer_params={"learning_rate": 0.05})
+    frozen = acc.get()[1]
+    n_seen = acc.num_inst
+    assert n_seen == 128
+    custom = mx.metric.np(
+        lambda label, pred: float((pred.argmax(1) == label).mean()))
+    mod.fit(NDArrayIter(X, y, batch_size=32), eval_metric=custom,
+            num_epoch=1, force_init=False,
+            optimizer_params={"learning_rate": 0.05})
+    grp = mod._exec_group
+    assert grp._metric_live is None and grp._metric_stat is None
+    # the first metric's value must be unchanged by the second fit
+    assert acc.num_inst == n_seen
+    np.testing.assert_allclose(acc.get()[1], frozen)

@@ -160,3 +160,46 @@ def test_dist_async_staleness_one_update_on_kvstore():
     kv.push(4, nd.zeros(SHAPE))        # applies the 7s
     kv.pull(4, out)
     check_diff_to_scalar(out, 0)
+
+
+def test_dist_async_exit_finalizer_drains_pending():
+    """ADVICE r4: the 'every gradient applied exactly once' contract must
+    hold without an explicit barrier() — the finalizer drains in-flight
+    reductions when the store is collected."""
+    import gc
+    kv = kvs.create("dist_async")
+    store = kv._store  # survives the kvstore object
+    kv.init(3, nd.zeros(SHAPE))
+    kv.push(3, nd.ones(SHAPE) * 4)     # in flight, not yet applied
+    del kv
+    gc.collect()
+    np.testing.assert_allclose(store[3].asnumpy(), 4.0)
+
+
+def test_dist_async_no_exit_drain_when_disabled():
+    import gc
+    kv = kvs.create("dist_async")
+    kv.set_barrier_before_exit(False)
+    store = kv._store
+    kv.init(3, nd.zeros(SHAPE))
+    kv.push(3, nd.ones(SHAPE) * 4)
+    del kv
+    gc.collect()
+    np.testing.assert_allclose(store[3].asnumpy(), 0.0)
+
+
+def test_dist_async_cold_start_skips_updater():
+    """ADVICE r4: no update may run before the first gradient lands —
+    an optimizer with weight decay must not tick on a synthetic zero."""
+    from mxnet_tpu import optimizer as opt
+    kv = kvs.create("dist_async")
+    kv.set_optimizer(opt.SGD(learning_rate=1.0, momentum=0.0, wd=0.1,
+                             rescale_grad=1.0))
+    w0 = nd.ones(SHAPE) * 10
+    kv.init(5, w0)
+    out = nd.zeros(SHAPE)
+    kv.push(5, nd.ones(SHAPE) * 3)
+    kv.pull(5, out)
+    # with the old zero-gradient cold start, wd would already have
+    # decayed the weight to 10 - 0.1*10 = 9
+    check_diff_to_scalar(out, 10)

@@ -106,3 +106,20 @@ def test_perl_training_end_to_end():
     _build_perl()
     out = _run_perl_t("train.t")
     assert "perl-driven training learns the task" in out
+
+
+def test_perl_bad_args_croak_not_segfault():
+    """XS entry points must croak on non-reference args (ADVICE r4): a
+    croak is a clean die (rc 255); a segfault would be rc -11."""
+    _build_capi()
+    _build_perl()
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        ["perl", "-Mblib=%s" % os.path.join(PKG, "blib"),
+         "-MAI::MXNetTPU", "-e",
+         'AI::MXNetTPU::nd_create("not a ref", 1, 0)'],
+        cwd=ROOT, capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode > 0, proc.returncode  # died, didn't crash
+    assert "expected an ARRAY reference" in proc.stderr
