@@ -154,6 +154,75 @@ def test_r_delimiters_balanced():
         _check_delimiters(fn, src)
 
 
+def _parse_r_or_toolchain(sources):
+    """Parse-level gate (VERDICT r4 #5): use R's own parser when an R
+    binary exists, else the vendored recursive-descent parser
+    (tools/r_parser.py) — never regex-only."""
+    import shutil
+    import subprocess
+    import tempfile
+    r_bin = shutil.which("Rscript")
+    if r_bin:
+        # parse the extracted SOURCE TEXT (vignette entries carry the R
+        # chunks, not the raw .Rmd) from a temp file — the names in
+        # ``sources`` are display-relative, not cwd-resolvable
+        for fn, src in sources:
+            with tempfile.NamedTemporaryFile("w", suffix=".R",
+                                             delete=False) as tf:
+                tf.write(src)
+                tmp = tf.name
+            try:
+                proc = subprocess.run(
+                    [r_bin, "-e",
+                     "invisible(parse(file=commandArgs(TRUE)))",
+                     "--args", tmp],
+                    capture_output=True, text=True, timeout=120)
+                assert proc.returncode == 0, \
+                    "%s: %s" % (fn, proc.stderr[-500:])
+            finally:
+                os.unlink(tmp)
+        return "Rscript"
+    from tools.r_parser import parse, RParseError
+    errs = []
+    for fn, src in sources:
+        try:
+            parse(src)
+        except RParseError as e:
+            errs.append("%s: %s" % (fn, e))
+    assert not errs, "\n".join(errs)
+    return "vendored"
+
+
+def test_r_sources_parse():
+    """Every .R file in the package must PARSE (not just regex-scan)."""
+    mode = _parse_r_or_toolchain(list(_r_sources()))
+    assert mode in ("Rscript", "vendored")
+
+
+def test_r_demo_vignette_sources_parse():
+    _parse_r_or_toolchain(list(_r_demo_vignette_sources()))
+
+
+def test_r_parser_gate_is_not_vacuous():
+    """Targeted corruptions of a real source must be rejected — guards
+    against the parse gate silently accepting everything."""
+    from tools.r_parser import parse, RParseError
+    fn, src = next(iter(_r_sources()))
+    corruptions = [
+        src.replace("{", "", 1),                   # drop one opener
+        src + "\nx <- (1 +\n",                     # unclosed tail
+        src + "\nfunction(, a) 1\n",               # malformed formals
+        src.replace("function(", "function(,", 1),  # corrupt a header
+    ]
+    for i, bad in enumerate(corruptions):
+        try:
+            parse(bad)
+            raise AssertionError(
+                "corruption %d of %s parsed cleanly" % (i, fn))
+        except RParseError:
+            pass
+
+
 def test_ops_used_by_r_layer_exist():
     import mxnet_tpu.capi_bridge as cb
     ops = set(cb.all_op_names())

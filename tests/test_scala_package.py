@@ -130,6 +130,48 @@ def test_scala_delimiters_balanced():
         assert not in_str, "%s: unterminated string" % fn
 
 
+def test_scala_sources_parse():
+    """Parse-level gate (VERDICT r4 #5): scalac when provisioned, else
+    the vendored tokenizer + structural parser (tools/scala_syntax.py) —
+    nested comments, interpolated-string splices, delimiter pairing and
+    declaration-header grammar, with line-accurate errors. Types stay
+    unchecked without scalac (documented limit)."""
+    import shutil
+    import tempfile
+    files = [os.path.join(SCALA_DIR, rel) for rel, _ in _scala_sources()]
+    scalac = shutil.which("scalac")
+    if scalac:
+        with tempfile.TemporaryDirectory() as tmp:
+            proc = subprocess.run([scalac, "-d", tmp] + files,
+                                  capture_output=True, text=True,
+                                  timeout=600)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+        return
+    from tools.scala_syntax import check_file
+    errs = []
+    for fn in files:
+        errs += check_file(fn)
+    assert not errs, "\n".join(errs)
+
+
+def test_scala_parser_gate_is_not_vacuous():
+    from tools.scala_syntax import check, ScalaSyntaxError
+    fn, src = next(iter(_scala_sources()))
+    idx = src.rindex("}")
+    corruptions = [
+        src[:idx] + src[idx + 1:],          # drop the final closer
+        src + "\nclass {\n}",               # nameless class
+        src + "\nobject Q { def = 1 }",     # reserved-op def name
+        src.replace("{", "(", 1),           # mispair a delimiter
+    ]
+    for i, bad in enumerate(corruptions):
+        try:
+            check(bad)
+            raise AssertionError("corruption %d of %s passed" % (i, fn))
+        except ScalaSyntaxError:
+            pass
+
+
 def test_ops_used_by_scala_layer_exist():
     import mxnet_tpu.capi_bridge as cb
     ops = set(cb.all_op_names())
