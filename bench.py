@@ -274,6 +274,18 @@ def main():
         except Exception as e:
             extra["handwritten_error"] = str(e)[:120]
 
+    if os.environ.get("BENCH_FIT", "1") != "0":
+        # north-star path: throughput via the REAL Module.fit loop with a
+        # live eval metric (VERDICT r4 #1). The device-side metric tally
+        # makes per-batch update_metric free; the per-epoch drain (one
+        # readback, data-dependent on every step program) is the honest
+        # completion barrier for each epoch.
+        try:
+            extra.update(_bench_fit(mx, mod, batches, batch,
+                                    img_per_sec, steps))
+        except Exception as e:
+            extra["fit_error"] = str(e)[:160]
+
     extra.update(pipe_extra)
     if pipe_recs is not None:
         try:
@@ -287,6 +299,76 @@ def main():
             shutil.rmtree(pipe_tmp, ignore_errors=True)
         extra.update(_pipeline_verdict(extra))
     _emit(img_per_sec, extra)
+
+
+class _DeviceBatchIter(object):
+    """Minimal DataIter over pre-staged device-resident batches: fit's
+    input-pipeline cost is measured separately (pipeline_* fields), so
+    the fit benchmark isolates the LOOP itself — step + metric + epoch
+    bookkeeping — exactly like the synthetic headline does for the step."""
+
+    def __init__(self, batches, provide_data, provide_label, n_batches):
+        self._batches = batches
+        self._n = n_batches
+        self._i = 0
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._i >= self._n:
+            raise StopIteration
+        b = self._batches[self._i % len(self._batches)]
+        self._i += 1
+        return b
+
+    next = __next__
+
+    def reset(self):
+        self._i = 0
+
+
+def _bench_fit(mx, mod, batches, batch, step_img_per_sec, steps):
+    """Module.fit(eval_metric='acc') throughput via two fit() calls of
+    different epoch counts, differenced (two-window slope over whole
+    epochs). Every per-epoch cost fit really pays — the device-tally
+    drain readback, metric reset, iterator reset — is inside the
+    window; compile/session warmup cancels in the difference."""
+    # 12*steps (240 at the default 20) still UNDERSTATES real epochs —
+    # ImageNet at this rate is ~10,000 steps/epoch — so the per-epoch
+    # drain cost this measures is an upper bound on the true one
+    ep_batches = int(os.environ.get("BENCH_FIT_EPOCH_BATCHES",
+                                    str(max(4, steps * 12))))
+    it = _DeviceBatchIter(batches, mod.data_shapes, mod.label_shapes,
+                          ep_batches)
+    metric = mx.metric.Accuracy()
+
+    def run(n_epochs):
+        t0 = time.time()
+        # bind/init/init_optimizer are no-ops on the already-driven
+        # module; fit reuses the compiled one-program step
+        mod.fit(it, eval_metric=metric, num_epoch=n_epochs)
+        return time.time() - t0
+
+    run(1)  # warm the fit path (metric program recompile)
+    t_long = min(run(4) for _ in range(2))
+    t_short = min(run(2) for _ in range(2))
+    out = {"fit_epoch_batches": ep_batches}
+    if t_long > t_short > 0:
+        rate = 2 * ep_batches * batch / (t_long - t_short)
+        out["fit_img_per_sec"] = round(rate, 2)
+        if step_img_per_sec > 0:
+            out["fit_vs_step"] = round(rate / step_img_per_sec, 3)
+        grp = mod._exec_group
+        out["fit_device_metric"] = getattr(grp, "_metric_live",
+                                           None) is metric
+        out["fit_train_acc"] = round(float(metric.get()[1]), 4)
+    else:
+        out["fit_error"] = "degenerate fit windows (%.2fs vs %.2fs)" % (
+            t_long, t_short)
+    return out
 
 
 def _make_rec_files(mx, img, step_batch):

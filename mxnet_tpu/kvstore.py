@@ -34,11 +34,12 @@ from .ndarray import NDArray
 __all__ = ["KVStore", "create"]
 
 
-def _drain_pending(ctx):
-    """Finalizer body for dist_async stores (no ref to the store itself):
-    apply still-in-flight reductions, best-effort — the dist backend may
-    already be torn down at interpreter exit."""
-    if not ctx["enabled"]:
+def _drain_pending(ctx, best_effort=True):
+    """THE drain for dist_async's in-flight reductions — shared by
+    barrier() (errors propagate) and the exit finalizer (best-effort: the
+    dist backend may already be torn down; no ref to the store object so
+    the finalizer cannot resurrect it)."""
+    if best_effort and not ctx["enabled"]:
         return
     pending, store = ctx["pending"], ctx["store"]
     for k in sorted(list(pending), key=str):
@@ -50,6 +51,8 @@ def _drain_pending(ctx):
             else:
                 store[k] = effective
         except Exception:  # pragma: no cover - teardown race
+            if not best_effort:
+                raise
             return
 
 
@@ -204,14 +207,10 @@ class KVStore(object):
     def barrier(self):
         # dist_async: a barrier is the quiesce point — flush the in-flight
         # staleness-1 reductions so no trailing gradient is ever lost
-        # (push() comment; the exit barrier drains end-of-training state)
-        if self._pending:
-            for k in sorted(self._pending, key=str):
-                effective = self._pending.pop(k)()
-                if self._updater is not None:
-                    self._updater(k, effective, self._store[k])
-                else:
-                    self._store[k] = effective
+        # (push() comment; one drain implementation shared with the exit
+        # finalizer — _drain_pending)
+        if hasattr(self, "_flush_ctx"):
+            _drain_pending(self._flush_ctx, best_effort=False)
         if self._dist is not None:
             self._dist.barrier()
 

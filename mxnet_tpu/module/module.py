@@ -594,6 +594,13 @@ class Module(BaseModule):
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
+    def _epoch_end_params(self):
+        if getattr(self._exec_group, "fused", False):
+            # one packed readback; no re-upload — the mesh params ARE the
+            # training state, set_params would just round-trip them
+            return self.get_params()
+        return super()._epoch_end_params()
+
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:

@@ -25,6 +25,15 @@ static AV *want_av(pTHX_ SV *sv, const char *what) {
   return (AV *)SvRV(sv);
 }
 
+/* av_fetch returns NULL for holes/short arrays — croak, don't deref */
+static SV *want_elem(pTHX_ AV *av, SSize_t i, const char *what) {
+  SV **p = av_fetch(av, i, 0);
+  if (p == NULL) {
+    croak("%s: missing element %ld", what, (long)i);
+  }
+  return *p;
+}
+
 /* malloc that croaks on OOM instead of handing NULL to the C ABI */
 static void *xs_alloc(pTHX_ size_t n) {
   void *p = malloc(n ? n : 1);
@@ -66,7 +75,7 @@ mxtpu_pred_create(const char *symbol_json, SV *param_sv, int dev_type, int dev_i
      * the free() calls below, so no allocation may precede a croak */
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = want_av(aTHX_ *av_fetch(shapes_av, i, 0), "shapes_av[i]");
+      AV *shape = want_av(aTHX_ want_elem(aTHX_ shapes_av, i, "shapes_av"), "shapes_av[i]");
       total += (mx_uint)(av_len(shape) + 1);
     }
     keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
@@ -75,11 +84,11 @@ mxtpu_pred_create(const char *symbol_json, SV *param_sv, int dev_type, int dev_i
     indptr[0] = 0;
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = want_av(aTHX_ *av_fetch(shapes_av, i, 0), "shapes_av[i]");
+      AV *shape = want_av(aTHX_ want_elem(aTHX_ shapes_av, i, "shapes_av"), "shapes_av[i]");
       mx_uint ndim = (mx_uint)(av_len(shape) + 1);
-      keys[i] = SvPV_nolen(*av_fetch(names_av, i, 0));
+      keys[i] = SvPV_nolen(want_elem(aTHX_ names_av, i, "names_av"));
       for (j = 0; j < ndim; ++j) {
-        shape_data[total + j] = (mx_uint)SvUV(*av_fetch(shape, j, 0));
+        shape_data[total + j] = (mx_uint)SvUV(want_elem(aTHX_ shape, j, "shape"));
       }
       total += ndim;
       indptr[i + 1] = total;
@@ -107,7 +116,7 @@ mxtpu_pred_set_input(IV handle, const char *key, SV *data_ref)
     n = (mx_uint)(av_len(data_av) + 1);
     buf = (mx_float *)xs_alloc(aTHX_ n * sizeof(mx_float));
     for (i = 0; i < n; ++i) {
-      buf[i] = (mx_float)SvNV(*av_fetch(data_av, i, 0));
+      buf[i] = (mx_float)SvNV(want_elem(aTHX_ data_av, i, "data_av"));
     }
     rc = MXPredSetInput(INT2PTR(PredictorHandle, handle), key, buf, n);
     free(buf);
@@ -221,7 +230,7 @@ mxtpu_nd_create(SV *shape_ref, int dev_type, int dev_id)
     ndim = (mx_uint)(av_len(shape_av) + 1);
     shape = (mx_uint *)xs_alloc(aTHX_ ndim * sizeof(mx_uint));
     for (i = 0; i < ndim; ++i) {
-      shape[i] = (mx_uint)SvUV(*av_fetch(shape_av, i, 0));
+      shape[i] = (mx_uint)SvUV(want_elem(aTHX_ shape_av, i, "shape_av"));
     }
     rc = MXNDArrayCreate(shape, ndim, dev_type, dev_id, 0, &out);
     free(shape);
@@ -261,7 +270,7 @@ mxtpu_nd_copy_from(IV handle, SV *data_ref)
     n = (mx_uint)(av_len(data_av) + 1);
     buf = (mx_float *)xs_alloc(aTHX_ n * sizeof(mx_float));
     for (i = 0; i < n; ++i) {
-      buf[i] = (mx_float)SvNV(*av_fetch(data_av, i, 0));
+      buf[i] = (mx_float)SvNV(want_elem(aTHX_ data_av, i, "data_av"));
     }
     rc = MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, handle), buf,
                                   (size_t)n);
@@ -337,18 +346,18 @@ mxtpu_imperative_invoke(IV creator, SV *in_ref, SV *out_ref, SV *key_ref, SV *va
     num_params = (int)(av_len(key_av) + 1);
     ins = (NDArrayHandle *)xs_alloc(aTHX_ num_in * sizeof(NDArrayHandle));
     for (i = 0; i < num_in; ++i) {
-      ins[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(in_av, i, 0)));
+      ins[i] = INT2PTR(NDArrayHandle, SvIV(want_elem(aTHX_ in_av, i, "in_av")));
     }
     keys = (const char **)xs_alloc(aTHX_ num_params * sizeof(char *));
     vals = (const char **)xs_alloc(aTHX_ num_params * sizeof(char *));
     for (i = 0; i < num_params; ++i) {
-      keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
-      vals[i] = SvPV_nolen(*av_fetch(val_av, i, 0));
+      keys[i] = SvPV_nolen(want_elem(aTHX_ key_av, i, "key_av"));
+      vals[i] = SvPV_nolen(want_elem(aTHX_ val_av, i, "val_av"));
     }
     if (num_out > 0) {
       outs = (NDArrayHandle *)xs_alloc(aTHX_ num_out * sizeof(NDArrayHandle));
       for (i = 0; i < num_out; ++i) {
-        outs[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(out_av, i, 0)));
+        outs[i] = INT2PTR(NDArrayHandle, SvIV(want_elem(aTHX_ out_av, i, "out_av")));
       }
       outp = outs;
     } else {
@@ -421,8 +430,8 @@ mxtpu_sym_atomic(const char *op, SV *key_ref, SV *val_ref)
     keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
     vals = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
     for (i = 0; i < n; ++i) {
-      keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
-      vals[i] = SvPV_nolen(*av_fetch(val_av, i, 0));
+      keys[i] = SvPV_nolen(want_elem(aTHX_ key_av, i, "key_av"));
+      vals[i] = SvPV_nolen(want_elem(aTHX_ val_av, i, "val_av"));
     }
     rc = MXSymbolCreateAtomicSymbol(creator, n, keys, vals, &out);
     free(keys);
@@ -453,12 +462,12 @@ mxtpu_sym_compose(IV handle, const char *name, SV *key_ref, SV *arg_ref)
       }
       keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
       for (i = 0; i < n; ++i) {
-        keys[i] = SvPV_nolen(*av_fetch(key_av, i, 0));
+        keys[i] = SvPV_nolen(want_elem(aTHX_ key_av, i, "key_av"));
       }
     }
     args = (SymbolHandle *)xs_alloc(aTHX_ n * sizeof(SymbolHandle));
     for (i = 0; i < n; ++i) {
-      args[i] = INT2PTR(SymbolHandle, SvIV(*av_fetch(arg_av, i, 0)));
+      args[i] = INT2PTR(SymbolHandle, SvIV(want_elem(aTHX_ arg_av, i, "arg_av")));
     }
     rc = MXSymbolCompose(INT2PTR(SymbolHandle, handle), name, n, keys,
                          args);
@@ -536,7 +545,7 @@ mxtpu_sym_infer_shape(IV handle, SV *name_ref, SV *shape_ref)
     /* validate before allocating (croak would leak; see pred_create) */
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = want_av(aTHX_ *av_fetch(shape_av, i, 0), "shape_av[i]");
+      AV *shape = want_av(aTHX_ want_elem(aTHX_ shape_av, i, "shape_av"), "shape_av[i]");
       total += (mx_uint)(av_len(shape) + 1);
     }
     keys = (const char **)xs_alloc(aTHX_ n * sizeof(char *));
@@ -545,11 +554,11 @@ mxtpu_sym_infer_shape(IV handle, SV *name_ref, SV *shape_ref)
     indptr[0] = 0;
     total = 0;
     for (i = 0; i < n; ++i) {
-      AV *shape = want_av(aTHX_ *av_fetch(shape_av, i, 0), "shape_av[i]");
+      AV *shape = want_av(aTHX_ want_elem(aTHX_ shape_av, i, "shape_av"), "shape_av[i]");
       mx_uint ndim = (mx_uint)(av_len(shape) + 1);
-      keys[i] = SvPV_nolen(*av_fetch(name_av, i, 0));
+      keys[i] = SvPV_nolen(want_elem(aTHX_ name_av, i, "name_av"));
       for (j = 0; j < ndim; ++j) {
-        shape_data[total + j] = (mx_uint)SvUV(*av_fetch(shape, j, 0));
+        shape_data[total + j] = (mx_uint)SvUV(want_elem(aTHX_ shape, j, "shape"));
       }
       total += ndim;
       indptr[i + 1] = total;
@@ -619,14 +628,14 @@ mxtpu_executor_bind(IV sym, int dev_type, int dev_id, SV *arg_ref, SV *grad_ref,
     grads = (NDArrayHandle *)xs_alloc(aTHX_ n * sizeof(NDArrayHandle));
     reqs = (mx_uint *)xs_alloc(aTHX_ n * sizeof(mx_uint));
     for (i = 0; i < n; ++i) {
-      IV g = SvIV(*av_fetch(grad_av, i, 0));
-      args[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(arg_av, i, 0)));
+      IV g = SvIV(want_elem(aTHX_ grad_av, i, "grad_av"));
+      args[i] = INT2PTR(NDArrayHandle, SvIV(want_elem(aTHX_ arg_av, i, "arg_av")));
       grads[i] = g ? INT2PTR(NDArrayHandle, g) : NULL;
-      reqs[i] = (mx_uint)SvUV(*av_fetch(req_av, i, 0));
+      reqs[i] = (mx_uint)SvUV(want_elem(aTHX_ req_av, i, "req_av"));
     }
     aux = (NDArrayHandle *)xs_alloc(aTHX_ naux * sizeof(NDArrayHandle));
     for (i = 0; i < naux; ++i) {
-      aux[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(aux_av, i, 0)));
+      aux[i] = INT2PTR(NDArrayHandle, SvIV(want_elem(aTHX_ aux_av, i, "aux_av")));
     }
     rc = MXExecutorBind(INT2PTR(SymbolHandle, sym), dev_type, dev_id, n,
                         args, grads, reqs, naux, aux, &out);
@@ -657,7 +666,7 @@ mxtpu_executor_backward(IV handle, SV *grads_ref)
     n = (mx_uint)(av_len(grads_av) + 1);
     grads = (NDArrayHandle *)xs_alloc(aTHX_ n * sizeof(NDArrayHandle));
     for (i = 0; i < n; ++i) {
-      grads[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(grads_av, i, 0)));
+      grads[i] = INT2PTR(NDArrayHandle, SvIV(want_elem(aTHX_ grads_av, i, "grads_av")));
     }
     rc = MXExecutorBackward(INT2PTR(ExecutorHandle, handle), n, grads);
     free(grads);

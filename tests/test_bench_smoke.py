@@ -15,7 +15,7 @@ def test_bench_emits_contract_json():
     from __graft_entry__ import virtual_cpu_env  # the one clean-env home
     env = virtual_cpu_env(1)
     env.update(BENCH_BATCH="4", BENCH_STEPS="2", BENCH_PIPELINE="0",
-               BENCH_DTYPE="float32")
+               BENCH_DTYPE="float32", BENCH_FIT_EPOCH_BATCHES="3")
     proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
                           capture_output=True, text=True, timeout=1200,
                           env=env, cwd=ROOT)
@@ -27,3 +27,6 @@ def test_bench_emits_contract_json():
     assert rec["metric"] == "resnet50_train_throughput"
     assert rec["value"] > 0
     assert rec["path"] == "module" and rec["fused_group"] is True
+    # the north-star fit loop must be measured, on the device-metric path
+    assert rec.get("fit_img_per_sec", 0) > 0, rec
+    assert rec.get("fit_device_metric") is True, rec
