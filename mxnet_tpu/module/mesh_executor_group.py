@@ -860,21 +860,25 @@ class MeshExecutorGroup(object):
         import jax.numpy as jnp
         sums, counts = self._metric_acc
         # ONE fused readback: separate fetches would cost two ~130ms
-        # round trips per drain on this transport. Counts ride across as
-        # a BITCAST (not a value cast) so they stay exact past 2^24.
+        # round trips per drain on this transport. The pack rides in the
+        # INTEGER domain — small i32 counts bitcast to f32 are denormals,
+        # which the TPU vector unit flushes to zero (observed: a fit's
+        # num_inst read back as 0); f32 sums bitcast to i32 are plain
+        # bits and survive. Host side un-bitcasts the sum column.
         fn = self._jits.get("pack_tally")
         if fn is None:
             from jax import lax
 
             def pack_tally(s, c):
                 return jnp.stack(
-                    [s, lax.bitcast_convert_type(c, jnp.float32)], axis=1)
+                    [lax.bitcast_convert_type(s, jnp.int32), c], axis=1)
 
             fn = self._jits["pack_tally"] = jax.jit(
                 pack_tally, out_shardings=self._repl)
-        packed = onp.asarray(fn(sums, counts), onp.float32)
-        out = packed.astype(onp.float64)
-        out[:, 1] = packed[:, 1].view(onp.int32)
+        packed = onp.asarray(fn(sums, counts))
+        out = onp.empty((packed.shape[0], 2), onp.float64)
+        out[:, 0] = packed[:, 0].copy().view(onp.float32)
+        out[:, 1] = packed[:, 1]
         return out
 
     def _zero_metric_tally(self):

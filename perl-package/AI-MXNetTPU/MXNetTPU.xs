@@ -34,12 +34,13 @@ static SV *want_elem(pTHX_ AV *av, SSize_t i, const char *what) {
   return *p;
 }
 
-/* malloc that croaks on OOM instead of handing NULL to the C ABI */
+/* scope-managed allocation: Newx croaks on OOM, SAVEFREEPV hands the
+ * buffer to perl's savestack so it is freed when the XSUB scope exits —
+ * INCLUDING via croak's longjmp. No manual free(), no leak-on-croak. */
 static void *xs_alloc(pTHX_ size_t n) {
-  void *p = malloc(n ? n : 1);
-  if (p == NULL) {
-    croak("AI::MXNetTPU: out of memory (%lu bytes)", (unsigned long)n);
-  }
+  char *p;
+  Newx(p, n ? n : 1, char);
+  SAVEFREEPV(p);
   return p;
 }
 
@@ -71,8 +72,8 @@ mxtpu_pred_create(const char *symbol_json, SV *param_sv, int dev_type, int dev_i
     names_av = want_av(aTHX_ names_ref, "names_ref");
     shapes_av = want_av(aTHX_ shapes_ref, "shapes_ref");
     n = (mx_uint)(av_len(names_av) + 1);
-    /* validate every nested AV BEFORE allocating — croak longjmps past
-     * the free() calls below, so no allocation may precede a croak */
+    /* validate the nested shape AVs up front (clearer errors; the
+     * allocations themselves are croak-safe via SAVEFREEPV) */
     total = 0;
     for (i = 0; i < n; ++i) {
       AV *shape = want_av(aTHX_ want_elem(aTHX_ shapes_av, i, "shapes_av"), "shapes_av[i]");
@@ -96,9 +97,6 @@ mxtpu_pred_create(const char *symbol_json, SV *param_sv, int dev_type, int dev_i
     param_bytes = SvPV(param_sv, param_len);
     rc = MXPredCreate(symbol_json, param_bytes, (int)param_len, dev_type,
                       dev_id, n, keys, indptr, shape_data, &handle);
-    free(shape_data);
-    free(indptr);
-    free(keys);
     croak_on_fail(aTHX_ rc, "MXPredCreate");
     RETVAL = PTR2IV(handle);
   OUTPUT:
@@ -119,7 +117,6 @@ mxtpu_pred_set_input(IV handle, const char *key, SV *data_ref)
       buf[i] = (mx_float)SvNV(want_elem(aTHX_ data_av, i, "data_av"));
     }
     rc = MXPredSetInput(INT2PTR(PredictorHandle, handle), key, buf, n);
-    free(buf);
     croak_on_fail(aTHX_ rc, "MXPredSetInput");
 
 void
@@ -152,16 +149,12 @@ mxtpu_pred_get_output(IV handle, unsigned index, unsigned size)
     {
       int rc = MXPredGetOutput(INT2PTR(PredictorHandle, handle),
                                (mx_uint)index, buf, (mx_uint)size);
-      if (rc != 0) {
-        free(buf);
-        croak("MXPredGetOutput failed: %s", MXGetLastError());
-      }
+      croak_on_fail(aTHX_ rc, "MXPredGetOutput");
     }
     EXTEND(SP, size);
     for (i = 0; i < size; ++i) {
       mPUSHn((double)buf[i]);
     }
-    free(buf);
 
 void
 mxtpu_pred_free(IV handle)
@@ -233,7 +226,6 @@ mxtpu_nd_create(SV *shape_ref, int dev_type, int dev_id)
       shape[i] = (mx_uint)SvUV(want_elem(aTHX_ shape_av, i, "shape_av"));
     }
     rc = MXNDArrayCreate(shape, ndim, dev_type, dev_id, 0, &out);
-    free(shape);
     croak_on_fail(aTHX_ rc, "MXNDArrayCreate");
     RETVAL = PTR2IV(out);
   OUTPUT:
@@ -274,7 +266,6 @@ mxtpu_nd_copy_from(IV handle, SV *data_ref)
     }
     rc = MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, handle), buf,
                                   (size_t)n);
-    free(buf);
     croak_on_fail(aTHX_ rc, "MXNDArraySyncCopyFromCPU");
 
 void
@@ -296,15 +287,24 @@ mxtpu_nd_to_array(IV handle)
     buf = (mx_float *)xs_alloc(aTHX_ size * sizeof(mx_float));
     rc = MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, handle), buf,
                                 (size_t)size);
-    if (rc != 0) {
-      free(buf);
-      croak("MXNDArraySyncCopyToCPU failed: %s", MXGetLastError());
-    }
+    croak_on_fail(aTHX_ rc, "MXNDArraySyncCopyToCPU");
     EXTEND(SP, size);
     for (i = 0; i < size; ++i) {
       mPUSHn((double)buf[i]);
     }
-    free(buf);
+
+void
+mxtpu_nd_context(IV handle)
+  PREINIT:
+    int dev_type;
+    int dev_id;
+  PPCODE:
+    croak_on_fail(aTHX_ MXNDArrayGetContext(INT2PTR(NDArrayHandle, handle),
+                                            &dev_type, &dev_id),
+                  "MXNDArrayGetContext");
+    EXTEND(SP, 2);
+    mPUSHi(dev_type);
+    mPUSHi(dev_id);
 
 void
 mxtpu_nd_wait_all()
@@ -366,18 +366,11 @@ mxtpu_imperative_invoke(IV creator, SV *in_ref, SV *out_ref, SV *key_ref, SV *va
     }
     rc = MXImperativeInvoke(INT2PTR(AtomicSymbolCreator, creator), num_in,
                             ins, &num_out, &outp, num_params, keys, vals);
-    free(ins);
-    free(keys);
-    free(vals);
-    if (rc != 0) {
-      if (outs) free(outs);
-      croak("MXImperativeInvoke failed: %s", MXGetLastError());
-    }
+    croak_on_fail(aTHX_ rc, "MXImperativeInvoke");
     EXTEND(SP, num_out);
     for (i = 0; i < num_out; ++i) {
       mPUSHi(PTR2IV(outp[i]));
     }
-    if (outs) free(outs);
 
 IV
 mxtpu_sym_variable(const char *name)
@@ -434,8 +427,6 @@ mxtpu_sym_atomic(const char *op, SV *key_ref, SV *val_ref)
       vals[i] = SvPV_nolen(want_elem(aTHX_ val_av, i, "val_av"));
     }
     rc = MXSymbolCreateAtomicSymbol(creator, n, keys, vals, &out);
-    free(keys);
-    free(vals);
     croak_on_fail(aTHX_ rc, "MXSymbolCreateAtomicSymbol");
     RETVAL = PTR2IV(out);
   OUTPUT:
@@ -471,8 +462,6 @@ mxtpu_sym_compose(IV handle, const char *name, SV *key_ref, SV *arg_ref)
     }
     rc = MXSymbolCompose(INT2PTR(SymbolHandle, handle), name, n, keys,
                          args);
-    if (keys) free(keys);
-    free(args);
     croak_on_fail(aTHX_ rc, "MXSymbolCompose");
 
 void
@@ -567,9 +556,6 @@ mxtpu_sym_infer_shape(IV handle, SV *name_ref, SV *shape_ref)
                             shape_data, &in_size, &in_ndim, &in_data,
                             &out_size, &out_ndim, &out_data, &aux_size,
                             &aux_ndim, &aux_data, &complete);
-    free(keys);
-    free(indptr);
-    free(shape_data);
     croak_on_fail(aTHX_ rc, "MXSymbolInferShape");
     if (!complete) {
       croak("MXSymbolInferShape: incomplete (missing input shapes)");
@@ -639,10 +625,6 @@ mxtpu_executor_bind(IV sym, int dev_type, int dev_id, SV *arg_ref, SV *grad_ref,
     }
     rc = MXExecutorBind(INT2PTR(SymbolHandle, sym), dev_type, dev_id, n,
                         args, grads, reqs, naux, aux, &out);
-    free(args);
-    free(grads);
-    free(reqs);
-    free(aux);
     croak_on_fail(aTHX_ rc, "MXExecutorBind");
     RETVAL = PTR2IV(out);
   OUTPUT:
@@ -669,7 +651,6 @@ mxtpu_executor_backward(IV handle, SV *grads_ref)
       grads[i] = INT2PTR(NDArrayHandle, SvIV(want_elem(aTHX_ grads_av, i, "grads_av")));
     }
     rc = MXExecutorBackward(INT2PTR(ExecutorHandle, handle), n, grads);
-    free(grads);
     croak_on_fail(aTHX_ rc, "MXExecutorBackward");
 
 void

@@ -1,0 +1,83 @@
+package AI::MXNetTPU::Metric;
+# Evaluation metrics — reference counterpart AI::MXNet::EvalMetric
+# (perl-package/AI-MXNet/lib/AI/MXNet/Metric.pm): running
+# sum_metric/num_inst accumulators with reset/get, created by name.
+use strict;
+use warnings;
+
+my %REGISTRY = (
+    acc      => 'AI::MXNetTPU::Metric::Accuracy',
+    accuracy => 'AI::MXNetTPU::Metric::Accuracy',
+    mse      => 'AI::MXNetTPU::Metric::MSE',
+);
+
+sub create {
+    my ($class, $name, %params) = @_;
+    return $name if ref $name;      # already a metric object
+    my $impl = $REGISTRY{lc $name}
+        or die "unknown metric '$name' (have: "
+             . join(", ", sort keys %REGISTRY) . ")\n";
+    return $impl->new(%params);
+}
+
+sub new {
+    my ($class, %params) = @_;
+    my $self = bless { name => $params{name} // lc((split /::/, $class)[-1]),
+                       sum_metric => 0, num_inst => 0 }, $class;
+    return $self;
+}
+
+sub reset {
+    my ($self) = @_;
+    @$self{qw(sum_metric num_inst)} = (0, 0);
+}
+
+sub get {
+    my ($self) = @_;
+    return ($self->{name},
+            $self->{num_inst} ? $self->{sum_metric} / $self->{num_inst}
+                              : 'nan');
+}
+
+# update(\@labels, $pred_ndarray_or_flat_list, $nrows?) — subclasses
+sub update { die "abstract" }
+
+package AI::MXNetTPU::Metric::Accuracy;
+our @ISA = ('AI::MXNetTPU::Metric');
+
+sub update {
+    my ($self, $labels, $pred, $nrows) = @_;
+    my $probs = ref($pred) eq 'ARRAY' ? $pred : $pred->aslist;
+    $nrows //= scalar @$labels;
+    my $ncls = @$probs / @$labels;
+    for my $i (0 .. $nrows - 1) {
+        my ($best, $besti) = (-9**99, 0);
+        for my $c (0 .. $ncls - 1) {
+            my $v = $probs->[$i * $ncls + $c];
+            ($best, $besti) = ($v, $c) if $v > $best;
+        }
+        ++$self->{sum_metric} if $besti == $labels->[$i];
+        ++$self->{num_inst};
+    }
+}
+
+package AI::MXNetTPU::Metric::MSE;
+our @ISA = ('AI::MXNetTPU::Metric');
+
+sub update {
+    my ($self, $labels, $pred, $nrows) = @_;
+    my $out = ref($pred) eq 'ARRAY' ? $pred : $pred->aslist;
+    $nrows //= scalar @$labels;
+    my $per_row = @$out / @$labels;
+    for my $i (0 .. $nrows - 1) {
+        my $err = 0;
+        for my $j (0 .. $per_row - 1) {
+            my $d = $out->[$i * $per_row + $j] - $labels->[$i];
+            $err += $d * $d;
+        }
+        $self->{sum_metric} += $err / $per_row;
+        ++$self->{num_inst};
+    }
+}
+
+1;

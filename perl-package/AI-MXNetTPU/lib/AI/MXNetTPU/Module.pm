@@ -1,0 +1,269 @@
+package AI::MXNetTPU::Module;
+# Module-tier trainer — reference counterpart AI::MXNet::Module
+# (perl-package/AI-MXNet/lib/AI/MXNet/Module.pm): the intermediate-level
+# interface with explicit bind / init_params / init_optimizer /
+# forward / backward / update / update_metric lifecycle, plus the
+# high-level fit/score/predict loops on top of exactly those calls.
+# Runs over the same C-ABI Executor as AI::MXNetTPU::Model, but with a
+# pluggable Optimizer (AI::MXNetTPU::Optimizer registry, per-index
+# state) and Metric (AI::MXNetTPU::Metric) instead of a hardwired
+# sgd_mom loop.
+use strict;
+use warnings;
+use AI::MXNetTPU ();
+use AI::MXNetTPU::NDArray ();
+use AI::MXNetTPU::Symbol ();
+use AI::MXNetTPU::Executor ();
+use AI::MXNetTPU::Optimizer ();
+use AI::MXNetTPU::Metric ();
+
+# new(symbol => $sym, data_names => ['data'],
+#     label_names => ['softmax_label'], dev_type => 'cpu', dev_id => 0)
+sub new {
+    my ($class, %spec) = @_;
+    die "Module->new needs symbol =>\n" unless $spec{symbol};
+    return bless {
+        symbol      => $spec{symbol},
+        data_names  => $spec{data_names}  // ['data'],
+        label_names => $spec{label_names} // ['softmax_label'],
+        dev_type    => $spec{dev_type}    // 'cpu',
+        dev_id      => $spec{dev_id}      // 0,
+        binded      => 0,
+        params_initialized    => 0,
+        optimizer_initialized => 0,
+    }, $class;
+}
+
+sub _dev { my ($self) = @_;
+           return (dev_type => $self->{dev_type},
+                   dev_id => $self->{dev_id}); }
+
+# bind(data_shapes => { data => [N, ...] },
+#      label_shapes => { softmax_label => [N] }, for_training => 1)
+sub bind {
+    my ($self, %spec) = @_;
+    return $self if $self->{binded};
+    my %shapes = (%{ $spec{data_shapes} }, %{ $spec{label_shapes} // {} });
+    my $sym = $self->{symbol};
+    my ($arg_shapes, undef, $aux_shapes) = $sym->infer_shape(%shapes);
+    my %dev = $self->_dev;
+    my %is_input = map { $_ => 1 }
+        (@{ $self->{data_names} }, @{ $self->{label_names} });
+    my $training = $spec{for_training} // 1;
+
+    my (@args, @grads, @reqs);
+    my (%inputs, %params, %grads_of);
+    for my $name (@{ $sym->list_arguments }) {
+        my $arr = AI::MXNetTPU::NDArray->zeros($arg_shapes->{$name}, %dev);
+        push @args, $arr;
+        if ($is_input{$name}) {
+            push @grads, undef;
+            push @reqs, 'null';
+            $inputs{$name} = $arr;
+        } else {
+            my $want_grad = $training;
+            push @grads, $want_grad
+                ? AI::MXNetTPU::NDArray->zeros($arg_shapes->{$name}, %dev)
+                : undef;
+            push @reqs, $want_grad ? 'write' : 'null';
+            $params{$name} = $arr;
+            $grads_of{$name} = $grads[-1] if $want_grad;
+        }
+    }
+    my @aux = map { AI::MXNetTPU::NDArray->zeros($aux_shapes->{$_}, %dev) }
+        @{ $sym->list_auxiliary_states };
+
+    $self->{inputs} = \%inputs;
+    $self->{params} = \%params;
+    $self->{grads} = \%grads_of;
+    $self->{aux} = \@aux;
+    $self->{batch_size} = (values %{ $spec{data_shapes} })[0][0];
+    $self->{exec} = AI::MXNetTPU::Executor->bind(
+        $sym, args => \@args, grads => \@grads, reqs => \@reqs,
+        aux => \@aux, %dev);
+    $self->{binded} = 1;
+    return $self;
+}
+
+# init_params(initializer => sub { my ($name, $arr) = @_; ... },
+#             scale => 0.07)  — default: uniform(-scale, scale)
+sub init_params {
+    my ($self, %spec) = @_;
+    die "bind first\n" unless $self->{binded};
+    return $self if $self->{params_initialized} && !$spec{force_init};
+    my $scale = $spec{scale} // 0.07;
+    my $init = $spec{initializer} // sub {
+        my ($name, $arr) = @_;
+        my $n = $arr->size;
+        $arr->set([map { (rand() * 2 - 1) * $scale } 1 .. $n]);
+    };
+    for my $name (sort keys %{ $self->{params} }) {
+        $init->($name, $self->{params}{$name});
+    }
+    $self->{params_initialized} = 1;
+    return $self;
+}
+
+# init_optimizer(optimizer => 'sgd'|'adam'|$object,
+#                optimizer_params => { learning_rate => 0.1, ... })
+sub init_optimizer {
+    my ($self, %spec) = @_;
+    die "bind + init_params first\n"
+        unless $self->{binded} && $self->{params_initialized};
+    my $opt = $spec{optimizer} // 'sgd';
+    if (!ref $opt) {
+        my %params = %{ $spec{optimizer_params} // {} };
+        # the loss head emits SUM-over-batch gradients; the python
+        # Module's init_optimizer defaults rescale_grad to 1/batch the
+        # same way (module.py rescale_grad = 1.0/batch_size)
+        $params{rescale_grad} //= 1.0 / $self->{batch_size};
+        $opt = AI::MXNetTPU::Optimizer->create($opt, %params);
+    }
+    $self->{optimizer} = $opt;
+    # per-index optimizer state, reference Updater convention: index =
+    # position of the param in sorted order
+    my @names = sort keys %{ $self->{grads} };
+    $self->{_opt_names} = \@names;
+    $self->{_opt_state} = [map {
+        $opt->create_state($_, $self->{params}{ $names[$_] })
+    } 0 .. $#names];
+    $self->{optimizer_initialized} = 1;
+    return $self;
+}
+
+# forward({ data => \@flat, softmax_label => \@flat }, is_train => 1)
+sub forward {
+    my ($self, $batch, %spec) = @_;
+    for my $name (keys %$batch) {
+        my $arr = $self->{inputs}{$name}
+            or die "forward: '$name' is not a bound input\n";
+        $arr->set($batch->{$name});
+    }
+    $self->{exec}->forward($spec{is_train} // 1);
+    return $self;
+}
+
+sub backward { my ($self) = @_; $self->{exec}->backward([]); return $self; }
+
+sub update {
+    my ($self) = @_;
+    die "init_optimizer first\n" unless $self->{optimizer_initialized};
+    my $names = $self->{_opt_names};
+    for my $i (0 .. $#$names) {
+        my $name = $names->[$i];
+        $self->{optimizer}->update(
+            $i, $self->{params}{$name}, $self->{grads}{$name},
+            $self->{_opt_state}[$i]);
+    }
+    return $self;
+}
+
+sub get_outputs { my ($self) = @_; return $self->{exec}->outputs; }
+
+sub update_metric {
+    my ($self, $metric, $labels, $nrows) = @_;
+    $metric->update($labels, $self->get_outputs->[0], $nrows);
+}
+
+sub get_params {
+    my ($self) = @_;
+    return ({ map { $_ => $self->{params}{$_} } keys %{ $self->{params} } },
+            [@{ $self->{aux} }]);
+}
+
+sub set_params {
+    my ($self, $arg_params) = @_;
+    for my $name (keys %$arg_params) {
+        my $dst = $self->{params}{$name} or next;
+        my $src = $arg_params->{$name};
+        $dst->set(ref($src) eq 'ARRAY' ? $src : $src->aslist);
+    }
+    $self->{params_initialized} = 1;
+    return $self;
+}
+
+# -- high-level loops (reference BaseModule fit/score/predict) ----------
+sub _batches {
+    my ($self, $X, $y, $b) = @_;
+    my $bs = $self->{batch_size};
+    my (@xb, @yb);
+    my $real = 0;
+    for my $k (0 .. $bs - 1) {
+        my $i = $b * $bs + $k;
+        ++$real if $i < @$X;
+        $i %= @$X;                      # roll-over pad, like NDArrayIter
+        push @xb, @{ $X->[$i] };
+        push @yb, defined $y ? $y->[$i] : 0;
+    }
+    return (\@xb, \@yb, $real);
+}
+
+# fit(data => \@rows, label => \@labels, batch_size => N, epochs => E,
+#     optimizer => 'sgd', optimizer_params => {...}, eval_metric => 'acc')
+# returns the final epoch's training-metric value.
+sub fit {
+    my ($self, %spec) = @_;
+    my ($X, $y) = @spec{qw(data label)};
+    my $bs = $spec{batch_size} // 32;
+    my $dims = $spec{dims} // [scalar @{ $X->[0] }];
+    my ($dname) = @{ $self->{data_names} };
+    my ($lname) = @{ $self->{label_names} };
+    $self->bind(data_shapes => { $dname => [$bs, @$dims] },
+                label_shapes => { $lname => [$bs] });
+    $self->init_params(%spec);
+    $self->init_optimizer(%spec) unless $self->{optimizer_initialized};
+    my $metric = AI::MXNetTPU::Metric->create($spec{eval_metric} // 'acc');
+    my $nb = int((@$X + $bs - 1) / $bs);
+    my $value;
+    for my $epoch (1 .. ($spec{epochs} // 5)) {
+        $metric->reset;
+        for my $b (0 .. $nb - 1) {
+            my ($xb, $yb, $real) = $self->_batches($X, $y, $b);
+            $self->forward({ $dname => $xb, $lname => $yb },
+                           is_train => 1);
+            $self->backward;
+            $self->update;
+            $self->update_metric($metric, $yb, $real);
+        }
+        (undef, $value) = $metric->get;
+    }
+    return $value;
+}
+
+sub score {
+    my ($self, %spec) = @_;
+    my ($X, $y) = @spec{qw(data label)};
+    my ($dname) = @{ $self->{data_names} };
+    my ($lname) = @{ $self->{label_names} };
+    my $metric = AI::MXNetTPU::Metric->create($spec{eval_metric} // 'acc');
+    my $bs = $self->{batch_size};
+    my $nb = int((@$X + $bs - 1) / $bs);
+    for my $b (0 .. $nb - 1) {
+        my ($xb, $yb, $real) = $self->_batches($X, $y, $b);
+        $self->forward({ $dname => $xb, $lname => $yb }, is_train => 0);
+        $self->update_metric($metric, $yb, $real);
+    }
+    my (undef, $value) = $metric->get;
+    return $value;
+}
+
+sub predict {
+    my ($self, %spec) = @_;
+    my $X = $spec{data};
+    my ($dname) = @{ $self->{data_names} };
+    my ($lname) = @{ $self->{label_names} };
+    my $bs = $self->{batch_size};
+    my $nb = int((@$X + $bs - 1) / $bs);
+    my @rows;
+    for my $b (0 .. $nb - 1) {
+        my ($xb, $yb, $real) = $self->_batches($X, undef, $b);
+        $self->forward({ $dname => $xb, $lname => $yb }, is_train => 0);
+        my $out = $self->get_outputs->[0]->aslist;
+        my $per = @$out / $bs;
+        push @rows, [@$out[$_ * $per .. ($_ + 1) * $per - 1]]
+            for 0 .. $real - 1;
+    }
+    return \@rows;
+}
+
+1;

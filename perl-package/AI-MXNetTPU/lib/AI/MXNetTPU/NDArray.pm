@@ -68,6 +68,15 @@ sub uniform {
 }
 
 sub shape  { my ($self) = @_; return [AI::MXNetTPU::nd_shape($self->{handle})]; }
+
+# device: (dev_type => 'cpu'|'tpu', dev_id => N) — splattable into
+# zeros/ones/from_array so new arrays land beside this one
+my %DEV_NAME = (1 => 'cpu', 2 => 'tpu');
+sub device {
+    my ($self) = @_;
+    my ($type, $id) = AI::MXNetTPU::nd_context($self->{handle});
+    return { dev_type => $DEV_NAME{$type} // 'cpu', dev_id => $id };
+}
 sub size   { my $n = 1; $n *= $_ for @{ $_[0]->shape }; return $n; }
 sub aslist { my ($self) = @_; return [AI::MXNetTPU::nd_to_array($self->{handle})]; }
 sub set    { my ($self, $data) = @_; AI::MXNetTPU::nd_copy_from($self->{handle}, $data); return $self; }

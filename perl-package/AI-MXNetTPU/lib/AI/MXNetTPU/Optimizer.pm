@@ -1,0 +1,116 @@
+package AI::MXNetTPU::Optimizer;
+# Optimizer registry over the fused update ops — reference counterpart
+# AI::MXNet::Optimizer (perl-package/AI-MXNet/lib/AI/MXNet/Optimizer.pm):
+# create-by-name, per-index state creation, update() dispatching to the
+# SAME fused kernels the python frontend uses (sgd_update /
+# sgd_mom_update / adam_update via the imperative C ABI).
+use strict;
+use warnings;
+use AI::MXNetTPU::NDArray ();
+
+my %REGISTRY = (
+    sgd  => 'AI::MXNetTPU::Optimizer::SGD',
+    adam => 'AI::MXNetTPU::Optimizer::Adam',
+);
+
+sub create {
+    my ($class, $name, %params) = @_;
+    my $impl = $REGISTRY{lc $name}
+        or die "unknown optimizer '$name' (have: "
+             . join(", ", sort keys %REGISTRY) . ")\n";
+    return $impl->new(%params);
+}
+
+sub register {
+    my ($class, $name, $impl) = @_;
+    $REGISTRY{lc $name} = $impl;
+}
+
+# -- shared base ---------------------------------------------------------
+sub new {
+    my ($class, %params) = @_;
+    my $self = bless {
+        learning_rate => $params{learning_rate} // 0.01,
+        wd            => $params{wd} // 0.0,
+        rescale_grad  => $params{rescale_grad} // 1.0,
+        lr_mult       => $params{lr_mult} // {},
+        num_update    => 0,
+    }, $class;
+    $self->_init(%params);
+    return $self;
+}
+
+sub _init { }
+
+sub _lr {
+    my ($self, $index) = @_;
+    my $mult = $self->{lr_mult}{$index} // 1.0;
+    return $self->{learning_rate} * $mult;
+}
+
+package AI::MXNetTPU::Optimizer::SGD;
+our @ISA = ('AI::MXNetTPU::Optimizer');
+
+sub _init {
+    my ($self, %params) = @_;
+    $self->{momentum} = $params{momentum} // 0.0;
+}
+
+# state: momentum buffer (undef when momentum == 0, like the reference)
+sub create_state {
+    my ($self, $index, $weight) = @_;
+    return undef if !$self->{momentum};
+    return AI::MXNetTPU::NDArray->zeros($weight->shape,
+                                        %{ $weight->device });
+}
+
+sub update {
+    my ($self, $index, $weight, $grad, $state) = @_;
+    ++$self->{num_update};
+    my %hyper = (lr => $self->_lr($index), wd => $self->{wd},
+                 rescale_grad => $self->{rescale_grad});
+    if (defined $state) {
+        AI::MXNetTPU::NDArray::invoke(
+            'sgd_mom_update', [$weight, $grad, $state],
+            { %hyper, momentum => $self->{momentum} },
+            [$weight, $state]);
+    } else {
+        AI::MXNetTPU::NDArray::invoke(
+            'sgd_update', [$weight, $grad], \%hyper, [$weight]);
+    }
+}
+
+package AI::MXNetTPU::Optimizer::Adam;
+our @ISA = ('AI::MXNetTPU::Optimizer');
+
+sub _init {
+    my ($self, %params) = @_;
+    $self->{beta1} = $params{beta1} // 0.9;
+    $self->{beta2} = $params{beta2} // 0.999;
+    $self->{epsilon} = $params{epsilon} // 1e-8;
+    $self->{t} = {};
+}
+
+sub create_state {
+    my ($self, $index, $weight) = @_;
+    my %dev = %{ $weight->device };
+    return [AI::MXNetTPU::NDArray->zeros($weight->shape, %dev),
+            AI::MXNetTPU::NDArray->zeros($weight->shape, %dev)];
+}
+
+sub update {
+    my ($self, $index, $weight, $grad, $state) = @_;
+    my $t = ++$self->{t}{$index};
+    # bias-corrected step size, exactly like the python frontend
+    my $coef1 = 1.0 - $self->{beta1} ** $t;
+    my $coef2 = 1.0 - $self->{beta2} ** $t;
+    my $lr = $self->_lr($index) * sqrt($coef2) / $coef1;
+    AI::MXNetTPU::NDArray::invoke(
+        'adam_update', [$weight, $grad, @$state],
+        { lr => $lr, beta1 => $self->{beta1}, beta2 => $self->{beta2},
+          epsilon => $self->{epsilon}, wd => $self->{wd},
+          rescale_grad => $self->{rescale_grad} },
+        [$weight, @$state]);
+}
+
+1;

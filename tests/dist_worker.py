@@ -118,7 +118,10 @@ def run_fit(kv):
     rank, nworker = kv.rank, kv.num_workers
     onp.random.seed(7)  # same base dataset everywhere
     X = onp.random.rand(96, 8).astype(onp.float32)
-    y = onp.random.randint(0, 4, 96).astype(onp.float32)
+    # learnable labels (linear map) so the convergence A/B below can
+    # compare sync vs async FINAL ACCURACY, not just checksums
+    W = onp.random.rand(8, 4).astype(onp.float32)
+    y = (X @ W).argmax(axis=1).astype(onp.float32)
     # rank's shard, reference data-parallel convention
     Xr = X[rank::nworker]
     yr = y[rank::nworker]
@@ -136,7 +139,8 @@ def run_fit(kv):
     if os.environ.get("DIST_FIT_RESCALE"):
         optimizer_params["rescale_grad"] = float(
             os.environ["DIST_FIT_RESCALE"])
-    mod.fit(it, num_epoch=3, kvstore=kv, optimizer="sgd",
+    epochs = int(os.environ.get("DIST_FIT_EPOCHS", "3"))
+    mod.fit(it, num_epoch=epochs, kvstore=kv, optimizer="sgd",
             optimizer_params=optimizer_params,
             initializer=mx.initializer.Xavier())
     args, _ = mod.get_params()
@@ -146,6 +150,12 @@ def run_fit(kv):
     kv.barrier()
     print("DIST_FIT_CHECKSUM rank=%d type=%s sum=%s"
           % (rank, kv.type, h.hexdigest()), flush=True)
+    # full-dataset accuracy (same on every rank: params are identical)
+    score_it = mx.io.NDArrayIter(X, y, batch_size=8,
+                                 label_name="softmax_label")
+    acc = mod.score(score_it, mx.metric.Accuracy())[0][1]
+    print("DIST_FIT_ACC rank=%d type=%s acc=%.4f"
+          % (rank, kv.type, acc), flush=True)
 
 
 def main():

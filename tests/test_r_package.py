@@ -21,6 +21,7 @@ same flows natively; tier 1 keeps the shim honest without it.
 import os
 import re
 import subprocess
+import sys
 
 import pytest
 
@@ -93,7 +94,7 @@ def _namespace_exports():
     exports = set()
     for block in re.findall(r"export\(([^)]*)\)", ns):
         for name in block.split(","):
-            name = name.strip()
+            name = name.strip().strip("`")
             if name:
                 exports.add(name)
     return exports
@@ -322,3 +323,36 @@ def test_demo_vignette_library_name_matches_description():
             assert call == pkg_name, (
                 "%s loads '%s' but DESCRIPTION declares '%s'"
                 % (fn, call, pkg_name))
+
+
+def test_r_generated_ops_fresh():
+    """The generated op breadth (R-package/R/mxnet_generated.R, reference
+    mxnet_generated.R counterpart) must match the LIVE registry — the
+    generator re-runs and diffs, so a new op or changed signature fails
+    CI until regenerated."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_r_ops.py"),
+         "--check"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fresh" in proc.stdout
+
+
+def test_r_generated_ops_cover_registry():
+    import mxnet_tpu.capi_bridge as cb
+    with open(os.path.join(PKG, "R", "mxnet_generated.R")) as f:
+        src = f.read()
+    def static_shape(n):
+        try:
+            cb.func_info(n)
+            return True
+        except Exception:  # Custom/TorchModule: attr-dispatched signature
+            return False
+
+    hand = "\n".join(s for _, s in _r_sources())
+    public = [n for n in cb.all_op_names()
+              if not n.startswith("_") and static_shape(n)]
+    missing = [n for n in public
+               if "mx.nd.%s <- function" % n not in src
+               and not re.search(r"^mx\.nd\.%s\s*<-" % re.escape(n), hand,
+                                 re.M)]
+    assert not missing, "ops without generated wrappers: %s" % missing[:10]

@@ -61,8 +61,11 @@ def iter_computations(hlo_text):
     lines = []
     for ln in hlo_text.splitlines():
         stripped = ln.strip()
-        m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^)]*\)\s*->", stripped)
-        if m and stripped.endswith("{"):
+        # header: [ENTRY] %name (params...) -> type {   — params may nest
+        # parens (tuple-typed args), so only anchor name( ... ){ and ->
+        m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*\(", stripped)
+        if m and stripped.endswith("{") and "->" in stripped and \
+                not ln.startswith(" "):
             comp = m.group(1)
             lines = []
             continue
@@ -96,21 +99,49 @@ def collect_collectives(hlo_text):
     return out
 
 
-def summarize(hlo_text, loop_trip_counts=None, n_chips=8):
-    """Aggregate collective payloads. ``loop_trip_counts``: {substring:
-    trips} matched against computation names — collectives inside while
-    bodies execute per loop iteration, which static HLO text cannot
-    count; the caller knows the schedule it built."""
-    loop_trip_counts = loop_trip_counts or {}
+def loop_body_computations(hlo_text):
+    """Names of computations reachable from a `while` op's body/condition
+    — XLA names scan regions opaquely (e.g. ``region_0.2.sunk``, never
+    'while'), so loop membership must come from the while instructions'
+    own body=/condition= attributes, transitively through calls/fusions."""
+    called = {}
+    loop_roots = set()
+    call_re = re.compile(
+        r"(?:calls=|to_apply=|body=|condition=)%?([\w.\-]+)")
+    for comp, lines in iter_computations(hlo_text):
+        refs = set()
+        for ln in lines:
+            m = _split_instr(ln)
+            if m is None:
+                continue
+            _name, _type, opcode, rest = m
+            names = call_re.findall(ln)
+            refs.update(names)
+            if opcode == "while":
+                loop_roots.update(names)
+        called[comp] = refs
+    out = set()
+    frontier = set(loop_roots)
+    while frontier:
+        comp = frontier.pop()
+        if comp in out:
+            continue
+        out.add(comp)
+        frontier |= called.get(comp, set())
+    return out
+
+
+def summarize(hlo_text, loop_trips=1, n_chips=8):
+    """Aggregate collective payloads. ``loop_trips``: iteration count
+    applied to every collective living inside a while/scan body — static
+    HLO text cannot count trips, but the caller built the schedule and
+    knows them."""
+    in_loop = loop_body_computations(hlo_text) if loop_trips != 1 else set()
     per_op = collections.Counter()
     ring_bytes = 0.0
     rows = []
     for comp, opcode, nbytes, name in collect_collectives(hlo_text):
-        trips = 1
-        for sub, t in loop_trip_counts.items():
-            if sub in comp:
-                trips = t
-                break
+        trips = loop_trips if comp in in_loop else 1
         total = nbytes * trips
         per_op[opcode] += total
         # per-chip ICI traffic: ring all-reduce moves 2(N-1)/N * payload;
@@ -179,14 +210,20 @@ def _mesh_module(net, data_shape, label_shape, mesh_axes, n_dev,
     return compiled_step(eg)
 
 
-def build_dp(n_dev=8, per_dev_batch=128):
-    """Headline shape: ResNet-50 dp over all chips (grad psum)."""
+def build_dp(n_dev=8, per_dev_batch=8):
+    """Headline shape: ResNet-50 dp over all chips (grad psum).
+
+    dp collective volume is PARAM-sized (one gradient all-reduce), not
+    batch-sized — so the audit compiles at a small per-device batch (the
+    bs128 program takes >40min of CPU XLA compile for identical
+    collective bytes)."""
     from mxnet_tpu import models
     net = models.get_symbol("resnet-50", num_classes=1000)
     b = per_dev_batch * n_dev
     comp = _mesh_module(net, (b, 3, 224, 224), (b,), {"dp": n_dev}, n_dev)
-    return comp, {}, {"mode": "dp%d" % n_dev, "model": "resnet-50",
-                      "global_batch": b}
+    return comp, 1, {"mode": "dp%d" % n_dev, "model": "resnet-50",
+                      "global_batch": b,
+                      "note": "collective volume is batch-independent"}
 
 
 def build_tp(n_dev=8, d=1024, ff=4096, layers=4, batch=256):
@@ -208,7 +245,7 @@ def build_tp(n_dev=8, d=1024, ff=4096, layers=4, batch=256):
     comp = _mesh_module(x, (batch, d), (batch,),
                         {"dp": n_dp, "tp": n_tp}, n_dev,
                         param_sharding=rules)
-    return comp, {}, {"mode": "dp%d*tp%d" % (n_dp, n_tp),
+    return comp, 1, {"mode": "dp%d*tp%d" % (n_dp, n_tp),
                       "model": "megatron-mlp d%d ff%d L%d" % (d, ff, layers),
                       "global_batch": batch}
 
@@ -234,7 +271,7 @@ def build_pp(n_dev=8, d=512, microbatches=4, batch=64):
                         pipeline_microbatches=microbatches)
     # ppermutes live in the scan over the GPipe schedule:
     # (microbatches + n_pp - 1) iterations, forward and backward
-    trips = {"while": 2 * (microbatches + n_pp - 1)}
+    trips = 2 * (microbatches + n_pp - 1)
     return comp, trips, {"mode": "dp%d*pp%d" % (n_dp, n_pp),
                          "model": "gpipe-mlp d%d M%d" % (d, microbatches),
                          "global_batch": batch}
@@ -255,7 +292,7 @@ def build_ep(n_dev=8, d=512, ff=2048, experts=8, batch=64, seq=64):
     comp = _mesh_module(net, (batch * seq, d), (batch * seq,),
                         {"dp": n_dp, "ep": n_ep}, n_dev,
                         param_sharding=[("moe_expert", ("ep",))])
-    return comp, {}, {"mode": "dp%d*ep%d" % (n_dp, n_ep),
+    return comp, 1, {"mode": "dp%d*ep%d" % (n_dp, n_ep),
                       "model": "moe d%d ff%d E%d" % (d, ff, experts),
                       "global_batch": batch * seq}
 
@@ -272,7 +309,7 @@ def build_sp(n_dev=8, heads=8, seq=2048, dhead=64, batch=4):
     comp = _mesh_module(a, (batch, heads, seq, dhead), (batch,),
                         {"dp": n_dp, "sp": n_sp}, n_dev)
     # k/v blocks rotate sp-1 times per attention call, fwd + bwd replay
-    trips = {"while": 2 * (n_sp - 1)}
+    trips = 2 * (n_sp - 1)
     return comp, trips, {"mode": "dp%d*sp%d" % (n_dp, n_sp),
                          "model": "ring-attn h%d s%d" % (heads, seq),
                          "global_batch": batch}
@@ -285,7 +322,7 @@ MODES = {"dp": build_dp, "tp": build_tp, "pp": build_pp, "ep": build_ep,
 def run_mode(name, step_ms=None, n_dev=8, **kw):
     comp, trips, meta = MODES[name](n_dev=n_dev, **kw)
     txt = comp.as_text()
-    summary = summarize(txt, trips, n_chips=n_dev)
+    summary = summarize(txt, loop_trips=trips, n_chips=n_dev)
     rec = dict(meta)
     rec["per_op_gb"] = {k: round(v / 1e9, 4)
                         for k, v in summary["per_op_bytes"].items()}
