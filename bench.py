@@ -231,17 +231,11 @@ def main():
     # grows, so unequal counts would leave a residual bias in the slope.
     # Every rep is recorded so the artifact carries its own spread —
     # a PERF claim must quote the artifact band, not a best interactive
-    # run (VERDICT r4 #2).
-    longs = [_window(steps) for _ in range(3)]
-    shorts = [_window(steps_short) for _ in range(3)]
-    t_long, t_short = min(longs), min(shorts)
-    dt = t_long - t_short
-    n_slope = steps - steps_short
-    timing = "two_window_slope"
-    if n_slope <= 0 or dt <= 0:
-        # degenerate (BENCH_STEPS <= 3) or noise swamped the slope:
-        # fall back to the raw window and SAY so in the record
-        dt, n_slope, timing = t_long, steps, "raw_window"
+    # run (VERDICT r4 #2). One shared implementation: bench_timing.py.
+    from bench_timing import two_window_slope
+    sl = two_window_slope(_window, steps, steps_short, reps=3)
+    dt, n_slope, timing = sl["dt"], sl["n_slope"], sl["timing"]
+    t_long = min(sl["longs"])
 
     img_per_sec = n_slope * batch / dt
     achieved_tflops = img_per_sec * FLOPS_PER_IMG_TRAIN / 1e12
@@ -255,17 +249,14 @@ def main():
              "achieved_tflops": round(achieved_tflops, 2),
              "device_kind": devices[0].device_kind}
     if timing == "two_window_slope":
-        extra["window_fixed_cost_ms"] = round(
-            (t_short - t_long * steps_short / steps) * 1000 /
-            max(1e-9, 1 - steps_short / steps), 1)
+        extra["window_fixed_cost_ms"] = round(sl["fixed_cost_s"] * 1000, 1)
         extra["window_reps_s"] = {
-            "long": [round(t, 3) for t in longs],
-            "short": [round(t, 3) for t in shorts]}
+            "long": [round(t, 3) for t in sl["longs"]],
+            "short": [round(t, 3) for t in sl["shorts"]]}
         # pairwise slope band: rate from every (long, short) rep pair —
         # the honest min/median/max of what this harness can claim
-        pair_rates = sorted(
-            n_slope * batch / (tl - ts)
-            for tl in longs for ts in shorts if tl > ts)
+        pair_rates = [n_slope * batch / d for d in sl["pair_dts"]]
+        pair_rates.sort()
         if pair_rates:
             mid = pair_rates[len(pair_rates) // 2]
             extra["img_per_sec_band"] = {
@@ -371,17 +362,21 @@ def _bench_fit(mx, mod, batches, batch, step_img_per_sec, steps):
         return time.time() - t0
 
     run(1)  # warm the fit path (metric program recompile)
-    longs = [run(4) for _ in range(2)]
-    shorts = [run(2) for _ in range(2)]
-    t_long, t_short = min(longs), min(shorts)
+    from bench_timing import two_window_slope
+    sl = two_window_slope(run, 4, 2, reps=2)
     out = {"fit_epoch_batches": ep_batches,
-           "fit_reps_s": {"long": [round(t, 3) for t in longs],
-                          "short": [round(t, 3) for t in shorts]}}
-    if t_long > t_short > 0:
-        rate = 2 * ep_batches * batch / (t_long - t_short)
+           "fit_reps_s": {"long": [round(t, 3) for t in sl["longs"]],
+                          "short": [round(t, 3) for t in sl["shorts"]]}}
+    rate = sl["n_slope"] * ep_batches * batch / sl["dt"] \
+        if sl["dt"] > 0 else 0.0
+    # plausibility guard: fit cannot beat the raw step rate — a slope
+    # from noise-dominated near-equal windows once recorded 11.8x
+    # (bench_runs/r5/run1_full.json, pre-token-fix recompiles)
+    if sl["timing"] == "two_window_slope" and \
+            (step_img_per_sec <= 0 or rate <= 1.2 * step_img_per_sec):
         out["fit_img_per_sec"] = round(rate, 2)
-        pair = sorted(2 * ep_batches * batch / (tl - ts)
-                      for tl in longs for ts in shorts if tl > ts)
+        pair = sorted(sl["n_slope"] * ep_batches * batch / d
+                      for d in sl["pair_dts"])
         if pair:
             out["fit_img_per_sec_band"] = {
                 "min": round(pair[0], 1),
@@ -394,8 +389,13 @@ def _bench_fit(mx, mod, batches, batch, step_img_per_sec, steps):
                                            None) is metric
         out["fit_train_acc"] = round(float(metric.get()[1]), 4)
     else:
-        out["fit_error"] = "degenerate fit windows (%.2fs vs %.2fs)" % (
-            t_long, t_short)
+        out["fit_error"] = "degenerate fit windows: %r vs %r" % (
+            sl["longs"], sl["shorts"])
+        if step_img_per_sec > 0 and rate > 1.2 * step_img_per_sec:
+            out["fit_error"] = ("implausible fit slope %.0f img/s vs "
+                                "step %.0f — windows %r vs %r"
+                                % (rate, step_img_per_sec, sl["longs"],
+                                   sl["shorts"]))
     return out
 
 

@@ -288,13 +288,9 @@ def measure(batch=128, steps=20, compute_dtype="bfloat16", img=224):
         barrier()
         return time.time() - t0
 
-    steps_short = max(3, steps // 5)
-    t_long = min(_window(steps) for _ in range(3))
-    t_short = min(_window(steps_short) for _ in range(3))
-    dt, n_slope = t_long - t_short, steps - steps_short
-    if n_slope <= 0 or dt <= 0:
-        dt, n_slope = t_long, steps
-    return n_slope * batch / dt
+    from bench_timing import two_window_slope
+    sl = two_window_slope(_window, steps, max(3, steps // 5), reps=3)
+    return sl["n_slope"] * batch / sl["dt"]
 
 
 if __name__ == "__main__":

@@ -13,15 +13,24 @@ import mxnet_tpu as mx
 from mxnet_tpu import models
 
 
-def score(network, dev, batch_size, num_batches, batch_group=1):
+def score(network, dev, batch_size, num_batches, batch_group=1,
+          compute_dtype=None):
     if network == "inception-v3":
         data_shape = (batch_size, 3, 299, 299)
     else:
         data_shape = (batch_size, 3, 224, 224)
     sym = models.get_symbol(network, num_classes=1000)
 
+    # bf16 activations on TPU (MXU-native + half the HBM bytes), like
+    # the training bench — an f32 eval program moves 15.9 GB/batch vs
+    # 7.7 GB and scores ~2.4x slower (measured round 5). NB: gate on
+    # the JAX platform — Context.device_type says 'gpu' for mx.tpu()
+    # (reference device-code compat)
+    if compute_dtype is None and dev.jax_device().platform == "tpu":
+        compute_dtype = "bfloat16"
     mod = mx.mod.Module(sym, context=dev,
-                        label_names=["softmax_label"])
+                        label_names=["softmax_label"],
+                        compute_dtype=compute_dtype)
     mod.bind(for_training=False, inputs_need_grad=False,
              data_shapes=[("data", data_shape)], label_shapes=None)
     mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
@@ -73,14 +82,27 @@ def score(network, dev, batch_size, num_batches, batch_group=1):
         out = dispatch()
     barrier(out)
     launches = num_batches // batch_group if grouped else num_batches
-    tic = time.time()
-    for _ in range(launches):
-        out = dispatch()
-    # single-queue device: the last forward completes after all others
-    barrier(out)
-    # effective group: 1 when the non-fused fallback ran per-batch
-    return (num_batches * batch_size / (time.time() - tic),
-            batch_group if grouped else 1)
+
+    def window(n):
+        tic = time.time()
+        out = None
+        for _ in range(n):
+            out = dispatch()
+        # single-queue device: the last forward completes after all
+        # others; the barrier is the window's one readback
+        barrier(out)
+        return time.time() - tic
+
+    # two-window slope (PERF.md measurement correction): the window-
+    # ending readback costs ~100-137ms on this transport — a single
+    # window understates short scoring runs by double digits. One
+    # shared implementation: bench_timing.two_window_slope.
+    from bench_timing import two_window_slope
+    sl = two_window_slope(window, launches, max(1, launches // 4),
+                          reps=3)
+    eff_batch = batch_size * (batch_group if grouped else 1)
+    rate = sl["n_slope"] * eff_batch / sl["dt"]
+    return rate, (batch_group if grouped else 1)
 
 
 if __name__ == "__main__":
@@ -91,11 +113,14 @@ if __name__ == "__main__":
     parser.add_argument("--num-batches", type=int, default=10)
     parser.add_argument("--batch-group", type=int, default=1,
                         help="batches scored per XLA launch (fused path)")
+    parser.add_argument("--dtype", default=None,
+                        help="compute dtype (default: bfloat16 on TPU)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     dev = mx.tpu(0) if args.tpus is not None else mx.cpu()
     for net in args.networks.split(","):
         speed, eff_group = score(net, dev, args.batch_size,
-                                 args.num_batches, args.batch_group)
+                                 args.num_batches, args.batch_group,
+                                 compute_dtype=args.dtype)
         logging.info("network: %s, batch %d, group %d: %.1f images/sec",
                      net, args.batch_size, eff_group, speed)

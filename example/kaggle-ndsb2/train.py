@@ -1,0 +1,109 @@
+"""Second National Data Science Bowl — cardiac volume regression.
+
+Reference counterpart: example/kaggle-ndsb2/Train.py (CNN over MRI
+frame stacks regressing systole/diastole volumes, scored by CRPS over
+the 600-bin cumulative distribution; Preprocessing.py crops frame
+sequences, Train.R is the R variant). TPU-native version: the same
+CNN-regression + CRPS flow through Module, with a synthetic MRI-like
+dataset (`--synthetic`, the CI path) whose target volume is the area of
+a bright ellipse — learnable, so the CRPS assert is meaningful.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+BINS = 60  # reference uses 600; scaled with the synthetic volume range
+
+
+def heart_net(frames):
+    """Small conv stack over the frame axis -> volume scalar
+    (reference Train.py get_lenet, regression head)."""
+    net = mx.sym.Variable("data")
+    for i, nf in enumerate([16, 32]):
+        net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=nf, name="conv%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=1, name="volume")
+    return mx.sym.LinearRegressionOutput(net, name="lro")
+
+
+def synthetic_mri(n=240, frames=4, img=24, seed=9):
+    """Frame stacks with a bright ellipse; label = its area fraction
+    (the 'ventricle volume')."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, frames, img, img), np.float32)
+    y = np.zeros(n, np.float32)
+    yy, xx = np.mgrid[:img, :img]
+    for i in range(n):
+        a = 3 + rng.rand() * 6
+        b = 3 + rng.rand() * 6
+        mask = (((xx - img / 2) / a) ** 2 + ((yy - img / 2) / b) ** 2) < 1
+        for t in range(frames):
+            X[i, t] = 0.1 * rng.rand(img, img) + mask * 0.9
+        y[i] = mask.mean() * 10.0  # volume in [0, ~5]
+    return X, y
+
+
+def crps(probs_cdf, actual):
+    """Continuous Ranked Probability Score over the BINS-step CDF
+    (reference Train.py / submission scoring)."""
+    grid = np.arange(BINS)[None, :] * (10.0 / BINS)
+    heaviside = (grid >= actual[:, None]).astype(np.float64)
+    return float(((probs_cdf - heaviside) ** 2).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--data-dir", help="preprocessed Kaggle frame stacks")
+    ap.add_argument("--num-epoch", type=int, default=25)
+    ap.add_argument("--batch-size", type=int, default=24)
+    ap.add_argument("--frames", type=int, default=4)
+    args = ap.parse_args()
+
+    if args.data_dir and not args.synthetic:
+        raise NotImplementedError(
+            "real-data path needs the reference's Preprocessing.py crop "
+            "pipeline (example/kaggle-ndsb2/Preprocessing.py); run with "
+            "--synthetic for the end-to-end flow")
+    X, y = synthetic_mri(frames=args.frames)
+    n_train = int(0.8 * len(y))
+    train = mx.io.NDArrayIter(X[:n_train], y[:n_train],
+                              batch_size=args.batch_size, shuffle=True,
+                              label_name="lro_label")
+    val = mx.io.NDArrayIter(X[n_train:], y[n_train:],
+                            batch_size=args.batch_size,
+                            label_name="lro_label")
+
+    mod = mx.mod.Module(heart_net(args.frames), context=mx.cpu(),
+                        label_names=("lro_label",))
+    mx.random.seed(11)
+    mod.fit(train, eval_data=val, eval_metric="mse",
+            num_epoch=args.num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.init.Xavier())
+
+    # CRPS over a step-CDF centered at the predicted volume, the
+    # reference's submission transform (sigmoid-smoothed step)
+    pred = mod.predict(val).asnumpy().ravel()[:len(y) - n_train]
+    actual = y[n_train:]
+    grid = np.arange(BINS)[None, :] * (10.0 / BINS)
+    cdf = 1.0 / (1.0 + np.exp(-(grid - pred[:, None]) / 0.3))
+    score = crps(cdf, actual)
+    mse = float(((pred - actual) ** 2).mean())
+    print("val MSE %.4f  CRPS %.4f" % (mse, score))
+    assert score < 0.08, "CRPS too high: %.4f" % score
+
+
+if __name__ == "__main__":
+    main()

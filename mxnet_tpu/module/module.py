@@ -601,6 +601,16 @@ class Module(BaseModule):
             return self.get_params()
         return super()._epoch_end_params()
 
+    def _epoch_end_sync(self, need_params):
+        if getattr(self._exec_group, "fused", False):
+            # device params are the single authority: host mirrors stay
+            # lazy (get_params materializes on demand) unless a callback
+            # needs them NOW — saves a ~1s/epoch packed readback on
+            # remote-attached transports
+            self._params_dirty = True
+            return self._epoch_end_params() if need_params else None
+        return super()._epoch_end_sync(need_params)
+
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:

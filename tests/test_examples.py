@@ -55,6 +55,10 @@ CASES = [
      ["--iter-num", "5", "--size", "128",
       "--output", "/tmp/profiler_demo_ci.json"]),
     ("moe/train_moe.py", ["--epochs", "10"]),
+    ("kaggle-ndsb1/train_dsb.py", ["--synthetic", "--num-epoch", "15",
+      "--submission", "/tmp/submission_ci.csv"]),
+    ("kaggle-ndsb2/train.py", ["--synthetic", "--num-epoch", "25"]),
+    ("speech-demo/train_timit.py", ["--num-epoch", "15"]),
     ("image-classification/train_imagenet.py",
      ["--network", "resnet-18", "--image-shape", "3,64,64",
       "--batch-size", "16", "--synthetic-images", "64",
