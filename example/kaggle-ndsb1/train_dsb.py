@@ -90,17 +90,25 @@ def main():
     else:
         classes = gen_img_list(args.data_dir, "train_list.csv")
         num_classes = len(classes)
-        from mxnet_tpu.image import imdecode  # real-data path
+        from mxnet_tpu.image import imdecode, _resize  # real-data path
         X, y, names = [], [], []
         with open("train_list.csv") as f:
             for idx, label, rel in csv.reader(f):
                 with open(os.path.join(args.data_dir, rel), "rb") as img_f:
-                    a = imdecode(img_f.read(), to_rgb=False)
-                X.append(np.asarray(a.asnumpy(), np.float32).mean(-1)[None]
+                    a = imdecode(img_f.read(), to_rgb=False).asnumpy()
+                # plankton images are variable-sized: normalize to img²
+                a = _resize(a, args.img, args.img)
+                X.append(np.asarray(a, np.float32).mean(-1)[None]
                          / 255.0)
                 y.append(float(label))
                 names.append(rel)
         X, y = np.stack(X), np.asarray(y, np.float32)
+        # the list csv is class-sorted; an unshuffled tail split would
+        # hold out whole classes (reference gen_img_list.py shuffles)
+        rng = np.random.RandomState(0)
+        order = rng.permutation(len(y))
+        X, y = X[order], y[order]
+        names = [names[i] for i in order]
 
     n_train = int(0.8 * len(y))
     train = mx.io.NDArrayIter(X[:n_train], y[:n_train],

@@ -37,6 +37,33 @@ from ..executor import _build_eval, _build_eval_segmented
 # monotonic tokens for optimizer instances (train_step jit cache keys)
 _STEP_TOKENS = itertools.count()
 
+
+def _compiler_options():
+    """TPU compiler options for the step programs, from
+    ``MXNET_XLA_COMPILER_OPTIONS`` ("key=value,key=value").
+
+    The remote-attached client rejects TPU flags in local XLA_FLAGS
+    (they are remote-compiler flags), but jit's ``compiler_options``
+    rides through the compile service — this is the supported tuning
+    knob (e.g. ``xla_tpu_scoped_vmem_limit_kib=65536``). Reference
+    counterpart: the MXNET_* engine tuning env family."""
+    import os
+    raw = os.environ.get("MXNET_XLA_COMPILER_OPTIONS", "")
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        if "=" in part:
+            key, val = part.split("=", 1)
+            out[key.strip()] = val.strip()
+        elif part.strip():
+            # a typo'd tuning flag must not silently no-op — the whole
+            # point of the knob is measurable effect
+            logging.warning(
+                "MXNET_XLA_COMPILER_OPTIONS: ignoring segment %r "
+                "(expected key=value, comma-separated)", part.strip())
+    return out or None
+
 __all__ = ["MeshExecutorGroup"]
 
 
@@ -310,6 +337,14 @@ class MeshExecutorGroup(object):
             return self._jits[key]
         import jax
 
+        # optional TPU compiler options (MXNET_XLA_COMPILER_OPTIONS)
+        copts = _compiler_options()
+        if copts:
+            import functools
+            jax_jit = functools.partial(jax.jit, compiler_options=copts)
+        else:
+            jax_jit = jax.jit
+
         cdt = self.compute_dtype
         label_names = set(self._label_names)
         grad_names = list(self._grad_names)
@@ -374,7 +409,7 @@ class MeshExecutorGroup(object):
                 outs = tuple(o.astype(onp.float32) for o in outs)
                 return outs, new_aux
 
-            fn = jax.jit(fwd, in_shardings=(psh, repl, batch, None),
+            fn = jax_jit(fwd, in_shardings=(psh, repl, batch, None),
                          out_shardings=(self._out_shardings, repl))
         elif kind == "fwd_eval_stacked":
             # persistent multi-batch scoring: K batches stacked on a
@@ -407,7 +442,7 @@ class MeshExecutorGroup(object):
                 _, outs = jax.lax.scan(body, rng, inputs)
                 return outs
 
-            fn = jax.jit(fwd_stacked,
+            fn = jax_jit(fwd_stacked,
                          in_shardings=(psh, repl, st_batch, None),
                          out_shardings=st_outs)
         elif kind.startswith("train_step:"):
@@ -442,7 +477,7 @@ class MeshExecutorGroup(object):
             # update path gates donation the same way)
             donate = (0, 2) if self._platform != "cpu" else ()
             if mstat is None:
-                fn = jax.jit(
+                fn = jax_jit(
                     step_math,
                     # states: committed per-leaf in step_update (momentum
                     # etc. shard like their param); None = follow the arg
@@ -471,7 +506,7 @@ class MeshExecutorGroup(object):
                     return (outs, new_aux, grads, new_params, new_states,
                             (sums, counts))
 
-                fn = jax.jit(
+                fn = jax_jit(
                     train_step,
                     in_shardings=(psh, repl, None, batch, None, None,
                                   None, (repl, repl)),
@@ -487,7 +522,7 @@ class MeshExecutorGroup(object):
 
             in_sh = (psh, repl, batch, None) + (
                 (self._out_shardings,) if with_heads else ())
-            fn = jax.jit(fwd_bwd, in_shardings=in_sh,
+            fn = jax_jit(fwd_bwd, in_shardings=in_sh,
                          out_shardings=(self._out_shardings, repl, gsh))
         self._jits[key] = fn
         return fn

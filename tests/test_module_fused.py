@@ -624,3 +624,17 @@ def test_predict_batch_group_warns_on_classic_group(caplog):
     assert out.shape[0] == 16
     assert any("batch_group" in r.message for r in caplog.records), \
         caplog.records
+
+
+def test_compiler_options_env_parsing(monkeypatch):
+    """MXNET_XLA_COMPILER_OPTIONS rides jit(compiler_options=...) through
+    the remote compile service (local XLA_FLAGS reject TPU flags)."""
+    from mxnet_tpu.module.mesh_executor_group import _compiler_options
+    monkeypatch.delenv("MXNET_XLA_COMPILER_OPTIONS", raising=False)
+    assert _compiler_options() is None
+    monkeypatch.setenv("MXNET_XLA_COMPILER_OPTIONS",
+                       "xla_tpu_scoped_vmem_limit_kib=65536, a=b")
+    assert _compiler_options() == {
+        "xla_tpu_scoped_vmem_limit_kib": "65536", "a": "b"}
+    monkeypatch.setenv("MXNET_XLA_COMPILER_OPTIONS", "garbage")
+    assert _compiler_options() is None
