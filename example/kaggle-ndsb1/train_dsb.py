@@ -95,7 +95,7 @@ def main():
         with open("train_list.csv") as f:
             for idx, label, rel in csv.reader(f):
                 with open(os.path.join(args.data_dir, rel), "rb") as img_f:
-                    a = imdecode(img_f.read(), to_rgb=False).asnumpy()
+                    a = imdecode(img_f.read(), to_rgb=False)
                 # plankton images are variable-sized: normalize to img²
                 a = _resize(a, args.img, args.img)
                 X.append(np.asarray(a, np.float32).mean(-1)[None]

@@ -1,0 +1,31 @@
+"""Debugging a convolution
+(reference example/python-howto/debug_conv.py sets a gdb breakpoint in
+src/operator/convolution-inl.h; here Convolution is a jnp fcompute run
+by the interpreter-mode executor, so the same visibility comes from
+executor.debug_str() and per-op Monitor taps — no DEBUG=1 rebuild)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+data = mx.sym.Variable("data")
+conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                          pad=(1, 1), name="conv1")
+net = mx.sym.SoftmaxOutput(mx.sym.Flatten(conv), name="softmax")
+
+ex = net.simple_bind(ctx=mx.cpu(), data=(2, 1, 8, 8), softmax_label=(2,))
+# 1) the memory/graph picture the reference reads out of gdb frames:
+print(ex.debug_str()[:400])
+# 2) tap the conv output itself (interpreter-mode per-op callback)
+taps = {}
+ex.set_monitor_callback(lambda name, arr: taps.setdefault(
+    name, np.asarray(arr).shape))
+ex.forward(is_train=False,
+           data=mx.nd.array(np.random.rand(2, 1, 8, 8)))
+conv_taps = [k for k in taps if "conv1" in k]
+print("tapped:", sorted(taps)[:4])
+assert conv_taps, taps
+print("debug_conv OK")

@@ -1,0 +1,31 @@
+"""Monitoring intermediate outputs/weights during training
+(reference example/python-howto/monitor_weights.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+net = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(net, num_hidden=8, name="fc1")
+net = mx.sym.Activation(net, act_type="tanh")
+net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+seen = []
+mon = mx.mon.Monitor(1, stat_func=lambda d: mx.nd.array(
+    [float(np.abs(d.asnumpy()).mean())]),
+    pattern=".*fc.*", sort=True)
+
+rng = np.random.RandomState(3)
+X = rng.rand(64, 6).astype(np.float32)
+y = (X[:, 0] > 0.5).astype(np.float32)
+it = mx.io.NDArrayIter(X, y, batch_size=16)
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=2, monitor=mon,
+        optimizer_params={"learning_rate": 0.1},
+        batch_end_callback=lambda p: seen.append(p.nbatch))
+assert seen, "training ran"
+print("monitor_weights OK")

@@ -1,0 +1,28 @@
+"""Configuring a net to expose multiple outputs
+(reference example/python-howto/multiple_outputs.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+net = mx.sym.Variable("data")
+fc1 = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+act = mx.sym.Activation(fc1, act_type="relu")
+out1 = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(act, num_hidden=4,
+                                                  name="cls"),
+                            name="softmax")
+out2 = mx.sym.LinearRegressionOutput(
+    mx.sym.FullyConnected(act, num_hidden=1, name="reg"), name="lro")
+group = mx.sym.Group([out1, out2, mx.sym.BlockGrad(fc1)])
+print("outputs:", group.list_outputs())
+
+ex = group.simple_bind(ctx=mx.cpu(), data=(8, 10),
+                       softmax_label=(8,), lro_label=(8, 1))
+ex.forward(is_train=False, data=mx.nd.array(np.random.rand(8, 10)))
+for name, arr in zip(group.list_outputs(), ex.outputs):
+    print("%-18s %s" % (name, arr.shape))
+assert len(ex.outputs) == 3
+print("multiple outputs OK")

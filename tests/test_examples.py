@@ -55,6 +55,10 @@ CASES = [
      ["--iter-num", "5", "--size", "128",
       "--output", "/tmp/profiler_demo_ci.json"]),
     ("moe/train_moe.py", ["--epochs", "10"]),
+    ("python-howto/multiple_outputs.py", []),
+    ("python-howto/data_iter.py", []),
+    ("python-howto/monitor_weights.py", []),
+    ("python-howto/debug_conv.py", []),
     ("kaggle-ndsb1/train_dsb.py", ["--synthetic", "--num-epoch", "15",
       "--submission", "/tmp/submission_ci.csv"]),
     ("kaggle-ndsb2/train.py", ["--synthetic", "--num-epoch", "25"]),
