@@ -307,6 +307,18 @@ mxtpu_nd_context(IV handle)
     mPUSHi(dev_id);
 
 void
+mxtpu_list_all_op_names()
+  PREINIT:
+    mx_uint n, i;
+    const char **names;
+  PPCODE:
+    croak_on_fail(aTHX_ MXListAllOpNames(&n, &names), "MXListAllOpNames");
+    EXTEND(SP, n);
+    for (i = 0; i < n; ++i) {
+      mPUSHp(names[i], strlen(names[i]));
+    }
+
+void
 mxtpu_nd_wait_all()
   CODE:
     croak_on_fail(aTHX_ MXNDArrayWaitAll(), "MXNDArrayWaitAll");

@@ -122,6 +122,34 @@ sub _binop {
 
 sub wait_all { AI::MXNetTPU::nd_wait_all(); }
 
+# ---------------------------------------------------------------------
+# Runtime-generated op surface — reference counterpart: AI::MXNet's
+# build-time generated NDArray method wrappers. TPU-native twist: the
+# registry is enumerated LIVE over the C ABI (MXListAllOpNames) at load
+# and one sub per public op lands in AI::MXNetTPU::NDArray::Op, so the
+# surface can never go stale against the framework it binds.
+#   my $y = AI::MXNetTPU::NDArray::Op::relu([$x]);
+#   AI::MXNetTPU::NDArray::Op::sgd_update([$w, $g], { lr => 0.1 }, [$w]);
+package AI::MXNetTPU::NDArray::Op;
+
+sub _install_ops {
+    for my $op (AI::MXNetTPU::list_all_op_names()) {
+        next if $op =~ /^_/;
+        (my $sub = $op) =~ s/[^A-Za-z0-9_]/_/g;
+        no strict 'refs';
+        next if defined &{"AI::MXNetTPU::NDArray::Op::$sub"};
+        *{"AI::MXNetTPU::NDArray::Op::$sub"} = sub {
+            my ($ins, $params, $outs) = @_;
+            my @res = AI::MXNetTPU::NDArray::invoke(
+                $op, $ins // [], $params // {}, $outs // []);
+            return wantarray ? @res : $res[0];
+        };
+    }
+}
+_install_ops();
+
+package AI::MXNetTPU::NDArray;
+
 sub DESTROY {
     my ($self) = @_;
     AI::MXNetTPU::nd_free($self->{handle})

@@ -1,0 +1,34 @@
+#!/usr/bin/perl
+# Runtime-generated op surface: every public registry op is callable as
+# AI::MXNetTPU::NDArray::Op::<name> (reference: AI::MXNet's generated
+# NDArray methods, here enumerated live over MXListAllOpNames).
+use strict;
+use warnings;
+use Test::More;
+use AI::MXNetTPU;
+use AI::MXNetTPU::NDArray;
+
+my @names = AI::MXNetTPU::list_all_op_names();
+cmp_ok(scalar @names, '>', 200, 'registry enumerates (' . @names . ' ops)');
+
+ok(defined &AI::MXNetTPU::NDArray::Op::relu, 'relu generated');
+ok(defined &AI::MXNetTPU::NDArray::Op::broadcast_add, 'broadcast_add generated');
+ok(defined &AI::MXNetTPU::NDArray::Op::Convolution, 'Convolution generated');
+
+my $x = AI::MXNetTPU::NDArray->from_array([-2, -1, 0, 3], [4]);
+my $y = AI::MXNetTPU::NDArray::Op::relu([$x]);
+is_deeply($y->aslist, [0, 0, 0, 3], 'generated relu computes');
+
+my $a = AI::MXNetTPU::NDArray->from_array([1, 2], [2]);
+my $b = AI::MXNetTPU::NDArray->from_array([10, 20], [2]);
+my $c = AI::MXNetTPU::NDArray::Op::broadcast_add([$a, $b]);
+is_deeply($c->aslist, [11, 22], 'generated broadcast_add computes');
+
+# in-place fused optimizer kernel through the generated surface
+my $w = AI::MXNetTPU::NDArray->from_array([1, 1], [2]);
+my $g = AI::MXNetTPU::NDArray->from_array([0.5, 0.5], [2]);
+AI::MXNetTPU::NDArray::Op::sgd_update([$w, $g], { lr => 0.1 }, [$w]);
+my $got = $w->aslist;
+cmp_ok(abs($got->[0] - 0.95), '<', 1e-5, 'generated sgd_update in-place');
+
+done_testing();

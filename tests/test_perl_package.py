@@ -152,3 +152,22 @@ def test_perl_module_tier_end_to_end():
         % (proc.stdout, proc.stderr))
     assert "explicit loop learns" in proc.stdout
     assert "adam fit learns" in proc.stdout
+
+
+def test_perl_generated_op_surface():
+    """Runtime-generated op subs (reference: AI::MXNet's generated
+    NDArray methods): the registry enumerates live over MXListAllOpNames
+    and every public op is callable."""
+    _build_capi()
+    _build_perl()
+    env = dict(os.environ)
+    env["MXNET_TPU_HOME"] = ROOT
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        ["perl", "-Mblib=%s" % os.path.join(PKG, "blib"),
+         os.path.join(PKG, "t", "genops.t")],
+        cwd=ROOT, capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, (
+        "genops.t failed:\nstdout:%s\nstderr:%s"
+        % (proc.stdout, proc.stderr))
+    assert "generated sgd_update in-place" in proc.stdout

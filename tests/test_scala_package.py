@@ -193,3 +193,33 @@ def test_layout_present():
                 "core/src/main/scala/org/mxnettpu/FeedForward.scala",
                 "native/src/main/native/org_mxnettpu_LibInfo.cc"]:
         assert os.path.exists(os.path.join(PKG, rel)), rel + " missing"
+
+
+def test_scala_generated_ops_fresh():
+    """Full-registry op breadth (reference NDArrayMacro/SymbolMacro):
+    regenerate and diff, so the generated surface can't go stale."""
+    import sys
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_scala_ops.py"),
+         "--check"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fresh" in proc.stdout
+
+
+def test_scala_generated_ops_cover_registry():
+    import mxnet_tpu.capi_bridge as cb
+    with open(os.path.join(SCALA_DIR, "NDArrayGenerated.scala")) as f:
+        src = f.read()
+
+    def static_shape(n):
+        try:
+            cb.func_info(n)
+            return True
+        except Exception:
+            return False
+
+    public = [n for n in cb.all_op_names()
+              if not n.startswith("_") and static_shape(n)]
+    missing = [n for n in public
+               if "NDArray.invoke(\"%s\"" % n not in src]
+    assert not missing, "ops without Scala wrappers: %s" % missing[:10]
