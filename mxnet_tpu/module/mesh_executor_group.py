@@ -445,6 +445,32 @@ class MeshExecutorGroup(object):
             fn = jax_jit(fwd_stacked,
                          in_shardings=(psh, repl, st_batch, None),
                          out_shardings=st_outs)
+        elif kind.startswith("fwd_eval_stat:"):
+            # evaluation with the metric tallied ON DEVICE: forward +
+            # statistic + donated accumulate as one program per batch,
+            # zero readbacks until the caller drains (score_device)
+            estat = self._escore_stat
+            elabels = list(self._label_names)
+
+            def fwd_eval_stat(params, aux, inputs, rng, acc):
+                import jax.numpy as jnp
+                outs, _new_aux = run_fwd(params, aux, inputs, rng, False)
+                outs = tuple(o.astype(onp.float32) for o in outs)
+                rows = estat(jnp, [inputs[n] for n in elabels], outs)
+                if isinstance(rows, tuple):
+                    rows = [rows]
+                sums, counts = acc
+                sums = sums + jnp.stack([jnp.asarray(s, jnp.float32)
+                                         for s, _ in rows])
+                counts = counts + jnp.stack(
+                    [jnp.asarray(c, jnp.int32) for _, c in rows])
+                return sums, counts
+
+            fn = jax_jit(
+                fwd_eval_stat,
+                in_shardings=(psh, repl, batch, None, (repl, repl)),
+                out_shardings=(repl, repl),
+                donate_argnums=(4,) if self._platform != "cpu" else ())
         elif kind.startswith("train_step:"):
             # whole train step — fwd+bwd+optimizer — as ONE XLA program:
             # one launch per step and the update fuses into the
@@ -888,18 +914,51 @@ class MeshExecutorGroup(object):
         self._metric_acc = None
         self._metric_step_done = False
 
-    def _read_metric_tally(self):
-        if self._metric_acc is None:
-            return onp.zeros((self._metric_slots, 2), onp.float64)
+    def score_device(self, eval_data, eval_metric, num_batch=None):
+        """Evaluate with the metric tallied on device (one launch per
+        batch, ONE readback at the end) — the eval-side twin of
+        ``enable_device_metric``. Independent tally slot, so a live fit
+        tally is untouched. Returns the metric's name/value pairs, or
+        ``None`` when the metric is not fusable (caller falls back to
+        the host loop)."""
+        stat = eval_metric.fused_stat()
+        if stat is None or not self._label_names:
+            return None
+        import jax
+
+        self._materialize_backward()
+        token = getattr(eval_metric, "_mxtpu_tally_token", None)
+        if token is None:
+            token = eval_metric._mxtpu_tally_token = next(_STEP_TOKENS)
+        self._escore_stat = stat
+        fn = self._get_jit("fwd_eval_stat:m%d" % token)
+        slots = getattr(stat, "n_slots", 1)
+        acc = (jax.device_put(onp.zeros(slots, onp.float32), self._repl),
+               jax.device_put(onp.zeros(slots, onp.int32), self._repl))
+        params = {n: b._read() for n, b in self._param_dict.items()}
+        aux = {n: b._read() for n, b in self._aux_dict.items()}
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            inputs = self._stage(batch)
+            rng = _random.next_key() if self._needs_rng else \
+                onp.zeros((2,), onp.uint32)
+            acc = fn(params, aux, inputs, rng, acc)
+        eval_metric.reset()
+        eval_metric._fold_tally(self._pack_tally_pair(*acc))
+        return eval_metric.get_name_value()
+
+    def _pack_tally_pair(self, sums, counts):
+        """Read a (sums f32, counts i32) device tally as numpy (n, 2).
+
+        ONE fused readback: separate fetches would cost two ~130ms
+        round trips per drain on this transport. The pack rides in the
+        INTEGER domain — small i32 counts bitcast to f32 are denormals,
+        which the TPU vector unit flushes to zero (observed: a fit's
+        num_inst read back as 0); f32 sums bitcast to i32 are plain
+        bits and survive. Host side un-bitcasts the sum column."""
         import jax
         import jax.numpy as jnp
-        sums, counts = self._metric_acc
-        # ONE fused readback: separate fetches would cost two ~130ms
-        # round trips per drain on this transport. The pack rides in the
-        # INTEGER domain — small i32 counts bitcast to f32 are denormals,
-        # which the TPU vector unit flushes to zero (observed: a fit's
-        # num_inst read back as 0); f32 sums bitcast to i32 are plain
-        # bits and survive. Host side un-bitcasts the sum column.
         fn = self._jits.get("pack_tally")
         if fn is None:
             from jax import lax
@@ -915,6 +974,11 @@ class MeshExecutorGroup(object):
         out[:, 0] = packed[:, 0].copy().view(onp.float32)
         out[:, 1] = packed[:, 1]
         return out
+
+    def _read_metric_tally(self):
+        if self._metric_acc is None:
+            return onp.zeros((self._metric_slots, 2), onp.float64)
+        return self._pack_tally_pair(*self._metric_acc)
 
     def _zero_metric_tally(self):
         self._metric_acc = None

@@ -580,6 +580,34 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0):
+        """Evaluate; on the fused mesh path with a decomposable metric the
+        tally rides the device (one launch per batch, ONE readback —
+        the host loop's per-batch ``asnumpy`` costs ~100ms each on
+        remote transports). Per-batch callbacks need the running host
+        value, so their presence keeps the reference loop."""
+        import os
+        grp = self._exec_group
+        if batch_end_callback is None and getattr(grp, "fused", False) \
+                and os.environ.get("MXNET_DEVICE_METRIC", "1") != "0":
+            assert self.binded and self.params_initialized
+            from .. import metric as metric_mod
+            eval_metric = metric_mod.create(eval_metric)
+            if reset:
+                eval_data.reset()
+            result = grp.score_device(eval_data, eval_metric, num_batch)
+            if result is not None:
+                self._fire(score_end_callback, epoch,
+                           num_batch or 0, eval_metric, locals())
+                return result
+            reset = False  # already rewound; device path declined
+        return super().score(eval_data, eval_metric, num_batch=num_batch,
+                             batch_end_callback=batch_end_callback,
+                             score_end_callback=score_end_callback,
+                             reset=reset, epoch=epoch)
+
     def _install_device_metric(self, eval_metric):
         import os
         grp = self._exec_group

@@ -216,3 +216,68 @@ def test_refit_with_host_metric_detaches_old_tally():
     # the first metric's value must be unchanged by the second fit
     assert acc.num_inst == n_seen
     np.testing.assert_allclose(acc.get()[1], frozen)
+
+
+def test_score_device_matches_host(monkeypatch):
+    """score() on the fused path tallies on device — values must equal
+    the host loop's exactly."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(128, 8).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    mod, _ = _fit(mx.metric.Accuracy(), monkeypatch, True, epochs=1)
+    it = NDArrayIter(X, y, batch_size=32, shuffle=False)
+    dev = dict(mod.score(it, mx.metric.Accuracy()))
+    monkeypatch.setenv("MXNET_DEVICE_METRIC", "0")
+    host = dict(mod.score(it, mx.metric.Accuracy()))
+    assert dev.keys() == host.keys()
+    for k in host:
+        np.testing.assert_allclose(dev[k], host[k], rtol=1e-6)
+
+
+def test_score_device_composite_and_custom(monkeypatch):
+    mod, _ = _fit(mx.metric.Accuracy(), monkeypatch, True, epochs=1)
+    rng = np.random.RandomState(5)
+    X = rng.rand(128, 8).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=32, shuffle=False)
+    comp = mx.metric.CompositeEvalMetric(
+        [mx.metric.Accuracy(), mx.metric.CrossEntropy()])
+    dev = mod.score(it, comp)
+    monkeypatch.setenv("MXNET_DEVICE_METRIC", "0")
+    host = mod.score(it, mx.metric.CompositeEvalMetric(
+        [mx.metric.Accuracy(), mx.metric.CrossEntropy()]))
+    for (dn, dv), (hn, hv) in zip(dev, host):
+        assert dn == hn
+        np.testing.assert_allclose(dv, hv, rtol=1e-5)
+    # CustomMetric declines the device path and still works
+    monkeypatch.setenv("MXNET_DEVICE_METRIC", "1")
+    custom = mx.metric.np(
+        lambda label, pred: float((pred.argmax(1) == label).mean()))
+    got = mod.score(it, custom)
+    assert 0.0 <= got[0][1] <= 1.0
+
+
+def test_fit_with_eval_data_uses_device_both_ways(monkeypatch):
+    """fit(eval_data=...) must keep the TRAIN tally intact across the
+    per-epoch validation score (separate tally slots)."""
+    monkeypatch.setenv("MXNET_DEVICE_METRIC", "1")
+    rng = np.random.RandomState(5)
+    X = rng.rand(128, 8).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=32, shuffle=False)
+    val = NDArrayIter(X, y, batch_size=32, shuffle=False)
+    metric = mx.metric.Accuracy()
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+    mx.random.seed(42)
+    mod.fit(it, eval_data=val, eval_metric=metric, num_epoch=2,
+            optimizer_params={"learning_rate": 0.05})
+    assert mod._exec_group._metric_live is metric
+    monkeypatch.setenv("MXNET_DEVICE_METRIC", "0")
+    host_metric = mx.metric.Accuracy()
+    mod2 = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+    mx.random.seed(42)
+    it.reset(); val.reset()
+    mod2.fit(it, eval_data=val, eval_metric=host_metric, num_epoch=2,
+             optimizer_params={"learning_rate": 0.05})
+    np.testing.assert_allclose(metric.get()[1], host_metric.get()[1],
+                               rtol=1e-6)
