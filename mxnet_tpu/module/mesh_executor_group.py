@@ -38,6 +38,21 @@ from ..executor import _build_eval, _build_eval_segmented
 _STEP_TOKENS = itertools.count()
 
 
+def _tally_add(jnp, stat, labels, outs, acc):
+    """Fold one batch's metric statistic into a (sums f32, counts i32)
+    device tally — shared by the train step and the eval program.
+    Counts ride int32: an f32 tally would stop counting at 2^24."""
+    rows = stat(jnp, labels, outs)
+    if isinstance(rows, tuple):
+        rows = [rows]
+    sums, counts = acc
+    sums = sums + jnp.stack([jnp.asarray(s, jnp.float32)
+                             for s, _ in rows])
+    counts = counts + jnp.stack([jnp.asarray(c, jnp.int32)
+                                 for _, c in rows])
+    return sums, counts
+
+
 def _compiler_options():
     """TPU compiler options for the step programs, from
     ``MXNET_XLA_COMPILER_OPTIONS`` ("key=value,key=value").
@@ -456,15 +471,8 @@ class MeshExecutorGroup(object):
                 import jax.numpy as jnp
                 outs, _new_aux = run_fwd(params, aux, inputs, rng, False)
                 outs = tuple(o.astype(onp.float32) for o in outs)
-                rows = estat(jnp, [inputs[n] for n in elabels], outs)
-                if isinstance(rows, tuple):
-                    rows = [rows]
-                sums, counts = acc
-                sums = sums + jnp.stack([jnp.asarray(s, jnp.float32)
-                                         for s, _ in rows])
-                counts = counts + jnp.stack(
-                    [jnp.asarray(c, jnp.int32) for _, c in rows])
-                return sums, counts
+                return _tally_add(jnp, estat,
+                                  [inputs[n] for n in elabels], outs, acc)
 
             fn = jax_jit(
                 fwd_eval_stat,
@@ -519,18 +527,11 @@ class MeshExecutorGroup(object):
                     outs, new_aux, grads, new_params, new_states = \
                         step_math(params, aux, states, inputs, rng, lrs,
                                   wds)
-                    rows = mstat(jnp, [inputs[n] for n in mlabels], outs)
-                    if isinstance(rows, tuple):
-                        rows = [rows]
-                    sums, counts = macc
-                    # counts ride int32, not f32: a float tally would stop
-                    # incrementing past 2^24 samples between drains
-                    sums = sums + jnp.stack([jnp.asarray(s, jnp.float32)
-                                             for s, _ in rows])
-                    counts = counts + jnp.stack(
-                        [jnp.asarray(c, jnp.int32) for _, c in rows])
+                    new_macc = _tally_add(
+                        jnp, mstat, [inputs[n] for n in mlabels], outs,
+                        macc)
                     return (outs, new_aux, grads, new_params, new_states,
-                            (sums, counts))
+                            new_macc)
 
                 fn = jax_jit(
                     train_step,
@@ -917,8 +918,11 @@ class MeshExecutorGroup(object):
     def score_device(self, eval_data, eval_metric, num_batch=None):
         """Evaluate with the metric tallied on device (one launch per
         batch, ONE readback at the end) — the eval-side twin of
-        ``enable_device_metric``. Independent tally slot, so a live fit
-        tally is untouched. Returns the metric's name/value pairs, or
+        ``enable_device_metric``. Uses its own accumulator, so a live
+        fit tally on a DIFFERENT metric object is untouched; passing
+        the fit metric itself behaves like the host loop does (score
+        resets the metric — mid-epoch train statistics are consumed on
+        either path). Returns ``(name_value_pairs, batches_seen)``, or
         ``None`` when the metric is not fusable (caller falls back to
         the host loop)."""
         stat = eval_metric.fused_stat()
@@ -937,16 +941,24 @@ class MeshExecutorGroup(object):
                jax.device_put(onp.zeros(slots, onp.int32), self._repl))
         params = {n: b._read() for n, b in self._param_dict.items()}
         aux = {n: b._read() for n, b in self._aux_dict.items()}
+        seen = 0
         for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
+            if not batch.label or all(lb is None for lb in batch.label):
+                # the host loop raises in check_label_shapes; scoring
+                # against _stage's zero-filled labels would be a silent
+                # wrong answer
+                raise MXNetError(
+                    "score() needs labels; batch %d has none" % nbatch)
             inputs = self._stage(batch)
             rng = _random.next_key() if self._needs_rng else \
                 onp.zeros((2,), onp.uint32)
             acc = fn(params, aux, inputs, rng, acc)
+            seen = nbatch + 1
         eval_metric.reset()
         eval_metric._fold_tally(self._pack_tally_pair(*acc))
-        return eval_metric.get_name_value()
+        return eval_metric.get_name_value(), seen
 
     def _pack_tally_pair(self, sums, counts):
         """Read a (sums f32, counts i32) device tally as numpy (n, 2).

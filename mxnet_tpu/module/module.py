@@ -599,9 +599,10 @@ class Module(BaseModule):
                 eval_data.reset()
             result = grp.score_device(eval_data, eval_metric, num_batch)
             if result is not None:
-                self._fire(score_end_callback, epoch,
-                           num_batch or 0, eval_metric, locals())
-                return result
+                pairs, seen = result
+                self._fire(score_end_callback, epoch, seen, eval_metric,
+                           locals())
+                return pairs
             reset = False  # already rewound; device path declined
         return super().score(eval_data, eval_metric, num_batch=num_batch,
                              batch_end_callback=batch_end_callback,

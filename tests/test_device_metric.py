@@ -281,3 +281,44 @@ def test_fit_with_eval_data_uses_device_both_ways(monkeypatch):
              optimizer_params={"learning_rate": 0.05})
     np.testing.assert_allclose(metric.get()[1], host_metric.get()[1],
                                rtol=1e-6)
+
+
+def test_score_device_labelless_batch_raises(monkeypatch):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.io import DataBatch
+    mod, _ = _fit(mx.metric.Accuracy(), monkeypatch, True, epochs=1)
+
+    class NoLabelIter(object):
+        provide_data = mod.data_shapes
+        provide_label = mod.label_shapes
+
+        def __init__(self):
+            self.done = False
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.done:
+                raise StopIteration
+            self.done = True
+            return DataBatch([mx.nd.array(
+                np.zeros((32, 8), np.float32))], [])
+
+        def reset(self):
+            self.done = False
+
+    with pytest.raises(MXNetError):
+        mod.score(NoLabelIter(), mx.metric.Accuracy())
+
+
+def test_score_end_callback_sees_batch_count(monkeypatch):
+    seen = []
+    mod, _ = _fit(mx.metric.Accuracy(), monkeypatch, True, epochs=1)
+    rng = np.random.RandomState(5)
+    X = rng.rand(128, 8).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=32, shuffle=False)
+    mod.score(it, mx.metric.Accuracy(),
+              score_end_callback=lambda p: seen.append(p.nbatch))
+    assert seen == [4], seen
