@@ -19,13 +19,15 @@ from . import ndarray as nd
 __all__ = ["Monitor"]
 
 
+def _rms_stat(x):
+    """Default statistic: |x|'s root-mean-square (the reference's
+    norm/sqrt(size) "asum" default)."""
+    return nd.norm(x) / (x.size ** 0.5)
+
+
 class Monitor(object):
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return nd.norm(x) / (x.size ** 0.5)
-            stat_func = asum_stat
-        self.stat_func = stat_func
+        self.stat_func = stat_func or _rms_stat
         self.interval = interval
         self.activated = False
         self.queue = []
@@ -36,9 +38,8 @@ class Monitor(object):
 
     def stat_helper(self, name, array):
         """Per-op-output callback fed by the executor's tapped run."""
-        if not self.activated or not self.re_prog.match(name):
-            return
-        self.queue.append((self.step, name, self.stat_func(array)))
+        if self.activated and self.re_prog.match(name):
+            self.queue.append((self.step, name, self.stat_func(array)))
 
     def install(self, exe):
         exe.set_monitor_callback(self.stat_helper)
@@ -50,38 +51,40 @@ class Monitor(object):
             self.activated = True
         self.step += 1
 
+    def _tap_state_dicts(self):
+        """End-of-batch weight/aux taps (the reference monitors these in
+        addition to op outputs)."""
+        for exe in self.exes:
+            for source in (exe.arg_dict, exe.aux_dict):
+                for name, array in source.items():
+                    if self.re_prog.match(name):
+                        self.queue.append(
+                            (self.step, name, self.stat_func(array)))
+
+    @staticmethod
+    def _render(stat):
+        values = stat if isinstance(stat, list) else [stat]
+        parts = []
+        for v in values:
+            assert isinstance(v, nd.NDArray), \
+                "stat_func must return NDArray(s)"
+            parts.append(str(v.asscalar() if v.shape == (1,)
+                             else v.asnumpy()))
+        return "\t".join(parts) + "\t"
+
     def toc(self):
         if not self.activated:
             return []
         self.activated = False
-        for exe in self.exes:
-            for name, array in exe.arg_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
-            for name, array in exe.aux_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
-        res = []
+        self._tap_state_dicts()
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, nd.NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                assert isinstance(v, nd.NDArray)
-                if v.shape == (1,):
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
+            self.queue.sort(key=lambda entry: entry[1])
+        drained = [(step, name, self._render(stat))
+                   for step, name, stat in self.queue]
         self.queue = []
-        return res
+        return drained
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: {:7d} {:30s} {:s}".format(n, k, v))
+        for step, name, rendered in self.toc():
+            logging.info("Batch: {:7d} {:30s} {:s}".format(
+                step, name, rendered))
