@@ -57,6 +57,26 @@ class Initializer(object):
     def dumps(self):
         return json.dumps([self.__class__.__name__.lower(), self._kwargs])
 
+    # parameter-name-convention dispatch (first match wins; same order
+    # the reference's if/elif chain checks). Tried by __call__ after the
+    # per-variable __init__ attr.
+    _NAME_RULES = (
+        (lambda n: n.startswith("upsampling"), "_init_bilinear"),
+        (lambda n: n.endswith("bias"), "_init_bias"),
+        (lambda n: n.endswith("gamma"), "_init_gamma"),
+        (lambda n: n.endswith("beta"), "_init_beta"),
+        (lambda n: n.endswith("weight"), "_init_weight"),
+        (lambda n: n.endswith(("moving_mean", "running_mean")),
+         "_init_zero"),
+        (lambda n: n.endswith(("moving_var", "running_var")),
+         "_init_one"),
+        (lambda n: n.endswith("moving_inv_var"), "_init_zero"),
+        (lambda n: n.endswith("moving_avg"), "_init_zero"),
+        # RNN initial states (begin_state vars of the cell toolkit)
+        (lambda n: "begin_state" in n or "init_state" in n
+         or ("init_" in n and ("_c" in n or "_h" in n)), "_init_zero"),
+    )
+
     def __call__(self, name, arr):
         if not isinstance(name, string_types):
             raise TypeError("name must be string")
@@ -66,40 +86,21 @@ class Initializer(object):
         if attrs and attrs.get("__init__"):
             create(attrs["__init__"])._init_weight(name, arr)
             return
-        if name.startswith("upsampling"):
-            self._init_bilinear(name, arr)
-        elif name.endswith("bias"):
-            self._init_bias(name, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(name, arr)
-        elif name.endswith("beta"):
-            self._init_beta(name, arr)
-        elif name.endswith("weight"):
-            self._init_weight(name, arr)
-        elif name.endswith("moving_mean") or name.endswith("running_mean"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_var") or name.endswith("running_var"):
-            self._init_one(name, arr)
-        elif name.endswith("moving_inv_var"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_avg"):
-            self._init_zero(name, arr)
-        elif "begin_state" in name or "init_state" in name or \
-                ("init_" in name and ("_c" in name or "_h" in name)):
-            self._init_zero(name, arr)  # RNN initial states
-        else:
-            self._init_default(name, arr)
+        for matches, handler in self._NAME_RULES:
+            if matches(name):
+                getattr(self, handler)(name, arr)
+                return
+        self._init_default(name, arr)
 
     def _init_bilinear(self, _, arr):
-        shape = arr.shape
-        weight = onp.zeros(int(onp.prod(shape)), dtype="float32")
-        f = onp.ceil(shape[3] / 2.0)
+        # separable tent filter, the standard bilinear-upsampling kernel
+        h, w = arr.shape[2], arr.shape[3]
+        f = onp.ceil(w / 2.0)
         c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(int(onp.prod(shape))):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        arr[:] = weight.reshape(shape)
+        tent_x = 1 - onp.abs(onp.arange(w) / f - c)
+        tent_y = 1 - onp.abs(onp.arange(h) / f - c)
+        arr[:] = onp.broadcast_to(tent_y[:, None] * tent_x[None, :],
+                                  arr.shape).astype("float32")
 
     def _init_bias(self, _, arr):
         arr[:] = 0.0
