@@ -110,6 +110,7 @@ def main():
         X, y = X[order], y[order]
         names = [names[i] for i in order]
 
+    np.random.seed(7)  # NDArrayIter(shuffle=True) draws the global rng
     n_train = int(0.8 * len(y))
     train = mx.io.NDArrayIter(X[:n_train], y[:n_train],
                               batch_size=args.batch_size, shuffle=True,

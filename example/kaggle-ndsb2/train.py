@@ -77,6 +77,7 @@ def main():
             "pipeline (example/kaggle-ndsb2/Preprocessing.py); run with "
             "--synthetic for the end-to-end flow")
     X, y = synthetic_mri(frames=args.frames)
+    np.random.seed(11)  # NDArrayIter(shuffle=True) draws the global rng
     n_train = int(0.8 * len(y))
     train = mx.io.NDArrayIter(X[:n_train], y[:n_train],
                               batch_size=args.batch_size, shuffle=True,

@@ -97,6 +97,7 @@ def main():
     num_feat, num_phones = 8, 5
     X, Y = synthetic_frames(seq_len=args.seq_len, num_feat=num_feat,
                             num_phones=num_phones)
+    np.random.seed(5)  # NDArrayIter(shuffle=True) draws the global rng
     # per-frame labels flatten to match the (B*T, P) softmax
     it = mx.io.NDArrayIter(X, Y.reshape(len(Y), -1),
                            batch_size=args.batch_size, shuffle=True,
