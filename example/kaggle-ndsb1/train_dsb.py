@@ -88,11 +88,12 @@ def main():
         X, y = synthetic_dataset(num_classes, img=args.img)
         names = ["img_%d.jpg" % i for i in range(len(y))]
     else:
-        classes = gen_img_list(args.data_dir, "train_list.csv")
+        list_csv = os.path.join(args.data_dir, "train_list.csv")
+        classes = gen_img_list(args.data_dir, list_csv)
         num_classes = len(classes)
         from mxnet_tpu.image import imdecode, _resize  # real-data path
         X, y, names = [], [], []
-        with open("train_list.csv") as f:
+        with open(list_csv) as f:
             for idx, label, rel in csv.reader(f):
                 with open(os.path.join(args.data_dir, rel), "rb") as img_f:
                     a = imdecode(img_f.read(), to_rgb=False)
