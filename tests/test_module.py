@@ -223,3 +223,44 @@ def test_feedforward_api():
     assert acc > 0.85, acc
     preds = model.predict(X)
     assert preds.shape == (160, 4)
+
+
+def test_python_loss_module_chain():
+    """PythonModule/PythonLossModule (SURVEY module API, python tier):
+    a python loss brick computes the backward from a grad callable."""
+    from mxnet_tpu.module.python_module import PythonLossModule
+    from mxnet_tpu.io import DataBatch
+
+    mod = PythonLossModule(
+        grad_func=lambda scores, labels:
+            scores.asnumpy() - np.eye(4)[labels.asnumpy().astype(int)])
+    mod.bind(data_shapes=[("data", (2, 4))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    mod.init_optimizer()
+    assert mod.output_shapes == [("pyloss_output", (2, 4))]
+
+    scores = mx.nd.array(np.full((2, 4), 0.25, np.float32))
+    labels = mx.nd.array(np.array([1, 3], np.float32))
+    mod.forward(DataBatch([scores], [labels]), is_train=True)
+    out = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, 0.25)
+    mod.backward()
+    grad = mod.get_input_grads()[0].asnumpy()
+    want = np.full((2, 4), 0.25) - np.eye(4)[[1, 3]]
+    np.testing.assert_allclose(grad, want, rtol=1e-6)
+
+    # metric feed only fires for label-bearing bricks
+    metric = mx.metric.Loss()
+    mod.update_metric(metric, [labels])
+    assert metric.num_inst > 0
+
+    # contract errors surface loudly
+    with pytest.raises(ValueError):
+        mod.backward(out_grads=[scores])
+    bare = PythonLossModule()
+    bare.bind(data_shapes=[("data", (2, 4))])
+    bare.for_training = True
+    bare.forward(DataBatch([scores], []), is_train=True)
+    with pytest.raises(NotImplementedError):
+        bare.backward()
