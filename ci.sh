@@ -30,6 +30,39 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
     --network resnet-8 --num-epochs 5 --batch-size 128 \
     --min-accuracy 0.95 || FAILED=1
 
+stage "checkpoint resume gate (preempt after epoch 1, resume from latest())"
+# durable-checkpoint contract (docs/api/checkpoint.md): a run killed
+# after a committed epoch and resumed with fit(resume_from=manager)
+# must land on the same final accuracy as the uninterrupted run —
+# params, optimizer momentum, BN stats and RNG all come back
+CKPT_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 3 --batch-size 128 --seed 7 \
+    --acc-out "$CKPT_TMP/acc_straight.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 3 --batch-size 128 --seed 7 \
+    --checkpoint-dir "$CKPT_TMP/ckpt" --exit-after-epoch 1
+rc=$?
+if [ "$rc" -ne 66 ]; then
+    echo "expected simulated preemption exit 66, got $rc"
+    FAILED=1
+fi
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 3 --batch-size 128 --seed 7 \
+    --checkpoint-dir "$CKPT_TMP/ckpt" --resume \
+    --acc-out "$CKPT_TMP/acc_resumed.txt" || FAILED=1
+python - "$CKPT_TMP/acc_straight.txt" "$CKPT_TMP/acc_resumed.txt" <<'PY' || FAILED=1
+import sys
+a, b = (float(open(p).read()) for p in sys.argv[1:3])
+assert abs(a - b) <= 1e-3, \
+    "resumed accuracy %.4f != uninterrupted %.4f" % (b, a)
+print("resume gate: uninterrupted %.4f vs resumed %.4f" % (a, b))
+PY
+rm -rf "$CKPT_TMP"
+
 stage "multi-chip dryrun (8 virtual devices)"
 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
     || FAILED=1

@@ -59,8 +59,31 @@ def main():
                         help="exit nonzero if final validation accuracy "
                              "lands below this (the CI convergence gate, "
                              "reference Jenkinsfile test_score stage)")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="durable async checkpoints: commit one "
+                             "atomic step entry per epoch into this "
+                             "directory (mxnet_tpu.checkpoint"
+                             ".CheckpointManager)")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume params/optimizer/RNG from the "
+                             "latest committed step in --checkpoint-dir "
+                             "(no-op when the directory is empty)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="seed numpy + mxnet RNG (fixes the shuffle "
+                             "order so a resumed run retraces the "
+                             "uninterrupted one)")
+    parser.add_argument("--exit-after-epoch", type=int, default=None,
+                        help="hard-exit (code 66) once this many epochs "
+                             "committed — the CI crash/resume gate's "
+                             "simulated preemption")
+    parser.add_argument("--acc-out", default=None,
+                        help="write the final validation accuracy to "
+                             "this file (CI resume gate comparison)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.seed is not None:
+        np.random.seed(args.seed)
+        mx.random.seed(args.seed)
 
     ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
         else [mx.cpu()]
@@ -82,8 +105,27 @@ def main():
     net = models.get_symbol(args.network, num_classes=10,
                             image_shape=(3, 28, 28))
     mod = mx.mod.Module(net, context=ctx)
-    checkpoint = mx.callback.do_checkpoint(args.model_prefix) \
-        if args.model_prefix else None
+    callbacks = []
+    if args.model_prefix:
+        callbacks.append(mx.callback.do_checkpoint(args.model_prefix))
+    manager = None
+    if args.checkpoint_dir:
+        manager = mx.checkpoint.CheckpointManager(args.checkpoint_dir,
+                                                  keep=3)
+        callbacks.append(mx.callback.module_checkpoint(
+            mod, save_optimizer_states=True, manager=manager))
+    if args.exit_after_epoch is not None:
+        assert manager is not None, "--exit-after-epoch needs " \
+            "--checkpoint-dir (it simulates preemption after the commit)"
+
+        def _preempt(iter_no, sym=None, arg=None, aux=None):
+            if iter_no + 1 >= args.exit_after_epoch:
+                manager.wait_until_finished()
+                logging.info("simulated preemption after epoch %d",
+                             iter_no)
+                os._exit(66)
+
+        callbacks.append(_preempt)
     mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
             kvstore=args.kv_store,
             initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
@@ -91,9 +133,15 @@ def main():
                               "wd": 1e-4},
             batch_end_callback=mx.callback.Speedometer(args.batch_size,
                                                        20),
-            epoch_end_callback=checkpoint)
+            epoch_end_callback=callbacks or None,
+            resume_from=manager if args.resume else None)
+    if manager is not None:
+        manager.wait_until_finished()
     score = mod.score(val, "acc")
     print("final validation:", score)
+    if args.acc_out:
+        with open(args.acc_out, "w") as f:
+            f.write("%.6f\n" % dict(score)["accuracy"])
     if args.min_accuracy is not None:
         acc = dict(score)["accuracy"]
         assert acc >= args.min_accuracy, (

@@ -23,15 +23,33 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+def module_checkpoint(mod, prefix=None, period=1,
+                      save_optimizer_states=False, manager=None,
+                      async_save=True):
     """Epoch callback: save ``mod`` every ``period`` epochs as
-    ``prefix-%04d.params`` (+ ``.states``)."""
+    ``prefix-%04d.params`` (+ ``.states``).
+
+    With ``manager=`` (a :class:`mxnet_tpu.checkpoint
+    .CheckpointManager`) the save commits a durable step entry per
+    epoch — atomic, async by default (the next epoch's first train
+    step overlaps the disk write), sharded per local device shard. The
+    step number is the 0-based epoch index just completed, which is
+    what ``fit(resume_from=manager)`` reads to continue at the next
+    epoch. ``prefix`` may then be omitted; if both are given, the
+    legacy prefix files are still written too (for tooling that
+    consumes them)."""
+    if prefix is None and manager is None:
+        raise ValueError("module_checkpoint needs a prefix or a manager")
     period = max(1, int(period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         epoch = iter_no + 1
         if epoch % period == 0:
-            mod.save_checkpoint(prefix, epoch, save_optimizer_states)
+            if manager is not None:
+                mod.save_checkpoint(prefix, iter_no, save_optimizer_states,
+                                    manager=manager, async_save=async_save)
+            if prefix is not None:
+                mod.save_checkpoint(prefix, epoch, save_optimizer_states)
 
     return _callback
 

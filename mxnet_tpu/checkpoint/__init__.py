@@ -1,0 +1,60 @@
+"""mxnet_tpu.checkpoint — durable training-state persistence.
+
+The checkpoint subsystem (docs/api/checkpoint.md). Three layers:
+
+* :class:`CheckpointManager` — a directory of step-numbered entries,
+  each committed atomically (temp dir + fsync + rename), saved async on
+  the host engine worker, sharded per local device shard, and
+  garbage-collected by a ``keep``/``keep_every`` retention policy.
+* :mod:`~mxnet_tpu.checkpoint.serialize` — atomic file writes, per-shard
+  array files with crc32 verification, shard snapshot/reassembly.
+* legacy helpers — the reference-era ``arg:``/``aux:`` flat param file
+  (``prefix-%04d.params``) packing shared by ``model.save_checkpoint``,
+  ``Module.save_checkpoint`` and ``BaseModule.save_params``, now written
+  atomically through :func:`mxnet_tpu.ndarray.save`.
+"""
+from __future__ import annotations
+
+from .manager import Checkpoint, CheckpointManager
+from . import serialize
+
+__all__ = ["Checkpoint", "CheckpointManager", "serialize",
+           "pack_params", "split_params", "save_params_file",
+           "load_params_file"]
+
+
+def pack_params(arg_params, aux_params):
+    """Flatten (arg_params, aux_params) into one ``arg:``/``aux:``
+    prefixed dict — the name-packing every checkpoint format shares."""
+    packed = {("arg:%s" % k): v for k, v in (arg_params or {}).items()}
+    packed.update({("aux:%s" % k): v
+                   for k, v in (aux_params or {}).items()})
+    return packed
+
+
+def split_params(packed):
+    """Inverse of :func:`pack_params`; unknown prefixes raise."""
+    from ..base import MXNetError
+    arg_params, aux_params = {}, {}
+    for k, v in packed.items():
+        kind, _, name = k.partition(":")
+        if kind == "arg":
+            arg_params[name] = v
+        elif kind == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError("invalid checkpoint param key %r "
+                             "(want arg:/aux: prefix)" % (k,))
+    return arg_params, aux_params
+
+
+def save_params_file(fname, arg_params, aux_params):
+    """Write the legacy flat ``.params`` file (atomically)."""
+    from .. import ndarray as nd
+    nd.save(fname, pack_params(arg_params, aux_params))
+
+
+def load_params_file(fname):
+    """Load a legacy flat ``.params`` file -> (arg_params, aux_params)."""
+    from .. import ndarray as nd
+    return split_params(nd.load(fname))

@@ -1,0 +1,338 @@
+"""CheckpointManager — async, sharded, atomic step checkpoints.
+
+One manager owns one checkpoint directory of step-numbered entries::
+
+    <dir>/step_00000003/           committed entry (the rename IS the commit)
+        manifest.json              per-array shapes/dtypes/shard crc32s
+        a00001_s00.npy ...         one file per (array, local shard)
+        optimizer.bin              raw optimizer-state bytes (optional)
+        rng.npz                    global RNG state (optional)
+    <dir>/.tmp-step_00000004-*/    in-flight or crashed partial entry
+
+Durability contract: every file in an entry is written and fsynced
+inside a ``.tmp-*`` staging dir, the dir itself is fsynced, and only
+then is the staging dir renamed onto ``step_NNNNNNNN`` (and the parent
+fsynced). A crash at ANY point — including mid-rename — leaves either a
+committed entry or an ignorable ``.tmp-*``; :meth:`latest` only ever
+reports entries whose manifest is in place, so the previous good step
+stays restorable.
+
+Saves run **async** by default: ``save()`` snapshots every array to
+host memory synchronously (cheap, and immune to later in-place /
+donated-buffer mutation by the next train step), then hands
+serialization + commit to the host :class:`~mxnet_tpu.engine.Engine`
+worker so the next ``fit`` step overlaps the disk write. ``save()``
+itself is the error-propagation barrier: it waits for the previous
+save and re-raises its failure before snapshotting the next one;
+``wait_until_finished()`` does the same on demand.
+
+Sharded arrays (jax Arrays carrying a mesh ``NamedSharding``) write one
+file per unique local shard — no full gather — and restore re-assembles
+the global array on host, so an entry saved on an 8-device mesh loads
+onto 1 device (or any other layout).
+"""
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import re
+import shutil
+import time
+import uuid
+from collections import namedtuple
+
+from .. import engine as _engine
+from .. import random as _random
+from ..base import MXNetError
+from . import serialize
+
+__all__ = ["CheckpointManager", "Checkpoint", "is_checkpoint_dir"]
+
+_STEP_FMT = "step_%08d"
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+_TMP_PREFIX = ".tmp-"
+_MANIFEST = "manifest.json"
+
+Checkpoint = namedtuple(
+    "Checkpoint", ["step", "params", "optimizer_state", "extra", "rng"])
+Checkpoint.__doc__ = """A restored checkpoint entry.
+
+``params`` maps array name -> assembled global numpy array;
+``optimizer_state`` is the raw bytes handed to ``save()`` (or None);
+``extra`` the JSON metadata dict; ``rng`` a ``mxnet_tpu.random``
+state dict (or None).
+"""
+
+
+def is_checkpoint_dir(path):
+    """True if ``path`` is a directory holding at least one committed
+    ``step_NNNNNNNN`` entry (used to disambiguate manager directories
+    from legacy file prefixes that happen to name a directory)."""
+    if not os.path.isdir(path):
+        return False
+    for name in os.listdir(path):
+        if _STEP_RE.match(name) and os.path.exists(
+                os.path.join(path, name, _MANIFEST)):
+            return True
+    return False
+
+
+def _commit_entry(tmp_dir, final_dir):
+    """The atomic commit: fsync the staged entry, rename it onto its
+    step name, fsync the parent. Everything before the rename is
+    invisible to readers; a crash before it leaves only ``.tmp-*``."""
+    serialize.fsync_dir(tmp_dir)
+    os.replace(tmp_dir, final_dir)
+    serialize.fsync_dir(os.path.dirname(final_dir))
+
+
+class CheckpointManager(object):
+    """Owns a directory of atomic, step-numbered checkpoint entries.
+
+    Parameters
+    ----------
+    directory : str
+        Root of the checkpoint tree (created if missing).
+    keep : int or None
+        Retain only the newest ``keep`` committed steps (None = all).
+    keep_every : int or None
+        Additionally retain every step divisible by ``keep_every``
+        (a sparse long-horizon trail the ``keep`` window won't GC).
+    """
+
+    def __init__(self, directory, keep=None, keep_every=None):
+        self.directory = os.path.abspath(str(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        if keep is not None and keep < 1:
+            raise ValueError("keep must be >= 1 (the latest entry is "
+                             "never garbage-collected)")
+        if keep_every is not None and keep_every < 1:
+            raise ValueError("keep_every must be >= 1")
+        self.keep = keep
+        self.keep_every = keep_every
+        self._pending = []     # [(event, errbox, step)]
+        self._atexit_registered = False
+
+    def _drain_at_exit(self):
+        try:
+            self.wait_until_finished()
+        except Exception:   # noqa: BLE001 - can't raise during shutdown
+            logging.getLogger(__name__).exception(
+                "async checkpoint save failed during interpreter exit")
+
+    def _sweep_partials(self):
+        """Remove crashed ``.tmp-*`` partials. Called from :meth:`save`
+        only — a saver owns the directory (single-writer contract) and
+        its own staged entries are committed by the ``save()`` barrier
+        before this runs; read-only managers (``Module.load``,
+        ``restore``) never sweep, so constructing one on a directory a
+        live trainer is writing into is safe."""
+        for name in os.listdir(self.directory):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # ------------------------------------------------------------ query
+    def _entry_dir(self, step):
+        return os.path.join(self.directory, _STEP_FMT % step)
+
+    def all_steps(self):
+        """Sorted committed steps (entries with a manifest in place)."""
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(os.path.join(self.directory, name,
+                                                 _MANIFEST)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest(self):
+        """Newest committed step, or None. Never reports an in-flight,
+        partial, or crashed entry."""
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # ------------------------------------------------------------- save
+    def save(self, step, arrays, optimizer_state=None, extra=None,
+             rng_state="auto", async_save=True):
+        """Stage a new checkpoint entry for ``step``.
+
+        ``arrays`` maps name -> NDArray / jax.Array / numpy array.
+        Arrays are snapshotted to host *now* (so the caller may mutate
+        or donate the originals immediately); serialization and the
+        atomic commit run on the engine worker when ``async_save``.
+        Raises any error from the *previous* async save first.
+        """
+        step = int(step)
+        self.wait_until_finished()   # barrier + previous-save errors
+        self._sweep_partials()
+        if step in self.all_steps():
+            raise MXNetError("checkpoint step %d already exists in %s"
+                             % (step, self.directory))
+        snaps = [(str(name), serialize.snapshot(value))
+                 for name, value in arrays.items()]
+        if rng_state == "auto":
+            rng_state = _random.get_state()
+        opt_bytes = bytes(optimizer_state) if optimizer_state is not None \
+            else None
+        extra = dict(extra or {})
+        save_time = time.time()
+        tmp = os.path.join(self.directory, "%s%s-%s" % (
+            _TMP_PREFIX, _STEP_FMT % step, uuid.uuid4().hex[:8]))
+        final = self._entry_dir(step)
+        errbox = []
+
+        def job():
+            try:
+                self._write_entry(tmp, step, snaps, opt_bytes, extra,
+                                  rng_state, save_time)
+                _commit_entry(tmp, final)
+                self._gc()
+            except BaseException as exc:  # noqa: BLE001 - repropagated
+                errbox.append(exc)
+                shutil.rmtree(tmp, ignore_errors=True)
+
+        if async_save:
+            if not self._atexit_registered:
+                # drain staged saves at interpreter exit: the engine
+                # worker is a daemon thread, so the final async save of
+                # a run that just falls off the end of fit() would
+                # otherwise be killed mid-write (entry uncommitted) and
+                # its error never surface. Registered lazily so
+                # read-only managers (Module.load, resume_from) are not
+                # pinned for the process lifetime.
+                atexit.register(self._drain_at_exit)
+                self._atexit_registered = True
+            event = _engine.get().push_async(job)
+            self._pending.append((event, errbox, step))
+        else:
+            job()
+            if errbox:
+                raise MXNetError("checkpoint save (step %d) failed"
+                                 % step) from errbox[0]
+        return step
+
+    def _write_entry(self, tmp, step, snaps, opt_bytes, extra, rng_state,
+                     save_time):
+        os.makedirs(tmp)
+        manifest = {"format": serialize.FORMAT, "step": step,
+                    "save_unix_time": save_time, "extra": extra,
+                    "arrays": {}}
+        for ai, (name, shards) in enumerate(snaps):
+            full = next((arr for idx, arr in shards if idx is None), None)
+            if full is not None:
+                gshape = list(full.shape)
+            else:  # global extent = max stop bound per dim over shards
+                gshape = [max(idx[d][1] for idx, _ in shards)
+                          for d in range(len(shards[0][0]))]
+            entry = {"shape": gshape,
+                     "dtype": str(shards[0][1].dtype),
+                     "shards": []}
+            for si, (idx, arr) in enumerate(shards):
+                fname = "a%05d_s%02d.npy" % (ai, si)
+                meta = serialize.write_array(os.path.join(tmp, fname), arr)
+                meta["file"] = fname
+                meta["index"] = None if idx is None else \
+                    [[int(a), int(b)] for a, b in idx]
+                entry["shards"].append(meta)
+            manifest["arrays"][name] = entry
+        if opt_bytes is not None:
+            crc = serialize.write_bytes(os.path.join(tmp, "optimizer.bin"),
+                                        opt_bytes)
+            manifest["optimizer"] = {"file": "optimizer.bin",
+                                     "size": len(opt_bytes), "crc32": crc}
+        else:
+            manifest["optimizer"] = None
+        if rng_state is not None:
+            serialize.dump_rng(os.path.join(tmp, "rng.npz"), rng_state)
+            manifest["rng"] = {"file": "rng.npz"}
+        else:
+            manifest["rng"] = None
+        serialize.write_json(os.path.join(tmp, _MANIFEST), manifest)
+
+    def wait_until_finished(self):
+        """Block until all async saves committed; re-raise the first
+        failure (the error-propagation barrier)."""
+        pending, self._pending = self._pending, []
+        first = None
+        for event, errbox, step in pending:
+            event.wait()
+            if errbox and first is None:
+                first = (step, errbox[0])
+        if first is not None:
+            raise MXNetError("async checkpoint save (step %d) failed"
+                             % first[0]) from first[1]
+
+    # ---------------------------------------------------------- restore
+    def restore(self, step=None):
+        """Load a committed entry (default: :meth:`latest`) as a
+        :class:`Checkpoint`, re-assembling sharded arrays into global
+        host arrays regardless of the saving mesh layout."""
+        self.wait_until_finished()
+        if step is None:
+            step = self.latest()
+            if step is None:
+                raise MXNetError("no committed checkpoint in %s"
+                                 % self.directory)
+        step = int(step)
+        entry = self._entry_dir(step)
+        manifest_path = os.path.join(entry, _MANIFEST)
+        if not os.path.exists(manifest_path):
+            raise MXNetError("checkpoint step %d is not committed in %s"
+                             % (step, self.directory))
+        manifest = serialize.read_json(manifest_path)
+        if manifest.get("format") != serialize.FORMAT:
+            raise MXNetError("unknown checkpoint format %r in %s"
+                             % (manifest.get("format"), entry))
+        params = {}
+        for name, meta in manifest["arrays"].items():
+            shards = []
+            for smeta in meta["shards"]:
+                arr = serialize.read_array(
+                    os.path.join(entry, smeta["file"]), smeta)
+                idx = smeta["index"]
+                shards.append((None if idx is None else
+                               tuple((a, b) for a, b in idx), arr))
+            params[name] = serialize.assemble(meta["shape"], meta["dtype"],
+                                              shards)
+        opt_bytes = None
+        if manifest.get("optimizer"):
+            with open(os.path.join(entry,
+                                   manifest["optimizer"]["file"]),
+                      "rb") as f:
+                opt_bytes = f.read()
+            import zlib
+            if (zlib.crc32(opt_bytes) & 0xFFFFFFFF) != \
+                    manifest["optimizer"]["crc32"]:
+                raise MXNetError("optimizer state in step %d failed its "
+                                 "crc32 check" % step)
+        rng = None
+        if manifest.get("rng"):
+            rng = serialize.load_rng(
+                os.path.join(entry, manifest["rng"]["file"]))
+        return Checkpoint(step=step, params=params,
+                          optimizer_state=opt_bytes,
+                          extra=manifest.get("extra", {}), rng=rng)
+
+    # --------------------------------------------------------------- gc
+    def _retained(self, steps):
+        if not steps:
+            return set()
+        kept = {steps[-1]}                       # latest is untouchable
+        if self.keep is None and self.keep_every is None:
+            return set(steps)
+        if self.keep is not None:
+            kept.update(steps[-self.keep:])
+        if self.keep_every is not None:
+            kept.update(s for s in steps if s % self.keep_every == 0)
+        return kept
+
+    def _gc(self):
+        """Apply the retention policy to committed entries (runs after
+        every successful commit)."""
+        steps = self.all_steps()
+        kept = self._retained(steps)
+        for s in steps:
+            if s not in kept:
+                shutil.rmtree(self._entry_dir(s), ignore_errors=True)

@@ -102,28 +102,27 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """prefix-symbol.json + prefix-%04d.params (model.py save_checkpoint)."""
+    """prefix-symbol.json + prefix-%04d.params (model.py save_checkpoint).
+
+    Thin wrapper over :mod:`mxnet_tpu.checkpoint`'s legacy param-file
+    helpers — the write is atomic (tmp + fsync + rename). For durable,
+    async, sharded step checkpoints use
+    :class:`mxnet_tpu.checkpoint.CheckpointManager` instead."""
+    from .checkpoint import save_params_file
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    save_params_file(param_name, arg_params, aux_params)
     logging.info('Saved checkpoint to "%s"', param_name)
 
 
 def load_checkpoint(prefix, epoch):
-    """Load (symbol, arg_params, aux_params) (model.py load_checkpoint)."""
+    """Load (symbol, arg_params, aux_params) (model.py load_checkpoint).
+    Thin wrapper over :mod:`mxnet_tpu.checkpoint`'s legacy helpers."""
+    from .checkpoint import load_params_file
     symbol = sym_mod.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        if tp == "aux":
-            aux_params[name] = v
+    arg_params, aux_params = load_params_file("%s-%04d.params"
+                                              % (prefix, epoch))
     return (symbol, arg_params, aux_params)
 
 

@@ -592,9 +592,18 @@ class MeshExecutorGroup(object):
             return
         fn = self._jits.get("pack_params")
         if fn is None:
+            repl = self._repl
+
             def pack(arrs):
+                # constrain every input to replicated BEFORE the ravel:
+                # concatenating mixed partially-replicated arrays makes
+                # the SPMD partitioner emit a dp-axis SUM instead of a
+                # replication (observed on XLA:CPU, dp=2 doubles every
+                # param), which silently corrupted sharded-module
+                # get_params/save_params
                 return jnp.concatenate(
-                    [a.ravel().astype(jnp.float32) for a in arrs])
+                    [jax.lax.with_sharding_constraint(a, repl)
+                     .ravel().astype(jnp.float32) for a in arrs])
 
             fn = self._jits["pack_params"] = jax.jit(
                 pack, out_shardings=self._repl)

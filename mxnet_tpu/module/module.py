@@ -146,8 +146,28 @@ class Module(BaseModule):
         self._label_shapes = None
 
     @staticmethod
-    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
-        """Create from a checkpoint (module.py:97)."""
+    def load(prefix, epoch=None, load_optimizer_states=False, **kwargs):
+        """Create from a checkpoint (module.py:97).
+
+        ``prefix`` may be the legacy file prefix (with ``epoch``
+        required), or a :class:`mxnet_tpu.checkpoint.CheckpointManager`
+        (or its directory path) — then ``epoch`` selects a committed
+        step, default the latest, and the symbol comes from the entry's
+        manifest."""
+        from ..checkpoint import CheckpointManager
+        from ..checkpoint.manager import is_checkpoint_dir
+        # a string routes to the manager path only when it actually
+        # holds committed step entries (or no epoch was given, which the
+        # legacy path cannot mean) — a legacy prefix colliding with an
+        # unrelated directory name keeps loading its prefix files
+        if isinstance(prefix, CheckpointManager) or (
+                isinstance(prefix, str) and os.path.isdir(prefix) and
+                (epoch is None or is_checkpoint_dir(prefix))):
+            return Module._load_from_manager(prefix, epoch,
+                                             load_optimizer_states,
+                                             **kwargs)
+        assert epoch is not None, \
+            "epoch is required when loading from a legacy prefix"
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
         mod._arg_params = args
@@ -157,8 +177,56 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """Save symbol + params (+ optimizer states) (module.py:135-156)."""
+    @staticmethod
+    def _load_from_manager(manager, step=None, load_optimizer_states=False,
+                           **kwargs):
+        """Rebuild a Module from a durable checkpoint entry. The entry is
+        self-describing (symbol json rides in the manifest ``extra``);
+        sharded saves re-assemble to global host arrays here, so the new
+        Module may bind onto any device count / mesh layout."""
+        from .. import symbol as sym_mod
+        from ..base import MXNetError
+        from ..checkpoint import CheckpointManager, split_params
+        if not isinstance(manager, CheckpointManager):
+            manager = CheckpointManager(manager)
+        ckpt = manager.restore(step)
+        sym_json = ckpt.extra.get("symbol")
+        if sym_json is None:
+            raise MXNetError(
+                "checkpoint step %d in %s carries no symbol — it was not "
+                "saved by Module.save_checkpoint(manager=...)"
+                % (ckpt.step, manager.directory))
+        arg_np, aux_np = split_params(ckpt.params)
+        mod = Module(symbol=sym_mod.load_json(sym_json), **kwargs)
+        mod._arg_params = {k: nd.array(v, dtype=v.dtype)
+                           for k, v in arg_np.items()}
+        mod._aux_params = {k: nd.array(v, dtype=v.dtype)
+                           for k, v in aux_np.items()}
+        mod.params_initialized = True
+        if load_optimizer_states:
+            if ckpt.optimizer_state is None:
+                raise MXNetError(
+                    "checkpoint step %d in %s has no optimizer state "
+                    "(save with save_optimizer_states=True)"
+                    % (ckpt.step, manager.directory))
+            mod._preload_opt_states = ckpt.optimizer_state
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        manager=None, async_save=True):
+        """Save symbol + params (+ optimizer states) (module.py:135-156).
+
+        With ``manager=`` (a :class:`mxnet_tpu.checkpoint
+        .CheckpointManager`) the save goes to a durable step entry
+        instead of prefix files: atomic commit, async by default (the
+        next train step overlaps the disk write), per-shard files for
+        mesh-sharded parameters (no full gather), symbol + epoch + RNG
+        in the manifest so ``fit(resume_from=manager)`` restores
+        everything. ``epoch`` becomes the step number; ``prefix`` is
+        ignored on this path and may be None."""
+        if manager is not None:
+            return self._save_to_manager(manager, epoch,
+                                         save_optimizer_states, async_save)
         self._symbol.save("%s-symbol.json" % prefix)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
@@ -167,6 +235,41 @@ class Module(BaseModule):
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)
             self.logger.info('Saved optimizer state to "%s"', state_name)
+
+    def _save_to_manager(self, manager, step, save_optimizer_states,
+                         async_save):
+        arrays = self._checkpoint_arrays()
+        opt_state = None
+        if save_optimizer_states:
+            assert self.optimizer_initialized
+            opt_state = self._optimizer_state_bytes()
+        extra = {"epoch": int(step), "symbol": self._symbol.tojson()}
+        manager.save(step, arrays, optimizer_state=opt_state, extra=extra,
+                     async_save=async_save)
+        self.logger.info('Staged checkpoint step %d into "%s"%s', step,
+                         manager.directory,
+                         " (async)" if async_save else "")
+        return step
+
+    def _checkpoint_arrays(self):
+        """Packed ``arg:``/``aux:`` name -> checkpointable array for the
+        manager path. The fused mesh group hands over its device-resident
+        (possibly sharded) buffers directly — the manager snapshots one
+        host copy per unique local shard, never a full gather; classic
+        groups go through the host mirrors."""
+        from ..checkpoint import pack_params
+        assert self.binded and self.params_initialized
+        grp = self._exec_group
+        if getattr(grp, "fused", False):
+            return pack_params(grp._param_dict, grp._aux_dict)
+        return pack_params(*self.get_params())
+
+    def _optimizer_state_bytes(self):
+        if self._update_on_kvstore:
+            assert self._kvstore._updater is not None, \
+                "Cannot snapshot states for distributed training"
+            return self._kvstore._updater.get_states()
+        return self._updater.get_states()
 
     # ------------------------------------------------------------------
     @property
@@ -255,7 +358,7 @@ class Module(BaseModule):
         if force_rebind:
             self._reset_bind()
         if self.binded:
-            self.logger.warning("Already binded, ignoring bind()")
+            self._warn_once("rebind", "Already binded, ignoring bind()")
             return
 
         self.for_training = for_training
@@ -463,7 +566,8 @@ class Module(BaseModule):
         """Create kvstore + optimizer (module.py:432-502)."""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning("optimizer already initialized, ignoring...")
+            self._warn_once("reinit_optimizer",
+                            "optimizer already initialized, ignoring...")
             return
         self._kvstore_arg = kvstore
 
@@ -649,7 +753,16 @@ class Module(BaseModule):
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        """Restore optimizer states from a ``.states`` file or, on the
+        manager checkpoint path, from the raw state bytes directly."""
         assert self.optimizer_initialized
+        if isinstance(fname, (bytes, bytearray)):
+            states = bytes(fname)
+            if self._update_on_kvstore:
+                self._kvstore._updater.set_states(states)
+            else:
+                self._updater.set_states(states)
+            return
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:

@@ -117,7 +117,7 @@ class SequentialModule(BaseModule):
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
-            self.logger.warning("Already binded, ignoring bind()")
+            self._warn_once("rebind", "Already binded, ignoring bind()")
             return
         if inputs_need_grad:
             assert for_training
@@ -158,7 +158,8 @@ class SequentialModule(BaseModule):
                        force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning("optimizer already initialized, ignoring.")
+            self._warn_once("reinit_optimizer",
+                            "optimizer already initialized, ignoring.")
             return
         for m in self._modules:
             m.init_optimizer(kvstore=kvstore, optimizer=optimizer,

@@ -732,25 +732,37 @@ def _cvcopyMakeBorder(src, top, bot, left, right, type=0, value=0.0):  # noqa: A
 # container (documented own format, not binary-compatible with the reference)
 # ---------------------------------------------------------------------------
 def save(fname, data):
-    """Save a list or str->NDArray dict of NDArrays to file."""
+    """Save a list or str->NDArray dict of NDArrays to file.
+
+    The write is crash-atomic: content goes to ``fname + ".tmp"``, is
+    fsynced, then renamed over ``fname`` (``os.replace``). A preemption
+    mid-write leaves the previous file intact plus at most a stray
+    ``.tmp`` that :func:`load` refuses to read.
+    """
     if isinstance(data, NDArray):
         data = [data]
-    # pass an open handle so numpy does not append ".npz" to the filename
     if isinstance(data, dict):
-        arrs = {k: v.asnumpy() for k, v in data.items()}
-        with open(fname, "wb") as f:
-            onp.savez(f, __mx_format__="dict", **arrs)
+        fmt, arrs = "dict", {k: v.asnumpy() for k, v in data.items()}
     elif isinstance(data, (list, tuple)):
+        fmt = "list"
         arrs = {"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)}
-        with open(fname, "wb") as f:
-            onp.savez(f, __mx_format__="list", **arrs)
     else:
         raise ValueError("data needs to either be a NDArray, dict or list")
+    from .checkpoint.serialize import atomic_write_stream
+    # savez streams into the tmp handle (which also stops numpy
+    # appending ".npz"); atomic_write_stream does the fsync + rename
+    atomic_write_stream(
+        fname, lambda f: onp.savez(f, __mx_format__=fmt, **arrs))
 
 
 def load(fname):
     """Load NDArrays saved by ``save`` — returns list or dict like the
-    reference's MXNDArrayLoad."""
+    reference's MXNDArrayLoad. ``.tmp`` files (an interrupted
+    :func:`save` that never committed) are rejected."""
+    if str(fname).endswith(".tmp"):
+        raise MXNetError(
+            "refusing to load %r: .tmp files are uncommitted partial "
+            "writes left by an interrupted save" % (fname,))
     with onp.load(fname, allow_pickle=False) as npz:
         fmt = str(npz["__mx_format__"]) if "__mx_format__" in npz else "dict"
         items = {k: npz[k] for k in npz.files if k != "__mx_format__"}

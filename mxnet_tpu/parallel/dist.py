@@ -140,7 +140,12 @@ def init_from_env():
     # ps-lite elastic training knob onto jax recoverability. Set via
     # jax.config (an env var would be ignored if jax imported first).
     if os.environ.get("MXNET_KVSTORE_ELASTIC", "0") == "1":
-        jax.config.update("jax_enable_recoverability", True)
+        try:
+            jax.config.update("jax_enable_recoverability", True)
+        except AttributeError:
+            # jax on the baked toolchain predates the recoverability
+            # flag; survivors then rely on the heartbeat timeout alone
+            pass
     from jax._src import distributed as _dstate
     # NOTE: probe the coordination client, NOT jax.process_count() — the
     # latter initializes the XLA backend, after which initialize() is
@@ -150,10 +155,18 @@ def init_from_env():
         port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
         rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         hb = int(os.environ.get("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "100"))
-        jax.distributed.initialize(
+        kwargs = dict(
             coordinator_address="%s:%s" % (coord, port),
-            num_processes=n_worker, process_id=rank,
-            heartbeat_timeout_seconds=hb)
+            num_processes=n_worker, process_id=rank)
+        try:
+            jax.distributed.initialize(heartbeat_timeout_seconds=hb,
+                                       **kwargs)
+        except TypeError:
+            # the kwarg binding fails before any client state is
+            # created, so retrying without the knob is safe; old jax
+            # then uses its built-in heartbeat/missed-heartbeat env
+            # defaults instead
+            jax.distributed.initialize(**kwargs)
 
 
 def get_runtime():

@@ -7,7 +7,23 @@ meshes (dp / dp×tp / dp×tp×sp) and maps mxnet-style context lists onto them.
 """
 from __future__ import annotations
 
-__all__ = ["make_mesh", "data_parallel_mesh", "mesh_from_contexts"]
+__all__ = ["make_mesh", "data_parallel_mesh", "mesh_from_contexts",
+           "shard_bounds"]
+
+
+def shard_bounds(index, shape):
+    """A jax shard index (tuple of slices over the global shape) as a
+    tuple of per-dim ``(start, stop)`` bounds — the canonical shard
+    coordinate the checkpoint subsystem keys per-shard files by
+    (checkpoint/serialize.py snapshot/assemble). Strided shards have no
+    contiguous byte extent and are rejected."""
+    out = []
+    for sl, n in zip(index, shape):
+        start, stop, step = sl.indices(n)
+        if step != 1:
+            raise ValueError("non-contiguous shard index %r" % (sl,))
+        out.append((start, stop))
+    return tuple(out)
 
 
 def make_mesh(axis_sizes, devices=None):

@@ -13,7 +13,8 @@ import threading
 
 import numpy as onp
 
-__all__ = ["seed", "uniform", "normal", "randint", "next_key"]
+__all__ = ["seed", "uniform", "normal", "randint", "next_key",
+           "get_state", "set_state"]
 
 _state = threading.local()
 _DEFAULT_SEED = 0
@@ -31,6 +32,22 @@ def seed(seed_state):
     import jax
     _state.key = jax.random.PRNGKey(int(seed_state))
     onp.random.seed(int(seed_state) % (2 ** 32))
+
+
+def get_state():
+    """Snapshot the global RNG state (this thread's jax key chain plus
+    the numpy legacy generator) as a host-side dict — what the
+    checkpoint subsystem persists so a resumed ``fit`` draws the same
+    stream the uninterrupted run would have."""
+    return {"jax_key": onp.asarray(_get(), onp.uint32),
+            "numpy": onp.random.get_state()}
+
+
+def set_state(state):
+    """Restore a snapshot taken by :func:`get_state`."""
+    import jax.numpy as jnp
+    _state.key = jnp.asarray(onp.asarray(state["jax_key"], onp.uint32))
+    onp.random.set_state(tuple(state["numpy"]))
 
 
 def next_key():

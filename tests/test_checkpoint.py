@@ -1,0 +1,391 @@
+"""Durable checkpointing subsystem (mxnet_tpu.checkpoint).
+
+Pins the subsystem's contract (ISSUE 1):
+
+* atomic commits — a crash (injected exception / simulated kill) at any
+  point before the rename leaves ``latest()`` on the previous good step;
+* async saves — ``save()`` snapshots to host and returns while the
+  engine worker serializes, so the next train step overlaps the write;
+  mutating the source arrays after ``save()`` cannot corrupt the entry;
+* sharded saves — a TP-sharded module writes one file per unique local
+  shard (no gather) and restores onto a different device count;
+* end-to-end resume — ``fit(resume_from=manager)`` restores params,
+  updater states, and RNG, and continues exactly where the
+  uninterrupted run would be;
+* retention GC, the atomic legacy ``nd.save`` path, and the once-per-
+  module "Already binded" warning.
+"""
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import engine
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager, serialize
+from mxnet_tpu.checkpoint import manager as manager_mod
+from mxnet_tpu.io import NDArrayIter
+
+MEGATRON_RULES = [
+    ("fc1_weight", ("tp", None)),
+    ("fc1_bias", ("tp",)),
+    ("fc2_weight", (None, "tp")),
+]
+
+
+def _mlp():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _iter(seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(64, 32).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.float32)
+    return NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+
+
+def _module(ctxs=None, **kw):
+    return mx.mod.Module(_mlp(), context=ctxs or [mx.cpu(0)], **kw)
+
+
+def _fit(mod, it, num_epoch, resume_from=None, callback=None):
+    mod.fit(it, num_epoch=num_epoch, resume_from=resume_from,
+            epoch_end_callback=callback,
+            initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+
+def _params_np(mod):
+    return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+# ---------------------------------------------------------------------------
+# satellite: legacy nd.save atomicity
+# ---------------------------------------------------------------------------
+def test_nd_save_atomic_and_load_rejects_tmp(tmp_path):
+    fname = str(tmp_path / "x.params")
+    mx.nd.save(fname, {"a": mx.nd.array([1, 2, 3])})
+    assert not os.path.exists(fname + ".tmp")  # tmp renamed away
+    got = mx.nd.load(fname)
+    np.testing.assert_array_equal(got["a"].asnumpy(), [1, 2, 3])
+    # an interrupted save's stray .tmp must never be loadable
+    shutil.copy(fname, fname + ".tmp")
+    with pytest.raises(MXNetError):
+        mx.nd.load(fname + ".tmp")
+    # overwriting keeps the old file intact if the write dies pre-rename
+    blob = open(fname, "rb").read()
+    with pytest.raises(ValueError):
+        mx.nd.save(fname, object())  # rejected before any write
+    assert open(fname, "rb").read() == blob
+
+
+def test_shard_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "s.npy")
+    meta = serialize.write_array(path, np.arange(6, dtype=np.float32))
+    serialize.read_array(path, meta)  # clean read passes
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF  # bit-flip inside the payload
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(MXNetError):
+        serialize.read_array(path, meta)
+
+
+# ---------------------------------------------------------------------------
+# manager: round trip, async, crash, GC
+# ---------------------------------------------------------------------------
+def test_roundtrip_plain_module(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    it = _iter()
+    mod = _module()
+    np.random.seed(5)
+    mx.random.seed(5)
+    _fit(mod, it, 2, callback=mx.callback.module_checkpoint(
+        mod, save_optimizer_states=True, manager=mgr))
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [0, 1] and mgr.latest() == 1
+
+    ckpt = mgr.restore()
+    assert ckpt.step == 1 and ckpt.extra["epoch"] == 1
+    assert ckpt.optimizer_state and ckpt.rng is not None
+
+    mod2 = mx.mod.Module.load(mgr, load_optimizer_states=True,
+                              context=[mx.cpu(0)])
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_optimizer(optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9})
+    a, b = _params_np(mod), _params_np(mod2)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+    # momentum state came back too (sgd momentum is one array per param)
+    def _leaves(state):
+        if isinstance(state, (list, tuple)):
+            for s in state:
+                yield from _leaves(s)
+        elif state is not None:
+            yield state.asnumpy() if hasattr(state, "asnumpy") \
+                else np.asarray(state)
+
+    sa, sb = mod._updater.states, mod2._updater.states
+    assert set(sa) == set(sb)
+    for k in sa:
+        for la, lb in zip(_leaves(sa[k]), _leaves(sb[k])):
+            np.testing.assert_array_equal(la, lb, err_msg=str(k))
+
+
+def test_async_save_snapshots_before_mutation(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    arrs = {"w": mx.nd.array([1.0, 2.0, 3.0])}
+    before = arrs["w"].asnumpy().copy()
+    mgr.save(0, arrs, async_save=True)
+    arrs["w"][:] = -7.0  # the next "train step" mutates in place
+    mgr.wait_until_finished()
+    np.testing.assert_array_equal(mgr.restore(0).params["w"], before)
+
+
+@pytest.mark.skipif(engine.is_naive(),
+                    reason="NaiveEngine runs saves synchronously")
+def test_async_save_overlaps_commit(tmp_path, monkeypatch):
+    """save() returns while the entry is still uncommitted; the commit
+    lands on the engine worker and wait_until_finished() observes it."""
+    import threading
+    gate = threading.Event()
+    real = manager_mod._commit_entry
+
+    def stalled(tmp, final):
+        gate.wait(30)
+        real(tmp, final)
+
+    monkeypatch.setattr(manager_mod, "_commit_entry", stalled)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(3, {"w": mx.nd.array([1.0])}, async_save=True)
+    assert mgr.latest() is None  # returned before the commit
+    gate.set()
+    mgr.wait_until_finished()
+    assert mgr.latest() == 3
+
+
+def test_async_save_drained_at_interpreter_exit(tmp_path):
+    """A script that stages an async save and falls off the end must
+    still commit it: the manager's atexit hook drains the engine worker
+    (a daemon thread that would otherwise die mid-write)."""
+    root = str(tmp_path / "ckpt")
+    script = (
+        "import sys, time\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu.checkpoint import CheckpointManager, serialize\n"
+        "real = serialize.write_array\n"
+        "def slow(path, arr):\n"
+        "    time.sleep(1.5)\n"
+        "    return real(path, arr)\n"
+        "serialize.write_array = slow\n"
+        "mgr = CheckpointManager(sys.argv[1])\n"
+        "mgr.save(0, {'w': mx.nd.array([5.0])}, async_save=True)\n"
+        "# no wait_until_finished(): exits with the save in flight\n")
+    res = subprocess.run([sys.executable, "-c", script, root],
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    mgr = CheckpointManager(root)
+    assert mgr.latest() == 0
+    np.testing.assert_array_equal(mgr.restore().params["w"], [5.0])
+
+
+def test_crash_before_rename_keeps_previous_step(tmp_path, monkeypatch):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(0, {"w": mx.nd.array([42.0])}, async_save=False)
+
+    def die(tmp, final):
+        raise OSError("simulated preemption before rename")
+
+    monkeypatch.setattr(manager_mod, "_commit_entry", die)
+    mgr.save(1, {"w": mx.nd.array([-1.0])}, async_save=True)
+    with pytest.raises(MXNetError, match="step 1"):
+        mgr.wait_until_finished()
+    monkeypatch.undo()
+    # the failed step never became visible; the good one still restores
+    assert mgr.all_steps() == [0] and mgr.latest() == 0
+    np.testing.assert_array_equal(mgr.restore().params["w"], [42.0])
+    # and the save after the failure proceeds normally
+    mgr.save(1, {"w": mx.nd.array([9.0])}, async_save=False)
+    assert mgr.latest() == 1
+
+
+def test_partial_entries_are_invisible_and_cleaned(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root)
+    mgr.save(2, {"w": mx.nd.array([1.0])}, async_save=False)
+    # a SIGKILL mid-write leaves exactly these states on disk:
+    crashed = os.path.join(root, ".tmp-step_00000003-deadbeef")
+    os.makedirs(crashed)
+    open(os.path.join(crashed, "a00000_s00.npy"), "wb").write(b"partial")
+    manifestless = os.path.join(root, "step_00000007")
+    os.makedirs(manifestless)  # e.g. interrupted GC
+    assert mgr.all_steps() == [2] and mgr.latest() == 2
+    # a read-only manager (a concurrent Module.load / evaluator) must
+    # NOT touch another writer's staging dirs
+    mgr_reader = CheckpointManager(root)
+    assert mgr_reader.latest() == 2
+    assert os.path.exists(crashed)
+    # the resumed trainer's next save sweeps the wreckage
+    mgr2 = CheckpointManager(root)
+    mgr2.save(8, {"w": mx.nd.array([2.0])}, async_save=False)
+    assert not os.path.exists(crashed)
+    assert mgr2.all_steps() == [2, 8]
+
+
+def test_retention_gc(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2, keep_every=4)
+    for s in range(10):
+        mgr.save(s, {"w": mx.nd.array([float(s)])}, async_save=False)
+    # newest 2 plus every 4th survive
+    assert mgr.all_steps() == [0, 4, 8, 9]
+    np.testing.assert_array_equal(mgr.restore(4).params["w"], [4.0])
+
+
+def test_step_collision_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(5, {"w": mx.nd.array([1.0])}, async_save=False)
+    with pytest.raises(MXNetError, match="already exists"):
+        mgr.save(5, {"w": mx.nd.array([2.0])}, async_save=False)
+
+
+def test_rng_state_roundtrip():
+    mx.random.seed(11)
+    np.random.seed(11)
+    state = mx.random.get_state()
+    a1 = mx.random.uniform(0, 1, (4,)).asnumpy()
+    n1 = np.random.rand(3)
+    mx.random.set_state(state)
+    np.testing.assert_array_equal(mx.random.uniform(0, 1, (4,)).asnumpy(),
+                                  a1)
+    np.testing.assert_array_equal(np.random.rand(3), n1)
+
+
+# ---------------------------------------------------------------------------
+# sharded saves and cross-layout restore
+# ---------------------------------------------------------------------------
+def test_sharded_save_restores_on_one_device(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    it = _iter()
+    mod = _module([mx.cpu(i) for i in range(8)],
+                  mesh_axes={"dp": 2, "tp": 4},
+                  param_sharding=MEGATRON_RULES)
+    np.random.seed(7)
+    mx.random.seed(7)
+    _fit(mod, it, 1)
+    mod.save_checkpoint(None, 0, save_optimizer_states=True, manager=mgr)
+    mgr.wait_until_finished()
+
+    entry = os.path.join(mgr.directory, "step_00000000")
+    manifest = json.load(open(os.path.join(entry, "manifest.json")))
+    sharded = {n: m for n, m in manifest["arrays"].items()
+               if len(m["shards"]) > 1}
+    # the three Megatron-sharded params write one file per tp shard,
+    # never a gathered copy
+    assert {n.split(":", 1)[1] for n in sharded} == \
+        {"fc1_weight", "fc1_bias", "fc2_weight"}
+    assert all(len(m["shards"]) == 4 for m in sharded.values())
+
+    mod1 = mx.mod.Module.load(mgr, context=[mx.cpu(0)])
+    mod1.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    a, b = _params_np(mod), _params_np(mod1)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# fit(resume_from=) end to end
+# ---------------------------------------------------------------------------
+def _train_straight(num_epoch, manager=None, stop_after=None):
+    it = _iter(3)
+    mod = _module()
+    np.random.seed(21)
+    mx.random.seed(21)
+    cb = None
+    if manager is not None:
+        cb = mx.callback.module_checkpoint(mod, save_optimizer_states=True,
+                                           manager=manager)
+    _fit(mod, it, stop_after if stop_after else num_epoch, callback=cb)
+    if manager is not None:
+        manager.wait_until_finished()
+    return mod, it
+
+
+def test_fit_resume_matches_uninterrupted(tmp_path):
+    ref, _ = _train_straight(4)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    _train_straight(4, manager=mgr, stop_after=2)  # "preempted" here
+    assert mgr.latest() == 1
+
+    it = _iter(3)
+    mod = _module()
+    # fresh process: different init seeds must not matter — everything
+    # comes from the checkpoint
+    np.random.seed(99)
+    mx.random.seed(99)
+    _fit(mod, it, 4, resume_from=mgr)
+    a, b = _params_np(ref), _params_np(mod)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=k)
+
+
+def test_resume_from_empty_manager_starts_fresh(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    it = _iter()
+    mod = _module()
+    _fit(mod, it, 1, resume_from=mgr)  # no entries: plain cold start
+    assert mod.params_initialized
+
+
+def test_load_legacy_prefix_colliding_with_directory(tmp_path, monkeypatch):
+    """A legacy prefix whose name also exists as an unrelated directory
+    must keep loading its prefix files, not be misrouted to the
+    manager path."""
+    monkeypatch.chdir(tmp_path)
+    os.makedirs("mymodel")  # e.g. the model's output folder
+    it = _iter()
+    mod = _module()
+    _fit(mod, it, 1)
+    mod.save_checkpoint("mymodel", 1)
+    mod2 = mx.mod.Module.load("mymodel", 1, context=[mx.cpu(0)])
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    a, b = _params_np(mod), _params_np(mod2)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_module_checkpoint_needs_target():
+    with pytest.raises(ValueError):
+        mx.callback.module_checkpoint(_module())
+
+
+# ---------------------------------------------------------------------------
+# satellite: once-per-module warning spam
+# ---------------------------------------------------------------------------
+def test_repeated_fit_warns_once(caplog):
+    it = _iter()
+    mod = _module()
+    with caplog.at_level(logging.WARNING, logger="root"):
+        for _ in range(3):
+            _fit(mod, it, 1)
+    binded = [r for r in caplog.records
+              if "Already binded" in r.getMessage()
+              and r.levelno == logging.WARNING]
+    opt = [r for r in caplog.records
+           if "optimizer already initialized" in r.getMessage()
+           and r.levelno == logging.WARNING]
+    assert len(binded) == 1, binded
+    assert len(opt) == 1, opt
