@@ -52,6 +52,29 @@ def _peaks(device_kind, n_dev):
     return None, None
 
 
+class _DedupeLogFilter(object):
+    """Drop repeated identical WARNING+ records.  The bench drives
+    fit/bind in timed windows, and each re-entry used to print its own
+    "Already binded"/"optimizer already initialized" notice —
+    BENCH_r05's JSON tail drowned in them.  One line per distinct
+    message keeps the output readable; INFO and below pass untouched
+    (progress lines legitimately repeat), which also bounds the seen
+    set."""
+
+    def __init__(self):
+        self._seen = set()
+
+    def filter(self, record):
+        import logging
+        if record.levelno < logging.WARNING:
+            return True
+        key = (record.levelno, record.getMessage())
+        if key in self._seen:
+            return False
+        self._seen.add(key)
+        return True
+
+
 def _emit(value, extra=None):
     rec = {"metric": "resnet50_train_throughput", "value": round(value, 2),
            "unit": "images/sec", "vs_baseline": round(value / BASELINE_IMG_S,
@@ -118,6 +141,9 @@ def main():
                            int(sys.argv[4]), int(sys.argv[5]),
                            sys.argv[6] == "dev")
         return
+
+    import logging
+    logging.getLogger().addFilter(_DedupeLogFilter())
 
     import numpy as np
     import jax
@@ -295,6 +321,15 @@ def main():
         except Exception as e:
             extra["fit_error"] = str(e)[:160]
 
+    if fused and os.environ.get("BENCH_GROUPED", "1") != "0":
+        # iterations-per-loop: the same fit loop with batch_group=K —
+        # K steps per launch through the scanned train-step program
+        try:
+            extra.update(_bench_grouped(mx, mod, batches, batch,
+                                        img_per_sec, steps))
+        except Exception as e:
+            extra["grouped_error"] = str(e)[:160]
+
     extra.update(pipe_extra)
     if pipe_recs is not None:
         try:
@@ -339,6 +374,49 @@ class _DeviceBatchIter(object):
         self._i = 0
 
 
+def _fit_window_slope(run, ep_batches, batch, step_img_per_sec, prefix,
+                      plaus):
+    """Two fit-call windows of different epoch counts, differenced —
+    the ONE implementation of the fit-loop slope (plain fit AND grouped
+    fit consume it, so the methodology/guards cannot drift between the
+    two metrics).  Emits ``<prefix>_img_per_sec`` + band + ``_vs_step``
+    when the slope is sane, else a ``<prefix>_error`` that names
+    degeneracy vs implausibility.  The plausibility guard exists
+    because a slope from noise-dominated near-equal windows once
+    recorded 11.8x (bench_runs/r5/run1_full.json, pre-token-fix
+    recompiles); ``plaus`` is the allowed ratio over the raw step
+    rate.  Returns (fields, ok)."""
+    from bench_timing import two_window_slope
+    sl = two_window_slope(run, 4, 2, reps=2)
+    out = {prefix + "_reps_s": {
+        "long": [round(t, 3) for t in sl["longs"]],
+        "short": [round(t, 3) for t in sl["shorts"]]}}
+    rate = sl["n_slope"] * ep_batches * batch / sl["dt"] \
+        if sl["dt"] > 0 else 0.0
+    ok = sl["timing"] == "two_window_slope" and \
+        (step_img_per_sec <= 0 or rate <= plaus * step_img_per_sec)
+    if ok:
+        out[prefix + "_img_per_sec"] = round(rate, 2)
+        pair = sorted(sl["n_slope"] * ep_batches * batch / d
+                      for d in sl["pair_dts"])
+        if pair:
+            out[prefix + "_img_per_sec_band"] = {
+                "min": round(pair[0], 1),
+                "median": round(pair[len(pair) // 2], 1),
+                "max": round(pair[-1], 1)}
+        if step_img_per_sec > 0:
+            out[prefix + "_vs_step"] = round(rate / step_img_per_sec, 3)
+    else:
+        out[prefix + "_error"] = "degenerate %s windows: %r vs %r" % (
+            prefix, sl["longs"], sl["shorts"])
+        if step_img_per_sec > 0 and rate > plaus * step_img_per_sec:
+            out[prefix + "_error"] = (
+                "implausible %s slope %.0f img/s vs step %.0f — "
+                "windows %r vs %r" % (prefix, rate, step_img_per_sec,
+                                      sl["longs"], sl["shorts"]))
+    return out, ok
+
+
 def _bench_fit(mx, mod, batches, batch, step_img_per_sec, steps):
     """Module.fit(eval_metric='acc') throughput via two fit() calls of
     different epoch counts, differenced (two-window slope over whole
@@ -362,40 +440,53 @@ def _bench_fit(mx, mod, batches, batch, step_img_per_sec, steps):
         return time.time() - t0
 
     run(1)  # warm the fit path (metric program recompile)
-    from bench_timing import two_window_slope
-    sl = two_window_slope(run, 4, 2, reps=2)
-    out = {"fit_epoch_batches": ep_batches,
-           "fit_reps_s": {"long": [round(t, 3) for t in sl["longs"]],
-                          "short": [round(t, 3) for t in sl["shorts"]]}}
-    rate = sl["n_slope"] * ep_batches * batch / sl["dt"] \
-        if sl["dt"] > 0 else 0.0
-    # plausibility guard: fit cannot beat the raw step rate — a slope
-    # from noise-dominated near-equal windows once recorded 11.8x
-    # (bench_runs/r5/run1_full.json, pre-token-fix recompiles)
-    if sl["timing"] == "two_window_slope" and \
-            (step_img_per_sec <= 0 or rate <= 1.2 * step_img_per_sec):
-        out["fit_img_per_sec"] = round(rate, 2)
-        pair = sorted(sl["n_slope"] * ep_batches * batch / d
-                      for d in sl["pair_dts"])
-        if pair:
-            out["fit_img_per_sec_band"] = {
-                "min": round(pair[0], 1),
-                "median": round(pair[len(pair) // 2], 1),
-                "max": round(pair[-1], 1)}
-        if step_img_per_sec > 0:
-            out["fit_vs_step"] = round(rate / step_img_per_sec, 3)
+    # plausibility: fit cannot beat the raw step rate
+    fields, ok = _fit_window_slope(run, ep_batches, batch,
+                                   step_img_per_sec, "fit", plaus=1.2)
+    out = {"fit_epoch_batches": ep_batches}
+    out.update(fields)
+    if ok:
         grp = mod._exec_group
         out["fit_device_metric"] = getattr(grp, "_metric_live",
                                            None) is metric
         out["fit_train_acc"] = round(float(metric.get()[1]), 4)
-    else:
-        out["fit_error"] = "degenerate fit windows: %r vs %r" % (
-            sl["longs"], sl["shorts"])
-        if step_img_per_sec > 0 and rate > 1.2 * step_img_per_sec:
-            out["fit_error"] = ("implausible fit slope %.0f img/s vs "
-                                "step %.0f — windows %r vs %r"
-                                % (rate, step_img_per_sec, sl["longs"],
-                                   sl["shorts"]))
+    return out
+
+
+def _bench_grouped(mx, mod, batches, batch, step_img_per_sec, steps):
+    """Module.fit(batch_group=K) throughput — K whole train steps per
+    XLA launch via the scanned grouped program.  Same two-fit-windows
+    slope discipline as _bench_fit; device-resident batches isolate the
+    LOOP+LAUNCH amortization (the transfer-side amortization is
+    pipeline_grouped_img_per_sec).  With ~5 ms launch overhead on
+    ~47 ms steps (PERF.md) the expected gain is modest here and large
+    on the fed pipeline, where each group also saves (K-1) fixed
+    ~110 ms transfer costs."""
+    group_k = int(os.environ.get("BENCH_GROUP", "4"))
+    ep_batches = int(os.environ.get("BENCH_FIT_EPOCH_BATCHES",
+                                    str(max(4, steps * 12))))
+    it = _DeviceBatchIter(batches, mod.data_shapes, mod.label_shapes,
+                          ep_batches)
+    metric = mx.metric.Accuracy()
+
+    def run(n_epochs):
+        t0 = time.time()
+        mod.fit(it, eval_metric=metric, num_epoch=n_epochs,
+                batch_group=group_k)
+        return time.time() - t0
+
+    run(1)  # warm (grouped-program compile)
+    if not mod.grouped_train_engaged():
+        return {"grouped_error": "grouped program did not engage "
+                                 "(fit fell back to per-batch)"}
+    # plausibility at 1.3x (vs fit's 1.2x): grouping legitimately saves
+    # fixed per-step overheads, so it may modestly beat the step rate
+    fields, _ok = _fit_window_slope(run, ep_batches, batch,
+                                    step_img_per_sec, "grouped",
+                                    plaus=1.3)
+    out = {"grouped_batch_group": group_k,
+           "grouped_epoch_batches": ep_batches}
+    out.update(fields)
     return out
 
 
@@ -694,6 +785,7 @@ def _bench_pipeline(mx, mod, recs, step_batch, steps, img, synthetic_img_s,
 
     threads, procs, dev_aug = _io_iter_opts()
     n_images = recs["_n_images"]
+    group_k = int(os.environ.get("BENCH_GROUP", "4"))
     out = {}
     # NOTE: no PrefetchingIter wrapper here — on few-core hosts the
     # extra producer thread contends with the decode pool and the
@@ -741,6 +833,39 @@ def _bench_pipeline(mx, mod, recs, step_batch, steps, img, synthetic_img_s,
             mod.update()
         barrier()
         out[key] = round(steps * step_batch / (time.time() - t0), 2)
+
+        if fmt == "npy" and group_k > 1 and \
+                os.environ.get("BENCH_GROUPED", "1") != "0" and \
+                getattr(mod._exec_group, "fused", False):
+            # grouped fed window: K iterator batches -> ONE stacked
+            # host block -> ONE device_put -> ONE scanned K-step
+            # program.  Each group pays the fixed per-transfer cost
+            # (~110 ms on this transport) once instead of K times —
+            # the amortization the iterations-per-loop path exists for.
+            try:
+                n_groups = max(2, steps // group_k)
+                run_group, gstate = _grouped_pipeline_step(
+                    mod, group_k, next_batch)
+                run_group()  # compile/warm the grouped program
+                barrier()
+                t0 = time.time()
+                for _ in range(n_groups):
+                    run_group()
+                barrier()
+                rate = round(
+                    n_groups * group_k * step_batch / (time.time() - t0),
+                    2)
+                if gstate["fallbacks"]:
+                    # a declined group trained per batch — the window
+                    # no longer measures the grouped program
+                    out["pipeline_grouped_error"] = (
+                        "%d/%d groups fell back to per-batch steps"
+                        % (gstate["fallbacks"], n_groups + 1))
+                else:
+                    out["pipeline_grouped_img_per_sec"] = rate
+                    out["pipeline_grouped_batch_group"] = group_k
+            except Exception as e:
+                out["pipeline_grouped_error"] = str(e)[:120]
         it.pool.shutdown(wait=False)
 
     if "pipeline_img_per_sec" in out:
@@ -750,6 +875,26 @@ def _bench_pipeline(mx, mod, recs, step_batch, steps, img, synthetic_img_s,
             out["pipeline_img_per_sec"]
             / out["iter_only_npy_img_per_sec"], 3)
     return out
+
+
+def _grouped_pipeline_step(mod, group_k, next_batch):
+    """One fed grouped step: pull K batches, train them as one staged
+    block through Module._grouped_step (falling back per batch if the
+    grouped program declines, so the window still measures training).
+    Returns (run_group, state); ``state["fallbacks"]`` counts declined
+    groups — a nonzero count means the recorded rate did NOT exercise
+    the grouped program and must be flagged, not reported as grouped."""
+    state = {"fallbacks": 0}
+
+    def run_group():
+        group = [next_batch() for _ in range(group_k)]
+        if not mod._grouped_step(group):
+            state["fallbacks"] += 1
+            for b in group:
+                mod.forward_backward(b)
+                mod.update()
+
+    return run_group, state
 
 
 def _pipeline_verdict(extra):

@@ -63,6 +63,28 @@ print("resume gate: uninterrupted %.4f vs resumed %.4f" % (a, b))
 PY
 rm -rf "$CKPT_TMP"
 
+stage "batch-group gate (grouped K-step training == per-batch, 1 epoch)"
+# iterations-per-loop contract (docs/how_to/perf.md "batch_group"): the
+# scanned K-step train program is bit-identical to per-batch training,
+# so a seeded 1-epoch run must land on the same accuracy either way
+BG_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --acc-out "$BG_TMP/acc_plain.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --batch-group 4 --acc-out "$BG_TMP/acc_grouped.txt" || FAILED=1
+python - "$BG_TMP/acc_plain.txt" "$BG_TMP/acc_grouped.txt" <<'PY' || FAILED=1
+import sys
+a, b = (float(open(p).read()) for p in sys.argv[1:3])
+assert abs(a - b) <= 1e-3, \
+    "batch_group accuracy %.4f != per-batch %.4f" % (b, a)
+print("batch-group gate: per-batch %.4f vs grouped %.4f" % (a, b))
+PY
+rm -rf "$BG_TMP"
+
 stage "multi-chip dryrun (8 virtual devices)"
 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
     || FAILED=1

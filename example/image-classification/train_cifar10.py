@@ -79,6 +79,12 @@ def main():
     parser.add_argument("--acc-out", default=None,
                         help="write the final validation accuracy to "
                              "this file (CI resume gate comparison)")
+    parser.add_argument("--batch-group", type=int, default=None,
+                        help="train K batches per XLA launch through "
+                             "the grouped (iterations-per-loop) train "
+                             "step — one staged transfer and one "
+                             "scanned program per K batches; numerics "
+                             "match per-batch training exactly")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     if args.seed is not None:
@@ -134,9 +140,21 @@ def main():
             batch_end_callback=mx.callback.Speedometer(args.batch_size,
                                                        20),
             epoch_end_callback=callbacks or None,
-            resume_from=manager if args.resume else None)
+            resume_from=manager if args.resume else None,
+            batch_group=args.batch_group)
     if manager is not None:
         manager.wait_until_finished()
+    trained = mod._optimizer is not None and mod._optimizer.num_update > 0
+    if args.batch_group and args.batch_group > 1 and trained:
+        # the CI equivalence gate must FAIL, not trivially pass, if the
+        # grouped path silently fell back to per-batch training (a
+        # fallback would make both gate runs identical per-batch runs).
+        # Gated on `trained`: a resume already at num_epochs runs zero
+        # batches — nothing engaged because nothing trained.
+        assert mod.grouped_train_engaged(), (
+            "--batch-group %d requested but the grouped train program "
+            "never engaged (fit fell back to per-batch training)"
+            % args.batch_group)
     score = mod.score(val, "acc")
     print("final validation:", score)
     if args.acc_out:

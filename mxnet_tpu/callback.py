@@ -89,27 +89,43 @@ def log_train_metric(period, auto_reset=False):
 class Speedometer(object):
     """Batch callback: log samples/sec (and the training metric, if one
     is attached) every ``frequent`` batches. The window restarts at every
-    epoch boundary (detected by ``nbatch`` wrapping backwards)."""
+    epoch boundary (detected by ``nbatch`` wrapping backwards).
+
+    Stride-aware: ``fit(batch_group=K)`` fires the callback once per
+    group with ``nbatch`` advancing by K, so the window counts the
+    batches actually seen since the last log (identical behavior at
+    stride 1) and the rate is computed from that true count. The metric
+    read below is the window's ONE device-tally drain — it happens at a
+    group boundary, never mid-group."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
         self._tic = None
         self._last_count = 0
+        self._seen = 0
 
     def __call__(self, param):
         count = param.nbatch
-        if count < self._last_count:
+        # <= not <: nbatch strictly increases WITHIN an epoch, so an
+        # equal count is also a new epoch (single-group/single-batch
+        # epochs repeat the same nbatch every epoch — with < the wrap
+        # never fired and the window silently spanned epochs)
+        if count <= self._last_count:
             self._tic = None  # new epoch: restart the timing window
+            self._seen = 0
+        delta = count - self._last_count
         self._last_count = count
 
         if self._tic is None:
             self._tic = time.time()
+            self._seen = 0
             return
-        if count % self.frequent != 0:
+        self._seen += delta
+        if self._seen < self.frequent:
             return
 
-        speed = self.frequent * self.batch_size / (time.time() - self._tic)
+        speed = self._seen * self.batch_size / (time.time() - self._tic)
         metric = param.eval_metric
         if metric is not None:
             # reading the metric materializes outputs -> device-synced rate
@@ -124,6 +140,7 @@ class Speedometer(object):
             logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                          param.epoch, count, speed)
         self._tic = time.time()
+        self._seen = 0
 
 
 class ProgressBar(object):

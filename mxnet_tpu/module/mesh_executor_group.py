@@ -433,14 +433,9 @@ class MeshExecutorGroup(object):
             # (PERF.md: ~5 ms/launch vs ~7 ms ideal bs32 batch time).
             # The reference's analogue is benchmark_score's tight loop
             # over per-batch Forward (docs/how_to/perf.md:116-148).
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            def lift(sh):
-                return NamedSharding(self.mesh, P(*((None,)
-                                                    + sh.spec)))
-
-            st_batch = lift(self._batch_sharding)
-            st_outs = tuple(lift(s) for s in self._out_shardings)
+            st_batch = self._stacked_sharding(self._batch_sharding)
+            st_outs = tuple(self._stacked_sharding(s)
+                            for s in self._out_shardings)
 
             def fwd_stacked(params, aux, inputs, rng):
                 def body(rng_c, inp):
@@ -540,6 +535,107 @@ class MeshExecutorGroup(object):
                     out_shardings=(self._out_shardings, repl, gsh, psh,
                                    None, (repl, repl)),
                     donate_argnums=donate + ((7,) if donate else ()))
+        elif kind.startswith("train_step_grouped:"):
+            # K train steps as ONE XLA program (TPUEstimator's
+            # iterations_per_loop, reconstructed): lax.scan of the same
+            # step math over a (K, batch, ...) staged block.  One launch
+            # and ONE host->device transfer cover K steps — the ~110 ms
+            # fixed per-transfer cost and ~5 ms launch overhead measured
+            # on this transport (PERF.md) amortize K-fold, with zero
+            # readbacks inside the group (metric rides the device tally,
+            # the lr schedule rides a precomputed (K, n_params) row per
+            # step — see step_update_grouped).
+            fa = self._step_fa
+            mstat = self._metric_stat if ":m" in kind else None
+            mlabels = list(self._label_names)
+            out_structs = self._out_structs()
+
+            def grouped_math(params, aux, states, inputs, rng, lrs, wds,
+                             macc):
+                import jax.numpy as jnp
+                K = lrs.shape[0]
+                if self._needs_rng:
+                    # independent per-step keys (the per-batch path draws
+                    # one host next_key() per step; rng-free nets are
+                    # bit-identical either way, rng ops draw their own
+                    # streams like the pipelined schedule documents)
+                    subs = jax.random.split(rng, K)
+                else:
+                    subs = jnp.broadcast_to(rng, (K,) + rng.shape)
+
+                def body(carry, xs):
+                    params, aux, states, _outs, _grads, macc = carry
+                    inp, lr_row, sub = xs
+                    outs, aux, grads = fwd_bwd_math(params, aux, inp, sub)
+                    new_params = dict(params)
+                    new_states = []
+                    for k, n in enumerate(grad_names):
+                        p, s = fa(jnp, params[n], grads[n], states[k],
+                                  lr_row[k], wds[k])
+                        new_params[n] = p
+                        new_states.append(s)
+                    if mstat is not None:
+                        macc = _tally_add(jnp, mstat,
+                                          [inp[n] for n in mlabels], outs,
+                                          macc)
+                    return (new_params, aux, tuple(new_states), outs,
+                            grads, macc), None
+
+                # last step's outs/grads ride the carry (stacking all K
+                # via scan ys would cost K x params of HBM for grads)
+                zero_outs = tuple(jnp.zeros(s.shape, jnp.float32)
+                                  for s in out_structs)
+                zero_grads = {n: jnp.zeros(params[n].shape,
+                                           params[n].dtype)
+                              for n in grad_names}
+                carry = (params, aux, states, zero_outs, zero_grads,
+                         macc)
+                # rolled loop, never unrolled: XLA:CPU runs while-loop
+                # bodies on a slow path (8-30x per-step on conv nets),
+                # but unrolling lets XLA fuse ACROSS steps and the
+                # reassociated reductions break the bitwise match with
+                # K sequential per-batch programs (measured on the CPU
+                # mesh).  Exactness is the contract; the rolled loop
+                # also keeps compile time and program size
+                # K-independent on accelerators, where loop bodies run
+                # at full speed anyway.
+                (params, aux, states, outs, grads, macc), _ = \
+                    jax.lax.scan(body, carry, (inputs, lrs, subs))
+                return outs, aux, grads, params, states, macc
+
+            st_batch = self._stacked_sharding()
+            donate = (0, 2) if self._platform != "cpu" else ()
+            if mstat is None:
+                def train_grouped(params, aux, states, inputs, rng, lrs,
+                                  wds):
+                    import jax.numpy as jnp
+                    dummy = (jnp.zeros((0,), jnp.float32),
+                             jnp.zeros((0,), jnp.int32))
+                    outs, aux, grads, params, states, _ = grouped_math(
+                        params, aux, states, inputs, rng, lrs, wds,
+                        dummy)
+                    return outs, aux, grads, params, states
+
+                fn = jax_jit(
+                    train_grouped,
+                    in_shardings=(psh, repl, None, st_batch, None, None,
+                                  None),
+                    out_shardings=(self._out_shardings, repl, gsh, psh,
+                                   None),
+                    donate_argnums=donate)
+            else:
+                def train_grouped(params, aux, states, inputs, rng, lrs,
+                                  wds, macc):
+                    return grouped_math(params, aux, states, inputs, rng,
+                                        lrs, wds, macc)
+
+                fn = jax_jit(
+                    train_grouped,
+                    in_shardings=(psh, repl, None, st_batch, None, None,
+                                  None, (repl, repl)),
+                    out_shardings=(self._out_shardings, repl, gsh, psh,
+                                   None, (repl, repl)),
+                    donate_argnums=donate + ((7,) if donate else ()))
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
 
@@ -551,6 +647,7 @@ class MeshExecutorGroup(object):
                 (self._out_shardings,) if with_heads else ())
             fn = jax_jit(fwd_bwd, in_shardings=in_sh,
                          out_shardings=(self._out_shardings, repl, gsh))
+
         self._jits[key] = fn
         return fn
 
@@ -636,18 +733,26 @@ class MeshExecutorGroup(object):
                               onp.float32), self._batch_sharding)
         return inputs
 
-    def score_stacked(self, stacked_data):
-        """Score K batches in ONE launch (see "fwd_eval_stacked").
-
-        ``stacked_data``: dict data_name -> (K, B, ...) array (host or
-        device). Returns a tuple of stacked (K, ...) output jax arrays.
-        """
-        import jax
-
-        self._materialize_backward()
+    def _stacked_sharding(self, sharding=None):
+        """Lift a per-batch NamedSharding to its (K, ...) stacked form:
+        the leading group axis replicates, inner axes keep their spec.
+        Default: the batch input sharding (group axis + 'dp' batch)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
-        st_batch = NamedSharding(self.mesh,
-                                 P(*((None,) + self._batch_sharding.spec)))
+        if sharding is None:
+            sharding = self._batch_sharding
+        return NamedSharding(self.mesh, P(*((None,) + sharding.spec)))
+
+    def stage_stacked(self, stacked_data):
+        """Place a dict of name -> (K, batch, ...) blocks (host or
+        device, NDArray or raw) onto the mesh — ONE ``device_put`` per
+        block — and zero-fill bound inputs the block does not provide
+        (labels at predict time), like the per-batch ``_stage``.
+
+        The shared staging step of every K-batches-per-launch program:
+        stacked scoring (``score_stacked``) and the grouped train step
+        (``step_update_grouped``) both ride it."""
+        import jax
+        st_batch = self._stacked_sharding()
         inputs = {}
         K = None
         for name, arr in stacked_data.items():
@@ -660,6 +765,16 @@ class MeshExecutorGroup(object):
                 inputs[name] = jax.device_put(
                     onp.zeros((K, bs) + tuple(self._shape_of[name][1:]),
                               onp.float32), st_batch)
+        return inputs
+
+    def score_stacked(self, stacked_data):
+        """Score K batches in ONE launch (see "fwd_eval_stacked").
+
+        ``stacked_data``: dict data_name -> (K, B, ...) array (host or
+        device). Returns a tuple of stacked (K, ...) output jax arrays.
+        """
+        self._materialize_backward()
+        inputs = self.stage_stacked(stacked_data)
         fn = self._get_jit("fwd_eval_stacked")
         params = {n: b._read() for n, b in self._param_dict.items()}
         aux = {n: b._read() for n, b in self._aux_dict.items()}
@@ -847,6 +962,104 @@ class MeshExecutorGroup(object):
             self._param_dict[n]._write(p)
         for (key, n), ns in zip(triples, new_states):
             updater.write_state_tree(key, ns)
+        self._outputs_from = "bwd"
+        return True
+
+    def step_update_grouped(self, updater, stacked_data, num_device=1):
+        """Run K whole train steps — fwd+bwd+optimizer (+metric tally) —
+        as ONE XLA program over a ``(K, batch, ...)`` stacked block.
+
+        ``stacked_data``: dict input name -> (K, batch, ...) host or
+        device block; it is staged with ONE ``device_put`` per input
+        (``stage_stacked``), so the fixed per-transfer cost this
+        transport charges (~110 ms, PERF.md) is paid once per K steps
+        instead of once per step.  The lr-scheduler clock advances K
+        times on the HOST before launch — each scanned step consumes
+        its own true-``num_update`` lr row, so schedules that change
+        mid-group (and Adam's per-step bias correction) match K
+        sequential steps exactly.  Updater states / counters end up
+        exactly as K ``step_update`` calls would leave them.
+
+        Returns False (caller must run per-batch steps) when the fused
+        one-program step is not available for this optimizer."""
+        if not getattr(self, "_step_enabled", False) or \
+                not self.for_training:
+            return False
+        opt = updater.optimizer
+        fa = updater.fused_apply_or_none()
+        if fa is None:
+            return False
+        import jax
+        import numpy as np
+
+        # a still-deferred per-batch step must run before its params are
+        # superseded (same contract as forward())
+        self._materialize_backward()
+        inputs = self.stage_stacked(stacked_data)
+        K = next(iter(inputs.values())).shape[0]
+
+        triples = []
+        for index, n in enumerate(self.param_names):
+            if n in self._grad_dict:
+                triples.append((index * num_device, n))
+        ws = {}
+        for key, n in triples:
+            w = self._param_dict[n]
+            if key not in updater.states:
+                updater.states[key] = opt.create_state(key, w)
+            ws[n] = w._read()
+        # per-STEP lr rows: the scheduler (and Adam's t-dependent fused
+        # lr) is consulted at every one of the K update counts, exactly
+        # as K sequential step_update calls would
+        get_lr = getattr(opt, "_fused_lr", opt._get_lr)
+        lr_rows = []
+        for _ in range(K):
+            row = []
+            for key, _n in triples:
+                opt._update_count(key)
+                row.append(get_lr(key))
+            lr_rows.append(row)
+        lrs = np.asarray(lr_rows, np.float32)
+        wds = np.asarray([opt._get_wd(key) for key, _n in triples],
+                         np.float32)
+        states = [updater.read_state_tree(key, ws[n])
+                  for key, n in triples]
+
+        self._step_fa = fa
+        token = getattr(opt, "_mxtpu_step_token", None)
+        if token is None:
+            token = opt._mxtpu_step_token = next(_STEP_TOKENS)
+        kind = "train_step_grouped:%s:%d" % (type(opt).__name__, token)
+        if self._metric_stat is not None:
+            kind += ":m%d" % self._metric_token
+        fn = self._get_jit(kind)
+        params = {n: b._read() for n, b in self._param_dict.items()}
+        aux = {n: b._read() for n, b in self._aux_dict.items()}
+        rng = _random.next_key() if self._needs_rng else \
+            onp.zeros((2,), onp.uint32)
+        args = (params, aux, tuple(states), inputs, rng, lrs, wds)
+        if self._metric_stat is not None:
+            if self._metric_acc is None:
+                self._metric_acc = (
+                    jax.device_put(onp.zeros(self._metric_slots,
+                                             onp.float32), self._repl),
+                    jax.device_put(onp.zeros(self._metric_slots,
+                                             onp.int32), self._repl))
+            args = args + (self._metric_acc,)
+            (outs, new_aux, grads, new_params, new_states,
+             self._metric_acc) = fn(*args)
+            self._metric_step_done = True
+        else:
+            outs, new_aux, grads, new_params, new_states = fn(*args)
+        self._write_outs(outs)
+        self._write_aux(new_aux)
+        for n, g in grads.items():
+            self._grad_dict[n]._write(g)
+        for n, p in new_params.items():
+            self._param_dict[n]._write(p)
+        for (key, n), ns in zip(triples, new_states):
+            updater.write_state_tree(key, ns)
+        self._last_aux = None
         self._outputs_from = "bwd"
         return True
 

@@ -12,7 +12,7 @@ from .. import optimizer as opt
 from ..initializer import Uniform, InitDesc
 from ..model import _create_kvstore, _initialize_kvstore, _update_params, \
     _update_params_on_kvstore, load_checkpoint, save_checkpoint
-from .base_module import BaseModule
+from .base_module import BaseModule, _stack_batch_arrays
 from .executor_group import DataParallelExecutorGroup
 from .mesh_executor_group import MeshExecutorGroup
 
@@ -671,6 +671,61 @@ class Module(BaseModule):
                            kvstore=self._kvstore,
                            donate=fused and
                            self._exec_group._platform != "cpu")
+
+    def grouped_train_engaged(self):
+        """True when a grouped (``fit(batch_group=K)``) train program
+        has actually compiled and run on this module — the supported
+        engagement probe for benches and CI gates, so they need not
+        reach into the executor group's jit-cache key format."""
+        grp = self._exec_group
+        return any(isinstance(k, str) and
+                   k.startswith("train_step_grouped")
+                   for k in (getattr(grp, "_jits", None) or {}))
+
+    def _fit_grouped_ready(self, eval_metric):
+        """fit(batch_group=K) needs the whole group to run device-side:
+        the one-program train step (fused group + fusable optimizer,
+        local updates) and the metric riding the device tally — there
+        are no per-batch host outputs inside a scanned group to update
+        a host metric from."""
+        grp = self._exec_group
+        if not getattr(grp, "fused", False) or \
+                not getattr(grp, "_step_enabled", False):
+            return False
+        if self._updater is None or \
+                self._updater.fused_apply_or_none() is None:
+            return False
+        return grp._metric_live is eval_metric
+
+    def _grouped_step(self, batches):
+        """Assemble K iterator batches into one stacked block per input
+        and run them as ONE scanned train-step program (the
+        iterations-per-loop pattern; see ``MeshExecutorGroup
+        .step_update_grouped``).  Host batches stack into one contiguous
+        block (ONE ``device_put`` per input); device-resident batches
+        stack on device — neither path pays a readback."""
+        grp = self._exec_group
+        if not getattr(grp, "fused", False):
+            return False
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        stacked = {}
+        data_names = [d[0] for d in grp.data_shapes]
+        for i, name in enumerate(data_names):
+            stacked[name] = _stack_batch_arrays(
+                [b.data[i] for b in batches])
+        label_names = getattr(grp, "_label_names", [])
+        if label_names and batches[0].label:
+            for i, name in enumerate(label_names):
+                if i < len(batches[0].label) and \
+                        all(b.label[i] is not None for b in batches):
+                    stacked[name] = _stack_batch_arrays(
+                        [b.label[i] for b in batches])
+        if not grp.step_update_grouped(self._updater, stacked,
+                                       num_device=self._num_update_blocks):
+            return False
+        self._params_dirty = True
+        return True
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

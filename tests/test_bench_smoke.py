@@ -14,8 +14,17 @@ def test_bench_emits_contract_json():
     sys.path.insert(0, ROOT)
     from __graft_entry__ import virtual_cpu_env  # the one clean-env home
     env = virtual_cpu_env(1)
+    # BENCH_GROUPED=0 / BENCH_HANDWRITTEN=0: each of those stages
+    # builds and compiles ANOTHER full resnet-50 train program — pure
+    # compile time (100s+ each on this backend) inside the tier-1
+    # suite budget, where every second pushes later tests past the
+    # 870s cutoff.  The grouped path is pinned by
+    # tests/test_module_grouped.py, and both stages are
+    # try/except-guarded in bench main(), so drift there degrades to a
+    # recorded *_error field on the TPU run, not a crash.
     env.update(BENCH_BATCH="4", BENCH_STEPS="2", BENCH_PIPELINE="0",
-               BENCH_DTYPE="float32", BENCH_FIT_EPOCH_BATCHES="3")
+               BENCH_DTYPE="float32", BENCH_FIT_EPOCH_BATCHES="3",
+               BENCH_GROUPED="0", BENCH_HANDWRITTEN="0")
     proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
                           capture_output=True, text=True, timeout=1200,
                           env=env, cwd=ROOT)
