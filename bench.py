@@ -330,6 +330,17 @@ def main():
         except Exception as e:
             extra["grouped_error"] = str(e)[:160]
 
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        # online serving: bucketed Predictor + DynamicBatcher under
+        # concurrent mixed-size requests (docs/api/serving.md) — the
+        # production-shaped small-request load the training-side
+        # numbers cannot show. Off in the CPU contract smoke (every
+        # bucket is another full resnet-50 eval compile).
+        try:
+            extra.update(_bench_serve(mx, mod, batch, n_dev))
+        except Exception as e:
+            extra["serve_error"] = str(e)[:160]
+
     extra.update(pipe_extra)
     if pipe_recs is not None:
         try:
@@ -488,6 +499,84 @@ def _bench_grouped(mx, mod, batches, batch, step_img_per_sec, steps):
            "grouped_epoch_batches": ep_batches}
     out.update(fields)
     return out
+
+
+def _bench_serve(mx, mod, batch, n_dev):
+    """Online-serving load through mxnet_tpu.serving: a Predictor
+    (shape-bucketed program cache, params snapshotted from the trained
+    bench module) fronted by a DynamicBatcher, fired at by concurrent
+    client threads with mixed-size requests for a fixed wall window.
+
+    serve_qps counts completed requests/s; latency percentiles and the
+    batch-fill ratio come from the shared ServingStats snapshot, so the
+    artifact records how full the coalesced launches actually ran. The
+    post-warmup compile count is emitted too — it must be 0 (the
+    serving contract) and a nonzero value in an artifact is a red flag
+    on its own."""
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu.serving import DynamicBatcher, Predictor, QueueFull
+
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "5"))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    # serving requests are small; cap the ladder well below the train
+    # batch so warmup stays a handful of eval compiles
+    serve_max = int(os.environ.get("BENCH_SERVE_MAX_BATCH",
+                                   str(min(batch, 8 * n_dev))))
+    pred = Predictor(mod, max_batch_size=serve_max)
+    pred.warmup()
+    compiles0 = pred.stats()["compiles"]
+
+    shape = dict(mod.data_shapes)["data"]
+    rng = np.random.RandomState(3)
+    sizes = sorted({1, 2, 3, max(1, serve_max // 4),
+                    max(1, serve_max // 2)})
+    pool = [rng.rand(n, *shape[1:]).astype(np.float32) for n in sizes]
+    batcher = DynamicBatcher(pred, max_queue=4 * clients,
+                             max_wait_ms=2.0)
+    stop_at = time.time() + seconds
+    done_lock = threading.Lock()
+    done = [0]
+
+    def client(i):
+        k = i
+        while time.time() < stop_at:
+            x = pool[k % len(pool)]
+            k += 1
+            try:
+                batcher.predict(x, timeout=120)
+            except QueueFull:
+                time.sleep(0.002)
+                continue
+            with done_lock:
+                done[0] += 1
+
+    t0 = time.time()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    batcher.shutdown(drain=True)
+    elapsed = time.time() - t0
+    s = pred.stats()
+    lat = s["latency_ms"]
+    return {
+        "serve_qps": round(done[0] / elapsed, 2),
+        "serve_latency_ms_p50": (round(lat["p50"], 3)
+                                 if lat["p50"] is not None else None),
+        "serve_latency_ms_p99": (round(lat["p99"], 3)
+                                 if lat["p99"] is not None else None),
+        "serve_batch_fill": s["batch_fill"],
+        "serve_requests": s["completed"],
+        "serve_clients": clients,
+        "serve_buckets": pred.buckets,
+        "serve_rejected": s["rejected"],
+        "serve_post_warmup_compiles": s["compiles"] - compiles0,
+    }
 
 
 def _make_rec_files(mx, img, step_batch):

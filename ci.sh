@@ -85,6 +85,16 @@ print("batch-group gate: per-batch %.4f vs grouped %.4f" % (a, b))
 PY
 rm -rf "$BG_TMP"
 
+stage "serving smoke gate (Predictor parity + frozen compiles under traffic)"
+# online-serving contract (docs/api/serving.md): train 1 epoch, stand up
+# an in-process Predictor + DynamicBatcher, fire concurrent mixed-size
+# requests from client threads — served rows must be bitwise equal to
+# Module.predict and warmup() must leave ZERO further XLA compiles
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --serve-smoke || FAILED=1
+
 stage "multi-chip dryrun (8 virtual devices)"
 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
     || FAILED=1

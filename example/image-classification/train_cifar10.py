@@ -43,6 +43,61 @@ def synthetic_cifar(rng, n=4096):
     return X, y.astype(np.float32)
 
 
+def serve_smoke(mod, val, Xte, batch_size):
+    """The CI serving gate: an in-process Predictor + DynamicBatcher
+    over the just-trained module. Concurrent client threads fire
+    mixed-size requests; every client's rows must come back BITWISE
+    equal to ``Module.predict`` on the same inputs, and after
+    ``warmup()`` sustained traffic must trigger ZERO further XLA
+    compiles (the steady-state serving contract)."""
+    import threading
+
+    from mxnet_tpu.serving import DynamicBatcher, Predictor
+
+    ref = mod.predict(val).asnumpy()
+    pred = Predictor(mod, max_batch_size=min(batch_size, 32))
+    pred.warmup()
+    frozen = pred.stats()["compiles"]
+    srv = DynamicBatcher(pred, max_queue=256, max_wait_ms=2)
+    errs = []
+
+    def client(i):
+        rng = np.random.RandomState(100 + i)
+        for _ in range(8):
+            n = int(rng.randint(1, 9))
+            lo = int(rng.randint(0, len(ref) - n))
+            try:
+                out = srv.predict(Xte[lo:lo + n], timeout=300)
+            except Exception as e:  # noqa: BLE001 — gate must report
+                errs.append("client %d: %r" % (i, e))
+                return
+            if not np.array_equal(out, ref[lo:lo + n]):
+                errs.append("client %d: served rows != Module.predict"
+                            % i)
+                return
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    srv.shutdown(drain=True)
+    stats = pred.stats()
+    assert not errs, errs[:3]
+    assert stats["completed"] == 8 * 8, (
+        "gate verified only %d of %d requests" % (stats["completed"],
+                                                  8 * 8))
+    assert stats["compiles"] == frozen, (
+        "serving recompiled under traffic: %d compiles after warmup's %d"
+        % (stats["compiles"], frozen))
+    logging.info(
+        "serving smoke: %d requests ok, buckets %s, fill %.2f, "
+        "p50 %.1f ms, compiles frozen at %d",
+        stats["completed"], pred.buckets, stats["batch_fill"],
+        stats["latency_ms"]["p50"], frozen)
+
+
 def main():
     parser = argparse.ArgumentParser(description="train cifar10")
     parser.add_argument("--network", default="resnet-20",
@@ -85,6 +140,14 @@ def main():
                              "step — one staged transfer and one "
                              "scanned program per K batches; numerics "
                              "match per-batch training exactly")
+    parser.add_argument("--serve-smoke", action="store_true",
+                        help="after training, serve the model through "
+                             "an in-process mxnet_tpu.serving stack "
+                             "(Predictor + DynamicBatcher) under "
+                             "concurrent client threads and assert "
+                             "bitwise parity with Module.predict plus "
+                             "zero post-warmup XLA compiles (the CI "
+                             "serving gate)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     if args.seed is not None:
@@ -157,6 +220,8 @@ def main():
             % args.batch_group)
     score = mod.score(val, "acc")
     print("final validation:", score)
+    if args.serve_smoke:
+        serve_smoke(mod, val, Xte, args.batch_size)
     if args.acc_out:
         with open(args.acc_out, "w") as f:
             f.write("%.6f\n" % dict(score)["accuracy"])

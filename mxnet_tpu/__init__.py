@@ -67,6 +67,7 @@ from . import model
 from . import checkpoint
 from . import module
 from . import module as mod
+from . import serving
 from . import callback
 from . import monitor
 from . import monitor as mon

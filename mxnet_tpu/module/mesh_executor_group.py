@@ -1164,6 +1164,7 @@ class MeshExecutorGroup(object):
         params = {n: b._read() for n, b in self._param_dict.items()}
         aux = {n: b._read() for n, b in self._aux_dict.items()}
         seen = 0
+        host_tally = None
         for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
@@ -1173,14 +1174,64 @@ class MeshExecutorGroup(object):
                 # wrong answer
                 raise MXNetError(
                     "score() needs labels; batch %d has none" % nbatch)
+            rows = batch.data[0].shape[0]
+            if 0 < rows < self.batch_size:
+                # epoch tail: pad to the bound shape and run the PLAIN
+                # eval program (shared with the predict path) instead of
+                # tracing a remainder-shape tally program; the real
+                # rows' statistic folds on host (the donated device
+                # accumulate cannot mask padded rows)
+                host_tally = self._tail_stat_host(batch, rows, stat,
+                                                  host_tally)
+                seen = nbatch + 1
+                continue
             inputs = self._stage(batch)
             rng = _random.next_key() if self._needs_rng else \
                 onp.zeros((2,), onp.uint32)
             acc = fn(params, aux, inputs, rng, acc)
             seen = nbatch + 1
         eval_metric.reset()
-        eval_metric._fold_tally(self._pack_tally_pair(*acc))
+        packed = self._pack_tally_pair(*acc)
+        if host_tally is not None:
+            packed[:, 0] += host_tally[0]
+            packed[:, 1] += host_tally[1]
+        eval_metric._fold_tally(packed)
         return eval_metric.get_name_value(), seen
+
+    def _tail_stat_host(self, batch, rows, stat, host_tally):
+        """Score one smaller-than-bound tail batch without a new
+        compile: zero-pad inputs to the bound batch shape, run the
+        cached ``fwd_eval`` program, slice the real rows, and fold the
+        metric statistic into a host-side (sums, counts) pair that the
+        caller adds to the device tally at drain time."""
+        import jax.numpy as jnp
+        from ..io import DataBatch
+        from .base_module import pad_batch_rows
+        data = [nd.NDArray(pad_batch_rows(d, self.batch_size))
+                for d in batch.data]
+        label = [None if lb is None else
+                 nd.NDArray(pad_batch_rows(lb, self.batch_size))
+                 for lb in batch.label]
+        inputs = self._stage(DataBatch(data=data, label=label))
+        fn = self._get_jit("fwd_eval")
+        params = {n: b._read() for n, b in self._param_dict.items()}
+        aux = {n: b._read() for n, b in self._aux_dict.items()}
+        rng = _random.next_key() if self._needs_rng else \
+            onp.zeros((2,), onp.uint32)
+        outs, _ = fn(params, aux, inputs, rng)
+        sliced = tuple(o[:rows] if o.ndim >= 1 and
+                       o.shape[0] == self.batch_size else o for o in outs)
+        labels = [inputs[n][:rows] for n in self._label_names]
+        slots = getattr(stat, "n_slots", 1)
+        sums, counts = _tally_add(
+            jnp, stat, labels, sliced,
+            (jnp.zeros((slots,), jnp.float32),
+             jnp.zeros((slots,), jnp.int32)))
+        pair = (onp.asarray(sums, onp.float64),
+                onp.asarray(counts, onp.float64))
+        if host_tally is None:
+            return pair
+        return (host_tally[0] + pair[0], host_tally[1] + pair[1])
 
     def _pack_tally_pair(self, sums, counts):
         """Read a (sums f32, counts i32) device tally as numpy (n, 2).

@@ -144,6 +144,7 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._eval_pad_extra = 0
 
     @staticmethod
     def load(prefix, epoch=None, load_optimizer_states=False, **kwargs):
@@ -476,6 +477,7 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._eval_pad_extra = 0
 
     def reshape(self, data_shapes, label_shapes=None):
         """Re-bind with new batch shapes, keeping parameters (module.py)."""
@@ -641,7 +643,44 @@ class Module(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        self._eval_pad_extra = 0
+        train = self.for_training if is_train is None else bool(is_train)
+        if not train and getattr(self._exec_group, "fused", False):
+            data_batch = self._pad_eval_tail(data_batch)
         self._exec_group.forward(data_batch, is_train)
+
+    def _pad_eval_tail(self, batch):
+        """An eval batch with fewer rows than the bound batch size runs
+        padded to the bound shape through the SAME compiled program,
+        instead of tracing+compiling a second XLA program for the
+        remainder shape (the epoch-tail recompile; same pad-and-slice
+        trick as the serving bucketer — shared ``pad_batch_rows``
+        helper).  Rows are independent in an ``is_train=False``
+        forward, so the real rows are bit-identical either way; the
+        extra rows are sliced off in ``_unpadded_outputs`` /
+        ``update_metric`` via ``_eval_pad_extra``.  Raw-loop callers
+        that read outputs should slice ``[:n]`` themselves (the
+        existing contract for padded batches)."""
+        from .base_module import pad_batch_rows
+        from ..io import DataBatch
+        target = self._exec_group.batch_size
+        rows = batch.data[0].shape[0] if batch.data else 0
+        if rows == 0 or rows >= target:
+            return batch
+        # only the batch dim may shrink: any other mismatch is a true
+        # reshape and keeps the existing behavior
+        for (_name, shape), arr in zip(self._data_shapes, batch.data):
+            if tuple(arr.shape[1:]) != tuple(shape[1:]):
+                return batch
+        data = [nd.NDArray(pad_batch_rows(d, target)) for d in batch.data]
+        label = None
+        if batch.label:
+            label = [None if lb is None else
+                     nd.NDArray(pad_batch_rows(lb, target))
+                     for lb in batch.label]
+        self._eval_pad_extra = target - rows
+        return DataBatch(data=data, label=label, pad=batch.pad,
+                         index=batch.index)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
@@ -709,6 +748,10 @@ class Module(BaseModule):
             return False
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        # grouped steps bypass forward(); a stale eval-tail pad marker
+        # would make update_metric slice-and-host-update instead of
+        # consuming the device tally's step-done flag
+        self._eval_pad_extra = 0
         stacked = {}
         data_names = [d[0] for d in grp.data_shapes]
         for i, name in enumerate(data_names):
@@ -737,6 +780,19 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        extra = getattr(self, "_eval_pad_extra", 0)
+        if extra:
+            # tail-padded eval forward (_pad_eval_tail): the metric must
+            # see only the real rows — the padded rows are zeros, not
+            # data.  ``labels`` from the score loop are the ORIGINAL
+            # (unpadded) arrays; slice only when a caller passed padded
+            # ones.
+            keep = self._exec_group.batch_size - extra
+            outs = [o[0:keep] for o in self.get_outputs()]
+            labels = [lb if lb is None or lb.shape[0] <= keep
+                      else lb[0:keep] for lb in (labels or [])]
+            eval_metric.update(labels, outs)
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def score(self, eval_data, eval_metric, num_batch=None,

@@ -189,6 +189,17 @@ class SequentialModule(BaseModule):
                     % (type(m).__name__, len(batch.data), len(names)))
                 batch.provide_data = [(n, d.shape) for n, d
                                       in zip(names, batch.data)]
+        # an eval epoch-tail batch is padded by the HEAD module
+        # (Module._pad_eval_tail); downstream modules then see a
+        # full-shape batch and compute extra=0 — propagate the head's
+        # marker so the wrapper predict loop and the metric-bearing
+        # module both slice the padded rows off
+        extra = getattr(self._modules[0], "_eval_pad_extra", 0)
+        self._eval_pad_extra = extra
+        if extra:
+            for m in self._modules[1:]:
+                if hasattr(m, "_eval_pad_extra"):
+                    m._eval_pad_extra = extra
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized

@@ -1,0 +1,41 @@
+"""mxnet_tpu.serving — online inference: dynamic batching, a
+shape-bucketed compiled-program cache, and backpressure.
+
+The serving half of the production stack (training half: the fused
+mesh Module + durable checkpoints). Three pieces:
+
+* :class:`Predictor` — binds a trained/loaded Module for inference
+  behind a compiled-program cache keyed by padded batch-size buckets;
+  ``warmup()`` pre-compiles every bucket so steady-state traffic never
+  triggers an XLA compile, and served rows are bitwise identical to
+  ``Module.predict``.
+* :class:`DynamicBatcher` — bounded request queue + background worker
+  that coalesces concurrent requests into one bucket-padded launch
+  within a ``max_wait_ms`` window; queue-full rejection, per-request
+  timeouts, graceful shutdown.
+* :class:`ServingStats` — one snapshot (``stats()``) of latency
+  p50/p95/p99, batch-fill ratio, queue depth, and compile counters.
+
+Quick start::
+
+    from mxnet_tpu.serving import Predictor, DynamicBatcher
+
+    pred = Predictor(trained_module, max_batch_size=64)   # or
+    # pred = Predictor.load("ckpt_dir", data_shapes=[("data", (1, 3, 28, 28))])
+    pred.warmup()                      # compile every bucket pre-traffic
+    with DynamicBatcher(pred, max_queue=256, max_wait_ms=2) as srv:
+        fut = srv.submit(x)            # from any number of threads
+        probs = fut.result()
+    print(pred.stats())
+
+See docs/api/serving.md for semantics and field reference.
+"""
+from __future__ import annotations
+
+from .batcher import DynamicBatcher
+from .errors import QueueFull, RequestTimeout, ServerClosed
+from .predictor import Predictor
+from .stats import ServingStats
+
+__all__ = ["Predictor", "DynamicBatcher", "ServingStats",
+           "QueueFull", "RequestTimeout", "ServerClosed"]

@@ -1,0 +1,238 @@
+"""DynamicBatcher — coalesce concurrent requests into full device
+launches, with backpressure.
+
+A device serving one request at a time runs at batch-1 utilization; a
+device serving whenever "enough" requests arrive runs near its training
+throughput. The batcher sits between the two: client threads ``submit``
+requests into a **bounded** queue and get a future back; a background
+worker coalesces whatever is queued — up to the Predictor's top bucket
+— within a ``max_wait_ms`` window measured from the first queued
+request, launches ONE bucket-padded device call through the Predictor,
+and routes each slice of the output back to its caller's future.
+
+Overload degrades instead of OOMing:
+
+* queue full -> ``submit`` raises :class:`QueueFull` synchronously
+  (backpressure; the request is never enqueued);
+* a request older than ``timeout_ms`` is dropped at launch time and its
+  future carries :class:`RequestTimeout`;
+* ``shutdown(drain=True)`` stops intake, serves out the queue, and
+  joins the worker; ``drain=False`` fails pending futures with
+  :class:`ServerClosed`.
+
+The batcher shares its Predictor's :class:`ServingStats`, so
+``stats()`` shows queue depth, batch-fill ratio, and per-request
+latency percentiles for the whole stack.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+from .errors import QueueFull, RequestTimeout, ServerClosed
+
+__all__ = ["DynamicBatcher"]
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "future", "deadline", "t_submit")
+
+    def __init__(self, arrays, rows, future, deadline, t_submit):
+        self.arrays = arrays
+        self.rows = rows
+        self.future = future
+        self.deadline = deadline
+        self.t_submit = t_submit
+
+
+class DynamicBatcher:
+    """Bounded request queue + coalescing worker over a Predictor.
+
+    Parameters
+    ----------
+    predictor : Predictor
+        The bucketed inference engine requests are served through.
+    max_queue : int
+        Queue capacity in requests; beyond it ``submit`` rejects
+        (:class:`QueueFull`).
+    max_wait_ms : float
+        Coalescing window measured from the FIRST queued request: the
+        worker launches as soon as the top bucket is full or the window
+        closes, whichever comes first. 0 serves whatever is queued
+        immediately (lowest latency, lowest fill).
+    timeout_ms : float, optional
+        Per-request deadline; requests still queued past it fail with
+        :class:`RequestTimeout` instead of occupying a launch.
+    start : bool
+        Start the worker thread immediately (default). ``start=False``
+        lets tests (and staged deployments) fill the queue first.
+    """
+
+    def __init__(self, predictor, max_queue=256, max_wait_ms=2.0,
+                 timeout_ms=None, start=True):
+        self._pred = predictor
+        self._stats = predictor._stats
+        self._max_queue = int(max_queue)
+        self._max_wait = max(0.0, float(max_wait_ms)) / 1000.0
+        self._timeout = (float(timeout_ms) / 1000.0
+                         if timeout_ms is not None else None)
+        self._max_rows = predictor.max_batch_size
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = None
+        self._stats.set_queue_probe(lambda: len(self._queue))
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self):
+        """Start (or restart after ``start=False``) the worker thread."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("batcher is shut down")
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._worker, name="mxnet-tpu-serving-batcher",
+                daemon=True)
+            self._thread.start()
+
+    def submit(self, data, timeout_ms=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the request's outputs (single array for
+        single-output nets, else a list). Raises :class:`ServerClosed`
+        after shutdown and :class:`QueueFull` when the bounded queue is
+        at capacity — the backpressure signal. Malformed requests raise
+        ``ValueError`` here, on the caller's thread."""
+        arrays, rows = self._pred._normalize(data)
+        t = time.perf_counter()
+        limit = self._timeout if timeout_ms is None else \
+            float(timeout_ms) / 1000.0
+        req = _Request(arrays, rows, Future(),
+                       t + limit if limit is not None else None, t)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("batcher is shut down")
+            if len(self._queue) >= self._max_queue:
+                self._stats.note_reject()
+                raise QueueFull(
+                    "serving queue at capacity (%d requests) — shed "
+                    "load or retry with backoff" % self._max_queue)
+            self._queue.append(req)
+            self._stats.note_request()
+            self._cond.notify_all()
+        return req.future
+
+    def predict(self, data, timeout=None, timeout_ms=None):
+        """Blocking convenience: ``submit`` + ``Future.result``.
+        ``timeout`` (seconds) bounds the caller-side wait; ``timeout_ms``
+        overrides the batcher's per-request deadline."""
+        return self.submit(data, timeout_ms=timeout_ms).result(timeout)
+
+    def stats(self):
+        return self._pred.stats()
+
+    # ------------------------------------------------------------------
+    def shutdown(self, drain=True, timeout=None):
+        """Stop intake and end the worker. ``drain=True`` serves every
+        already-queued request first (graceful); ``drain=False`` fails
+        them with :class:`ServerClosed`. Idempotent."""
+        with self._cond:
+            already = self._closed
+            self._closed = True
+            if not drain or self._thread is None:
+                # nobody will serve these — fail them out loud
+                while self._queue:
+                    req = self._queue.popleft()
+                    self._stats.note_error()
+                    req.future.set_exception(
+                        ServerClosed("batcher shut down before launch"))
+            self._cond.notify_all()
+            thread, self._thread = self._thread, None
+        if thread is not None and not already:
+            thread.join(timeout)
+
+    def close(self):
+        self.shutdown(drain=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+
+    # ------------------------------------------------------------------
+    def _worker(self):
+        while True:
+            reqs = self._gather()
+            if reqs is None:
+                return
+            if reqs:
+                self._launch(reqs)
+
+    def _gather(self):
+        """Block for the first request, then coalesce more until the
+        top bucket is full, the ``max_wait_ms`` window (from the first
+        request) closes, or the next request would overflow the bucket.
+        Returns the live (non-expired, non-cancelled) requests, or None
+        when shut down with an empty queue."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                # untimed: submit() and shutdown() both notify, so an
+                # idle server parks instead of polling
+                self._cond.wait()
+            reqs = [self._queue.popleft()]
+            rows = reqs[0].rows
+            window_end = reqs[0].t_submit + self._max_wait
+            while rows < self._max_rows:
+                if self._queue:
+                    if rows + self._queue[0].rows > self._max_rows:
+                        break
+                    nxt = self._queue.popleft()
+                    reqs.append(nxt)
+                    rows += nxt.rows
+                    continue
+                remaining = window_end - time.perf_counter()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
+        now = time.perf_counter()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                self._stats.note_timeout()
+                r.future.set_exception(RequestTimeout(
+                    "request expired after %.1f ms in queue"
+                    % ((now - r.t_submit) * 1000.0)))
+            elif r.future.set_running_or_notify_cancel():
+                live.append(r)
+        return live
+
+    def _launch(self, reqs):
+        import numpy as onp
+        total = sum(r.rows for r in reqs)
+        try:
+            if len(reqs) == 1:
+                arrays = reqs[0].arrays
+            else:
+                names = list(reqs[0].arrays)
+                arrays = {k: onp.concatenate([r.arrays[k] for r in reqs])
+                          for k in names}
+            outs = self._pred._predict_rows(arrays, total)
+        except BaseException as e:  # noqa: B036 — futures must resolve
+            for r in reqs:
+                self._stats.note_error()
+                r.future.set_exception(e)
+            return
+        off = 0
+        now = time.perf_counter()
+        for r in reqs:
+            res = [o[off:off + r.rows] for o in outs]
+            off += r.rows
+            r.future.set_result(res[0] if len(res) == 1 else res)
+            self._stats.note_completed((now - r.t_submit) * 1000.0)

@@ -1,0 +1,34 @@
+"""Serving-path error types.
+
+Overload must degrade, not OOM: each failure mode a caller can react
+to gets its own exception class so client code (and the demo servers)
+can distinguish "back off and retry" (:class:`QueueFull`) from "this
+request died" (:class:`RequestTimeout`) from "stop sending"
+(:class:`ServerClosed`).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["QueueFull", "RequestTimeout", "ServerClosed"]
+
+
+class QueueFull(MXNetError):
+    """Backpressure: the batcher's bounded request queue is at capacity.
+
+    Raised synchronously by :meth:`DynamicBatcher.submit` — the request
+    was never enqueued. Callers should shed load or retry with backoff;
+    an unbounded queue here would turn overload into latency collapse
+    and eventually host OOM."""
+
+
+class RequestTimeout(MXNetError, TimeoutError):
+    """The request's deadline passed before it reached the device.
+
+    Set as the future's exception by the batcher worker when a queued
+    request expires (``timeout_ms``). Also a ``TimeoutError`` so generic
+    timeout handling catches it."""
+
+
+class ServerClosed(MXNetError):
+    """The batcher has been shut down and accepts no new requests."""

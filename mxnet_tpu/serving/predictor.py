@@ -1,0 +1,349 @@
+"""Predictor — online inference over a trained Module with a
+shape-bucketed compiled-program cache.
+
+The reference's inference story is a blocking ``Module.predict`` loop
+over a whole ``DataIter`` — fine for offline eval, useless for online
+traffic: every new request shape would trace+compile a fresh XLA
+program (seconds to minutes), and per-request launches at batch 1 waste
+the device. The Predictor solves the compile half of that problem (the
+``DynamicBatcher`` solves the utilization half):
+
+* it binds one inference Module per **batch-size bucket** (powers of
+  two up to ``max_batch_size`` by default), all sharing ONE set of
+  device-resident parameter buffers through the existing
+  ``shared_module`` path — on the fused mesh path that is the same
+  ``MeshExecutorGroup`` staging machinery training uses, so a sharded
+  (GSPMD/NamedSharding) module serves from the same mesh layout it
+  trained on;
+* a request of ``n`` rows is zero-padded up to the smallest bucket
+  ``>= n`` and the outputs sliced back to ``n`` — steady-state traffic
+  therefore only ever runs the pre-compiled bucket programs, never a
+  new shape (``warmup()`` pre-compiles every bucket before traffic,
+  and the compile counter in ``stats()`` pins "zero recompiles after
+  warmup"). Padding is row-exact: an ``is_train=False`` forward is
+  row-independent, so the served rows are bitwise identical to
+  ``Module.predict`` on the same inputs (pinned by tests);
+* requests larger than the top bucket are chunked across launches.
+
+Parameters are snapshotted from the source module at construction
+(``device_put`` of the same host values), so serving never races
+training updates; rebuild the Predictor (or construct it from a
+``CheckpointManager``) to pick up new weights.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..io import DataBatch
+from ..module import Module
+from ..module.base_module import pad_batch_rows  # shared pad rule
+from .stats import ServingStats
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Bind a trained/loaded :class:`Module` for online inference.
+
+    Parameters
+    ----------
+    module : Module
+        Source of symbol + parameters. May be a live (bound) training
+        module or an unbound ``Module.load`` result; its parameters are
+        snapshotted — later training steps do not leak into serving.
+    data_shapes : list of (name, shape), optional
+        Input descriptors; the batch dimension is replaced per bucket.
+        Defaults to the source module's bound ``data_shapes``.
+    buckets : list of int, optional
+        Explicit batch-size buckets. Each must be a positive multiple
+        of the data-parallel factor (mesh ``dp`` axis, or the context
+        count). Default: powers of two from ``dp`` up to
+        ``max_batch_size``.
+    max_batch_size : int
+        Top bucket for the default power-of-two ladder (ignored when
+        ``buckets`` is given). Larger requests are chunked.
+    context : list of Context, optional
+        Serving devices; defaults to the source module's contexts.
+    """
+
+    def __init__(self, module, data_shapes=None, buckets=None,
+                 max_batch_size=32, context=None, logger=None,
+                 latency_window=2048):
+        if not isinstance(module, Module):
+            raise MXNetError(
+                "Predictor needs a plain Module (got %s); for wrapper "
+                "modules serve the underlying Module"
+                % type(module).__name__)
+        self.logger = logger or logging.getLogger("mxnet_tpu.serving")
+        self._stats = ServingStats(latency_window=latency_window)
+        import threading
+        self._lock = threading.RLock()
+
+        # -- source introspection --------------------------------------
+        symbol = module.symbol
+        if module.binded and module.params_initialized:
+            arg_params, aux_params = module.get_params()
+        elif module.params_initialized and \
+                getattr(module, "_arg_params", None) is not None:
+            arg_params = module._arg_params
+            aux_params = module._aux_params or {}
+        else:
+            raise MXNetError(
+                "Predictor needs initialized parameters: bind+init the "
+                "module, or load it from params files / a "
+                "CheckpointManager first")
+        if data_shapes is None:
+            if not module.binded:
+                raise MXNetError(
+                    "data_shapes is required when the source module is "
+                    "not bound (e.g. a Module.load result)")
+            data_shapes = module.data_shapes
+        self._data_descs = [(name, tuple(shape))
+                            for name, shape in data_shapes]
+        contexts = list(context) if context is not None else \
+            list(module._context)
+
+        # -- bucket ladder ---------------------------------------------
+        mesh_axes = module._mesh_axes
+        dp = (mesh_axes or {}).get("dp", len(contexts))
+        if buckets is None:
+            # the ladder starts at 2 (not 1): XLA lowers a batch-1
+            # matmul as a gemv with a different accumulation order, so
+            # a 1-row bucket would break the bitwise-parity contract
+            # with Module.predict; padding one zero row is free
+            b, buckets = max(2, int(dp)), []
+            while b <= max_batch_size:
+                buckets.append(b)
+                b *= 2
+            if not buckets:
+                raise MXNetError(
+                    "max_batch_size=%d is smaller than the data-parallel "
+                    "factor %d — no bucket fits" % (max_batch_size, dp))
+        else:
+            buckets = sorted({int(b) for b in buckets})
+            if not buckets:
+                raise MXNetError("buckets must not be empty")
+            bad = [b for b in buckets if b <= 0 or b % dp]
+            if bad:
+                raise MXNetError(
+                    "buckets %r must be positive multiples of the "
+                    "data-parallel factor %d (mesh dp axis / context "
+                    "count) so every bucket shards evenly" % (bad, dp))
+            if buckets[0] == 1:
+                raise MXNetError(
+                    "a 1-row bucket breaks the bitwise-parity contract "
+                    "(XLA's batch-1 gemv lowering accumulates in a "
+                    "different order); use a minimum bucket of 2 — "
+                    "padding the one extra row is free")
+        self._buckets = buckets
+
+        # -- one inference module per bucket, ONE set of param buffers -
+        def _shapes_at(b):
+            return [(name, (b,) + shape[1:])
+                    for name, shape in self._data_descs]
+
+        def _make(extra):
+            return Module(symbol, data_names=module._data_names,
+                          label_names=module._label_names,
+                          logger=self.logger, context=contexts,
+                          compute_dtype=module._compute_dtype,
+                          mesh_axes=mesh_axes,
+                          param_sharding=module._param_sharding,
+                          _allow_fused=module._allow_fused, **extra)
+
+        base = _make({})
+        base.bind(data_shapes=_shapes_at(buckets[-1]), for_training=False)
+        base.set_params(arg_params, aux_params)
+        self._modules = {buckets[-1]: base}
+        for b in buckets[:-1]:
+            m = _make({})
+            m.bind(data_shapes=_shapes_at(b), for_training=False,
+                   shared_module=base)
+            self._modules[b] = m
+        self._base = base
+        for m in self._modules.values():
+            self._instrument(m)
+        self._warmed = False
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def load(source, epoch=None, data_shapes=None, data_names=("data",),
+             label_names=("softmax_label",), context=None, **kwargs):
+        """Predictor straight from a checkpoint: ``source`` is a legacy
+        prefix (``epoch`` required), a ``CheckpointManager``, or a
+        checkpoint directory (``epoch`` then selects a committed step,
+        default the latest). Routes through :meth:`Module.load`, so the
+        symbol rides in from the manifest on the manager path."""
+        mod = Module.load(source, epoch, data_names=list(data_names),
+                          label_names=list(label_names), context=context)
+        return Predictor(mod, data_shapes=data_shapes, context=context,
+                         **kwargs)
+
+    # ------------------------------------------------------------------
+    @property
+    def buckets(self):
+        return list(self._buckets)
+
+    @property
+    def max_batch_size(self):
+        return self._buckets[-1]
+
+    @property
+    def output_names(self):
+        return list(self._base.output_names)
+
+    @property
+    def data_names(self):
+        return [name for name, _ in self._data_descs]
+
+    def stats(self):
+        """Snapshot of the serving counters: request outcomes, latency
+        percentiles, batch-fill ratio, queue depth, compile count (see
+        docs/api/serving.md for field semantics)."""
+        return self._stats.snapshot()
+
+    def _instrument(self, mod):
+        """Count XLA traces through this module's eval functions — each
+        jit trace runs the traced Python body exactly once, so wrapping
+        the evaluator closure is an honest compile counter (and catches
+        any accidental new input signature, not just new buckets)."""
+        grp = mod._exec_group
+        if not getattr(grp, "fused", False):
+            # classic per-executor path jits at executor construction;
+            # traces are not observable from here
+            self._stats.compile_tracking = False
+            return
+        stats = self._stats
+        for attr in ("_eval_fn", "_pipe_eval_fn"):
+            inner = getattr(grp, attr, None)
+            if inner is None:
+                continue
+
+            def counted(*a, __inner=inner, **kw):
+                stats.note_compile()
+                return __inner(*a, **kw)
+
+            setattr(grp, attr, counted)
+
+    # ------------------------------------------------------------------
+    def _normalize(self, data):
+        """Accept a numpy/jax/NDArray array (single-input nets), a
+        list/tuple in ``data_names`` order, or a name->array dict;
+        return (name->f32 raw array dict, n_rows). Feature dims are
+        validated against the bound shapes so a malformed request fails
+        at submit time, not on the batcher thread."""
+        names = self.data_names
+        if isinstance(data, dict):
+            arrays = dict(data)
+        elif isinstance(data, (list, tuple)):
+            arrays = dict(zip(names, data))
+        else:
+            if len(names) != 1:
+                raise ValueError(
+                    "this net has %d inputs %r; pass a dict or a list"
+                    % (len(names), names))
+            arrays = {names[0]: data}
+        missing = [n for n in names if n not in arrays]
+        if missing:
+            raise ValueError("request is missing input(s) %r" % missing)
+        out, rows = {}, None
+        for name, shape in self._data_descs:
+            v = arrays[name]
+            if hasattr(v, "_read"):
+                v = v._read()
+            if isinstance(v, onp.ndarray) or onp.isscalar(v) or \
+                    isinstance(v, (list, tuple)):
+                v = onp.ascontiguousarray(v, dtype=onp.float32)
+            elif v.dtype != onp.float32:
+                v = v.astype(onp.float32)
+            if tuple(v.shape[1:]) != tuple(shape[1:]):
+                raise ValueError(
+                    "input %r has row shape %r, bound shape wants %r"
+                    % (name, tuple(v.shape[1:]), tuple(shape[1:])))
+            if rows is None:
+                rows = v.shape[0]
+            elif v.shape[0] != rows:
+                raise ValueError(
+                    "inputs disagree on row count: %d vs %d"
+                    % (v.shape[0], rows))
+            out[name] = v
+        if not rows:
+            raise ValueError("request has zero rows")
+        return out, rows
+
+    def bucket_for(self, n):
+        """Smallest bucket that fits ``n`` rows (the top bucket for
+        oversized requests — those are chunked)."""
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    # ------------------------------------------------------------------
+    def warmup(self):
+        """Run every bucket once (zero inputs) so all programs compile
+        BEFORE traffic; afterwards steady-state serving performs zero
+        XLA compiles (``stats()['compiles']`` stays frozen — pinned by
+        tests/test_serving.py). Returns the stats snapshot."""
+        with self._lock:
+            for b in self._buckets:
+                zeros = {name: onp.zeros((b,) + shape[1:], onp.float32)
+                         for name, shape in self._data_descs}
+                self._run_bucket(b, zeros, b, warmup=True)
+            self._warmed = True
+        return self.stats()
+
+    def predict(self, data):
+        """Serve one request synchronously (no batching): pad to the
+        bucket, launch, slice. Returns a single numpy array for
+        single-output nets, else a list in ``output_names`` order.
+        Thread-safe; for concurrent callers prefer a
+        :class:`DynamicBatcher`, which coalesces them into fewer,
+        fuller launches."""
+        arrays, rows = self._normalize(data)
+        t0 = time.perf_counter()
+        self._stats.note_request()
+        outs = self._predict_rows(arrays, rows)
+        self._stats.note_completed((time.perf_counter() - t0) * 1000.0)
+        return outs[0] if len(outs) == 1 else outs
+
+    def _predict_rows(self, arrays, rows):
+        """Serve ``rows`` normalized rows; always returns the list of
+        per-output numpy arrays. The batcher calls this directly (it
+        does its own request accounting)."""
+        parts = []
+        with self._lock:
+            start = 0
+            while start < rows:
+                take = min(rows - start, self._buckets[-1])
+                chunk = {k: v[start:start + take]
+                         for k, v in arrays.items()} if (start or
+                                                         take < rows) \
+                    else arrays
+                parts.append(self._run_bucket(self.bucket_for(take),
+                                              chunk, take))
+                start += take
+        if len(parts) == 1:
+            return parts[0]
+        return [onp.concatenate([p[i] for p in parts])
+                for i in range(len(parts[0]))]
+
+    def _run_bucket(self, bucket, arrays, rows, warmup=False):
+        """One device launch at ``bucket``: zero-pad the request rows
+        up to the bucket's bound shape (the same ``pad_batch_rows``
+        rule the predict/score epoch-tail fix uses) and slice the
+        outputs back to the real rows."""
+        mod = self._modules[bucket]
+        batch = DataBatch(
+            data=[nd.NDArray(pad_batch_rows(arrays[name], bucket))
+                  for name, _ in self._data_descs],
+            label=None, pad=bucket - rows)
+        mod.forward(batch, is_train=False)
+        outs = [o.asnumpy()[:rows] for o in mod.get_outputs()]
+        self._stats.note_batch(bucket, rows, warmup=warmup)
+        return outs

@@ -1,0 +1,128 @@
+"""Serving observability: counters, gauges, and latency percentiles.
+
+One :class:`ServingStats` instance is shared by a ``Predictor`` and any
+``DynamicBatcher`` built on it, so ``stats()`` is a single coherent
+snapshot of the serving stack: request outcomes, device-launch batch
+fill, queue depth, and the compile counter that pins the "zero
+recompiles after warmup" contract.
+
+Everything is updated under one lock from multiple threads (client
+threads submit, the batcher worker completes); the latency reservoir is
+a bounded ring of the most recent samples, so percentiles track current
+behavior instead of averaging over the process lifetime.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["ServingStats"]
+
+
+class ServingStats:
+    """Thread-safe serving counters with a bounded latency reservoir."""
+
+    def __init__(self, latency_window=2048):
+        self._lock = threading.Lock()
+        self._window = int(latency_window)
+        self._lat = [0.0] * self._window
+        self._lat_n = 0            # total samples ever (ring write head)
+        self.requests = 0          # submitted (batcher or direct predict)
+        self.completed = 0
+        self.rejected = 0          # queue-full backpressure rejections
+        self.timeouts = 0          # expired before launch
+        self.errors = 0
+        self.batches = 0           # device launches (excl. warmup)
+        self.warmup_batches = 0
+        self.real_rows = 0         # request rows actually served
+        self.padded_rows = 0       # bucket rows launched (incl. padding)
+        self.compiles = 0          # XLA traces through serving programs
+        self.compile_tracking = True
+        self.bucket_hits = {}      # bucket size -> launch count
+        self._queue_probe = None   # () -> current queue depth
+
+    # -- recorders (called by Predictor / DynamicBatcher) ---------------
+    def note_compile(self):
+        with self._lock:
+            self.compiles += 1
+
+    def note_request(self, n=1):
+        with self._lock:
+            self.requests += n
+
+    def note_reject(self):
+        with self._lock:
+            self.rejected += 1
+
+    def note_timeout(self):
+        with self._lock:
+            self.timeouts += 1
+
+    def note_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def note_batch(self, bucket, rows, warmup=False):
+        with self._lock:
+            if warmup:
+                self.warmup_batches += 1
+                return
+            self.batches += 1
+            self.real_rows += rows
+            self.padded_rows += bucket
+            self.bucket_hits[bucket] = self.bucket_hits.get(bucket, 0) + 1
+
+    def note_completed(self, latency_ms):
+        with self._lock:
+            self.completed += 1
+            self._lat[self._lat_n % self._window] = float(latency_ms)
+            self._lat_n += 1
+
+    def set_queue_probe(self, fn):
+        """Install a ``() -> int`` gauge for the current queue depth
+        (the batcher points this at its deque)."""
+        self._queue_probe = fn
+
+    # -- snapshot -------------------------------------------------------
+    @staticmethod
+    def _pct(sorted_vals, p):
+        if not sorted_vals:
+            return None
+        idx = min(len(sorted_vals) - 1,
+                  max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+        return sorted_vals[idx]
+
+    def snapshot(self):
+        """One coherent dict of every counter/gauge/percentile — the
+        ``stats()`` surface documented in docs/api/serving.md."""
+        with self._lock:
+            n = min(self._lat_n, self._window)
+            lats = sorted(self._lat[:n])
+            fill = (self.real_rows / float(self.padded_rows)
+                    if self.padded_rows else None)
+            out = {
+                "requests": self.requests,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "batches": self.batches,
+                "warmup_batches": self.warmup_batches,
+                "batch_fill": round(fill, 4) if fill is not None else None,
+                "compiles": self.compiles,
+                "compile_tracking": self.compile_tracking,
+                "bucket_hits": dict(self.bucket_hits),
+                "latency_ms": {
+                    "count": self.completed,
+                    "mean": round(sum(lats) / n, 3) if n else None,
+                    "p50": self._pct(lats, 50),
+                    "p95": self._pct(lats, 95),
+                    "p99": self._pct(lats, 99),
+                    "max": lats[-1] if lats else None,
+                },
+            }
+        probe = self._queue_probe
+        try:
+            out["queue_depth"] = int(probe()) if probe is not None else 0
+        except Exception:
+            out["queue_depth"] = 0
+        return out

@@ -67,6 +67,9 @@ CASES = [
      ["--network", "resnet-18", "--image-shape", "3,64,64",
       "--batch-size", "16", "--synthetic-images", "64",
       "--num-epochs", "2"]),
+    ("image-classification/serve_cifar10.py",
+     ["--num-epochs", "1", "--clients", "4", "--requests", "8",
+      "--max-batch-size", "16"]),
 ]
 
 

@@ -1,0 +1,357 @@
+"""Online serving (mxnet_tpu.serving): the hard contracts.
+
+* Served outputs are BITWISE identical to ``Module.predict`` on the
+  same inputs — including request sizes that match no bucket exactly
+  (padded up and sliced back) and oversized requests (chunked).
+* After ``warmup()`` the compile counter equals the bucket count and
+  stays FROZEN under sustained mixed-size traffic — steady-state
+  serving performs zero XLA compiles.
+* Concurrent clients get THEIR OWN rows back (the batcher's routing),
+  overload rejects instead of queueing unboundedly, expired requests
+  time out, shutdown drains gracefully.
+* The shared pad-and-slice rule also fixes the ``Module.predict`` /
+  ``score`` epoch-tail recompile: a final partial batch runs padded
+  through the already-compiled program.
+
+The conftest provisions 8 virtual CPU devices; most tests serve from a
+single-device module (dp=1), one from a 2-device mesh.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.serving import (DynamicBatcher, Predictor, QueueFull,
+                               RequestTimeout, ServerClosed)
+
+DIM = 6
+
+
+def _net():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.BatchNorm(net, name="bn", fix_gamma=False)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, DIM).astype(np.float32),
+            rng.randint(0, 10, n).astype(np.float32))
+
+
+def _train_module(ctxs, batch=8, epochs=2):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_net(), context=ctxs)
+    X, y = _data()
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=batch), num_epoch=epochs,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One trained single-device module + its reference predictions."""
+    mod = _train_module([mx.cpu()])
+    X, _ = _data()
+    ref = mod.predict(mx.io.NDArrayIter(X, None, batch_size=8)).asnumpy()
+    return mod, X, ref
+
+
+@pytest.fixture(scope="module")
+def predictor(trained):
+    mod, _X, _ref = trained
+    pred = Predictor(mod, max_batch_size=16)
+    pred.warmup()
+    return pred
+
+
+def _count_eval_traces(mod):
+    """Instrument a module's fused group to count XLA traces (each jit
+    trace runs the evaluator closure exactly once)."""
+    grp = mod._exec_group
+    box = [0]
+    inner = grp._eval_fn
+
+    def counting(*a, **k):
+        box[0] += 1
+        return inner(*a, **k)
+
+    grp._eval_fn = counting
+    return box
+
+
+class _RaggedIter(mx.io.DataIter):
+    """Yields explicit row counts (no iterator-side padding) — the
+    epoch-tail shape the pad-and-slice fix targets."""
+
+    def __init__(self, X, y, sizes):
+        super().__init__(batch_size=sizes[0])
+        self.X, self.y, self.sizes = X, y, sizes
+        self.provide_data = [("data", (sizes[0], X.shape[1]))]
+        self.provide_label = [("softmax_label", (sizes[0],))]
+        self.reset()
+
+    def reset(self):
+        self._i = 0
+        self._off = 0
+
+    def next(self):
+        if self._i >= len(self.sizes):
+            raise StopIteration
+        n = self.sizes[self._i]
+        o = self._off
+        self._i += 1
+        self._off += n
+        label = [mx.nd.array(self.y[o:o + n])] if self.y is not None \
+            else []
+        return mx.io.DataBatch(data=[mx.nd.array(self.X[o:o + n])],
+                               label=label, pad=0)
+
+
+# ---------------------------------------------------------------------
+# parity + bucketing
+# ---------------------------------------------------------------------
+def test_served_outputs_bitwise_parity(trained, predictor):
+    _mod, X, ref = trained
+    # exact-bucket, odd (padded), and oversized (chunked) request sizes
+    for n in (1, 2, 3, 5, 8, 11, 16, 17, 37, 64):
+        out = predictor.predict(X[:n])
+        assert out.shape == (n, 10)
+        assert np.array_equal(out, ref[:n]), "size %d not bitwise" % n
+
+
+def test_bucket_selection(predictor):
+    assert predictor.buckets == [2, 4, 8, 16]
+    assert predictor.max_batch_size == 16
+    for n, want in [(1, 2), (2, 2), (3, 4), (4, 4), (5, 8), (8, 8),
+                    (9, 16), (16, 16), (17, 16), (100, 16)]:
+        assert predictor.bucket_for(n) == want, n
+
+
+def test_custom_buckets_and_validation(trained):
+    mod, X, ref = trained
+    pred = Predictor(mod, buckets=[4, 6, 12])
+    assert pred.buckets == [4, 6, 12]
+    out = pred.predict(X[:5])  # pads to 6
+    assert np.array_equal(out, ref[:5])
+    with pytest.raises(mx.MXNetError):
+        Predictor(mod, buckets=[0, 4])
+    with pytest.raises(mx.MXNetError):
+        Predictor(mod, max_batch_size=0)
+    with pytest.raises(mx.MXNetError):
+        Predictor(mod, buckets=[])
+    with pytest.raises(mx.MXNetError):
+        # bucket 1 = XLA's gemv lowering = not bitwise vs Module.predict
+        Predictor(mod, buckets=[1, 8])
+
+
+def test_multi_device_mesh_parity():
+    """A predictor over a 2-device mesh: buckets are multiples of dp
+    and serving shards each launch like training did."""
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    mod = _train_module(ctxs)
+    X, _ = _data()
+    ref = mod.predict(mx.io.NDArrayIter(X, None, batch_size=8)).asnumpy()
+    pred = Predictor(mod, max_batch_size=8)
+    assert pred.buckets == [2, 4, 8]
+    pred.warmup()
+    for n in (1, 3, 6, 8, 13):
+        assert np.array_equal(pred.predict(X[:n]), ref[:n]), n
+    with pytest.raises(mx.MXNetError):
+        Predictor(mod, buckets=[3, 4])  # 3 does not shard over dp=2
+
+
+# ---------------------------------------------------------------------
+# compile freeze
+# ---------------------------------------------------------------------
+def test_warmup_compiles_every_bucket_then_frozen(trained):
+    mod, X, _ref = trained
+    pred = Predictor(mod, max_batch_size=16)
+    assert pred.stats()["compiles"] == 0
+    pred.warmup()
+    s = pred.stats()
+    assert s["compile_tracking"]
+    assert s["compiles"] == len(pred.buckets)
+    # sustained mixed-size traffic (direct + batched): ZERO new compiles
+    srv = DynamicBatcher(pred, max_queue=64, max_wait_ms=1)
+    for i in range(40):
+        n = 1 + (i * 5) % 16
+        if i % 2:
+            pred.predict(X[:n])
+        else:
+            srv.predict(X[:n], timeout=30)
+    srv.shutdown()
+    assert pred.stats()["compiles"] == len(pred.buckets)
+
+
+# ---------------------------------------------------------------------
+# dynamic batcher
+# ---------------------------------------------------------------------
+def test_concurrent_clients_get_their_own_rows(trained, predictor):
+    _mod, X, ref = trained
+    srv = DynamicBatcher(predictor, max_queue=128, max_wait_ms=5)
+    errs = []
+
+    def client(i):
+        n = 1 + (i % 7)
+        lo = (i * 3) % 40
+        try:
+            out = srv.predict(X[lo:lo + n], timeout=60)
+            if not np.array_equal(out, ref[lo:lo + n]):
+                errs.append("client %d got wrong rows" % i)
+        except Exception as e:  # noqa: BLE001 — collected for assert
+            errs.append("client %d: %r" % (i, e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    srv.shutdown()
+    assert not errs, errs
+    s = predictor.stats()
+    # coalescing actually happened: fewer launches than requests
+    assert s["batches"] < s["requests"]
+    assert 0 < s["batch_fill"] <= 1.0
+
+
+def test_queue_full_rejection(predictor):
+    X, _ = _data()
+    srv = DynamicBatcher(predictor, max_queue=3, start=False)
+    before = predictor.stats()["rejected"]
+    futs = [srv.submit(X[:2]) for _ in range(3)]
+    with pytest.raises(QueueFull):
+        srv.submit(X[:2])
+    assert predictor.stats()["rejected"] == before + 1
+    srv.start()  # drain: the queued three still complete correctly
+    for f in futs:
+        assert f.result(timeout=30).shape == (2, 10)
+    srv.shutdown()
+
+
+def test_request_timeout(predictor):
+    X, _ = _data()
+    srv = DynamicBatcher(predictor, max_queue=8, timeout_ms=20,
+                         start=False)
+    before = predictor.stats()["timeouts"]
+    fut = srv.submit(X[:2])
+    import time
+    time.sleep(0.1)  # expire while the worker is stopped
+    srv.start()
+    with pytest.raises(RequestTimeout):
+        fut.result(timeout=30)
+    assert predictor.stats()["timeouts"] == before + 1
+    srv.shutdown()
+
+
+def test_shutdown_semantics(predictor):
+    X, _ = _data()
+    # graceful: pending requests drain, then submits are refused
+    srv = DynamicBatcher(predictor, max_queue=8, start=False)
+    fut = srv.submit(X[:3])
+    srv.start()
+    srv.shutdown(drain=True)
+    assert fut.result(timeout=30).shape == (3, 10)
+    with pytest.raises(ServerClosed):
+        srv.submit(X[:3])
+    # non-draining: pending futures fail instead of hanging forever
+    srv2 = DynamicBatcher(predictor, max_queue=8, start=False)
+    fut2 = srv2.submit(X[:3])
+    srv2.shutdown(drain=False)
+    with pytest.raises(ServerClosed):
+        fut2.result(timeout=5)
+
+
+def test_malformed_request_fails_at_submit(predictor):
+    srv = DynamicBatcher(predictor, max_queue=8)
+    with pytest.raises(ValueError):
+        srv.submit(np.zeros((2, DIM + 1), np.float32))
+    with pytest.raises(ValueError):
+        srv.submit(np.zeros((0, DIM), np.float32))
+    srv.shutdown()
+
+
+def test_latency_stats_fields(predictor):
+    X, _ = _data()
+    predictor.predict(X[:4])
+    s = predictor.stats()
+    lat = s["latency_ms"]
+    assert lat["count"] >= 1 and lat["p50"] is not None
+    assert lat["p50"] <= lat["p99"] <= lat["max"]
+    assert s["queue_depth"] == 0
+    assert set(s["bucket_hits"]) <= set(predictor.buckets)
+
+
+# ---------------------------------------------------------------------
+# restore-for-serving
+# ---------------------------------------------------------------------
+def test_checkpoint_manager_restore_serving(tmp_path, trained):
+    mod, X, ref = trained
+    manager = mx.checkpoint.CheckpointManager(str(tmp_path / "ckpt"))
+    mod.save_checkpoint(None, 3, manager=manager, async_save=False)
+    pred = Predictor.load(str(tmp_path / "ckpt"),
+                          data_shapes=[("data", (8, DIM))],
+                          max_batch_size=8)
+    pred.warmup()
+    for n in (2, 5, 8):
+        assert np.array_equal(pred.predict(X[:n]), ref[:n]), n
+
+
+def test_legacy_prefix_restore_serving(tmp_path, trained):
+    mod, X, ref = trained
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    pred = Predictor.load(prefix, 1, data_shapes=[("data", (8, DIM))],
+                          max_batch_size=8)
+    assert np.array_equal(pred.predict(X[:7]), ref[:7])
+
+
+# ---------------------------------------------------------------------
+# epoch-tail pad-and-slice (shared helper) on Module.predict / score
+# ---------------------------------------------------------------------
+def test_predict_tail_padded_not_recompiled(trained):
+    mod, X, ref = trained
+    traces = _count_eval_traces(mod)
+    out = mod.predict(_RaggedIter(X[:21], None, [8, 8, 5])).asnumpy()
+    # the 5-row tail padded to the bound shape: same program, 0 traces
+    # beyond the (already compiled) full-batch eval program
+    assert traces[0] == 0
+    assert np.array_equal(out, ref[:21])
+
+
+def test_score_tail_device_and_host_paths_agree(trained, monkeypatch):
+    mod, X, _ref = trained
+    _, y = _data()
+    dev = mod.score(_RaggedIter(X[:21], y[:21], [8, 8, 5]), "acc")
+    monkeypatch.setenv("MXNET_DEVICE_METRIC", "0")
+    host = mod.score(_RaggedIter(X[:21], y[:21], [8, 8, 5]), "acc")
+    monkeypatch.undo()
+    full = mod.score(_RaggedIter(X[:24], y[:24], [8, 8, 8]), "acc")
+    assert dev == host
+    # the tail run scores exactly its 21 rows, not a padded 24
+    preds = mod.predict(mx.io.NDArrayIter(X, None, batch_size=8)) \
+        .asnumpy().argmax(axis=1)
+    want21 = float((preds[:21] == y[:21]).mean())
+    want24 = float((preds[:24] == y[:24]).mean())
+    assert dev[0][1] == pytest.approx(want21, abs=1e-12)
+    assert full[0][1] == pytest.approx(want24, abs=1e-12)
+
+
+def test_score_tail_no_remainder_trace(trained):
+    mod, X, _ref = trained
+    _, y = _data()
+    # prime both eval programs (fwd_eval via predict, fwd_eval_stat via
+    # a full-shape score), THEN count: the ragged run must add nothing.
+    # The tally program is cached per metric INSTANCE, so the same
+    # metric object must score both runs.
+    metric = mx.metric.Accuracy()
+    mod.score(_RaggedIter(X[:16], y[:16], [8, 8]), metric)
+    traces = _count_eval_traces(mod)
+    mod.score(_RaggedIter(X[:21], y[:21], [8, 8, 5]), metric)
+    assert traces[0] == 0
