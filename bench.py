@@ -330,6 +330,19 @@ def main():
         except Exception as e:
             extra["grouped_error"] = str(e)[:160]
 
+    if fused and os.environ.get("BENCH_PREFETCH", "1") != "0":
+        # async device-feed pipeline: the SAME host-fed fit loop with
+        # and without the DeviceLoader ring (mxnet_tpu.data) — the
+        # delta is exactly what overlapping host assembly + transfer
+        # with the step buys on this transport. Off in the CPU
+        # contract smoke (a fresh metric tally token means one more
+        # full resnet-50 train-step compile).
+        try:
+            extra.update(_bench_prefetch(mx, mod, batch, steps,
+                                         img_per_sec))
+        except Exception as e:
+            extra["prefetch_error"] = str(e)[:160]
+
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # online serving: bucketed Predictor + DynamicBatcher under
         # concurrent mixed-size requests (docs/api/serving.md) — the
@@ -498,6 +511,82 @@ def _bench_grouped(mx, mod, batches, batch, step_img_per_sec, steps):
     out = {"grouped_batch_group": group_k,
            "grouped_epoch_batches": ep_batches}
     out.update(fields)
+    return out
+
+
+def _bench_prefetch(mx, mod, batch, steps, step_img_per_sec):
+    """Device-feed pipeline throughput (mxnet_tpu.data.DeviceLoader):
+    two host-FED fit windows — plain (every batch's device_put on the
+    step's critical path) vs prefetched (a background stager keeps a
+    depth-2 ring of batches already resident, transfers overlapped
+    with compute).  Same two-fit-windows slope discipline as
+    _bench_fit.  ``prefetch_vs_plain`` is the overlap win;
+    ``host_wait_ms_per_step`` (from PipelineStats) says how much of
+    the input path the ring could NOT hide — on a balanced pipeline
+    it approaches 0 while the plain loop pays the full transfer."""
+    import numpy as np
+
+    from mxnet_tpu.data import DeviceLoader
+    from mxnet_tpu.io import DataBatch
+
+    shape = dict(mod.data_shapes)["data"]
+    rng = np.random.RandomState(7)
+    host_batches = []
+    for _ in range(2):
+        X = rng.rand(*shape).astype(np.float32)
+        yv = rng.randint(0, 1000, shape[0]).astype(np.float32)
+        host_batches.append(DataBatch(data=[mx.nd.array(X)],
+                                      label=[mx.nd.array(yv)]))
+    ep_batches = int(os.environ.get("BENCH_FIT_EPOCH_BATCHES",
+                                    str(max(4, steps * 12))))
+    depth = int(os.environ.get("BENCH_PREFETCH_DEPTH", "2"))
+    # ONE metric for both windows: each new metric object is a new
+    # device-tally token, i.e. another full train-step compile
+    metric = mx.metric.Accuracy()
+
+    def make_iter():
+        return _DeviceBatchIter(host_batches, mod.data_shapes,
+                                mod.label_shapes, ep_batches)
+
+    def run_plain(n_epochs):
+        t0 = time.time()
+        mod.fit(make_iter(), eval_metric=metric, num_epoch=n_epochs)
+        return time.time() - t0
+
+    out = {"prefetch_depth": depth,
+           "prefetch_epoch_batches": ep_batches}
+    run_plain(1)  # warm the host-fed path (+ this metric's program)
+    plain_fields, plain_ok = _fit_window_slope(
+        run_plain, ep_batches, batch, step_img_per_sec,
+        "prefetch_plain", plaus=1.2)
+
+    # loader created only AFTER the plain windows: its stager starts
+    # transferring immediately, which would contend with (and inflate)
+    # the plain measurement on fixed-cost transports
+    loader = DeviceLoader(make_iter(), module=mod, depth=depth)
+
+    def run_pre(n_epochs):
+        t0 = time.time()
+        mod.fit(loader, eval_metric=metric, num_epoch=n_epochs)
+        return time.time() - t0
+
+    try:
+        run_pre(1)  # warm the ring (stager start, first transfers)
+        pre_fields, pre_ok = _fit_window_slope(
+            run_pre, ep_batches, batch, step_img_per_sec, "prefetch",
+            plaus=1.2)
+    finally:
+        snap = loader.pipeline_stats.snapshot()
+        loader.close()
+    out.update(plain_fields)
+    out.update(pre_fields)
+    out["host_wait_ms_per_step"] = snap["host_wait_ms_per_step"]
+    out["prefetch_ring_high_water"] = snap["ring_high_water"]
+    if pre_ok and plain_ok and \
+            plain_fields.get("prefetch_plain_img_per_sec"):
+        out["prefetch_vs_plain"] = round(
+            pre_fields["prefetch_img_per_sec"]
+            / plain_fields["prefetch_plain_img_per_sec"], 3)
     return out
 
 

@@ -85,6 +85,30 @@ print("batch-group gate: per-batch %.4f vs grouped %.4f" % (a, b))
 PY
 rm -rf "$BG_TMP"
 
+stage "device-feed gate (prefetch_to_device == plain, bit-identical params)"
+# async device-feed contract (docs/api/data.md): training through the
+# DeviceLoader ring — background mesh-aware staging, host/transfer/step
+# overlapped — must land on BIT-IDENTICAL final params to the plain
+# path (compared by sha256 digest, stronger than an accuracy check)
+PF_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --params-digest-out "$PF_TMP/digest_plain.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --prefetch-device 2 \
+    --params-digest-out "$PF_TMP/digest_prefetch.txt" || FAILED=1
+python - "$PF_TMP/digest_plain.txt" "$PF_TMP/digest_prefetch.txt" <<'PY' || FAILED=1
+import sys
+a, b = (open(p).read().strip() for p in sys.argv[1:3])
+assert a and a == b, \
+    "prefetch-device params digest %s != plain %s" % (b, a)
+print("device-feed gate: bit-identical params (sha256 %s...)" % a[:16])
+PY
+rm -rf "$PF_TMP"
+
 stage "serving smoke gate (Predictor parity + frozen compiles under traffic)"
 # online-serving contract (docs/api/serving.md): train 1 epoch, stand up
 # an in-process Predictor + DynamicBatcher, fire concurrent mixed-size

@@ -43,6 +43,24 @@ def synthetic_cifar(rng, n=4096):
     return X, y.astype(np.float32)
 
 
+def params_digest(mod):
+    """sha256 over every final param/aux array (sorted by name): the
+    CI bit-identity gates compare these digests, a stronger pin than
+    comparing accuracies."""
+    import hashlib
+    h = hashlib.sha256()
+    arg_params, aux_params = mod.get_params()
+    for name in sorted(arg_params):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(arg_params[name].asnumpy())
+                 .tobytes())
+    for name in sorted(aux_params or {}):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(aux_params[name].asnumpy())
+                 .tobytes())
+    return h.hexdigest()
+
+
 def serve_smoke(mod, val, Xte, batch_size):
     """The CI serving gate: an in-process Predictor + DynamicBatcher
     over the just-trained module. Concurrent client threads fire
@@ -140,6 +158,18 @@ def main():
                              "step — one staged transfer and one "
                              "scanned program per K batches; numerics "
                              "match per-batch training exactly")
+    parser.add_argument("--prefetch-device", type=int, default=None,
+                        help="train through the async device-feed "
+                             "pipeline (mxnet_tpu.data.DeviceLoader): "
+                             "keep a ring of N batches already "
+                             "resident on device so host assembly, "
+                             "transfer, and the step overlap; trained "
+                             "params are bit-identical to the plain "
+                             "path (the CI device-feed gate)")
+    parser.add_argument("--params-digest-out", default=None,
+                        help="write a sha256 over the final params + "
+                             "aux arrays to this file (CI bit-"
+                             "identity gates)")
     parser.add_argument("--serve-smoke", action="store_true",
                         help="after training, serve the model through "
                              "an in-process mxnet_tpu.serving stack "
@@ -204,7 +234,8 @@ def main():
                                                        20),
             epoch_end_callback=callbacks or None,
             resume_from=manager if args.resume else None,
-            batch_group=args.batch_group)
+            batch_group=args.batch_group,
+            prefetch_to_device=args.prefetch_device)
     if manager is not None:
         manager.wait_until_finished()
     trained = mod._optimizer is not None and mod._optimizer.num_update > 0
@@ -218,6 +249,13 @@ def main():
             "--batch-group %d requested but the grouped train program "
             "never engaged (fit fell back to per-batch training)"
             % args.batch_group)
+    if args.params_digest_out:
+        # digest BEFORE scoring: scoring must not (and does not)
+        # change params, but the gate pins the trained state itself
+        digest = params_digest(mod)
+        with open(args.params_digest_out, "w") as f:
+            f.write(digest + "\n")
+        logging.info("params digest: %s", digest)
     score = mod.score(val, "acc")
     print("final validation:", score)
     if args.serve_smoke:

@@ -53,6 +53,7 @@ from . import symbol as sym
 from . import symbol as symbol_doc
 from . import executor
 from . import io
+from . import data
 from . import image
 from . import recordio
 from . import metric

@@ -96,7 +96,17 @@ class Speedometer(object):
     batches actually seen since the last log (identical behavior at
     stride 1) and the rate is computed from that true count. The metric
     read below is the window's ONE device-tally drain — it happens at a
-    group boundary, never mid-group."""
+    group boundary, never mid-group.
+
+    When ``fit`` trains from the async device-feed pipeline
+    (``prefetch_to_device=`` / a :class:`mxnet_tpu.data.DeviceLoader`),
+    each log line also carries the window's **host-wait fraction** —
+    the share of the window's wall time the loop spent blocked on the
+    input path (from ``PipelineStats.host_wait_ms``; the loader is
+    found through the fit loop's ``train_data``).  ~0% means decode +
+    transfer are fully hidden behind the device step; a large value
+    means the epoch is input-bound — visible in the training log, not
+    just in bench.py."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
@@ -104,6 +114,16 @@ class Speedometer(object):
         self._tic = None
         self._last_count = 0
         self._seen = 0
+        self._wait_seen = None
+
+    @staticmethod
+    def _pipeline_stats(param):
+        """The live PipelineStats, when the fit loop trains from a
+        device-feed loader (``train_data`` in the callback's locals)."""
+        loc = getattr(param, "locals", None)
+        if not isinstance(loc, dict):
+            return None
+        return getattr(loc.get("train_data"), "pipeline_stats", None)
 
     def __call__(self, param):
         count = param.nbatch
@@ -117,15 +137,26 @@ class Speedometer(object):
         delta = count - self._last_count
         self._last_count = count
 
+        stats = self._pipeline_stats(param)
         if self._tic is None:
             self._tic = time.time()
             self._seen = 0
+            self._wait_seen = stats.snapshot()["host_wait_ms"] \
+                if stats is not None else None
             return
         self._seen += delta
         if self._seen < self.frequent:
             return
 
-        speed = self._seen * self.batch_size / (time.time() - self._tic)
+        elapsed = time.time() - self._tic
+        speed = self._seen * self.batch_size / elapsed
+        wait_txt = ""
+        if stats is not None and self._wait_seen is not None:
+            # the window's slice of the cumulative host-wait clock,
+            # as a fraction of the window's wall time
+            wait_ms = stats.snapshot()["host_wait_ms"] - self._wait_seen
+            wait_txt = "\thost-wait=%.1f%%" % (
+                100.0 * wait_ms / max(elapsed * 1000.0, 1e-9))
         metric = param.eval_metric
         if metric is not None:
             # reading the metric materializes outputs -> device-synced rate
@@ -134,13 +165,15 @@ class Speedometer(object):
             for name, value in pairs:
                 logging.info(
                     "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    "\tTrain-%s=%f",
-                    param.epoch, count, speed, name, value)
+                    "\tTrain-%s=%f%s",
+                    param.epoch, count, speed, name, value, wait_txt)
         else:
-            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                         param.epoch, count, speed)
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, count, speed, wait_txt)
         self._tic = time.time()
         self._seen = 0
+        self._wait_seen = stats.snapshot()["host_wait_ms"] \
+            if stats is not None else None
 
 
 class ProgressBar(object):

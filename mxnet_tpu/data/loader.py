@@ -1,0 +1,381 @@
+"""DeviceLoader — a bounded ring of batches already resident on device.
+
+The reference overlaps host decode with compute through
+``PrefetcherIter``'s host-side double buffer (iter_prefetcher.h:129) —
+but on an accelerator the host->device TRANSFER is a third pipeline
+stage the reference never had to hide (BENCH_r05: the fed rate collapsed
+to a few percent of synthetic because every ``device_put`` sat on the
+step's critical path).  The DeviceLoader is the tf.data/infeed design
+for this stack: a background stager thread pulls host batches from any
+``DataIter`` and dispatches ``jax.device_put`` for batch i+1/i+2 while
+the device still computes batch i, keeping a bounded ring (depth 2-3)
+of batches ALREADY on device.  Host decode, transfer, and compute then
+fully overlap; the consumer's ``next()`` only ever waits when the input
+path truly cannot keep up — and that wait is measured
+(``PipelineStats.host_wait_ms``), not guessed.
+
+Placement is mesh-aware: bound to a fused-mesh ``Module``, each input
+is placed with the group's ``NamedSharding`` (``device_put`` splits the
+host array into per-device shards directly — no host-side concat, no
+intermediate single-device copy), so ``Module.fit``'s own ``_stage``
+becomes a no-op on already-resident arrays and the trained parameters
+stay BITWISE equal to an unprefetched run.  With ``batch_group=K`` the
+stager assembles K iterator batches into one contiguous ``(K, B, ...)``
+host block and stages it through the group's shared ``stage_stacked``
+helper — one transfer per K steps, the grouped train program consumes
+the block without re-staging.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..io import DataBatch, DataIter
+from .stats import PipelineStats
+
+__all__ = ["DeviceLoader"]
+
+_END = object()
+
+
+def _host_value(arr):
+    return arr._read() if hasattr(arr, "_read") else arr
+
+
+class DeviceLoader(DataIter):
+    """Wrap ``data_iter`` so every delivered batch is device-resident.
+
+    Parameters
+    ----------
+    data_iter : DataIter
+        Host-side source (NDArrayIter, ImageRecordIter, a
+        :class:`TransformIter`, ...).  Pulled from the stager thread
+        only.
+    module : Module, optional
+        A BOUND module: its executor group supplies the target
+        shardings (batch inputs on the ``dp`` axis; ``(K, B, ...)``
+        blocks through ``stage_stacked``).  Without a module, batches
+        are placed whole on the default device — fine for a single
+        device, wrong for a mesh.
+    depth : int
+        Ring bound: maximum batches resident on device at once
+        (2-3 is the sweet spot — enough to hide one transfer behind
+        one step without tying up HBM).
+    batch_group : int, optional
+        Stage blocks of K batches through ``stage_stacked`` for
+        ``fit(batch_group=K)`` — one transfer and one scanned program
+        per K steps.  The epoch tail forms a final smaller block.
+    stats : PipelineStats, optional
+        Shared counter block; a fresh one is created by default and
+        exposed as ``.pipeline_stats`` (``Speedometer`` and the fit
+        epoch log read it from there).
+    close_source : bool
+        Also close ``data_iter`` (when it has a ``close``) from this
+        loader's ``close()``.  Default False: the loader does not own
+        an iterator the caller built — ``fit(prefetch_to_device=)``
+        closes only the loader it created, never the caller's
+        iterator.
+    """
+
+    def __init__(self, data_iter, module=None, depth=2, batch_group=None,
+                 stats=None, close_source=False):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        depth = int(depth)
+        if depth < 1:
+            raise MXNetError("depth must be >= 1 (got %d)" % depth)
+        group = int(batch_group) if batch_group else 0
+        if group == 1:
+            group = 0
+        self._iter = data_iter
+        self._depth = depth
+        self._group = group
+        self._close_source = bool(close_source)
+        self.pipeline_stats = stats or PipelineStats(ring_depth=depth)
+        self.pipeline_stats.ring_depth = depth
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self._data_names = [d[0] for d in self.provide_data]
+        self._label_names = [d[0] for d in (self.provide_label or [])]
+
+        self._group_handle = None
+        if module is not None:
+            grp = getattr(module, "_exec_group", None)
+            if grp is None or not getattr(grp, "fused", False):
+                # classic per-executor groups slice the batch per
+                # context host-side; background-staging whole batches
+                # would be wasted work there
+                module = None
+            else:
+                self._group_handle = grp
+        self._module = module
+
+        self._cond = threading.Condition()
+        self._ring = []          # staged entries, delivery order
+        self._closed = False
+        self._stager = None
+        self._start_epoch(reset_source=False)
+
+    # -- staging -------------------------------------------------------
+    def _stage_batch(self, batch):
+        """Place one host batch on device, preserving the exact bytes
+        ``MeshExecutorGroup._stage`` would transfer."""
+        import jax
+        grp = self._group_handle
+        sharding = grp._batch_sharding if grp is not None else None
+
+        def put(arr):
+            v = _host_value(arr)
+            if sharding is not None:
+                return jax.device_put(v, sharding)
+            return jax.device_put(v)
+
+        data = [nd.NDArray(put(d)) for d in batch.data]
+        label = None
+        if batch.label:
+            label = [None if lb is None else nd.NDArray(put(lb))
+                     for lb in batch.label]
+        return DataBatch(data=data, label=label, pad=batch.pad,
+                         index=batch.index,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def _stage_block(self, batches):
+        """K host batches -> ONE contiguous (K, B, ...) block per input,
+        staged through the group's ``stage_stacked`` (one ``device_put``
+        per input).  Delivered as per-batch views onto the block, each
+        carrying the staged dict so ``Module._grouped_step`` can hand
+        the block straight to the scanned program."""
+        from ..module.base_module import stack_group_inputs
+        stacked = stack_group_inputs(
+            batches, self._data_names, self._label_names,
+            stack=lambda arrs: onp.stack([onp.asarray(_host_value(a))
+                                          for a in arrs]))
+        staged = self._group_handle.stage_stacked(stacked)
+        out = []
+        for j, b in enumerate(batches):
+            data = [nd.NDArray(staged[n][j]) for n in self._data_names]
+            label = None
+            if b.label:
+                label = [nd.NDArray(staged[n][j]) if n in staged
+                         else b.label[i]
+                         for i, n in enumerate(self._label_names)
+                         if i < len(b.label)]
+            view = DataBatch(data=data, label=label, pad=b.pad,
+                             index=b.index)
+            view._staged_block = staged
+            view._staged_index = j
+            view._staged_size = len(batches)
+            out.append(view)
+        return out
+
+    def _stage_entry(self):
+        """Pull + stage the next ring entry (a list of delivered
+        batches).  Returns _END at epoch end, an exception to re-raise
+        in order, or the staged batches."""
+        if self._group:
+            pulled = []
+            for _ in range(self._group):
+                try:
+                    pulled.append(self._iter.next())
+                except StopIteration:
+                    break
+            if not pulled:
+                return _END
+            t0 = time.perf_counter()
+            if self._group_handle is not None and len(pulled) > 0 and \
+                    self._uniform_shapes(pulled):
+                staged = self._stage_block(pulled)
+            else:
+                staged = [self._stage_batch(b) for b in pulled]
+            rows = sum(b.data[0].shape[0] for b in staged)
+            self.pipeline_stats.note_staged(rows, time.perf_counter() - t0)
+            return staged
+        try:
+            batch = self._iter.next()
+        except StopIteration:
+            return _END
+        t0 = time.perf_counter()
+        staged = self._stage_batch(batch)
+        self.pipeline_stats.note_staged(staged.data[0].shape[0],
+                                        time.perf_counter() - t0)
+        return [staged]
+
+    @staticmethod
+    def _uniform_shapes(batches):
+        """A block must stack; ragged shapes (bucketed iterators) fall
+        back to per-batch staging — fit's grouped loop flushes on the
+        shape change anyway."""
+        def sig(b):
+            s = [tuple(d.shape) for d in b.data]
+            for lb in (b.label or []):
+                s.append(tuple(lb.shape) if lb is not None else None)
+            return s
+
+        first = sig(batches[0])
+        return all(sig(b) == first for b in batches[1:])
+
+    # -- stager thread -------------------------------------------------
+    def _run_stager(self, epoch):
+        while True:
+            with self._cond:
+                while not self._stop and len(self._ring) >= self._depth:
+                    if not self._noted_full:
+                        self._noted_full = True
+                        self.pipeline_stats.note_ring_full()
+                    self._cond.wait(0.05)
+                if self._stop:
+                    return
+                self._noted_full = False
+            try:
+                entry = self._stage_entry()
+            except Exception as exc:  # noqa: BLE001 — re-raised in order
+                entry = exc
+            with self._cond:
+                if self._stop or epoch != self._live_epoch:
+                    return
+                self._ring.append(entry)
+                self.pipeline_stats.note_ring(len(self._ring))
+                self._cond.notify_all()
+                if entry is _END or isinstance(entry, BaseException):
+                    return
+
+    def _start_epoch(self, reset_source):
+        self._stop_stager()
+        if reset_source:
+            self._iter.reset()
+        with self._cond:
+            self._ring = []
+            self._pending = []   # staged batches popped but undelivered
+            self._stop = False
+            self._exhausted = False
+            self._noted_full = False
+            self._live_epoch = getattr(self, "_live_epoch", -1) + 1
+        if not reset_source:
+            # construction: start pre-filling right away.  After a
+            # reset() the stager restarts LAZILY on the first next():
+            # an eager restart would pull batches from the source that
+            # a close() (e.g. fit's, after the final epoch's reset)
+            # silently drops — the caller's iterator must come out of
+            # a prefetched fit in the same state a plain fit leaves it
+            self._launch_stager()
+
+    def _launch_stager(self):
+        if self._stager is not None:
+            return
+        with self._cond:
+            epoch = self._live_epoch
+        self._stager = threading.Thread(
+            target=self._run_stager, args=(epoch,),
+            name="mxtpu-device-stager", daemon=True)
+        self._stager.start()
+
+    def _stop_stager(self):
+        stager = self._stager
+        if stager is None:
+            return
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        stager.join()
+        self._stager = None
+        with self._cond:
+            self._ring = []
+            self._pending = []
+
+    # -- DataIter surface ----------------------------------------------
+    def next(self):
+        if self._closed:
+            raise MXNetError("DeviceLoader is closed")
+        if self._stager is None:
+            self._launch_stager()
+        if self._pending:
+            batch = self._pending.pop(0)
+            self.pipeline_stats.note_delivered(batch.data[0].shape[0],
+                                               0.0)
+            return batch
+        t0 = time.perf_counter()
+        with self._cond:
+            if self._exhausted:
+                # the stager exited at epoch end (or on an error it
+                # already delivered) — keep raising StopIteration like
+                # every DataIter does until reset(), instead of waiting
+                # on a ring that can never refill
+                raise StopIteration
+            while not self._ring:
+                if self._stop:
+                    raise MXNetError("DeviceLoader was reset/closed "
+                                     "while a next() was blocked")
+                self._cond.wait(0.05)
+            entry = self._ring.pop(0)
+            if entry is _END or isinstance(entry, BaseException):
+                self._exhausted = True
+            self.pipeline_stats.note_ring(len(self._ring))
+            self._cond.notify_all()
+        wait = time.perf_counter() - t0
+        if entry is _END:
+            raise StopIteration
+        if isinstance(entry, BaseException):
+            raise entry
+        batch = entry[0]
+        self._pending = list(entry[1:])
+        self.pipeline_stats.note_delivered(batch.data[0].shape[0], wait)
+        return batch
+
+    def iter_next(self):
+        try:
+            self._current = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._current.data
+
+    def getlabel(self):
+        return self._current.label
+
+    def getpad(self):
+        return self._current.pad
+
+    def getindex(self):
+        return self._current.index
+
+    def reset(self):
+        """Rewind for a fresh epoch: cancel+join the stager and reset
+        the source; the stager restarts lazily on the next ``next()``,
+        so a reset consumes NOTHING from the source.  Repeatedly
+        callable; never delivers a stale pre-reset batch."""
+        if self._closed:
+            raise MXNetError("DeviceLoader is closed")
+        self._start_epoch(reset_source=True)
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self):
+        """Stop and join the stager thread, dropping the ring
+        (idempotent).  The source iterator is left usable unless the
+        loader was built with ``close_source=True``."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop_stager()
+        if self._close_source:
+            inner_close = getattr(self._iter, "close", None)
+            if callable(inner_close):
+                inner_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,105 @@
+"""PipelineStats — one shared counter block for the async device-feed
+pipeline, drained as an immutable snapshot.
+
+Every number answers the question BENCH_r05 raised ("is the input path
+or XLA the bottleneck?") without adding a readback anywhere: the stats
+are pure host-side clocks and counters, updated by the stager/transform
+threads and read by ``Speedometer``/``fit``/``bench.py``.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["PipelineStats"]
+
+
+class PipelineStats:
+    """Thread-safe counters for a :class:`~mxnet_tpu.data.DeviceLoader`
+    (and the :class:`~mxnet_tpu.data.TransformIter` feeding it).
+
+    Snapshot fields (``snapshot()``):
+
+    * ``batches_delivered`` / ``images_delivered`` — batches/rows handed
+      to the consumer so far.
+    * ``host_wait_ms`` — cumulative wall time the CONSUMER spent blocked
+      in ``next()`` waiting for the ring to produce a batch.  Zero means
+      the device step fully hides the input path; a large fraction of
+      the epoch means the pipeline is input-bound.
+    * ``host_wait_ms_per_step`` — ``host_wait_ms / batches_delivered``.
+    * ``stage_ms`` — cumulative time the stager spent assembling +
+      dispatching ``jax.device_put`` (overlapped with compute, so this
+      is throughput accounting, not a stall).
+    * ``stager_img_per_sec`` — staging throughput over the stager's
+      active time.
+    * ``ring_depth`` / ``ring_occupancy`` / ``ring_high_water`` — the
+      configured bound, the current fill, and the maximum fill ever
+      observed (the bound holding is the backpressure contract).
+    * ``ring_full_waits`` — times the stager blocked on a full ring
+      (a healthy overlapped pipeline blocks here, not in ``next()``).
+    """
+
+    def __init__(self, ring_depth=0):
+        self._lock = threading.Lock()
+        self.ring_depth = int(ring_depth)
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.batches_delivered = 0
+            self.images_delivered = 0
+            self.host_wait_ms = 0.0
+            self.stage_ms = 0.0
+            self.images_staged = 0
+            self.batches_staged = 0
+            self.ring_occupancy = 0
+            self.ring_high_water = 0
+            self.ring_full_waits = 0
+
+    # -- producer side -------------------------------------------------
+    def note_staged(self, rows, seconds):
+        with self._lock:
+            self.batches_staged += 1
+            self.images_staged += int(rows)
+            self.stage_ms += seconds * 1000.0
+
+    def note_ring(self, occupancy):
+        with self._lock:
+            self.ring_occupancy = int(occupancy)
+            if occupancy > self.ring_high_water:
+                self.ring_high_water = int(occupancy)
+
+    def note_ring_full(self):
+        with self._lock:
+            self.ring_full_waits += 1
+
+    # -- consumer side -------------------------------------------------
+    def note_delivered(self, rows, wait_seconds):
+        with self._lock:
+            self.batches_delivered += 1
+            self.images_delivered += int(rows)
+            self.host_wait_ms += wait_seconds * 1000.0
+
+    # -- reading -------------------------------------------------------
+    def snapshot(self):
+        """Immutable dict of the counters (field table:
+        docs/api/data.md)."""
+        with self._lock:
+            per_step = (self.host_wait_ms / self.batches_delivered
+                        if self.batches_delivered else 0.0)
+            stager_rate = (self.images_staged / (self.stage_ms / 1000.0)
+                           if self.stage_ms > 0 else 0.0)
+            return {
+                "batches_delivered": self.batches_delivered,
+                "images_delivered": self.images_delivered,
+                "host_wait_ms": round(self.host_wait_ms, 3),
+                "host_wait_ms_per_step": round(per_step, 3),
+                "stage_ms": round(self.stage_ms, 3),
+                "stager_img_per_sec": round(stager_rate, 2),
+                "ring_depth": self.ring_depth,
+                "ring_occupancy": self.ring_occupancy,
+                "ring_high_water": self.ring_high_water,
+                "ring_full_waits": self.ring_full_waits,
+            }
+
+    def __repr__(self):
+        return "PipelineStats(%r)" % (self.snapshot(),)

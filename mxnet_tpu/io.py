@@ -147,7 +147,20 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetcher (io.py:285; the reference's C++
-    PrefetcherIter wraps dmlc::ThreadedIter the same way)."""
+    PrefetcherIter wraps dmlc::ThreadedIter the same way).
+
+    Lifecycle: ``close()`` (or the context-manager exit) stops and
+    JOINS the worker threads — they used to be fire-and-forget daemons
+    that leaked one thread per iterator instance and could race a
+    late ``reset()``.  ``reset()`` is safe to call repeatedly and
+    while a prefetch is in flight: it synchronizes on the in-flight
+    fetch completing before the underlying iterators rewind, so no
+    worker ever reads a source mid-reset (the one pre-reset batch a
+    worker already fetched is discarded, matching the reference's
+    ThreadedIter semantics).  For the N-worker transformed version of
+    this pattern see :class:`mxnet_tpu.data.TransformIter`; for
+    device-resident double buffering,
+    :class:`mxnet_tpu.data.DeviceLoader`."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -169,9 +182,16 @@ class PrefetchingIter(DataIter):
 
         def prefetch_func(self, i):
             while True:
-                self.data_taken[i].wait()
+                # timed wait so a close() that lands between this
+                # worker's data_taken.clear() and its next wait cannot
+                # strand it (close's set() would be consumed by the
+                # clear and a bare wait() would sleep forever — the
+                # join-hang this close/join design replaces)
+                while not self.data_taken[i].wait(0.1):
+                    if not self.started:
+                        return
                 if not self.started:
-                    break
+                    return
                 try:
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
@@ -185,10 +205,33 @@ class PrefetchingIter(DataIter):
         for thread in self.prefetch_threads:
             thread.start()
 
-    def __del__(self):
+    def close(self):
+        """Stop and join the prefetch workers (idempotent).
+
+        The prefetcher cannot be used afterwards; the wrapped source
+        iterators stay usable (they belong to the caller).  Also runs
+        via the context-manager exit and (best-effort) the
+        finalizer."""
+        if not getattr(self, "started", False):
+            return
         self.started = False
         for e in self.data_taken:
             e.set()
+        for thread in self.prefetch_threads:
+            thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -209,6 +252,15 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
+        """Rewind every source for a fresh epoch (safe to repeat).
+
+        Waits for any in-flight prefetch to land first (so the
+        sources are never rewound under a concurrent fetch) and
+        discards that pre-reset batch; calling it again immediately —
+        or after the epoch exhausted — is safe and does the same
+        dance."""
+        if not self.started:
+            raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
@@ -219,6 +271,8 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        if not self.started:
+            raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
         if self.next_batch[0] is None:

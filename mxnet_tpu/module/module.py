@@ -12,7 +12,7 @@ from .. import optimizer as opt
 from ..initializer import Uniform, InitDesc
 from ..model import _create_kvstore, _initialize_kvstore, _update_params, \
     _update_params_on_kvstore, load_checkpoint, save_checkpoint
-from .base_module import BaseModule, _stack_batch_arrays
+from .base_module import BaseModule, stack_group_inputs
 from .executor_group import DataParallelExecutorGroup
 from .mesh_executor_group import MeshExecutorGroup
 
@@ -752,23 +752,36 @@ class Module(BaseModule):
         # would make update_metric slice-and-host-update instead of
         # consuming the device tally's step-done flag
         self._eval_pad_extra = 0
-        stacked = {}
-        data_names = [d[0] for d in grp.data_shapes]
-        for i, name in enumerate(data_names):
-            stacked[name] = _stack_batch_arrays(
-                [b.data[i] for b in batches])
-        label_names = getattr(grp, "_label_names", [])
-        if label_names and batches[0].label:
-            for i, name in enumerate(label_names):
-                if i < len(batches[0].label) and \
-                        all(b.label[i] is not None for b in batches):
-                    stacked[name] = _stack_batch_arrays(
-                        [b.label[i] for b in batches])
+        stacked = self._staged_group_block(batches)
+        if stacked is None:
+            stacked = stack_group_inputs(
+                batches, [d[0] for d in grp.data_shapes],
+                getattr(grp, "_label_names", []))
         if not grp.step_update_grouped(self._updater, stacked,
                                        num_device=self._num_update_blocks):
             return False
         self._params_dirty = True
         return True
+
+    @staticmethod
+    def _staged_group_block(batches):
+        """If every batch in the group is a view onto ONE DeviceLoader-
+        staged ``(K, B, ...)`` block covering exactly this group (in
+        order), return that block's already-staged input dict — the
+        scanned program consumes it directly (``stage_stacked``'s
+        ``device_put`` no-ops on resident arrays), skipping the
+        re-stack a generic group would pay.  Any mismatch (manual
+        loader with a different K, mixed sources) returns None and the
+        generic on-device stacking path handles it."""
+        block = getattr(batches[0], "_staged_block", None)
+        if block is None or \
+                getattr(batches[0], "_staged_size", -1) != len(batches):
+            return None
+        for j, b in enumerate(batches):
+            if getattr(b, "_staged_block", None) is not block or \
+                    getattr(b, "_staged_index", -1) != j:
+                return None
+        return block
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -236,7 +236,14 @@ class Predictor:
         list/tuple in ``data_names`` order, or a name->array dict;
         return (name->f32 raw array dict, n_rows). Feature dims are
         validated against the bound shapes so a malformed request fails
-        at submit time, not on the batcher thread."""
+        at submit time, not on the batcher thread.
+
+        Pre-staged (device-resident) inputs — e.g. the batches a
+        :class:`mxnet_tpu.data.DeviceLoader` delivers — pass through
+        WITHOUT a host round trip: a jax array stays on device (the
+        pad/slice rule runs device-side) and the served rows remain
+        bitwise equal to the same request from host memory (pinned by
+        tests/test_data_pipeline.py)."""
         names = self.data_names
         if isinstance(data, dict):
             arrays = dict(data)

@@ -24,11 +24,14 @@ def test_bench_emits_contract_json():
     # recorded *_error field on the TPU run, not a crash.
     # BENCH_SERVE=0 for the same reason: Predictor warmup compiles one
     # resnet-50 eval program per batch bucket (tests/test_serving.py
-    # pins the serving contracts on a small net instead)
+    # pins the serving contracts on a small net instead).
+    # BENCH_PREFETCH=0 likewise: its fresh metric tally token is one
+    # more full train-step compile (tests/test_data_pipeline.py pins
+    # the device-feed contracts on a small net)
     env.update(BENCH_BATCH="4", BENCH_STEPS="2", BENCH_PIPELINE="0",
                BENCH_DTYPE="float32", BENCH_FIT_EPOCH_BATCHES="3",
                BENCH_GROUPED="0", BENCH_HANDWRITTEN="0",
-               BENCH_SERVE="0")
+               BENCH_SERVE="0", BENCH_PREFETCH="0")
     proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
                           capture_output=True, text=True, timeout=1200,
                           env=env, cwd=ROOT)
