@@ -321,6 +321,18 @@ def main():
         except Exception as e:
             extra["fit_error"] = str(e)[:160]
 
+    if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+        # telemetry overhead: the SAME fit windows with recording off
+        # vs on (step timeline + compile watch + JSONL streaming) —
+        # pins the <2% zero-perturbation overhead contract
+        # (docs/api/telemetry.md). Off in the CPU contract smoke (its
+        # fresh metric tally token is one more full resnet-50 compile).
+        try:
+            extra.update(_bench_telemetry(mx, mod, batches, batch,
+                                          img_per_sec, steps))
+        except Exception as e:
+            extra["telemetry_error"] = str(e)[:160]
+
     if fused and os.environ.get("BENCH_GROUPED", "1") != "0":
         # iterations-per-loop: the same fit loop with batch_group=K —
         # K steps per launch through the scanned train-step program
@@ -474,6 +486,74 @@ def _bench_fit(mx, mod, batches, batch, step_img_per_sec, steps):
         out["fit_device_metric"] = getattr(grp, "_metric_live",
                                            None) is metric
         out["fit_train_acc"] = round(float(metric.get()[1]), 4)
+    return out
+
+
+def _bench_telemetry(mx, mod, batches, batch, step_img_per_sec, steps):
+    """Telemetry recording overhead on the REAL fit loop: the same
+    two-fit-windows slope, once with telemetry disabled and once with
+    the full recording path live (StepTimeline records, CompileWatch
+    wrappers, one JSONL step line per step to a temp file).
+    ``telemetry_overhead_pct`` is the throughput the recording costs —
+    the subsystem's <2% contract; ``telemetry_post_warmup_retraces``
+    must be 0 (fit declares the warmup boundary after its first
+    epoch)."""
+    import tempfile
+
+    from mxnet_tpu import telemetry as tel
+
+    ep_batches = int(os.environ.get("BENCH_FIT_EPOCH_BATCHES",
+                                    str(max(4, steps * 12))))
+    it = _DeviceBatchIter(batches, mod.data_shapes, mod.label_shapes,
+                          ep_batches)
+    # ONE metric for both windows: each new metric object is a new
+    # device-tally token, i.e. another full train-step compile
+    metric = mx.metric.Accuracy()
+
+    def run(n_epochs):
+        t0 = time.time()
+        mod.fit(it, eval_metric=metric, num_epoch=n_epochs)
+        return time.time() - t0
+
+    # snapshot operator telemetry (MXNET_TELEMETRY autostart) so this
+    # stage's off-window toggling doesn't tear down their sink/server
+    # for the rest of the bench run
+    was_enabled = tel.enabled()
+    prev_sink = tel.jsonl_sink()
+    prev_sink_path = prev_sink.path if prev_sink is not None else None
+    prev_server = tel.metrics_server()
+    prev_port = prev_server.port if prev_server is not None else None
+    tel.disable()
+    try:
+        run(1)  # warm this metric's train-step program
+        off_fields, off_ok = _fit_window_slope(
+            run, ep_batches, batch, step_img_per_sec, "telemetry_off",
+            plaus=1.2)
+
+        tmp = tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False)
+        tmp.close()
+        tel.enable(jsonl=tmp.name)
+        try:
+            run(1)  # warm the recording path (watch attach, sink open)
+            on_fields, on_ok = _fit_window_slope(
+                run, ep_batches, batch, step_img_per_sec, "telemetry_on",
+                plaus=1.2)
+            out = {"telemetry_post_warmup_retraces":
+                   tel.compile_watch().post_warmup_count,
+                   "telemetry_step_records": len(tel.timeline())}
+        finally:
+            tel.disable()
+            os.unlink(tmp.name)
+    finally:
+        if was_enabled:
+            tel.enable(jsonl=prev_sink_path, port=prev_port)
+    out.update(off_fields)
+    out.update(on_fields)
+    if off_ok and on_ok:
+        off_r = off_fields["telemetry_off_img_per_sec"]
+        on_r = on_fields["telemetry_on_img_per_sec"]
+        out["telemetry_overhead_pct"] = round(
+            100.0 * (off_r - on_r) / off_r, 2)
     return out
 
 

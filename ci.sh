@@ -107,6 +107,43 @@ assert a and a == b, \
     "prefetch-device params digest %s != plain %s" % (b, a)
 print("device-feed gate: bit-identical params (sha256 %s...)" % a[:16])
 PY
+
+stage "telemetry gate (telemetry-on fit == plain, bit-identical params + step JSONL)"
+# observability contract (docs/api/telemetry.md): a fit with the full
+# telemetry recording path live — step timeline, compile watch, one
+# JSONL line per step — must train to BIT-IDENTICAL params (sha256
+# digest) and leave a parseable event log with one step record per
+# train step (and zero post-warmup retraces, asserted in-script).
+# Reuses the device-feed gate's plain-path digest (identical command)
+# rather than retraining the same baseline a third time.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --telemetry-jsonl "$PF_TMP/steps.jsonl" \
+    --params-digest-out "$PF_TMP/digest_telemetry.txt" || FAILED=1
+python - "$PF_TMP/digest_plain.txt" "$PF_TMP/digest_telemetry.txt" \
+    "$PF_TMP/steps.jsonl" <<'PY' || FAILED=1
+import json, sys
+a, b = (open(p).read().strip() for p in sys.argv[1:3])
+assert a and a == b, \
+    "telemetry-on params digest %s != plain %s" % (b, a)
+lines = [json.loads(l) for l in open(sys.argv[3])]   # every line parses
+steps = [l for l in lines if l["kind"] == "step"]
+# one record per train step: the synthetic set is 4096 rows at batch
+# 128 -> 32 steps/epoch x 1 epoch; pin via the records' own coordinates
+per_epoch = {}
+for s in steps:
+    per_epoch.setdefault(s["epoch"], set()).add(s["nbatch"])
+assert per_epoch and all(
+    batches == set(range(max(batches) + 1)) and len(batches) >= 32
+    for batches in per_epoch.values()), \
+    "step records are not 1:1 with train steps: %r" % (
+        {e: len(b) for e, b in per_epoch.items()})
+assert any(l["kind"] == "metrics" for l in lines), "no metrics flush"
+print("telemetry gate: bit-identical params (sha256 %s...), %d step "
+      "records across %d epoch(s), %d JSONL lines"
+      % (a[:16], len(steps), len(per_epoch), len(lines)))
+PY
 rm -rf "$PF_TMP"
 
 stage "serving smoke gate (Predictor parity + frozen compiles under traffic)"

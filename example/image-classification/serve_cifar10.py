@@ -48,6 +48,11 @@ def main():
                         help="serve the latest committed step from this "
                              "CheckpointManager directory instead of "
                              "training in-process")
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="expose the telemetry registry as a "
+                             "Prometheus /metrics endpoint alongside "
+                             "the batcher (0 = pick a free port); the "
+                             "demo scrapes it once and prints a sample")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -89,7 +94,9 @@ def main():
 
     errs = []
     server = DynamicBatcher(pred, max_queue=4 * args.clients,
-                            max_wait_ms=args.max_wait_ms)
+                            max_wait_ms=args.max_wait_ms,
+                            metrics_port=args.metrics_port)
+    logging.info("Prometheus endpoint: %s", server.metrics_server.url)
 
     def client(i):
         crng = np.random.RandomState(1000 + i)
@@ -113,6 +120,23 @@ def main():
         t.start()
     for t in threads:
         t.join()
+
+    # scrape the live endpoint ONCE while traffic counters are hot —
+    # the Prometheus text must carry the serving counters a monitoring
+    # stack would alert on
+    import urllib.request
+    with urllib.request.urlopen(server.metrics_server.url,
+                                timeout=10) as resp:
+        prom = resp.read().decode()
+    assert resp.status == 200
+    assert "mxtpu_serving_" in prom and "_latency_ms_bucket" in prom, \
+        prom[:400]
+    sample = [ln for ln in prom.splitlines()
+              if ln.startswith("mxtpu_serving_") and "{" not in ln][:6]
+    print("prometheus scrape ok (%d lines), e.g.:" % len(prom.splitlines()))
+    for ln in sample:
+        print("   ", ln)
+
     server.shutdown(drain=True)
     wall = time.time() - t0
 

@@ -170,6 +170,18 @@ def main():
                         help="write a sha256 over the final params + "
                              "aux arrays to this file (CI bit-"
                              "identity gates)")
+    parser.add_argument("--telemetry-jsonl", default=None,
+                        help="enable mxnet_tpu.telemetry and stream one "
+                             "JSON line per train step (plus per-epoch "
+                             "metrics snapshots) into this file; "
+                             "training stays bit-identical to the "
+                             "telemetry-off path (the CI telemetry "
+                             "gate)")
+    parser.add_argument("--telemetry-port", type=int, default=None,
+                        help="serve the telemetry registry as a "
+                             "Prometheus /metrics endpoint on this "
+                             "port for the run's lifetime (0 picks a "
+                             "free port)")
     parser.add_argument("--serve-smoke", action="store_true",
                         help="after training, serve the model through "
                              "an in-process mxnet_tpu.serving stack "
@@ -180,6 +192,13 @@ def main():
                              "serving gate)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    telemetry_on = args.telemetry_jsonl or args.telemetry_port is not None
+    if telemetry_on:
+        server = mx.telemetry.enable(jsonl=args.telemetry_jsonl,
+                                     port=args.telemetry_port)
+        if server is not None:
+            logging.info("telemetry: Prometheus endpoint at %s",
+                         server.url)
     if args.seed is not None:
         np.random.seed(args.seed)
         mx.random.seed(args.seed)
@@ -238,6 +257,17 @@ def main():
             prefetch_to_device=args.prefetch_device)
     if manager is not None:
         manager.wait_until_finished()
+    if telemetry_on:
+        # the steady-state contract: after fit's first epoch declared
+        # the warmup boundary, the train loop must never retrace
+        post = mx.telemetry.compile_watch().post_warmup_count
+        assert post == 0, (
+            "train loop retraced %d time(s) after the warmup boundary: %r"
+            % (post, mx.telemetry.compile_watch().events()))
+        tl = mx.telemetry.timeline()
+        logging.info("telemetry: %d step records; slowest: %r",
+                     len(tl), tl.slowest(1))
+        mx.telemetry.flush_metrics("train_cifar10 end")
     trained = mod._optimizer is not None and mod._optimizer.num_update > 0
     if args.batch_group and args.batch_group > 1 and trained:
         # the CI equivalence gate must FAIL, not trivially pass, if the

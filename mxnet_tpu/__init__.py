@@ -73,6 +73,7 @@ from . import callback
 from . import monitor
 from . import monitor as mon
 from . import profiler
+from . import telemetry
 from . import visualization
 from . import visualization as viz
 from . import rnn

@@ -102,11 +102,12 @@ class Speedometer(object):
     (``prefetch_to_device=`` / a :class:`mxnet_tpu.data.DeviceLoader`),
     each log line also carries the window's **host-wait fraction** —
     the share of the window's wall time the loop spent blocked on the
-    input path (from ``PipelineStats.host_wait_ms``; the loader is
-    found through the fit loop's ``train_data``).  ~0% means decode +
-    transfer are fully hidden behind the device step; a large value
-    means the epoch is input-bound — visible in the training log, not
-    just in bench.py."""
+    input path (``PipelineStats.host_wait_ms``, read from the
+    telemetry registry's active-pipeline slot — ``fit`` publishes the
+    loader it trains through via ``telemetry.set_active_pipeline``).
+    ~0% means decode + transfer are fully hidden behind the device
+    step; a large value means the epoch is input-bound — visible in
+    the training log, not just in bench.py."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
@@ -118,12 +119,12 @@ class Speedometer(object):
 
     @staticmethod
     def _pipeline_stats(param):
-        """The live PipelineStats, when the fit loop trains from a
-        device-feed loader (``train_data`` in the callback's locals)."""
-        loc = getattr(param, "locals", None)
-        if not isinstance(loc, dict):
-            return None
-        return getattr(loc.get("train_data"), "pipeline_stats", None)
+        """The PipelineStats of the device-feed loader the CURRENT fit
+        trains through (None when fit is host-fed): the telemetry
+        registry's active-pipeline registration, which replaced the old
+        hack of sniffing ``train_data`` out of the fit loop's locals."""
+        from . import telemetry
+        return telemetry.active_pipeline()
 
     def __call__(self, param):
         count = param.nbatch

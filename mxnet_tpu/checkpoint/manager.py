@@ -44,8 +44,13 @@ from collections import namedtuple
 
 from .. import engine as _engine
 from .. import random as _random
+from .. import telemetry
 from ..base import MXNetError
 from . import serialize
+
+# one shared scope: checkpoint traffic is a per-process story (the
+# Prometheus/JSONL view), managers come and go per directory
+_TEL = telemetry.registry().scope("checkpoint")
 
 __all__ = ["CheckpointManager", "Checkpoint", "is_checkpoint_dir"]
 
@@ -183,13 +188,29 @@ class CheckpointManager(object):
         final = self._entry_dir(step)
         errbox = []
 
+        n_bytes = sum(arr.nbytes for _name, shards in snaps
+                      for _idx, arr in shards)
+        if opt_bytes is not None:
+            n_bytes += len(opt_bytes)
+
         def job():
+            t0 = time.perf_counter()
             try:
-                self._write_entry(tmp, step, snaps, opt_bytes, extra,
-                                  rng_state, save_time)
-                _commit_entry(tmp, final)
+                with telemetry.span("checkpoint.save", step=step):
+                    self._write_entry(tmp, step, snaps, opt_bytes, extra,
+                                      rng_state, save_time)
+                    _commit_entry(tmp, final)
                 self._gc()
+                # duration + bytes land in the shared registry: the
+                # telemetry story for "how much is checkpointing
+                # costing" without any readback or extra I/O
+                _TEL.counter("saves").add()
+                _TEL.counter("save_ms").add(
+                    (time.perf_counter() - t0) * 1000.0)
+                _TEL.counter("bytes_written").add(n_bytes)
+                _TEL.gauge("last_step").set(step)
             except BaseException as exc:  # noqa: BLE001 - repropagated
+                _TEL.counter("save_errors").add()
                 errbox.append(exc)
                 shutil.rmtree(tmp, ignore_errors=True)
 
@@ -276,6 +297,7 @@ class CheckpointManager(object):
                 raise MXNetError("no committed checkpoint in %s"
                                  % self.directory)
         step = int(step)
+        t0 = time.perf_counter()
         entry = self._entry_dir(step)
         manifest_path = os.path.join(entry, _MANIFEST)
         if not os.path.exists(manifest_path):
@@ -311,6 +333,11 @@ class CheckpointManager(object):
         if manifest.get("rng"):
             rng = serialize.load_rng(
                 os.path.join(entry, manifest["rng"]["file"]))
+        _TEL.counter("restores").add()
+        _TEL.counter("restore_ms").add((time.perf_counter() - t0) * 1000.0)
+        _TEL.counter("bytes_read").add(
+            sum(p.nbytes for p in params.values())
+            + (len(opt_bytes) if opt_bytes else 0))
         return Checkpoint(step=step, params=params,
                           optimizer_state=opt_bytes,
                           extra=manifest.get("extra", {}), rng=rng)

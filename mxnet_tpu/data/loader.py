@@ -94,6 +94,7 @@ class DeviceLoader(DataIter):
         self._depth = depth
         self._group = group
         self._close_source = bool(close_source)
+        self._owns_stats = stats is None
         self.pipeline_stats = stats or PipelineStats(ring_depth=depth)
         self.pipeline_stats.ring_depth = depth
         self.provide_data = data_iter.provide_data
@@ -176,6 +177,7 @@ class DeviceLoader(DataIter):
         """Pull + stage the next ring entry (a list of delivered
         batches).  Returns _END at epoch end, an exception to re-raise
         in order, or the staged batches."""
+        from .. import telemetry
         if self._group:
             pulled = []
             for _ in range(self._group):
@@ -186,11 +188,12 @@ class DeviceLoader(DataIter):
             if not pulled:
                 return _END
             t0 = time.perf_counter()
-            if self._group_handle is not None and len(pulled) > 0 and \
-                    self._uniform_shapes(pulled):
-                staged = self._stage_block(pulled)
-            else:
-                staged = [self._stage_batch(b) for b in pulled]
+            with telemetry.span("data.stage_block", k=len(pulled)):
+                if self._group_handle is not None and len(pulled) > 0 and \
+                        self._uniform_shapes(pulled):
+                    staged = self._stage_block(pulled)
+                else:
+                    staged = [self._stage_batch(b) for b in pulled]
             rows = sum(b.data[0].shape[0] for b in staged)
             self.pipeline_stats.note_staged(rows, time.perf_counter() - t0)
             return staged
@@ -199,7 +202,8 @@ class DeviceLoader(DataIter):
         except StopIteration:
             return _END
         t0 = time.perf_counter()
-        staged = self._stage_batch(batch)
+        with telemetry.span("data.stage"):
+            staged = self._stage_batch(batch)
         self.pipeline_stats.note_staged(staged.data[0].shape[0],
                                         time.perf_counter() - t0)
         return [staged]
@@ -362,6 +366,11 @@ class DeviceLoader(DataIter):
             return
         self._closed = True
         self._stop_stager()
+        if self._owns_stats:
+            # this loader created the stats: retire their registry
+            # scope so fit-per-call workloads don't grow the registry
+            # unboundedly (the object stays readable for post-mortems)
+            self.pipeline_stats.release()
         if self._close_source:
             inner_close = getattr(self._iter, "close", None)
             if callable(inner_close):

@@ -5,10 +5,20 @@ Every number answers the question BENCH_r05 raised ("is the input path
 or XLA the bottleneck?") without adding a readback anywhere: the stats
 are pure host-side clocks and counters, updated by the stager/transform
 threads and read by ``Speedometer``/``fit``/``bench.py``.
+
+Since the telemetry subsystem landed, PipelineStats is a **view over
+the shared** :class:`mxnet_tpu.telemetry.MetricsRegistry`: each
+instance claims a ``data.<i>.*`` scope, so the Prometheus endpoint and
+JSONL flush export pipeline health for free, while ``snapshot()``
+keeps its exact historical shape. ``Module.fit`` additionally
+publishes the loader it trains through as
+``telemetry.set_active_pipeline(...)`` — that is where ``Speedometer``
+and the epoch log read host-wait from (the old path reached into the
+fit loop's local variables).
 """
 from __future__ import annotations
 
-import threading
+from .. import telemetry
 
 __all__ = ["PipelineStats"]
 
@@ -38,68 +48,103 @@ class PipelineStats:
       (a healthy overlapped pipeline blocks here, not in ``next()``).
     """
 
-    def __init__(self, ring_depth=0):
-        self._lock = threading.Lock()
+    def __init__(self, ring_depth=0, scope=None):
+        self.scope = scope or telemetry.registry().unique_scope("data")
+        c = self.scope.counter
+        self._c_batches_delivered = c("batches_delivered")
+        self._c_images_delivered = c("images_delivered")
+        self._c_host_wait_ms = c("host_wait_ms")
+        self._c_stage_ms = c("stage_ms")
+        self._c_images_staged = c("images_staged")
+        self._c_batches_staged = c("batches_staged")
+        self._c_ring_full_waits = c("ring_full_waits")
+        self._g_ring_depth = self.scope.gauge("ring_depth")
+        self._g_ring_occupancy = self.scope.gauge("ring_occupancy")
+        self._g_ring_high_water = self.scope.gauge("ring_high_water")
         self.ring_depth = int(ring_depth)
         self.reset()
 
+    # registry-backed field reads (keeps the historical attribute
+    # surface: tests and the fit loop read these directly)
+    batches_delivered = telemetry.instrument_value("_c_batches_delivered")
+    images_delivered = telemetry.instrument_value("_c_images_delivered")
+    host_wait_ms = telemetry.instrument_value("_c_host_wait_ms")
+    stage_ms = telemetry.instrument_value("_c_stage_ms")
+    images_staged = telemetry.instrument_value("_c_images_staged")
+    batches_staged = telemetry.instrument_value("_c_batches_staged")
+    ring_full_waits = telemetry.instrument_value("_c_ring_full_waits")
+    ring_occupancy = telemetry.instrument_value("_g_ring_occupancy")
+    ring_high_water = telemetry.instrument_value("_g_ring_high_water")
+
+    @property
+    def ring_depth(self):
+        return int(self._g_ring_depth.value)
+
+    @ring_depth.setter
+    def ring_depth(self, depth):
+        self._g_ring_depth.set(int(depth))
+
+    def release(self):
+        """Drop this instance's ``data.<i>`` scope from the shared
+        registry (the counters keep working locally). A DeviceLoader
+        that created its own stats releases them on ``close()`` — a
+        fit-per-call workload would otherwise grow the registry and
+        every ``/metrics`` scrape without bound."""
+        self.scope.release()
+
     def reset(self):
-        with self._lock:
-            self.batches_delivered = 0
-            self.images_delivered = 0
-            self.host_wait_ms = 0.0
-            self.stage_ms = 0.0
-            self.images_staged = 0
-            self.batches_staged = 0
-            self.ring_occupancy = 0
-            self.ring_high_water = 0
-            self.ring_full_waits = 0
+        depth = self.ring_depth
+        for inst in (self._c_batches_delivered, self._c_images_delivered,
+                     self._c_host_wait_ms, self._c_stage_ms,
+                     self._c_images_staged, self._c_batches_staged,
+                     self._c_ring_full_waits, self._g_ring_occupancy,
+                     self._g_ring_high_water):
+            inst.reset()
+        self._g_ring_depth.set(depth)
 
     # -- producer side -------------------------------------------------
     def note_staged(self, rows, seconds):
-        with self._lock:
-            self.batches_staged += 1
-            self.images_staged += int(rows)
-            self.stage_ms += seconds * 1000.0
+        self._c_batches_staged.add()
+        self._c_images_staged.add(int(rows))
+        self._c_stage_ms.add(seconds * 1000.0)
 
     def note_ring(self, occupancy):
-        with self._lock:
-            self.ring_occupancy = int(occupancy)
-            if occupancy > self.ring_high_water:
-                self.ring_high_water = int(occupancy)
+        occupancy = int(occupancy)
+        self._g_ring_occupancy.set(occupancy)
+        if occupancy > self.ring_high_water:
+            self._g_ring_high_water.set(occupancy)
 
     def note_ring_full(self):
-        with self._lock:
-            self.ring_full_waits += 1
+        self._c_ring_full_waits.add()
 
     # -- consumer side -------------------------------------------------
     def note_delivered(self, rows, wait_seconds):
-        with self._lock:
-            self.batches_delivered += 1
-            self.images_delivered += int(rows)
-            self.host_wait_ms += wait_seconds * 1000.0
+        self._c_batches_delivered.add()
+        self._c_images_delivered.add(int(rows))
+        self._c_host_wait_ms.add(wait_seconds * 1000.0)
 
     # -- reading -------------------------------------------------------
     def snapshot(self):
         """Immutable dict of the counters (field table:
         docs/api/data.md)."""
-        with self._lock:
-            per_step = (self.host_wait_ms / self.batches_delivered
-                        if self.batches_delivered else 0.0)
-            stager_rate = (self.images_staged / (self.stage_ms / 1000.0)
-                           if self.stage_ms > 0 else 0.0)
-            return {
-                "batches_delivered": self.batches_delivered,
-                "images_delivered": self.images_delivered,
-                "host_wait_ms": round(self.host_wait_ms, 3),
-                "host_wait_ms_per_step": round(per_step, 3),
-                "stage_ms": round(self.stage_ms, 3),
-                "stager_img_per_sec": round(stager_rate, 2),
-                "ring_depth": self.ring_depth,
-                "ring_occupancy": self.ring_occupancy,
-                "ring_high_water": self.ring_high_water,
-                "ring_full_waits": self.ring_full_waits,
-            }
+        batches = self.batches_delivered
+        host_wait = self.host_wait_ms
+        stage_ms = self.stage_ms
+        per_step = host_wait / batches if batches else 0.0
+        stager_rate = (self.images_staged / (stage_ms / 1000.0)
+                       if stage_ms > 0 else 0.0)
+        return {
+            "batches_delivered": batches,
+            "images_delivered": self.images_delivered,
+            "host_wait_ms": round(host_wait, 3),
+            "host_wait_ms_per_step": round(per_step, 3),
+            "stage_ms": round(stage_ms, 3),
+            "stager_img_per_sec": round(stager_rate, 2),
+            "ring_depth": self.ring_depth,
+            "ring_occupancy": self.ring_occupancy,
+            "ring_high_water": self.ring_high_water,
+            "ring_full_waits": self.ring_full_waits,
+        }
 
     def __repr__(self):
         return "PipelineStats(%r)" % (self.snapshot(),)

@@ -825,7 +825,10 @@ class Module(BaseModule):
             eval_metric = metric_mod.create(eval_metric)
             if reset:
                 eval_data.reset()
-            result = grp.score_device(eval_data, eval_metric, num_batch)
+            from .. import telemetry
+            with telemetry.span("score.device", epoch=epoch):
+                result = grp.score_device(eval_data, eval_metric,
+                                          num_batch)
             if result is not None:
                 pairs, seen = result
                 self._fire(score_end_callback, epoch, seen, eval_metric,

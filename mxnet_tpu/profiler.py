@@ -86,17 +86,26 @@ def profiler_set_state(state="stop"):
                 pass
 
 
-def record_event(name, begin_us, end_us, pid=0):
-    """Append one duration event (engine's AddOprStat equivalent)."""
+def record_event(name, begin_us, end_us, pid=0, tid=None):
+    """Append one duration event (engine's AddOprStat equivalent).
+
+    Emitted as ONE complete event (``"ph": "X"`` with a ``dur``) keyed
+    by the REAL recording thread id. The old encoding — unpaired
+    ``"B"``/``"E"`` pairs stamped with ``tid=pid`` — collapsed every
+    scope onto one track, so nested scopes from different threads
+    interleaved their begin/end markers and Perfetto rendered garbage
+    nesting; complete events carry their own extent, so per-thread
+    containment of ``(ts, dur)`` intervals is unambiguous."""
     global _ran_undumped
     if _state != "run":
         return
     _ran_undumped = True
+    if tid is None:
+        tid = threading.get_ident()
     with _lock:
-        _events.append({"name": name, "cat": "operator", "ph": "B",
-                        "ts": begin_us, "pid": pid, "tid": pid})
-        _events.append({"name": name, "cat": "operator", "ph": "E",
-                        "ts": end_us, "pid": pid, "tid": pid})
+        _events.append({"name": name, "cat": "operator", "ph": "X",
+                        "ts": begin_us, "dur": max(0.0, end_us - begin_us),
+                        "pid": pid, "tid": tid})
 
 
 class Scope(object):
@@ -119,8 +128,10 @@ _native_events = []  # drained from the engine, kept so dumps stay cumulative
 
 def dump_profile():
     """Write accumulated events as Chrome tracing JSON (MXDumpProfile),
-    merging the native engine's per-op stamps (OprExecStat equivalents).
-    Callable repeatedly — both event sources accumulate across dumps."""
+    merging the native engine's per-op stamps (OprExecStat equivalents)
+    AND the telemetry span ring (``mxnet_tpu.telemetry.span``), so one
+    file carries the whole host-side timeline. Callable repeatedly —
+    every event source accumulates across dumps."""
     from . import engine as _engine
     eng = _engine.get()
     # "symbolic" mode never emits per-op stamps — skip the temp-file
@@ -145,6 +156,11 @@ def dump_profile():
         # per-imperative-op stamps (profiler.h:63-66 mode semantics)
         if _config.get("mode") == "all":
             events += list(_native_events)
+        # telemetry spans share the wall clock (time.time() * 1e6), so
+        # host spans, engine op stamps, and the jax.profiler XPlane
+        # trace line up on one timeline in Perfetto
+        from . import telemetry as _telemetry
+        events += _telemetry.trace_events()
         data = {"traceEvents": events, "displayTimeUnit": "ms"}
         with open(_config["filename"], "w") as f:
             json.dump(data, f)

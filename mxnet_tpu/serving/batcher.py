@@ -68,12 +68,25 @@ class DynamicBatcher:
     start : bool
         Start the worker thread immediately (default). ``start=False``
         lets tests (and staged deployments) fill the queue first.
+    metrics_port : int, optional
+        Serve the process-wide telemetry registry as a Prometheus
+        ``GET /metrics`` endpoint (stdlib ``http.server``) for the
+        batcher's lifetime — ``0`` picks a free port, readable as
+        ``.metrics_server.port``. The serving counters live in the
+        registry (``ServingStats`` is a view over it), so a scraper
+        pointed here sees queue depth, latency histogram, batch fill,
+        and compiles live.
     """
 
     def __init__(self, predictor, max_queue=256, max_wait_ms=2.0,
-                 timeout_ms=None, start=True):
+                 timeout_ms=None, start=True, metrics_port=None):
         self._pred = predictor
         self._stats = predictor._stats
+        self.metrics_server = None
+        if metrics_port is not None:
+            from .. import telemetry
+            self.metrics_server = telemetry.MetricsServer(
+                telemetry.registry(), port=int(metrics_port))
         self._max_queue = int(max_queue)
         self._max_wait = max(0.0, float(max_wait_ms)) / 1000.0
         self._timeout = (float(timeout_ms) / 1000.0
@@ -154,6 +167,9 @@ class DynamicBatcher:
             thread, self._thread = self._thread, None
         if thread is not None and not already:
             thread.join(timeout)
+        server, self.metrics_server = self.metrics_server, None
+        if server is not None:
+            server.close()
 
     def close(self):
         self.shutdown(drain=True)

@@ -345,12 +345,14 @@ class Predictor:
         up to the bucket's bound shape (the same ``pad_batch_rows``
         rule the predict/score epoch-tail fix uses) and slice the
         outputs back to the real rows."""
+        from .. import telemetry
         mod = self._modules[bucket]
         batch = DataBatch(
             data=[nd.NDArray(pad_batch_rows(arrays[name], bucket))
                   for name, _ in self._data_descs],
             label=None, pad=bucket - rows)
-        mod.forward(batch, is_train=False)
-        outs = [o.asnumpy()[:rows] for o in mod.get_outputs()]
+        with telemetry.span("serving.launch", bucket=bucket, rows=rows):
+            mod.forward(batch, is_train=False)
+            outs = [o.asnumpy()[:rows] for o in mod.get_outputs()]
         self._stats.note_batch(bucket, rows, warmup=warmup)
         return outs

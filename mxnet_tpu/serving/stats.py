@@ -6,81 +6,110 @@ snapshot of the serving stack: request outcomes, device-launch batch
 fill, queue depth, and the compile counter that pins the "zero
 recompiles after warmup" contract.
 
-Everything is updated under one lock from multiple threads (client
-threads submit, the batcher worker completes); the latency reservoir is
-a bounded ring of the most recent samples, so percentiles track current
-behavior instead of averaging over the process lifetime.
+Since the telemetry subsystem landed, ServingStats is a **view over
+the shared** :class:`mxnet_tpu.telemetry.MetricsRegistry`: every
+counter lives in a per-instance registry scope (``serving.<i>.*``), so
+the process-wide Prometheus endpoint / JSONL flush sees serving
+traffic without any extra wiring, while ``snapshot()`` keeps its exact
+historical shape. The latency reservoir stays a local bounded ring of
+the most recent samples (exact percentiles over current behavior);
+each completion also lands in the scope's ``latency_ms`` histogram for
+export.
 """
 from __future__ import annotations
 
 import threading
 
+from .. import telemetry
+
 __all__ = ["ServingStats"]
 
 
 class ServingStats:
-    """Thread-safe serving counters with a bounded latency reservoir."""
+    """Thread-safe serving counters over a telemetry-registry scope,
+    with a bounded latency reservoir."""
 
-    def __init__(self, latency_window=2048):
+    def __init__(self, latency_window=2048, scope=None):
         self._lock = threading.Lock()
         self._window = int(latency_window)
         self._lat = [0.0] * self._window
         self._lat_n = 0            # total samples ever (ring write head)
-        self.requests = 0          # submitted (batcher or direct predict)
-        self.completed = 0
-        self.rejected = 0          # queue-full backpressure rejections
-        self.timeouts = 0          # expired before launch
-        self.errors = 0
-        self.batches = 0           # device launches (excl. warmup)
-        self.warmup_batches = 0
-        self.real_rows = 0         # request rows actually served
-        self.padded_rows = 0       # bucket rows launched (incl. padding)
-        self.compiles = 0          # XLA traces through serving programs
+        self.scope = scope or telemetry.registry().unique_scope("serving")
+        c = self.scope.counter
+        self._c_requests = c("requests")   # submitted (batcher or direct)
+        self._c_completed = c("completed")
+        self._c_rejected = c("rejected")   # queue-full backpressure
+        self._c_timeouts = c("timeouts")   # expired before launch
+        self._c_errors = c("errors")
+        self._c_batches = c("batches")     # device launches (excl. warmup)
+        self._c_warmup_batches = c("warmup_batches")
+        self._c_real_rows = c("real_rows")     # request rows served
+        self._c_padded_rows = c("padded_rows")  # bucket rows launched
+        self._c_compiles = c("compiles")   # XLA traces through serving
+        self._h_latency = self.scope.histogram("latency_ms")
+        self._g_queue = self.scope.gauge("queue_depth")
         self.compile_tracking = True
         self.bucket_hits = {}      # bucket size -> launch count
         self._queue_probe = None   # () -> current queue depth
 
+    # -- registry-backed counter values (internal + snapshot use) -------
+    requests = telemetry.instrument_value("_c_requests")
+    completed = telemetry.instrument_value("_c_completed")
+    rejected = telemetry.instrument_value("_c_rejected")
+    timeouts = telemetry.instrument_value("_c_timeouts")
+    errors = telemetry.instrument_value("_c_errors")
+    batches = telemetry.instrument_value("_c_batches")
+    warmup_batches = telemetry.instrument_value("_c_warmup_batches")
+    real_rows = telemetry.instrument_value("_c_real_rows")
+    padded_rows = telemetry.instrument_value("_c_padded_rows")
+    compiles = telemetry.instrument_value("_c_compiles")
+
+    def release(self):
+        """Drop this instance's ``serving.<i>`` scope from the shared
+        registry (the counters keep working locally). Call when the
+        owning Predictor is discarded in a long-lived process."""
+        self.scope.release()
+
     # -- recorders (called by Predictor / DynamicBatcher) ---------------
     def note_compile(self):
-        with self._lock:
-            self.compiles += 1
+        self._c_compiles.add()
 
     def note_request(self, n=1):
-        with self._lock:
-            self.requests += n
+        self._c_requests.add(n)
 
     def note_reject(self):
-        with self._lock:
-            self.rejected += 1
+        self._c_rejected.add()
 
     def note_timeout(self):
-        with self._lock:
-            self.timeouts += 1
+        self._c_timeouts.add()
 
     def note_error(self):
-        with self._lock:
-            self.errors += 1
+        self._c_errors.add()
 
     def note_batch(self, bucket, rows, warmup=False):
+        if warmup:
+            self._c_warmup_batches.add()
+            return
+        self._c_batches.add()
+        self._c_real_rows.add(rows)
+        self._c_padded_rows.add(bucket)
         with self._lock:
-            if warmup:
-                self.warmup_batches += 1
-                return
-            self.batches += 1
-            self.real_rows += rows
-            self.padded_rows += bucket
             self.bucket_hits[bucket] = self.bucket_hits.get(bucket, 0) + 1
+        self.scope.counter("bucket_hits.%d" % bucket).add()
 
     def note_completed(self, latency_ms):
+        latency_ms = float(latency_ms)
+        self._c_completed.add()
+        self._h_latency.observe(latency_ms)
         with self._lock:
-            self.completed += 1
-            self._lat[self._lat_n % self._window] = float(latency_ms)
+            self._lat[self._lat_n % self._window] = latency_ms
             self._lat_n += 1
 
     def set_queue_probe(self, fn):
         """Install a ``() -> int`` gauge for the current queue depth
         (the batcher points this at its deque)."""
         self._queue_probe = fn
+        self._g_queue.set_fn(fn)
 
     # -- snapshot -------------------------------------------------------
     @staticmethod
@@ -97,29 +126,31 @@ class ServingStats:
         with self._lock:
             n = min(self._lat_n, self._window)
             lats = sorted(self._lat[:n])
-            fill = (self.real_rows / float(self.padded_rows)
-                    if self.padded_rows else None)
-            out = {
-                "requests": self.requests,
-                "completed": self.completed,
-                "rejected": self.rejected,
-                "timeouts": self.timeouts,
-                "errors": self.errors,
-                "batches": self.batches,
-                "warmup_batches": self.warmup_batches,
-                "batch_fill": round(fill, 4) if fill is not None else None,
-                "compiles": self.compiles,
-                "compile_tracking": self.compile_tracking,
-                "bucket_hits": dict(self.bucket_hits),
-                "latency_ms": {
-                    "count": self.completed,
-                    "mean": round(sum(lats) / n, 3) if n else None,
-                    "p50": self._pct(lats, 50),
-                    "p95": self._pct(lats, 95),
-                    "p99": self._pct(lats, 99),
-                    "max": lats[-1] if lats else None,
-                },
-            }
+            bucket_hits = dict(self.bucket_hits)
+        completed = self.completed
+        real_rows, padded_rows = self.real_rows, self.padded_rows
+        fill = (real_rows / float(padded_rows)) if padded_rows else None
+        out = {
+            "requests": self.requests,
+            "completed": completed,
+            "rejected": self.rejected,
+            "timeouts": self.timeouts,
+            "errors": self.errors,
+            "batches": self.batches,
+            "warmup_batches": self.warmup_batches,
+            "batch_fill": round(fill, 4) if fill is not None else None,
+            "compiles": self.compiles,
+            "compile_tracking": self.compile_tracking,
+            "bucket_hits": bucket_hits,
+            "latency_ms": {
+                "count": completed,
+                "mean": round(sum(lats) / n, 3) if n else None,
+                "p50": self._pct(lats, 50),
+                "p95": self._pct(lats, 95),
+                "p99": self._pct(lats, 99),
+                "max": lats[-1] if lats else None,
+            },
+        }
         probe = self._queue_probe
         try:
             out["queue_depth"] = int(probe()) if probe is not None else 0

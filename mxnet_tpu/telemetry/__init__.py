@@ -1,0 +1,181 @@
+"""mxnet_tpu.telemetry — unified metrics, tracing, and step-timeline
+observability.
+
+Everything the stack measures — serving counters, pipeline host-wait,
+checkpoint durations, train-step timings, XLA retraces — records into
+ONE process-wide :class:`MetricsRegistry`, exportable as an append-only
+JSONL event log and a Prometheus ``/metrics`` endpoint; host spans
+merge into the profiler's Chrome trace; the :class:`StepTimeline`
+answers "why was step 412 slow" after the fact; the
+:class:`CompileWatch` attributes every XLA retrace to a call site and
+warns when one lands after the warmup boundary.
+
+Quick start::
+
+    from mxnet_tpu import telemetry
+
+    telemetry.enable(jsonl="run.jsonl", port=9100)  # both optional
+    mod.fit(...)                                    # emits step records
+    print(telemetry.timeline().slowest(3))          # worst steps
+    print(telemetry.registry().snapshot())          # every counter
+    telemetry.disable()
+
+The contracts (ci.sh-gated, pinned by tests/test_telemetry.py):
+
+* **zero-perturbation** — a telemetry-on ``fit`` trains to
+  bitwise-identical params (host clocks only: no readback, no RNG);
+* **disabled-mode cost** — one branch per call site
+  (``telemetry.enabled()`` / a shared no-op span);
+* **post-warmup silence** — the steady-state train loop performs zero
+  XLA retraces (``compile.post_warmup_retraces`` stays 0).
+
+Env: ``MXNET_TELEMETRY=1`` enables at import (the programmatic
+``enable()`` twin); ``MXNET_TELEMETRY_JSONL`` / ``MXNET_TELEMETRY_PORT``
+set the sink path / metrics port for that autostart.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .compile_watch import CompileWatch
+from .export import JsonlSink, MetricsServer, render_prometheus
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry, Scope,
+                       instrument_value, DEFAULT_MS_BUCKETS)
+from .timeline import StepTimeline
+from .tracing import NOOP_SPAN, Span, clear_trace, span, trace_events
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "Scope",
+    "instrument_value", "StepTimeline", "CompileWatch", "Span", "span",
+    "JsonlSink", "MetricsServer", "render_prometheus",
+    "registry", "timeline", "compile_watch", "enable", "disable",
+    "enabled", "jsonl_sink", "metrics_server", "log_event",
+    "flush_metrics",
+    "serve_metrics", "trace_events", "clear_trace",
+    "set_active_pipeline", "active_pipeline", "DEFAULT_MS_BUCKETS",
+]
+
+_REGISTRY = MetricsRegistry()
+_TIMELINE = StepTimeline()
+_WATCH = None
+_lock = threading.Lock()
+_state = {"enabled": False, "sink": None, "server": None,
+          "active_pipeline": None}
+
+
+def registry():
+    """The process-wide :class:`MetricsRegistry` every subsystem
+    records into."""
+    return _REGISTRY
+
+
+def timeline():
+    """The process-wide :class:`StepTimeline` the ``fit`` loop writes."""
+    return _TIMELINE
+
+
+def compile_watch():
+    """The process-wide :class:`CompileWatch` (created on first use)."""
+    global _WATCH
+    with _lock:
+        if _WATCH is None:
+            _WATCH = CompileWatch()
+        return _WATCH
+
+
+def enabled():
+    """Whether telemetry recording (spans, step timeline, compile
+    watch, JSONL) is on — THE one branch disabled mode costs."""
+    return _state["enabled"]
+
+
+def enable(jsonl=None, port=None):
+    """Turn telemetry recording on. ``jsonl=`` opens an append-only
+    event-log sink; ``port=`` serves the Prometheus endpoint (0 picks a
+    free port). Idempotent; reconfigures sink/server when given."""
+    with _lock:
+        _state["enabled"] = True
+        if jsonl is not None:
+            old = _state["sink"]
+            if old is not None and old.path != str(jsonl):
+                old.close()
+                old = None
+            if old is None:
+                _state["sink"] = JsonlSink(jsonl)
+        if port is not None and _state["server"] is None:
+            _state["server"] = MetricsServer(_REGISTRY, port=port)
+    return _state["server"]
+
+
+def disable():
+    """Turn recording off and release the sink/endpoint. Instruments
+    and retained timeline records stay readable."""
+    with _lock:
+        _state["enabled"] = False
+        sink, _state["sink"] = _state["sink"], None
+        server, _state["server"] = _state["server"], None
+    if sink is not None:
+        sink.close()
+    if server is not None:
+        server.close()
+
+
+def jsonl_sink():
+    """The live :class:`JsonlSink`, or None."""
+    return _state["sink"]
+
+
+def metrics_server():
+    """The live :class:`MetricsServer`, or None."""
+    return _state["server"]
+
+
+def log_event(kind, payload):
+    """Append one event line to the JSONL sink (no-op without one)."""
+    sink = _state["sink"]
+    if sink is not None:
+        sink.write(kind, payload)
+
+
+def flush_metrics(reason=""):
+    """Append a full registry snapshot to the JSONL sink as one
+    ``{"kind": "metrics"}`` line (the 'one line per flush' contract)."""
+    sink = _state["sink"]
+    if sink is not None:
+        payload = {"metrics": _REGISTRY.snapshot()}
+        if reason:
+            payload["reason"] = str(reason)
+        sink.write("metrics", payload)
+
+
+def serve_metrics(port=0):
+    """Start (or return the already-running) Prometheus endpoint."""
+    with _lock:
+        if _state["server"] is None:
+            _state["server"] = MetricsServer(_REGISTRY, port=port)
+        return _state["server"]
+
+
+def set_active_pipeline(stats):
+    """Publish the device-feed :class:`~mxnet_tpu.data.PipelineStats`
+    the CURRENT fit trains through (None to clear). ``Speedometer`` and
+    the fit epoch log read host-wait from here — the registry-backed
+    replacement for sniffing the fit loop's locals."""
+    _state["active_pipeline"] = stats
+
+
+def active_pipeline():
+    """The registered :class:`PipelineStats`, or None."""
+    return _state["active_pipeline"]
+
+
+def _autostart():
+    if os.environ.get("MXNET_TELEMETRY", "0") != "1":
+        return
+    jsonl = os.environ.get("MXNET_TELEMETRY_JSONL") or None
+    port = os.environ.get("MXNET_TELEMETRY_PORT")
+    enable(jsonl=jsonl, port=int(port) if port else None)
+
+
+_autostart()

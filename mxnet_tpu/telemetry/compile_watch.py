@@ -1,0 +1,178 @@
+"""CompileWatch — every XLA retrace counted, attributed, and (after a
+declared warmup boundary) warned about.
+
+XLA compiles are the silent killer of steady-state throughput: a stray
+shape or a fresh metric token retraces a multi-second program in the
+middle of what should be a hot loop. The serving stack already pins
+"zero compiles after warmup" by wrapping the traced eval closure
+(``Predictor._instrument``); CompileWatch generalizes that trick for
+ANY fused module: each jit trace runs the traced Python body exactly
+once, so wrapping the executor group's eval functions is an honest
+retrace counter — and since the wrapper runs INSIDE the trace, it can
+read the abstract input shapes and walk the stack for the user-code
+call site that triggered the compile.
+
+Usage::
+
+    watch = telemetry.compile_watch()      # process-wide instance
+    watch.attach(mod)                      # after bind; idempotent
+    ... warmup traffic / first epoch ...
+    watch.mark_warmup_done()
+    ... steady state: every retrace now increments
+        ``compile.post_warmup_retraces`` and logs a warning naming the
+        call site and input shapes ...
+
+``Module.fit`` does all of this automatically when telemetry is
+enabled: attach at fit start, warmup boundary after the first epoch
+(all steady shapes — including the grouped epoch tail and the eval
+pass — have compiled by then), boundary reset when fit returns.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+import traceback
+
+__all__ = ["CompileWatch"]
+
+_WRAP_ATTRS = ("_eval_fn", "_pipe_eval_fn", "_remat_eval_fn")
+
+
+def _call_site():
+    """First stack frame outside this package and jax — the user-code
+    line whose call triggered the trace."""
+    for frame in reversed(traceback.extract_stack(limit=40)):
+        fn = frame.filename.replace("\\", "/")
+        if ("/mxnet_tpu/" in fn or "/jax/" in fn
+                or "/jax_graft/" in fn):
+            continue
+        return "%s:%d" % (fn, frame.lineno)
+    return "<unknown>"
+
+
+class CompileWatch(object):
+    """Retrace monitor over fused executor groups (module docstring)."""
+
+    def __init__(self, scope=None, logger=None, max_events=256):
+        if scope is None:
+            import mxnet_tpu.telemetry as _tel
+            scope = _tel.registry().scope("compile")
+        self._c_retraces = scope.counter("retraces")
+        self._c_post_warmup = scope.counter("post_warmup_retraces")
+        self.logger = logger or logging.getLogger("mxnet_tpu.telemetry")
+        self._lock = threading.Lock()
+        self._events = collections.deque(maxlen=int(max_events))
+        self._steady = False
+        self._warned_sites = set()
+        self._tls = threading.local()   # .suppress during eval_shape
+
+    # -- attachment -----------------------------------------------------
+    def attach(self, module_or_group):
+        """Wrap the fused executor group's eval functions (idempotent —
+        re-attaching after a rebind wraps the new group's functions,
+        re-attaching the same group is a no-op). Returns True when
+        attached; False for classic per-executor groups, whose traces
+        happen at executor construction and are not observable here."""
+        grp = getattr(module_or_group, "_exec_group", module_or_group)
+        if grp is None or not getattr(grp, "fused", False):
+            return False
+        # input (data+label) positions in the eval fn's flat arg list,
+        # so retrace events report the BATCH shapes, not the params'
+        names = [d[0] for d in grp.data_shapes] + \
+            list(getattr(grp, "_label_names", []))
+        input_idx = [(n, i) for i, n in enumerate(grp.arg_names)
+                     if n in set(names)]
+        attached = False
+        for attr in _WRAP_ATTRS:
+            inner = getattr(grp, attr, None)
+            if inner is None or \
+                    getattr(inner, "_mxtpu_compile_watch", None) is self:
+                attached = attached or inner is not None
+                continue
+
+            def wrapped(*a, __inner=inner, **kw):
+                self._note(a, input_idx)
+                return __inner(*a, **kw)
+
+            wrapped._mxtpu_compile_watch = self
+            setattr(grp, attr, wrapped)
+            attached = True
+        # the group's shape-inference helper runs the eval body under
+        # jax.eval_shape — an abstract evaluation, NOT a compile.
+        # Suppress counting inside it, or every grouped-program build
+        # (whose _get_jit calls _out_structs first) would double-count
+        # and a post-warmup output_shapes query would fire a false
+        # retrace warning.
+        structs = getattr(grp, "_out_structs", None)
+        if structs is not None and \
+                getattr(structs, "_mxtpu_compile_watch", None) is not self:
+
+            def structs_wrapped(*a, __inner=structs, **kw):
+                self._tls.suppress = True
+                try:
+                    return __inner(*a, **kw)
+                finally:
+                    self._tls.suppress = False
+
+            structs_wrapped._mxtpu_compile_watch = self
+            grp._out_structs = structs_wrapped
+        return attached
+
+    def _note(self, args, input_idx):
+        if getattr(self._tls, "suppress", False):
+            return
+        vals = args[0] if args else ()
+        shapes = {}
+        for name, i in input_idx:
+            if i < len(vals):
+                shapes[name] = tuple(getattr(vals[i], "shape", ()))
+        site = _call_site()
+        self._c_retraces.add()
+        with self._lock:
+            steady = self._steady
+            if steady:
+                self._c_post_warmup.add()
+            self._events.append({
+                "time": time.time(), "site": site, "shapes": shapes,
+                "post_warmup": steady})
+            warn = steady and (site, tuple(sorted(shapes.items()))) \
+                not in self._warned_sites
+            if warn:
+                self._warned_sites.add(
+                    (site, tuple(sorted(shapes.items()))))
+        if warn:
+            self.logger.warning(
+                "XLA retrace AFTER the warmup boundary at %s with input "
+                "shapes %s — a steady-state loop should never compile; "
+                "check for shape drift, a fresh metric object, or a "
+                "missing warmup bucket", site, shapes)
+
+    # -- warmup boundary ------------------------------------------------
+    def mark_warmup_done(self):
+        """Declare the warmup boundary: retraces from here on count as
+        ``post_warmup_retraces`` and warn with their call site."""
+        with self._lock:
+            self._steady = True
+
+    def reset_warmup(self):
+        """Leave steady state (a new fit's first epoch legitimately
+        compiles new programs)."""
+        with self._lock:
+            self._steady = False
+
+    # -- reading --------------------------------------------------------
+    @property
+    def count(self):
+        return self._c_retraces.value
+
+    @property
+    def post_warmup_count(self):
+        return self._c_post_warmup.value
+
+    def events(self):
+        """The newest retrace events: ``{"time", "site", "shapes",
+        "post_warmup"}`` dicts, oldest first."""
+        with self._lock:
+            return list(self._events)

@@ -1,0 +1,475 @@
+"""mxnet_tpu.telemetry — unified metrics, tracing, and step-timeline
+observability.
+
+Pins the subsystem's hard contracts: the registry is exact under
+concurrent writers, histograms bucket like Prometheus, the JSONL and
+Prometheus exporters round-trip the registry, spans merge into the
+profiler's Chrome trace as complete (``"ph": "X"``) events with real
+thread ids, ``fit`` writes one StepTimeline record per step (per group
+with ``batch_group=K``) with ZERO numeric perturbation (bitwise-equal
+params, ci.sh-gated too), the CompileWatch attributes every XLA
+retrace and stays at 0 post-warmup for a steady loop, disabled mode is
+a no-op, and the retrofitted ServingStats/PipelineStats keep their
+exact snapshot surface while living in the shared registry.
+"""
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.io import NDArrayIter
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_clean():
+    """Every test starts disabled with a fresh timeline/trace ring and
+    leaves no sink/server/active-pipeline behind."""
+    tel.disable()
+    tel.timeline().clear()
+    tel.clear_trace()
+    yield
+    tel.disable()
+    tel.timeline().clear()
+    tel.clear_trace()
+    tel.set_active_pipeline(None)
+
+
+def _mlp():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=64, seed=1):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, 6).astype(np.float32),
+            rng.randint(0, 10, n).astype(np.float32))
+
+
+def _fit(mod_net, X, y, seed=11, **kw):
+    mx.random.seed(seed)
+    mod = mx.mod.Module(mod_net, context=[mx.cpu(0)])
+    it = NDArrayIter(X, y, batch_size=16, shuffle=False)
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Uniform(0.07), **kw)
+    return mod
+
+
+def _params_bytes(mod):
+    arg, aux = mod.get_params()
+    return [np.ascontiguousarray(arg[k].asnumpy()).tobytes()
+            for k in sorted(arg)] + \
+           [np.ascontiguousarray(aux[k].asnumpy()).tobytes()
+            for k in sorted(aux or {})]
+
+
+# ----------------------------------------------------------------------
+# MetricsRegistry
+# ----------------------------------------------------------------------
+def test_registry_concurrent_writers():
+    """Counters and histograms stay exact under racing writer threads
+    (each instrument carries its own lock)."""
+    reg = tel.MetricsRegistry()
+    shared = reg.counter("t.shared")
+    hist = reg.histogram("t.lat_ms", buckets=(1.0, 10.0))
+    n_threads, n_iter = 8, 400
+
+    def work(i):
+        mine = reg.counter("t.worker.%d" % i)
+        for k in range(n_iter):
+            shared.add()
+            mine.add(2)
+            hist.observe(float(k % 20))
+            reg.gauge("t.g").set(i)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["counters"]["t.shared"] == n_threads * n_iter
+    for i in range(n_threads):
+        assert snap["counters"]["t.worker.%d" % i] == 2 * n_iter
+    h = snap["histograms"]["t.lat_ms"]
+    assert h["count"] == n_threads * n_iter
+    assert sum(h["counts"]) == h["count"]
+
+
+def test_histogram_bucketing():
+    """Values land in the first bucket with upper bound >= v; one
+    implicit +Inf bucket catches the overflow; sum/count track."""
+    reg = tel.MetricsRegistry()
+    h = reg.histogram("h", buckets=(1.0, 5.0, 10.0))
+    for v in (0.5, 1.0, 2.0, 5.0, 7.5, 100.0, 1e6):
+        h.observe(v)
+    v = h.value
+    assert v["buckets"] == [1.0, 5.0, 10.0]
+    # <=1: {0.5, 1.0}; (1,5]: {2.0, 5.0}; (5,10]: {7.5}; +Inf: 2
+    assert v["counts"] == [2, 2, 1, 2]
+    assert v["count"] == 7 and v["sum"] == pytest.approx(1000116.0)
+
+
+def test_registry_types_and_tree():
+    reg = tel.MetricsRegistry()
+    reg.counter("a.b.c").add(3)
+    reg.gauge("a.g").set_fn(lambda: 42)
+    assert reg.tree()["a"]["b"]["c"] == 3
+    assert reg.tree()["a"]["g"] == 42
+    with pytest.raises(TypeError):
+        reg.gauge("a.b.c")  # registered as a counter
+    s0, s1 = reg.unique_scope("fam"), reg.unique_scope("fam")
+    assert s0.prefix != s1.prefix  # per-instance namespaces never clash
+    s0.counter("x").add()
+    assert s0.snapshot()["counters"]["x"] == 1
+
+
+def test_jsonl_export_roundtrip(tmp_path):
+    """flush_metrics appends ONE wall-clock-stamped line whose payload
+    round-trips the registry snapshot."""
+    path = str(tmp_path / "events.jsonl")
+    tel.enable(jsonl=path)
+    tel.registry().counter("t.jsonl_probe").add(7)
+    tel.flush_metrics("unit test")
+    tel.log_event("custom", {"k": 1})
+    tel.disable()
+    lines = [json.loads(line) for line in open(path)]
+    assert [ln["kind"] for ln in lines] == ["metrics", "custom"]
+    assert all("ts" in ln for ln in lines)
+    assert lines[0]["metrics"]["counters"]["t.jsonl_probe"] == 7
+    assert lines[0]["reason"] == "unit test"
+    assert lines[1]["k"] == 1
+
+
+def test_prometheus_render_and_endpoint():
+    """The renderer speaks Prometheus text (typed, sanitized names,
+    cumulative histogram buckets) and the stdlib endpoint serves it."""
+    import urllib.request
+    reg = tel.MetricsRegistry()
+    reg.counter("serving.0.requests").add(5)
+    reg.gauge("q.depth").set(3)
+    h = reg.histogram("lat.ms", buckets=(1.0, 10.0))
+    for v in (0.5, 2.0, 99.0):
+        h.observe(v)
+    text = tel.render_prometheus(reg)
+    assert "# TYPE mxtpu_serving_0_requests counter" in text
+    assert "mxtpu_serving_0_requests 5.0" in text
+    assert "mxtpu_q_depth 3.0" in text
+    # cumulative: le=1 -> 1, le=10 -> 2, +Inf -> 3
+    assert 'mxtpu_lat_ms_bucket{le="1.0"} 1' in text
+    assert 'mxtpu_lat_ms_bucket{le="10.0"} 2' in text
+    assert 'mxtpu_lat_ms_bucket{le="+Inf"} 3' in text
+    assert "mxtpu_lat_ms_count 3" in text
+    with tel.MetricsServer(reg, port=0) as srv:
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.read().decode() == tel.render_prometheus(reg)
+        health = srv.url.replace("/metrics", "/healthz")
+        with urllib.request.urlopen(health, timeout=10) as resp:
+            assert resp.read() == b"ok\n"
+
+
+# ----------------------------------------------------------------------
+# Span tracing + profiler merge
+# ----------------------------------------------------------------------
+def test_span_nesting_merges_into_chrome_trace(tmp_path):
+    """Nested spans from two threads land in dump_profile's Chrome
+    trace as complete events with REAL thread ids, child intervals
+    contained in their parents; profiler.Scope emits the same complete
+    encoding (the old unpaired B/E-with-tid=pid events are gone)."""
+    from mxnet_tpu import profiler as prof
+    tel.enable()
+
+    def nest(tag):
+        with tel.span("outer_%s" % tag):
+            with tel.span("inner_%s" % tag, depth=1):
+                x = sum(range(2000))
+        return x
+
+    t = threading.Thread(target=nest, args=("bg",))
+    t.start()
+    nest("fg")
+    t.join()
+
+    out = tmp_path / "trace.json"
+    prof.profiler_set_config(mode="symbolic", filename=str(out))
+    prof.profiler_set_state("run")
+    with prof.Scope("legacy_scope"):
+        pass
+    prof.profiler_set_state("stop")
+    prof.dump_profile()
+    trace = json.load(open(out))
+    events = {e["name"]: e for e in trace["traceEvents"]}
+    for name in ("outer_fg", "inner_fg", "outer_bg", "inner_bg",
+                 "legacy_scope"):
+        assert events[name]["ph"] == "X" and "dur" in events[name], \
+            events.get(name)
+    assert not any(e.get("ph") in ("B", "E")
+                   for e in trace["traceEvents"])
+    # real thread ids: the two outer spans ran on different threads
+    assert events["outer_fg"]["tid"] != events["outer_bg"]["tid"]
+    for tag in ("fg", "bg"):
+        o, i = events["outer_" + tag], events["inner_" + tag]
+        assert i["tid"] == o["tid"]
+        assert o["ts"] <= i["ts"]
+        assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+    assert events["inner_fg"]["args"] == {"depth": 1}
+
+
+# ----------------------------------------------------------------------
+# StepTimeline through fit
+# ----------------------------------------------------------------------
+def test_step_timeline_short_fit():
+    """One record per train step with the documented fields; the first
+    step (the train-program compile) carries recompile=True, steady
+    steps False; slowest() ranks by total_ms; to_jsonl round-trips."""
+    X, y = _data()
+    tel.enable()
+    _fit(_mlp(), X, y)
+    recs = tel.timeline().records()
+    assert len(recs) == 2 * (len(X) // 16)   # 2 epochs x 4 steps
+    for r in recs:
+        for f in ("step", "epoch", "nbatch", "host_wait_ms", "step_ms",
+                  "metric_cb_ms", "checkpoint_ms", "batch_group",
+                  "recompile", "total_ms", "ts"):
+            assert f in r, (f, r)
+        assert r["batch_group"] == 1
+        assert r["total_ms"] >= r["step_ms"]
+    assert [r["step"] for r in recs] == \
+        [recs[0]["step"] + i for i in range(len(recs))]
+    assert recs[0]["recompile"] is True
+    assert not any(r["recompile"] for r in recs[1:])
+    slowest = tel.timeline().slowest(3)
+    assert slowest[0]["total_ms"] == max(r["total_ms"] for r in recs)
+    # steady-state contract: warmup boundary after epoch 0, then silence
+    assert tel.compile_watch().post_warmup_count == 0
+
+
+def test_step_timeline_to_jsonl(tmp_path):
+    X, y = _data()
+    tel.enable()
+    _fit(_mlp(), X, y)
+    path = str(tmp_path / "steps.jsonl")
+    n = tel.timeline().to_jsonl(path)
+    lines = [json.loads(line) for line in open(path)]
+    assert n == len(lines) == len(tel.timeline())
+    assert all(ln["kind"] == "step" for ln in lines)
+
+
+def test_step_timeline_grouped_and_prefetch():
+    """batch_group=K: one record per GROUP with the true group size;
+    prefetch_to_device: host-wait comes from the loader's ring and the
+    active-pipeline registration clears when fit returns."""
+    X, y = _data()
+    tel.enable()
+    _fit(_mlp(), X, y, batch_group=2)
+    recs = tel.timeline().records()
+    assert len(recs) == 2 * 2          # 4 steps/epoch in groups of 2
+    assert all(r["batch_group"] == 2 for r in recs)
+    assert tel.compile_watch().post_warmup_count == 0
+
+    tel.timeline().clear()
+    _fit(_mlp(), X, y, prefetch_to_device=2)
+    recs = tel.timeline().records()
+    assert len(recs) == 2 * 4
+    assert all(r["host_wait_ms"] >= 0.0 for r in recs)
+    assert tel.active_pipeline() is None   # cleared on fit exit
+
+
+def test_fit_streams_step_jsonl(tmp_path):
+    """With a sink configured, fit writes one "step" line per step as
+    it happens (the ci.sh telemetry gate's contract) plus per-epoch
+    metrics flushes; the epoch-end callback cost lands as its own
+    "checkpoint" event (the step lines streamed before the fold) AND
+    folds into the epoch's last timeline record."""
+    X, y = _data()
+    tel.enable(jsonl=str(tmp_path / "run.jsonl"))
+    _fit(_mlp(), X, y, epoch_end_callback=lambda *a: None)
+    tel.disable()
+    lines = [json.loads(line) for line in open(tmp_path / "run.jsonl")]
+    steps = [ln for ln in lines if ln["kind"] == "step"]
+    assert len(steps) == 2 * 4
+    assert {ln["epoch"] for ln in steps} == {0, 1}
+    assert sum(1 for ln in lines if ln["kind"] == "metrics") == 2
+    ck = [ln for ln in lines if ln["kind"] == "checkpoint"]
+    assert [c["epoch"] for c in ck] == [0, 1]
+    assert all(c["checkpoint_ms"] >= 0 for c in ck)
+    last_of_epoch0 = [r for r in tel.timeline().records()
+                      if r["epoch"] == 0][-1]
+    assert last_of_epoch0["checkpoint_ms"] >= 0
+
+
+# ----------------------------------------------------------------------
+# CompileWatch
+# ----------------------------------------------------------------------
+def test_compile_watch_catches_shape_unstable_eval(caplog):
+    """A deliberately shape-unstable eval retraces; the watch counts
+    it, attributes call site + input shapes, and warns once past the
+    warmup boundary."""
+    X, y = _data()
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0)])
+    mod.bind(data_shapes=[("data", (16, 6))], for_training=False)
+    mod.init_params(initializer=mx.init.Uniform(0.07))
+    watch = tel.CompileWatch(scope=tel.MetricsRegistry().scope("compile"))
+    assert watch.attach(mod)
+    assert watch.attach(mod)   # idempotent re-attach
+
+    from mxnet_tpu.io import DataBatch
+
+    def run(rows):
+        # forward is lazy on the fused path: reading the outputs is
+        # what traces+launches the program
+        mod.forward(DataBatch([mx.nd.array(X[:rows])], None),
+                    is_train=False)
+        return mod.get_outputs()[0].asnumpy()
+
+    run(16)
+    warm = watch.count
+    assert warm >= 1
+    run(16)
+    assert watch.count == warm      # cached program: no retrace
+    watch.mark_warmup_done()
+    with caplog.at_level(logging.WARNING, "mxnet_tpu.telemetry"):
+        mod.reshape(data_shapes=[("data", (32, 6))])   # shape drift
+        run(32)
+    assert watch.count > warm
+    assert watch.post_warmup_count >= 1
+    ev = [e for e in watch.events() if e["post_warmup"]][-1]
+    assert ev["shapes"].get("data") == (32, 6)
+    assert "test_telemetry.py" in ev["site"]
+    assert any("retrace AFTER the warmup boundary" in r.getMessage()
+               for r in caplog.records)
+    # abstract shape inference (jax.eval_shape over the wrapped body)
+    # is NOT a compile: output_shapes queries must not count/warn
+    n = watch.count
+    mod._exec_group._out_structs()
+    assert watch.count == n
+
+
+# ----------------------------------------------------------------------
+# Disabled mode + zero perturbation
+# ----------------------------------------------------------------------
+def test_disabled_mode_is_noop():
+    assert not tel.enabled()
+    assert tel.span("x") is tel.NOOP_SPAN
+    with tel.span("x"):
+        pass
+    assert tel.trace_events() == []
+    tel.log_event("step", {"a": 1})        # no sink: swallowed
+    tel.flush_metrics()
+    X, y = _data()
+    _fit(_mlp(), X, y)
+    assert len(tel.timeline()) == 0        # fit recorded nothing
+
+
+def test_zero_perturbation_bitwise_params():
+    """Telemetry-on training is bitwise identical to telemetry-off
+    (host clocks only — no readback, no RNG touch)."""
+    X, y = _data()
+    ref = _params_bytes(_fit(_mlp(), X, y, seed=23))
+    tel.enable()
+    on = _params_bytes(_fit(_mlp(), X, y, seed=23))
+    tel.disable()
+    assert ref == on
+
+
+# ----------------------------------------------------------------------
+# Stats views over the shared registry (snapshot-API compatibility)
+# ----------------------------------------------------------------------
+def test_serving_stats_snapshot_compat():
+    s = mx.serving.ServingStats(latency_window=8)
+    s.note_request(3)
+    s.note_compile()
+    s.note_batch(4, 3)
+    s.note_batch(8, 5, warmup=True)
+    s.note_completed(2.0)
+    s.note_completed(4.0)
+    s.note_reject()
+    s.note_timeout()
+    s.note_error()
+    s.set_queue_probe(lambda: 6)
+    snap = s.snapshot()
+    assert set(snap) == {
+        "requests", "completed", "rejected", "timeouts", "errors",
+        "batches", "warmup_batches", "batch_fill", "compiles",
+        "compile_tracking", "bucket_hits", "latency_ms", "queue_depth"}
+    assert snap["requests"] == 3 and snap["completed"] == 2
+    assert snap["batches"] == 1 and snap["warmup_batches"] == 1
+    assert snap["batch_fill"] == 0.75 and snap["bucket_hits"] == {4: 1}
+    assert snap["compiles"] == 1 and snap["queue_depth"] == 6
+    assert snap["latency_ms"]["p50"] in (2.0, 4.0)
+    assert snap["latency_ms"]["count"] == 2
+    # ... and the same numbers are visible through the SHARED registry
+    reg_view = s.scope.snapshot()
+    assert reg_view["counters"]["requests"] == 3
+    assert reg_view["counters"]["bucket_hits.4"] == 1
+    assert reg_view["gauges"]["queue_depth"] == 6
+    assert reg_view["histograms"]["latency_ms"]["count"] == 2
+
+
+def test_pipeline_stats_snapshot_compat():
+    p = mx.data.PipelineStats(ring_depth=3)
+    p.note_staged(16, 0.002)
+    p.note_ring(2)
+    p.note_ring_full()
+    p.note_delivered(16, 0.001)
+    snap = p.snapshot()
+    assert set(snap) == {
+        "batches_delivered", "images_delivered", "host_wait_ms",
+        "host_wait_ms_per_step", "stage_ms", "stager_img_per_sec",
+        "ring_depth", "ring_occupancy", "ring_high_water",
+        "ring_full_waits"}
+    assert snap["batches_delivered"] == 1
+    assert snap["images_delivered"] == 16
+    assert snap["host_wait_ms"] == pytest.approx(1.0)
+    assert snap["ring_depth"] == 3 and snap["ring_high_water"] == 2
+    assert snap["ring_full_waits"] == 1
+    reg_view = p.scope.snapshot()
+    assert reg_view["counters"]["images_delivered"] == 16
+    p.reset()
+    assert p.snapshot()["batches_delivered"] == 0
+    assert p.snapshot()["ring_depth"] == 3    # config survives reset
+
+
+def test_loader_close_releases_registry_scope():
+    """A DeviceLoader that created its own stats retires their
+    registry scope on close (fit-per-call workloads must not grow the
+    registry unboundedly); the stats OBJECT stays readable."""
+    from mxnet_tpu.data import DeviceLoader
+    X, y = _data()
+    loader = DeviceLoader(NDArrayIter(X, y, batch_size=16), depth=2)
+    prefix = loader.pipeline_stats.scope.prefix
+    loader.next()
+    assert tel.registry().snapshot(prefix=prefix)["counters"]
+    loader.close()
+    empty = tel.registry().snapshot(prefix=prefix)
+    assert not empty["counters"] and not empty["gauges"]
+    # the detached stats object keeps answering post-mortem queries
+    assert loader.pipeline_stats.snapshot()["batches_delivered"] == 1
+
+
+def test_checkpoint_records_duration_and_bytes(tmp_path):
+    before = tel.registry().snapshot()["counters"]
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path / "ckpt"))
+    arrays = {"arg:w": np.arange(32, dtype=np.float32)}
+    mgr.save(0, arrays, optimizer_state=b"\x01" * 10, async_save=False)
+    ckpt = mgr.restore()
+    after = tel.registry().snapshot()["counters"]
+
+    def delta(name):
+        return after.get("checkpoint.%s" % name, 0) - \
+            before.get("checkpoint.%s" % name, 0)
+
+    assert delta("saves") == 1 and delta("restores") == 1
+    assert delta("bytes_written") == 32 * 4 + 10
+    assert delta("bytes_read") == 32 * 4 + 10
+    assert delta("save_ms") > 0 and delta("restore_ms") > 0
+    assert np.array_equal(ckpt.params["arg:w"], arrays["arg:w"])
