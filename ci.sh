@@ -160,6 +160,17 @@ stage "multi-chip dryrun (8 virtual devices)"
 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
     || FAILED=1
 
+stage "multi-host dryrun (4 virtual hosts, elastic resume gate)"
+# mxnet_tpu.dist contract (docs/api/dist.md): the per-host
+# slice/stage/assemble path trains BITWISE identically to a plain fit
+# with zero post-warmup retraces, and a dp=8 -> worker-loss -> dp=4
+# elastic resume is bitwise equal to a continuous dp=4 run from the
+# same committed checkpoint (params, optimizer state, num_update).
+# Emits MULTIHOST_r01.json (mesh spec, per-process shard shapes,
+# barrier/heartbeat clocks, elastic-resume transcript).
+python -c "from __graft_entry__ import dryrun_multihost; dryrun_multihost(8, 4)" \
+    || FAILED=1
+
 echo
 if [ "$FAILED" -ne 0 ]; then
     echo "CI: FAILED"

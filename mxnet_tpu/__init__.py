@@ -10,8 +10,9 @@ from __future__ import annotations
 # Multi-process bootstrap MUST precede anything that can initialize the
 # XLA backend (jax.distributed.initialize rejects a live backend), the way
 # the reference dispatches DMLC_ROLE at import (kvstore_server.py). Cheap
-# no-op unless DMLC_NUM_WORKER > 1.
-from .parallel import dist as _dist_bootstrap
+# no-op unless the env declares a multi-process job (DMLC_NUM_WORKER /
+# JAX_NUM_PROCESSES > 1).
+from . import dist as _dist_bootstrap
 _dist_bootstrap.init_from_env()
 
 # Old jax (< 0.5) keeps shard_map in jax.experimental and spells the
@@ -85,6 +86,7 @@ from . import rtc
 from . import torch
 from . import plugin
 from . import parallel
+from . import dist
 
 from .attribute import AttrScope
 from .name import NameManager

@@ -285,6 +285,22 @@ class CheckpointManager(object):
             raise MXNetError("async checkpoint save (step %d) failed"
                              % first[0]) from first[1]
 
+    def step_metadata(self, step=None):
+        """The ``extra`` metadata of a committed entry (default: the
+        latest) WITHOUT loading any arrays — how the elastic trainer
+        and the multi-host dryrun read a step's resume coordinates
+        (``epoch``/``nbatch``/``num_update``/``dp_width``) cheaply."""
+        self.wait_until_finished()   # same barrier restore() takes
+        if step is None:
+            step = self.latest()
+            if step is None:
+                return None
+        manifest_path = os.path.join(self._entry_dir(int(step)), _MANIFEST)
+        if not os.path.exists(manifest_path):
+            raise MXNetError("checkpoint step %d is not committed in %s"
+                             % (int(step), self.directory))
+        return dict(serialize.read_json(manifest_path).get("extra", {}))
+
     # ---------------------------------------------------------- restore
     def restore(self, step=None):
         """Load a committed entry (default: :meth:`latest`) as a

@@ -1,0 +1,78 @@
+"""mxnet_tpu.dist — elastic multi-host training runtime.
+
+The modern replacement for the reference's kvstore ``dist_device_sync``
+/ ps-lite layer (PAPER.md LC layer: kvstore_dist.h, tools/launch.py +
+dmlc-tracker): instead of worker/server processes pushing gradients
+through ZMQ, the job is a set of peer JAX processes running ONE global
+SPMD program over a mesh whose ``dp`` axis spans hosts — the GSPMD
+"8 chips to a pod without changing application code" pattern
+(SNIPPETS.md). Four pieces:
+
+* **bootstrap** (:func:`initialize`) — ``jax.distributed.initialize``
+  from the JAX coordination env or the reference's ``DMLC_*``
+  variables, with bounded retry/backoff on coordinator connect, a
+  rendezvous barrier with timeout, and process metadata published into
+  the telemetry registry;
+* **staging** (:mod:`~mxnet_tpu.dist.staging`,
+  :class:`ShardedDataIter`) — each process pulls its deterministic
+  slice of the batch stream (seeded by ``(seed, epoch, batch_index,
+  rank)``, never worker identity) and the executor group assembles
+  per-process local shards into the global batch with
+  ``jax.make_array_from_process_local_data``, so the existing
+  scanned/prefetched step programs run unchanged;
+* **elastic fault tolerance** (:class:`ElasticTrainer`,
+  :class:`HeartbeatMonitor`) — on a detected or injected worker loss,
+  recompute the mesh from the surviving world and resume
+  ``fit(resume_from=)`` from the last *committed* CheckpointManager
+  step at the new dp width, with ``num_update``/lr-schedule continuity
+  pinned;
+* **virtual hosts** (:class:`VirtualCluster`) — CPU CI cannot run
+  multi-process collectives, so the identical slice/stage/assemble
+  code paths are driven single-process over simulated hosts, and the
+  MULTIHOST dryrun gate (ci.sh) pins the whole story.
+
+``mxnet_tpu.parallel.dist`` remains as a thin compatibility shim over
+this package; legacy ``kvstore.create("dist_*")`` stores ride the same
+runtime.
+"""
+from __future__ import annotations
+
+# Import-light by design: this package is imported by mxnet_tpu's own
+# bootstrap hook BEFORE the jax compat shims install, so only the
+# stdlib-clean modules load eagerly; everything else resolves lazily.
+from .bootstrap import initialize, init_from_env, coordination_env
+from .runtime import DistRuntime, get_runtime, reset_runtime
+
+__all__ = [
+    "initialize", "init_from_env", "coordination_env",
+    "DistRuntime", "get_runtime", "reset_runtime",
+    "ShardedDataIter", "shard_rows", "batch_seed",
+    "VirtualCluster", "VirtualFeed",
+    "ElasticTrainer", "HeartbeatMonitor", "WorkerLost",
+    "RestartRequired", "ProcessWorld",
+    "stage_sharded", "assemble_host_slices",
+]
+
+_LAZY = {
+    "ShardedDataIter": "sharded_iter", "shard_rows": "sharded_iter",
+    "batch_seed": "sharded_iter",
+    "VirtualCluster": "virtual", "VirtualFeed": "virtual",
+    "ElasticTrainer": "elastic", "HeartbeatMonitor": "elastic",
+    "WorkerLost": "elastic", "RestartRequired": "elastic",
+    "ProcessWorld": "elastic",
+    "stage_sharded": "staging", "assemble_host_slices": "staging",
+    "staging": "staging", "virtual": "virtual", "elastic": "elastic",
+    "sharded_iter": "sharded_iter",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    import importlib
+    module = importlib.import_module("." + mod, __name__)
+    value = module if name == mod else getattr(module, name)
+    globals()[name] = value
+    return value

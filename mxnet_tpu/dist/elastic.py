@@ -1,0 +1,375 @@
+"""Elastic fault tolerance — a dead worker is a restart, not a lost job.
+
+The reference's answer to a dead worker was ps-lite's heartbeat
+tracker plus operator tears (`kvstore_dist.h` GetNumDeadNode; jobs
+usually just died). Here the durable-checkpoint subsystem already
+guarantees a committed step survives anything, so elasticity is a
+CONTROL-FLOW problem:
+
+* a :class:`HeartbeatMonitor` thread watches the coordination
+  service's liveness view (``DistRuntime.num_dead_nodes``) and flips a
+  flag the training loop observes — detection happens off the step
+  path, the *reaction* happens ON it (you cannot safely tear a live
+  SPMD program down from another thread);
+* :class:`ElasticTrainer` wraps ``Module.fit(resume_from=)``: it
+  checkpoints every K optimizer steps (the manager's atomic async
+  commits), and when a worker is lost — detected or injected — it
+  recomputes the mesh from the SURVIVING world, rebuilds the module at
+  the new dp width through the caller's factory, and re-enters ``fit``
+  from the last *committed* step. ``num_update`` (and with it every
+  lr-schedule decision), optimizer state, BN stats and the global RNG
+  all come back from the checkpoint, and ``set_epoch`` +
+  ``fit``'s mid-epoch batch skip replay the exact stream position —
+  so the resumed trajectory is BITWISE the trajectory of a fresh run
+  started from that same step at the same width (the elastic-resume
+  contract, pinned by tests/test_dist_elastic.py and the
+  MULTIHOST dryrun gate).
+
+On a real multi-process job the surviving processes cannot re-mesh a
+live XLA backend in place; :class:`ProcessWorld.shrink` therefore
+raises :class:`RestartRequired` — the launcher relaunches at the new
+world size and ``fit(resume_from=manager)`` does the rest. The
+single-process :class:`~mxnet_tpu.dist.VirtualCluster` shrinks in
+place, which is how CI exercises the whole loop.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["WorkerLost", "RestartRequired", "HeartbeatMonitor",
+           "ElasticTrainer", "ProcessWorld"]
+
+
+class WorkerLost(MXNetError):
+    """A peer died mid-training. ``dead_hosts`` carries the lost host
+    ranks when known (injected faults); heartbeat detection only knows
+    HOW MANY died, carried as ``dead_count``."""
+
+    def __init__(self, msg, dead_hosts=(), dead_count=None):
+        super().__init__(msg)
+        self.dead_hosts = tuple(dead_hosts)
+        self.dead_count = len(self.dead_hosts) if dead_count is None \
+            else int(dead_count)
+
+
+class RestartRequired(MXNetError):
+    """A real multi-process job must be relaunched at the new world
+    size (carry ``num_processes`` to the launcher)."""
+
+    def __init__(self, msg, num_processes):
+        super().__init__(msg)
+        self.num_processes = int(num_processes)
+
+
+class HeartbeatMonitor:
+    """Poll peer liveness off the step path.
+
+    A daemon thread probes ``runtime.num_dead_nodes()`` every
+    ``interval_s`` (default ``MXNET_DIST_HEARTBEAT_INTERVAL``, 5s),
+    publishes ``dist.dead_nodes`` / ``dist.heartbeat_probe_ms`` into
+    the telemetry registry, and invokes ``on_dead(count)`` once per
+    increase. ``dead_count`` is the thread-safe flag the training
+    loop's per-batch check reads.
+    """
+
+    def __init__(self, runtime=None, interval_s=None, on_dead=None):
+        if runtime is None:
+            from .runtime import get_runtime
+            runtime = get_runtime()
+        self._runtime = runtime
+        self._interval = float(
+            os.environ.get("MXNET_DIST_HEARTBEAT_INTERVAL", "5")
+            if interval_s is None else interval_s)
+        self._on_dead = on_dead
+        self._stop = threading.Event()
+        self._thread = None
+        self._dead = 0
+        self._acked = 0
+        self._lock = threading.Lock()
+
+    @property
+    def dead_count(self):
+        with self._lock:
+            return self._dead
+
+    @property
+    def unacknowledged(self):
+        """Deaths not yet acknowledged by a recovery — what the elastic
+        fault check reacts to. Without the ack, one death would re-trip
+        the check on the first batch of EVERY resumed attempt."""
+        with self._lock:
+            return self._dead - self._acked
+
+    def acknowledge(self):
+        """Mark the current death count as handled (the trainer calls
+        this after shrinking the world)."""
+        with self._lock:
+            self._acked = self._dead
+
+    def _probe_once(self):
+        from .. import telemetry
+        scope = telemetry.registry().scope("dist")
+        t0 = time.perf_counter()
+        n = self._runtime.num_dead_nodes()
+        scope.counter("heartbeat_probe_ms").add(
+            (time.perf_counter() - t0) * 1000.0)
+        scope.gauge("dead_nodes").set(n)
+        fire = False
+        with self._lock:
+            if n > self._dead:
+                self._dead = n
+                fire = True
+        if fire and self._on_dead is not None:
+            self._on_dead(n)
+        return n
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._probe_once()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                logging.getLogger(__name__).exception(
+                    "heartbeat probe failed")
+
+    def start(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="mxtpu-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2 * self._interval + 1)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ProcessWorld:
+    """The real multi-process world as an elastic-trainer target.
+
+    ``contexts()`` spans this process's local devices (each process
+    runs its own trainer copy); ``shrink`` cannot re-mesh a live
+    backend and raises :class:`RestartRequired` with the surviving
+    world size for the launcher.
+    """
+
+    def __init__(self, runtime=None):
+        if runtime is None:
+            from .runtime import get_runtime
+            runtime = get_runtime()
+        self.runtime = runtime
+
+    @property
+    def device_count(self):
+        return len(self.runtime.global_devices)
+
+    def contexts(self):
+        # Context ids are LOCAL indices in a multi-process job
+        # (Context.jax_device indexes jax.local_devices() there) — a
+        # global device id would be out of range on every rank but 0
+        from ..context import Context
+        return [Context("cpu" if d.platform == "cpu" else "tpu", i)
+                for i, d in enumerate(self.runtime.local_devices)]
+
+    def shrink(self, dead_hosts, dead_count=None):
+        dead = max(len(tuple(dead_hosts)), int(dead_count or 0))
+        survivors = self.runtime.size - dead
+        raise RestartRequired(
+            "a live multi-process backend cannot shrink in place; "
+            "relaunch with %d processes and fit(resume_from=) the same "
+            "checkpoint directory" % survivors, survivors)
+
+    def describe(self):
+        return {"n_hosts": self.runtime.size,
+                "dp_width": self.device_count,
+                "rank": self.runtime.rank}
+
+
+class ElasticTrainer:
+    """``fit`` that survives worker loss by shrinking the world.
+
+    Parameters
+    ----------
+    world : VirtualCluster or ProcessWorld
+        The current world; must provide ``contexts()``,
+        ``device_count``, ``shrink(dead_hosts)``, ``describe()``.
+    module_factory : callable
+        ``module_factory(world) -> Module`` building the (unbound)
+        module for a world — called fresh for every attempt, so the
+        mesh is always computed from the surviving devices.
+    data_factory : callable
+        ``data_factory(world) -> DataIter`` building the training
+        stream for a world (typically a
+        :meth:`VirtualCluster.feed` or a ``ShardedDataIter``).
+    manager : CheckpointManager or str
+        The durable checkpoint directory; every attempt both writes to
+        it and resumes from its latest committed step.
+    checkpoint_every_steps : int
+        Commit cadence in optimizer steps (``num_update``). The last
+        committed step bounds how much work a failure replays.
+    min_dp_width : int
+        Refuse to shrink below this many devices.
+    max_restarts : int
+        Bounded: a job losing workers faster than it can resume must
+        fail loudly, not thrash forever.
+    """
+
+    def __init__(self, world, module_factory, data_factory, manager,
+                 checkpoint_every_steps=1, save_optimizer_states=True,
+                 min_dp_width=1, max_restarts=4, logger=None):
+        from ..checkpoint import CheckpointManager
+        if isinstance(manager, str):
+            manager = CheckpointManager(manager)
+        self.world = world
+        self.module_factory = module_factory
+        self.data_factory = data_factory
+        self.manager = manager
+        self.every = max(1, int(checkpoint_every_steps))
+        self.save_optimizer_states = bool(save_optimizer_states)
+        self.min_dp_width = int(min_dp_width)
+        self.max_restarts = int(max_restarts)
+        self.logger = logger or logging.getLogger(__name__)
+        self.transcript = []
+
+    # ------------------------------------------------------ callbacks
+    def _checkpoint_callback(self, mod, world):
+        """Batch-end callback committing a durable step entry whenever
+        ``num_update`` CROSSES a ``self.every`` boundary (not only on
+        exact multiples — under ``fit(batch_group=K)`` the clock
+        advances K at a time and an exact-modulo check would silently
+        stretch the cadence to lcm(K, every)). Entries are keyed by
+        ``num_update`` (monotone across resumes) and carry the exact
+        resume coordinates."""
+        # the resumed baseline: the manager's latest entry id IS its
+        # num_update (this trainer's key scheme)
+        state = {"prev": self.manager.latest() or 0}
+
+        def _cb(param):
+            n = mod._optimizer.num_update
+            crossed = n // self.every > state["prev"] // self.every
+            state["prev"] = n
+            if not crossed:
+                return
+            mod.save_checkpoint(
+                None, n, save_optimizer_states=self.save_optimizer_states,
+                manager=self.manager,
+                extra={"epoch": param.epoch, "nbatch": param.nbatch,
+                       "num_update": n, "dp_width": world.device_count})
+        return _cb
+
+    def _fault_callback(self, fail_at_update, dead_hosts, monitor, mod):
+        """Per-batch fault check: an injected fault (``fail_at_update``)
+        or a heartbeat-detected death raises :class:`WorkerLost` ON the
+        training thread — the only place the loop can be unwound
+        safely."""
+        def _cb(param):
+            if monitor is not None and monitor.unacknowledged:
+                # heartbeats know the COUNT of deaths, not identities —
+                # the shrink maps the count onto hosts (or, real mode,
+                # onto the surviving process count)
+                raise WorkerLost(
+                    "%d peer(s) lost (heartbeat)" % monitor.dead_count,
+                    dead_hosts=dead_hosts or (),
+                    dead_count=monitor.unacknowledged)
+            if fail_at_update is not None and \
+                    mod._optimizer.num_update >= fail_at_update:
+                raise WorkerLost(
+                    "injected fault at num_update=%d"
+                    % mod._optimizer.num_update, dead_hosts=dead_hosts)
+        return _cb
+
+    # ------------------------------------------------------------ fit
+    def fit(self, train_factory_kwargs=None, num_epoch=None,
+            inject_fault=None, monitor=None, batch_end_callback=None,
+            **fit_kwargs):
+        """Train to ``num_epoch``, surviving worker loss.
+
+        ``inject_fault=(num_update, dead_hosts)`` arms the virtual-mode
+        fault: the FIRST attempt raises :class:`WorkerLost` once
+        ``num_update`` reaches the given step, then the trainer shrinks
+        the world by ``dead_hosts`` and resumes — the CI-reachable
+        version of a real death. ``monitor`` may be a started
+        :class:`HeartbeatMonitor` for real liveness. Returns the
+        trained module; ``self.transcript`` records every attempt.
+        """
+        assert num_epoch is not None, "please specify number of epochs"
+        del train_factory_kwargs
+        world = self.world
+        attempt = 0
+        fault = inject_fault
+        while True:
+            if world.device_count < self.min_dp_width:
+                raise MXNetError(
+                    "surviving world (%d devices) below min_dp_width=%d"
+                    % (world.device_count, self.min_dp_width))
+            mod = self.module_factory(world)
+            data = self.data_factory(world)
+            cbs = [self._checkpoint_callback(mod, world)]
+            if fault is not None or monitor is not None:
+                cbs.append(self._fault_callback(
+                    fault[0] if fault else None,
+                    fault[1] if fault else (), monitor, mod))
+            if batch_end_callback is not None:
+                cbs.extend(batch_end_callback if isinstance(
+                    batch_end_callback, list) else [batch_end_callback])
+            entry = {"attempt": attempt, "dp_width": world.device_count,
+                     "resume_step": self.manager.latest(),
+                     "world": world.describe()}
+            t0 = time.perf_counter()
+            try:
+                mod.fit(data, num_epoch=num_epoch,
+                        resume_from=self.manager,
+                        batch_end_callback=cbs, **fit_kwargs)
+            except WorkerLost as exc:
+                entry.update({
+                    "event": "worker_lost", "error": str(exc),
+                    "dead_hosts": list(exc.dead_hosts),
+                    "train_s": round(time.perf_counter() - t0, 3),
+                    "at_num_update": mod._optimizer.num_update,
+                })
+                self.transcript.append(entry)
+                # commit what finished writing; a failed in-flight save
+                # must not kill the recovery (its step is simply not the
+                # latest committed one)
+                try:
+                    self.manager.wait_until_finished()
+                except MXNetError:
+                    self.logger.exception(
+                        "in-flight checkpoint failed during recovery")
+                attempt += 1
+                if attempt > self.max_restarts:
+                    raise MXNetError(
+                        "gave up after %d elastic restarts" % attempt
+                    ) from exc
+                world = world.shrink(exc.dead_hosts,
+                                     dead_count=exc.dead_count)
+                fault = None  # an injected fault fires once
+                if monitor is not None:
+                    # this death is handled; only a FURTHER death may
+                    # trip the next attempt's fault check
+                    monitor.acknowledge()
+                self.logger.warning(
+                    "worker lost (%s); resuming from step %s at dp=%d",
+                    exc, self.manager.latest(), world.device_count)
+                continue
+            entry.update({
+                "event": "finished",
+                "train_s": round(time.perf_counter() - t0, 3),
+                "final_num_update": mod._optimizer.num_update,
+            })
+            self.transcript.append(entry)
+            self.world = world
+            return mod

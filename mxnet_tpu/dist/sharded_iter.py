@@ -1,0 +1,181 @@
+"""ShardedDataIter — one process's deterministic slice of the stream.
+
+The reference feeds multi-worker training by pointing every worker at
+its own record partition (``ImageRecordIter(num_parts=N, part_index=
+rank)``); synthetic / in-memory pipelines instead replicate the source
+and slice each batch. This iterator is THE slice rule for the second
+style, and the rule everything else pins against:
+
+* process r of R takes the r-th CONTIGUOUS row block of every global
+  batch — matching ``jax.devices()`` process order, so the block lands
+  exactly on the rows the process's devices own under the global dp
+  mesh and ``make_array_from_process_local_data`` assembles with zero
+  row movement;
+* any per-batch randomness (an optional ``transform(batch, rng)``
+  applied to the local slice) is seeded from ``(seed, epoch,
+  batch_index, rank)`` — NEVER from worker identity, thread timing, or
+  pull order (the ``TransformIter`` discipline, with the rank folded in
+  because each rank's augmentation stream must differ while staying a
+  pure function of its coordinates);
+* ``set_epoch(e)`` pins the epoch coordinate explicitly —
+  ``Module.fit`` calls it with the TRUE epoch index each epoch, so a
+  run resumed at epoch e replays exactly the stream the uninterrupted
+  run saw at epoch e (the elastic-resume data contract).
+
+``provide_data``/``provide_label`` report the GLOBAL batch shapes:
+the module binds (and compiles) the global program; the delivered
+batches hold only this shard's rows, flagged for the staging rule.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..io import DataBatch, DataIter
+
+__all__ = ["ShardedDataIter", "shard_rows", "batch_seed"]
+
+
+def shard_rows(arr, rank, num_shards):
+    """The r-th contiguous row block of ``arr`` — THE slice rule shared
+    by this iterator and the virtual-host feed, so the two can never
+    drift on which rows a host owns."""
+    n = arr.shape[0]
+    if n % num_shards:
+        raise MXNetError(
+            "global batch of %d rows does not divide over %d shards"
+            % (n, num_shards))
+    block = n // num_shards
+    return arr[rank * block:(rank + 1) * block]
+
+
+def batch_seed(seed, epoch, batch_index, rank):
+    """SplitMix-style fold of (seed, epoch, batch_index, rank): adjacent
+    coordinates land on unrelated streams, and the value is a pure
+    function of those coordinates only — worker identity, pull timing,
+    and world size never enter (the TransformIter seeding rule with the
+    rank folded in)."""
+    x = (seed * 0x9e3779b97f4a7c15
+         + epoch * 0xbf58476d1ce4e5b9
+         + batch_index * 0x94d049bb133111eb
+         + rank * 0xd6e8feb86659fd93) & 0xffffffffffffffff
+    x ^= x >> 31
+    return x & 0x7fffffff
+
+
+class ShardedDataIter(DataIter):
+    """Deterministic per-rank view over a global-batch ``DataIter``.
+
+    Parameters
+    ----------
+    data_iter : DataIter
+        Source yielding GLOBAL batches (every rank runs an identical
+        copy — replicated synthetic data, a shared filesystem, ...).
+    rank, num_shards : int, optional
+        This process's coordinates. Default: the live
+        :class:`~mxnet_tpu.dist.DistRuntime`'s rank/size.
+    seed : int
+        Root of the per-batch transform seeding.
+    transform : callable, optional
+        ``transform(batch_slice_dict, rng) -> batch_slice_dict`` applied
+        to this rank's rows with the deterministically seeded rng
+        (device-side augmentation hooks); ``None`` = pure slicing.
+    """
+
+    def __init__(self, data_iter, rank=None, num_shards=None, seed=0,
+                 transform=None):
+        if rank is None or num_shards is None:
+            from .runtime import get_runtime
+            rt = get_runtime()
+            rank = rt.rank if rank is None else rank
+            num_shards = rt.size if num_shards is None else num_shards
+        rank, num_shards = int(rank), int(num_shards)
+        if not 0 <= rank < num_shards:
+            raise MXNetError("rank %d outside [0, %d)" % (rank, num_shards))
+        gbs = getattr(data_iter, "batch_size", 0)
+        if gbs and gbs % num_shards:
+            raise MXNetError(
+                "global batch %d does not divide over %d shards"
+                % (gbs, num_shards))
+        super().__init__(gbs // num_shards if gbs else 0)
+        self._iter = data_iter
+        self.rank = rank
+        self.num_shards = num_shards
+        self.global_batch_size = gbs
+        self._seed = int(seed)
+        self._transform = transform
+        self._epoch = 0
+        self._nbatch = -1
+        # bind against the GLOBAL shapes: the compiled program is the
+        # global program; staging assembles local rows into it
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+
+    # ---------------------------------------------------------- epochs
+    def set_epoch(self, epoch):
+        """Pin the epoch coordinate of the seeding (fit calls this with
+        the true epoch index; resumed runs replay the right stream)."""
+        self._epoch = int(epoch)
+
+    def reset(self):
+        self._iter.reset()
+        self._epoch += 1
+        self._nbatch = -1
+
+    def skip_batches(self, n):
+        """Advance the stream position by ``n`` batches WITHOUT paying
+        the slice/transform cost (fit's mid-epoch resume fast-forward —
+        only the position matters for determinism). Returns the number
+        actually skipped (an epoch end stops early)."""
+        done = 0
+        for _ in range(int(n)):
+            try:
+                self._iter.next()
+            except StopIteration:
+                break
+            self._nbatch += 1
+            done += 1
+        return done
+
+    # ----------------------------------------------------------- pulls
+    def _slice(self, arr):
+        vals = arr._read() if hasattr(arr, "_read") else arr
+        return shard_rows(vals, self.rank, self.num_shards)
+
+    def _local_pad(self, global_pad, global_rows):
+        """Pad rows sit at the END of the global batch, so they fall in
+        the trailing shards: this rank's pad is the overlap of the
+        global pad range with its row block."""
+        if not global_pad:
+            return 0
+        block = global_rows // self.num_shards
+        lo, hi = self.rank * block, (self.rank + 1) * block
+        return max(0, hi - max(lo, global_rows - global_pad))
+
+    def next(self):
+        from .. import ndarray as nd
+        batch = self._iter.next()     # raises StopIteration at epoch end
+        self._nbatch += 1
+        rows = batch.data[0].shape[0]
+        data = [nd.NDArray(self._slice(d)) for d in batch.data]
+        label = None
+        if batch.label:
+            label = [None if lb is None else nd.NDArray(self._slice(lb))
+                     for lb in batch.label]
+        if self._transform is not None:
+            rng = onp.random.RandomState(batch_seed(
+                self._seed, self._epoch, self._nbatch, self.rank))
+            parts = self._transform(
+                {"data": [d._read() for d in data],
+                 "label": [None if lb is None else lb._read()
+                           for lb in (label or [])]}, rng)
+            data = [nd.NDArray(d) for d in parts["data"]]
+            if label is not None:
+                label = [None if lb is None else nd.NDArray(lb)
+                         for lb in parts["label"]]
+        # no staging marker needed: MeshExecutorGroup._stage recognizes
+        # a rank-local slice by its row count vs the bound global batch
+        # (dist.staging.stage_sharded's global_shape argument)
+        return DataBatch(data=data, label=label,
+                        pad=self._local_pad(batch.pad or 0, rows),
+                        index=batch.index)

@@ -1,0 +1,248 @@
+"""Virtual hosts — multi-host semantics on one process.
+
+CPU CI cannot run real multi-process collectives (XLA:CPU backend
+limitation, pinned by tests/test_dist_multiprocess.py's skip), so the
+multi-host contracts are pinned the way the MULTICHIP dryruns pin
+sharding: a :class:`VirtualCluster` partitions the local devices (the
+8-device virtual CPU mesh) into simulated hosts and drives the SAME
+code the real deployment runs —
+
+* the per-host row slice is :func:`~mxnet_tpu.dist.shard_rows`, the
+  identical rule ``ShardedDataIter`` applies per process;
+* staging places each device's piece straight from its host's slice
+  and assembles the global array from single-device shards
+  (:func:`~mxnet_tpu.dist.staging.assemble_host_slices`) — the
+  shards-to-global assembly of ``make_array_from_process_local_data``,
+  minus the processes; no host-side concat on either path;
+* the assembled batches arrive in ``Module.fit`` device-resident with
+  the executor group's own batch sharding, so fit's ``_stage`` no-ops
+  on them (the DeviceLoader discipline) and trained params are BITWISE
+  equal to a plain fit — the harness proves the multi-host feed
+  changes nothing but where the rows come from.
+
+``VirtualCluster.shrink(dead_hosts)`` is the elastic story: the
+surviving hosts' devices become the new (narrower) dp mesh, which is
+exactly what a real restart at a smaller world size computes from
+``jax.devices()``.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..io import DataBatch, DataIter
+from .sharded_iter import batch_seed, shard_rows
+
+__all__ = ["VirtualCluster", "VirtualFeed"]
+
+
+class VirtualCluster:
+    """``n_hosts`` simulated hosts over the local devices.
+
+    Hosts are contiguous equal device groups in device order (host h =
+    devices ``[h*per:(h+1)*per]``), matching how ``jax.devices()``
+    orders a real multi-process job by rank.
+    """
+
+    def __init__(self, n_hosts, devices=None):
+        if devices is None:
+            import jax
+            devices = list(jax.devices())
+        devices = list(devices)
+        n_hosts = int(n_hosts)
+        if n_hosts < 1 or len(devices) % n_hosts:
+            raise MXNetError(
+                "%d devices do not split into %d equal hosts"
+                % (len(devices), n_hosts))
+        per = len(devices) // n_hosts
+        self.hosts = [devices[h * per:(h + 1) * per]
+                      for h in range(n_hosts)]
+
+    @property
+    def n_hosts(self):
+        return len(self.hosts)
+
+    @property
+    def devices(self):
+        return [d for host in self.hosts for d in host]
+
+    @property
+    def device_count(self):
+        return sum(len(h) for h in self.hosts)
+
+    def host_of_device(self):
+        """{jax device -> host rank} for the staging assembly."""
+        return {d: h for h, host in enumerate(self.hosts) for d in host}
+
+    def contexts(self):
+        """The cluster's devices as mxnet Contexts (the ``Module``
+        ``context=`` argument) — dp width == device count."""
+        from ..context import Context
+        return [Context("cpu" if d.platform == "cpu" else "tpu", d.id)
+                for d in self.devices]
+
+    def mesh(self):
+        """Global 1-D dp mesh over the cluster (host-major order)."""
+        from ..parallel.mesh import make_mesh
+        return make_mesh({"dp": self.device_count}, self.devices)
+
+    def batch_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh(), P("dp"))
+
+    def shrink(self, dead_hosts, dead_count=None):
+        """The surviving cluster after ``dead_hosts`` (host ranks) die —
+        the mesh a real elastic restart recomputes from the surviving
+        ``jax.devices()``. Heartbeat-detected losses carry only a COUNT
+        (no identities); the simulation then retires the trailing
+        ``dead_count`` hosts."""
+        dead_hosts = tuple(dead_hosts)
+        if not dead_hosts and dead_count:
+            dead_hosts = tuple(range(self.n_hosts - int(dead_count),
+                                     self.n_hosts))
+        dead = {int(h) for h in dead_hosts}
+        unknown = dead - set(range(self.n_hosts))
+        if unknown:
+            raise MXNetError("no such host(s): %s" % sorted(unknown))
+        survivors = [host for h, host in enumerate(self.hosts)
+                     if h not in dead]
+        if not survivors:
+            raise MXNetError("cannot shrink to an empty cluster")
+        out = VirtualCluster.__new__(VirtualCluster)
+        out.hosts = survivors
+        return out
+
+    def feed(self, data_iter, module=None, seed=0, transform=None):
+        """A :class:`VirtualFeed` staging ``data_iter``'s global batches
+        through this cluster's per-host assembly."""
+        return VirtualFeed(data_iter, self, module=module, seed=seed,
+                           transform=transform)
+
+    def describe(self):
+        """JSON-friendly cluster spec (the dryrun artifact's mesh
+        block)."""
+        return {
+            "n_hosts": self.n_hosts,
+            "devices_per_host": len(self.hosts[0]),
+            "dp_width": self.device_count,
+            "hosts": [[str(d) for d in host] for host in self.hosts],
+        }
+
+
+class VirtualFeed(DataIter):
+    """Stage global batches as if ``cluster.n_hosts`` processes fed them.
+
+    Pulls a GLOBAL batch from ``data_iter``, cuts every host's
+    contiguous slice with the shared :func:`shard_rows` rule (running
+    the optional ``transform(parts, rng)`` per host with the
+    ``(seed, epoch, batch_index, host)`` seeding — the identical stream
+    a real per-process ``ShardedDataIter`` would produce), and
+    assembles the device-resident global array per input. Delivered
+    batches carry arrays already placed with the bound module's batch
+    sharding, so fit's staging no-ops.
+    """
+
+    def __init__(self, data_iter, cluster, module=None, seed=0,
+                 transform=None):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        if self.batch_size and self.batch_size % cluster.device_count:
+            raise MXNetError(
+                "global batch %d does not divide the cluster's %d devices"
+                % (self.batch_size, cluster.device_count))
+        self._iter = data_iter
+        self._cluster = cluster
+        self._module = module
+        self._seed = int(seed)
+        self._transform = transform
+        self._epoch = 0
+        self._nbatch = -1
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self._host_of = cluster.host_of_device()
+        self._sharding_cache = None
+
+    # ------------------------------------------------------- epochs
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+
+    def reset(self):
+        self._iter.reset()
+        self._epoch += 1
+        self._nbatch = -1
+
+    def skip_batches(self, n):
+        """Advance the stream by ``n`` batches WITHOUT the per-host
+        slicing/transform or any device placement (fit's mid-epoch
+        resume fast-forward). Returns the number actually skipped."""
+        done = 0
+        for _ in range(int(n)):
+            try:
+                self._iter.next()
+            except StopIteration:
+                break
+            self._nbatch += 1
+            done += 1
+        return done
+
+    # ------------------------------------------------------ staging
+    def _sharding(self):
+        """The batch sharding staged against: the bound module's own
+        (so fit's device_put no-ops bitwise), else the cluster's."""
+        if self._sharding_cache is not None:
+            return self._sharding_cache
+        grp = getattr(self._module, "_exec_group", None)
+        if grp is not None and getattr(grp, "fused", False):
+            self._sharding_cache = grp._batch_sharding
+        else:
+            self._sharding_cache = self._cluster.batch_sharding()
+        return self._sharding_cache
+
+    def _host_parts(self, batch):
+        """Per-host {data: [...], label: [...]} row slices, transformed
+        under the per-(host, batch) deterministic rng."""
+        n = self._cluster.n_hosts
+
+        def read(a):
+            return a._read() if hasattr(a, "_read") else a
+
+        parts = []
+        for h in range(n):
+            part = {
+                "data": [shard_rows(read(d), h, n) for d in batch.data],
+                "label": [None if lb is None else shard_rows(read(lb), h, n)
+                          for lb in (batch.label or [])],
+            }
+            if self._transform is not None:
+                rng = onp.random.RandomState(batch_seed(
+                    self._seed, self._epoch, self._nbatch, h))
+                part = self._transform(part, rng)
+            parts.append(part)
+        return parts
+
+    def _assemble(self, slices, like):
+        from .staging import assemble_host_slices
+        gshape = (like.shape[0] * self._cluster.n_hosts,) \
+            + tuple(like.shape[1:])
+        return assemble_host_slices(self._sharding(), gshape, slices,
+                                    self._host_of)
+
+    def next(self):
+        from .. import ndarray as nd
+        batch = self._iter.next()     # StopIteration at epoch end
+        self._nbatch += 1
+        parts = self._host_parts(batch)
+        data = []
+        for i in range(len(batch.data)):
+            slices = [p["data"][i] for p in parts]
+            data.append(nd.NDArray(self._assemble(slices, slices[0])))
+        label = None
+        if batch.label:
+            label = []
+            for i in range(len(batch.label)):
+                if batch.label[i] is None:
+                    label.append(None)
+                    continue
+                slices = [p["label"][i] for p in parts]
+                label.append(nd.NDArray(self._assemble(slices, slices[0])))
+        return DataBatch(data=data, label=label, pad=batch.pad,
+                         index=batch.index)

@@ -21,6 +21,7 @@ standard race-bisection tool, src/engine/naive_engine.cc);
 """
 from __future__ import annotations
 
+import atexit
 import os
 import queue
 import threading
@@ -52,6 +53,19 @@ class Engine:
                 self._native = eng
         except Exception:  # pragma: no cover - build env without g++
             self._native = None
+        if self._native is not None:
+            # deterministic teardown: drain and JOIN the C++ worker pool
+            # while the interpreter is still fully alive. Relying on
+            # NativeEngine.__del__ during interpreter finalization races
+            # a worker mid-callback against Python teardown and
+            # intermittently aborts the process with "terminate called
+            # without an active exception" (reproducible under CPU
+            # contention with an in-flight async checkpoint save at
+            # exit). Registered at creation: atexit is LIFO, so hooks
+            # that SCHEDULE work at exit (CheckpointManager's drain,
+            # registered later) run first, and shutdown's wait_all still
+            # drains anything they pushed.
+            atexit.register(self.shutdown)
         self._q = None
         if self._native is None:
             # the fallback has no per-var hazard tracking, so correctness
@@ -82,6 +96,22 @@ class Engine:
             finally:
                 done.set()
                 self._q.task_done()
+
+    def shutdown(self):
+        """Drain pending ops and stop the native worker pool
+        (idempotent; the interpreter-exit hook). Work pushed AFTER
+        shutdown — late ``__del__``-driven host ops during final GC —
+        degrades to synchronous execution, which is always safe."""
+        native, self._native = self._native, None
+        if native is None:
+            return
+        try:
+            native.wait_all()
+        except BaseException:  # noqa: BLE001 - exit path; job errors
+            import logging    # already surfaced via their own waiters
+            logging.getLogger(__name__).exception(
+                "pending engine op failed during shutdown drain")
+        native.close()
 
     # ------------------------------------------------------------------ API
     @property

@@ -81,7 +81,10 @@ class KVStore(object):
         # (staleness-1 delayed application; see push())
         self._pending = {}
         if kind.startswith("dist"):
-            from .parallel import dist as _dist
+            # legacy dist_* stores ride the mxnet_tpu.dist runtime (the
+            # ps-lite replacement): same coordination service, same
+            # deterministic psum collectives as the global-mesh fit path
+            from . import dist as _dist
             self._dist = _dist.get_runtime()
         else:
             self._dist = None
@@ -247,6 +250,18 @@ class KVStore(object):
 def create(name="local"):
     """Create a KVStore: local | device | dist_sync | dist_device_sync |
     dist_async (KVStore::Create, src/kvstore/kvstore.cc:17-45).
+
+    .. deprecated::
+        The ``dist_*`` types are the LEGACY multi-host surface, kept so
+        reference launch scripts (``tools/launch.py`` + ``DMLC_*`` env)
+        keep working: they now route onto the :mod:`mxnet_tpu.dist`
+        runtime (``jax.distributed`` bootstrap + global-mesh psum —
+        there are no server processes to talk to). New code should let
+        ``Module.fit`` run on the global mesh directly (see
+        docs/api/dist.md): the kvstore push/pull hop adds a host
+        round-trip per key that the fused global-mesh step does not
+        pay, and elastic resume (``mxnet_tpu.dist.ElasticTrainer``)
+        only drives the fit path.
 
     Design note on ``dist_async``: the reference's async mode lets each
     worker's update land on the parameter server unsynchronized —

@@ -123,6 +123,21 @@ class MeshExecutorGroup(object):
             if for_training and grad_req == "write" else []
 
         devices = [c.jax_device() for c in contexts]
+        # multi-host: when the job spans processes (jax.distributed up)
+        # and the bind covers all local devices with a plain dp mesh,
+        # widen the mesh to EVERY process's devices — the global SPMD
+        # program whose dp axis spans hosts (mxnet_tpu.dist; SNIPPETS.md
+        # "8 chips to a pod without changing application code"). Batch
+        # staging then assembles per-process local shards
+        # (dist.staging.stage_sharded). MXNET_DIST_GLOBAL_MESH=0 opts
+        # out (each process then trains its own replica, the degraded
+        # pre-PR-6 behavior).
+        import os as _os
+        import jax as _jax_probe
+        if (_jax_probe.process_count() > 1 and mesh_axes is None
+                and _os.environ.get("MXNET_DIST_GLOBAL_MESH", "1") != "0"
+                and set(devices) == set(_jax_probe.local_devices())):
+            devices = list(_jax_probe.devices())
         # N-axis named mesh (default: one 'dp' axis over all devices).
         # GSPMD turns per-param PartitionSpecs over these axes into sliced
         # matmuls + collectives — the TP/MP story lives entirely in the
@@ -242,8 +257,11 @@ class MeshExecutorGroup(object):
         ctx0 = contexts[0]
 
         def zeros_with(shape, sharding):
-            arr = jax.device_put(onp.zeros(shape, onp.float32), sharding)
-            return nd.NDArray(arr, ctx=ctx0)
+            # the staging rule handles the multi-host case (device_put
+            # cannot place onto another process's devices; each process
+            # allocates and contributes only its LOCAL block)
+            from ..dist.staging import stage_zeros
+            return nd.NDArray(stage_zeros(shape, sharding), ctx=ctx0)
 
         p_sh = self._param_shardings
         if shared_group is not None:
@@ -714,23 +732,40 @@ class MeshExecutorGroup(object):
 
     # ------------------------------------------------------------------
     def _stage(self, batch):
-        """Shard the host batch onto the mesh ('dp' on axis 0)."""
-        import jax
+        """Shard the host batch onto the mesh ('dp' on axis 0).
+
+        Every input rides THE staging rule
+        (:func:`mxnet_tpu.dist.staging.stage_sharded`): single-process
+        it is exactly ``jax.device_put`` (device-resident arrays from
+        the DeviceLoader / virtual-host feed pass through bitwise);
+        multi-process it assembles this process's local rows — a
+        ``ShardedDataIter`` slice, or this process's block of a
+        replicated global batch — into the global array with
+        ``make_array_from_process_local_data``, so the compiled global
+        program runs unchanged across hosts."""
+        from ..dist.staging import stage_sharded
+
+        def put(arr):
+            val = arr._read() if hasattr(arr, "_read") else arr
+            return stage_sharded(
+                val, self._batch_sharding,
+                (self.batch_size,) + tuple(val.shape[1:]))
+
         inputs = {}
         data_names = [x[0] for x in self.data_shapes]
         for name, arr in zip(data_names, batch.data):
-            inputs[name] = jax.device_put(arr._read(), self._batch_sharding)
+            inputs[name] = put(arr)
         if self.label_shapes and batch.label:
             for name, arr in zip(self._label_names, batch.label):
                 if arr is not None:
-                    inputs[name] = jax.device_put(arr._read(),
-                                                  self._batch_sharding)
+                    inputs[name] = put(arr)
+        from ..dist.staging import stage_zeros
         bs = next(iter(inputs.values())).shape[0]
         for name in self._nonparam_names:
             if name not in inputs:
-                inputs[name] = jax.device_put(
-                    onp.zeros((bs,) + tuple(self._shape_of[name][1:]),
-                              onp.float32), self._batch_sharding)
+                inputs[name] = stage_zeros(
+                    (bs,) + tuple(self._shape_of[name][1:]),
+                    self._batch_sharding)
         return inputs
 
     def _stacked_sharding(self, sharding=None):
@@ -750,21 +785,27 @@ class MeshExecutorGroup(object):
 
         The shared staging step of every K-batches-per-launch program:
         stacked scoring (``score_stacked``) and the grouped train step
-        (``step_update_grouped``) both ride it."""
-        import jax
+        (``step_update_grouped``) both ride it. Blocks route through
+        the same :func:`~mxnet_tpu.dist.staging.stage_sharded` rule as
+        per-batch staging (single-process: plain ``device_put``;
+        multi-process: per-process ``(K, B/R, ...)`` blocks assemble
+        into the global ``(K, B, ...)`` array)."""
+        from ..dist.staging import stage_sharded
         st_batch = self._stacked_sharding()
         inputs = {}
         K = None
         for name, arr in stacked_data.items():
             arr = arr._read() if isinstance(arr, nd.NDArray) else arr
             K = arr.shape[0]
-            inputs[name] = jax.device_put(arr, st_batch)
+            inputs[name] = stage_sharded(
+                arr, st_batch,
+                (K, self.batch_size) + tuple(arr.shape[2:]))
+        from ..dist.staging import stage_zeros
         bs = next(iter(inputs.values())).shape[1]
         for name in self._nonparam_names:
             if name not in inputs:
-                inputs[name] = jax.device_put(
-                    onp.zeros((K, bs) + tuple(self._shape_of[name][1:]),
-                              onp.float32), st_batch)
+                inputs[name] = stage_zeros(
+                    (K, bs) + tuple(self._shape_of[name][1:]), st_batch)
         return inputs
 
     def score_stacked(self, stacked_data):

@@ -214,7 +214,7 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
-                        manager=None, async_save=True):
+                        manager=None, async_save=True, extra=None):
         """Save symbol + params (+ optimizer states) (module.py:135-156).
 
         With ``manager=`` (a :class:`mxnet_tpu.checkpoint
@@ -224,10 +224,14 @@ class Module(BaseModule):
         mesh-sharded parameters (no full gather), symbol + epoch + RNG
         in the manifest so ``fit(resume_from=manager)`` restores
         everything. ``epoch`` becomes the step number; ``prefix`` is
-        ignored on this path and may be None."""
+        ignored on this path and may be None. ``extra=`` merges caller
+        metadata into the manifest — step-granular entries
+        (``mxnet_tpu.dist.ElasticTrainer``) record their exact resume
+        coordinates (``epoch``/``nbatch``/``num_update``) this way."""
         if manager is not None:
             return self._save_to_manager(manager, epoch,
-                                         save_optimizer_states, async_save)
+                                         save_optimizer_states, async_save,
+                                         extra)
         self._symbol.save("%s-symbol.json" % prefix)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
@@ -238,14 +242,16 @@ class Module(BaseModule):
             self.logger.info('Saved optimizer state to "%s"', state_name)
 
     def _save_to_manager(self, manager, step, save_optimizer_states,
-                         async_save):
+                         async_save, extra=None):
         arrays = self._checkpoint_arrays()
         opt_state = None
         if save_optimizer_states:
             assert self.optimizer_initialized
             opt_state = self._optimizer_state_bytes()
-        extra = {"epoch": int(step), "symbol": self._symbol.tojson()}
-        manager.save(step, arrays, optimizer_state=opt_state, extra=extra,
+        merged = {"epoch": int(step), "symbol": self._symbol.tojson()}
+        if extra:
+            merged.update(extra)
+        manager.save(step, arrays, optimizer_state=opt_state, extra=merged,
                      async_save=async_save)
         self.logger.info('Staged checkpoint step %d into "%s"%s', step,
                          manager.directory,

@@ -584,10 +584,31 @@ class Updater(object):
             self.write_state_tree(i, ns)
 
     def set_states(self, states):
-        self.states = pickle.loads(states)
+        """Restore from :meth:`get_states` bytes. The v2 envelope also
+        restores the optimizer's update clock (``num_update`` and the
+        per-index counts), so a resumed run's lr schedule and Adam bias
+        correction continue EXACTLY where the checkpointed run stopped
+        — the elastic-resume continuity contract
+        (mxnet_tpu.dist.ElasticTrainer). Legacy payloads (a bare states
+        dict) still load; the clock then restarts at
+        ``begin_num_update``, matching the old behavior."""
+        payload = pickle.loads(states)
+        if isinstance(payload, dict) and payload.get("__fmt__") == 2:
+            self.states = payload["states"]
+            opt = self.optimizer
+            opt.num_update = int(payload["num_update"])
+            opt._index_update_count = dict(payload["index_update_count"])
+        else:
+            self.states = payload
 
     def get_states(self):
-        return pickle.dumps(self.states)
+        opt = self.optimizer
+        return pickle.dumps({
+            "__fmt__": 2,
+            "states": self.states,
+            "num_update": int(opt.num_update),
+            "index_update_count": dict(opt._index_update_count),
+        })
 
 
 def get_updater(optimizer):

@@ -1,177 +1,18 @@
-"""Multi-host distributed runtime.
+"""Compatibility shim — the multi-host runtime moved to ``mxnet_tpu.dist``.
 
-Replaces ps-lite + dmlc-tracker bootstrap (kvstore_dist.h:38-43, tools/
-launch.py): processes are brought up with ``jax.distributed.initialize``
-keyed off either the JAX coordination env or the reference's ``DMLC_*``
-variables (DMLC_NUM_WORKER / DMLC_WORKER_ID / DMLC_PS_ROOT_URI/PORT), so
-reference launch scripts keep working. Cross-host reduction is an XLA psum
-over a global mesh (ICI within a slice, DCN across slices) — there are no
-server processes at all.
+This module was the original 177-line stub (bootstrap + allreduce +
+barrier + liveness); PR 6 grew it into the full elastic multi-host
+subsystem under :mod:`mxnet_tpu.dist` (bootstrap retry/backoff,
+sharded data, ``make_array_from_process_local_data`` staging, elastic
+resume, virtual-host harness). The old import surface keeps working:
+
+>>> from mxnet_tpu.parallel import dist
+>>> dist.get_runtime().rank
+
+New code should import :mod:`mxnet_tpu.dist` directly.
 """
 from __future__ import annotations
 
-import os
+from ..dist import DistRuntime, get_runtime, init_from_env  # noqa: F401
 
 __all__ = ["DistRuntime", "get_runtime", "init_from_env"]
-
-_RUNTIME = None
-
-
-class DistRuntime:
-    def __init__(self):
-        import jax
-        self._jax = jax
-        self.rank = jax.process_index() if jax.process_count() > 1 else 0
-        self.size = jax.process_count()
-        self._mesh = None
-
-    def _global_mesh(self):
-        import jax
-        from jax.sharding import Mesh
-        if self._mesh is None:
-            self._mesh = Mesh(jax.devices(), ("hosts",))
-        return self._mesh
-
-    def allreduce(self, ndarray):
-        """Sum an NDArray across all processes (== dist_sync push+pull)."""
-        return self.allreduce_async(ndarray)()
-
-    def allreduce_async(self, ndarray):
-        """Dispatch the cross-process sum and return a zero-arg thunk
-        that materializes it.
-
-        The dispatch enqueues the collective and returns immediately;
-        only the MATERIALIZATION (reading the result) blocks on the
-        slowest rank. dist_async's staleness-1 schedule exploits
-        exactly this: it materializes each reduction one push later, so
-        the intervening step's compute overlaps the collective and no
-        rank stalls in push() on a straggler's in-flight gradient."""
-        if self.size == 1:
-            return lambda: ndarray
-
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        mesh = self._global_mesh()
-        val = ndarray._read()
-        ctx = ndarray.context
-        # replicate local value onto the global mesh, psum across hosts
-        arr = jax.make_array_from_process_local_data(
-            NamedSharding(mesh, P("hosts")),
-            jnp.broadcast_to(val[None], (1,) + val.shape))
-
-        # one runtime-lifetime jit wrapper: a fresh closure per call would
-        # defeat jit's identity-keyed cache and retrace every push
-        summed = getattr(self, "_allreduce_sum_jit", None)
-        if summed is None:
-            summed = self._allreduce_sum_jit = jax.jit(
-                lambda x: jnp.sum(x, axis=0))
-        out = summed(arr)  # global array, replicated; execution async
-
-        def materialize():
-            # hand back a PROCESS-LOCAL array (the kvstore mixes it
-            # with local weights in updaters); our shard of the
-            # replicated result is the full value
-            import numpy as onp
-            local = jax.device_put(
-                onp.asarray(out.addressable_shards[0].data),
-                ctx.jax_device())
-            from ..ndarray import NDArray
-            return NDArray(local, ctx=ctx)
-
-        return materialize
-
-    @property
-    def _client(self):
-        """The JAX coordination-service client (None single-process)."""
-        from jax._src import distributed
-        return distributed.global_state.client
-
-    def barrier(self, timeout=300):
-        """Real rendezvous through the coordination service
-        (kvstore_dist.h Barrier -> scheduler; here the JAX coordination
-        server plays the scheduler role)."""
-        if self.size == 1:
-            return
-        client = self._client
-        if client is not None:
-            self._barrier_n = getattr(self, "_barrier_n", 0) + 1
-            client.wait_at_barrier("mxtpu_barrier_%d" % self._barrier_n,
-                                   int(timeout * 1000))
-        else:  # pragma: no cover - client always exists when size > 1
-            import jax
-            jax.numpy.zeros(()).block_until_ready()
-
-    def num_dead_nodes(self, timeout=60):
-        """Count peers the coordination service no longer sees as live
-        (kvstore_dist.h:159-168 GetNumDeadNode; the reference asks the
-        ps-lite scheduler, we ask the coordination server's heartbeat
-        tracker). ``timeout`` is accepted for API parity; detection
-        latency is governed by MXNET_KVSTORE_HEARTBEAT_TIMEOUT, the probe
-        itself does not block."""
-        del timeout
-        if self.size == 1:
-            return 0
-        client = self._client
-        if client is None:
-            return 0
-        try:
-            live = client.get_live_nodes(list(range(self.size)))
-        except RuntimeError:
-            # the coordination RPC failing means the coordinator (or our
-            # link to it) is gone — everyone else is unreachable from
-            # here. Other exception types (API misuse) propagate.
-            return self.size - 1
-        return self.size - len(live)
-
-
-def init_from_env():
-    """Initialize jax.distributed from DMLC_*/JAX env (launch.py contract).
-
-    MXNET_KVSTORE_HEARTBEAT_TIMEOUT (seconds) tunes how quickly dead
-    peers are detected (ps-lite PS_HEARTBEAT_TIMEOUT equivalent)."""
-    n_worker = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    if n_worker <= 1:
-        return
-    import jax
-    # elastic mode: survivors keep running when a peer dies (so
-    # get_num_dead_node can report it) instead of the coordination
-    # client's default die-together policy. Maps the reference's
-    # ps-lite elastic training knob onto jax recoverability. Set via
-    # jax.config (an env var would be ignored if jax imported first).
-    if os.environ.get("MXNET_KVSTORE_ELASTIC", "0") == "1":
-        try:
-            jax.config.update("jax_enable_recoverability", True)
-        except AttributeError:
-            # jax on the baked toolchain predates the recoverability
-            # flag; survivors then rely on the heartbeat timeout alone
-            pass
-    from jax._src import distributed as _dstate
-    # NOTE: probe the coordination client, NOT jax.process_count() — the
-    # latter initializes the XLA backend, after which initialize() is
-    # rejected
-    if _dstate.global_state.client is None:
-        coord = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
-        rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
-        hb = int(os.environ.get("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "100"))
-        kwargs = dict(
-            coordinator_address="%s:%s" % (coord, port),
-            num_processes=n_worker, process_id=rank)
-        try:
-            jax.distributed.initialize(heartbeat_timeout_seconds=hb,
-                                       **kwargs)
-        except TypeError:
-            # the kwarg binding fails before any client state is
-            # created, so retrying without the knob is safe; old jax
-            # then uses its built-in heartbeat/missed-heartbeat env
-            # defaults instead
-            jax.distributed.initialize(**kwargs)
-
-
-def get_runtime():
-    global _RUNTIME
-    if _RUNTIME is None:
-        init_from_env()
-        _RUNTIME = DistRuntime()
-    return _RUNTIME

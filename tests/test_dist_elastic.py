@@ -1,0 +1,486 @@
+"""mxnet_tpu.dist — the elastic multi-host runtime, pinned single-process.
+
+CPU CI cannot run real multi-process collectives (see
+test_dist_multiprocess's skip), so every multi-host contract is pinned
+through the virtual-host harness that drives the identical
+slice/stage/assemble code paths:
+
+* ShardedDataIter determinism: the per-rank stream is a pure function
+  of (seed, epoch, batch_index, rank) — never worker identity;
+* virtual-host staging: per-host slices assembled from single-device
+  shards are BITWISE the plain device_put batch, and a fit through the
+  feed lands on bit-identical params;
+* elastic resume: dp=8 -> injected fault -> dp=4 resume is bitwise
+  equal (params, optimizer state incl. num_update, RNG) to a
+  continuous dp=4 run from the same committed step;
+* crash-between-commit: a partially written step entry is never
+  restored.
+"""
+import glob
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import dist
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager
+
+B = 32          # global batch
+ROWS = 256      # synthetic dataset rows -> 8 steps/epoch
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    X = rng.rand(ROWS, 16).astype(np.float32)
+    y = rng.randint(0, 10, ROWS).astype(np.float32)
+    return X, y
+
+
+X_GLOBAL, Y_GLOBAL = _data()
+
+
+def _iter():
+    return mx.io.NDArrayIter(X_GLOBAL, Y_GLOBAL, batch_size=B,
+                             label_name="softmax_label")
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _module_factory(world):
+    return mx.mod.Module(_mlp(), context=world.contexts())
+
+
+def _data_factory(world):
+    return world.feed(_iter())
+
+
+def _digest(mod):
+    import hashlib
+    h = hashlib.sha256()
+    args, auxs = mod.get_params()
+    for k in sorted(args):
+        h.update(args[k].asnumpy().tobytes())
+    for k in sorted(auxs):
+        h.update(auxs[k].asnumpy().tobytes())
+    return h.hexdigest()
+
+
+FIT_KW = dict(optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+              initializer=mx.initializer.Xavier())
+
+
+# ---------------------------------------------------------------- slicing
+def test_shard_rows_rule():
+    arr = np.arange(32).reshape(8, 4)
+    parts = [dist.shard_rows(arr, r, 4) for r in range(4)]
+    np.testing.assert_array_equal(np.concatenate(parts), arr)
+    with pytest.raises(MXNetError):
+        dist.shard_rows(arr, 0, 3)   # 8 rows don't divide over 3
+
+
+def test_batch_seed_pure_and_rank_distinct():
+    a = dist.batch_seed(7, 2, 5, 1)
+    assert a == dist.batch_seed(7, 2, 5, 1)      # pure function
+    # every coordinate matters
+    assert len({a, dist.batch_seed(8, 2, 5, 1), dist.batch_seed(7, 3, 5, 1),
+                dist.batch_seed(7, 2, 6, 1),
+                dist.batch_seed(7, 2, 5, 2)}) == 5
+
+
+def test_sharded_iter_slices_and_epoch_replay():
+    ranks = [dist.ShardedDataIter(_iter(), rank=r, num_shards=4, seed=9)
+             for r in range(4)]
+    first = [it.next() for it in ranks]
+    # union of the rank slices is the global batch, in rank order
+    got = np.concatenate([b.data[0].asnumpy() for b in first])
+    np.testing.assert_array_equal(got, X_GLOBAL[:B])
+    for b in first:
+        assert b.data[0].shape == (B // 4, 16)
+        assert b.label[0].shape == (B // 4,)
+    # epoch replay: set_epoch pins the stream coordinate
+    it = dist.ShardedDataIter(_iter(), rank=2, num_shards=4, seed=9)
+    a = it.next().data[0].asnumpy()
+    it.reset()
+    it.set_epoch(0)
+    b = it.next().data[0].asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_iter_transform_seeding():
+    """The transform rng is a pure function of (seed, epoch, batch,
+    rank): same coordinates -> identical bytes, different rank ->
+    different stream; worker identity/pull order never enter."""
+    def noise(parts, rng):
+        parts["data"] = [d + rng.rand(*d.shape).astype(np.float32)
+                         for d in parts["data"]]
+        return parts
+
+    def first_batch(rank, epoch):
+        it = dist.ShardedDataIter(_iter(), rank=rank, num_shards=4,
+                                  seed=5, transform=noise)
+        it.set_epoch(epoch)
+        return it.next().data[0].asnumpy()
+
+    np.testing.assert_array_equal(first_batch(1, 3), first_batch(1, 3))
+    assert not np.array_equal(first_batch(1, 3), first_batch(2, 3))
+    assert not np.array_equal(first_batch(1, 3), first_batch(1, 4))
+
+
+def test_sharded_iter_local_pad():
+    """Pad rows sit at the END of the global batch, so they fall into
+    the trailing shards: 40 rows at global batch 32 -> the tail batch
+    carries 24 pad rows, which cover shards 1-3 entirely and shard 0
+    not at all."""
+    def tail_pad(rank):
+        it = mx.io.NDArrayIter(X_GLOBAL[:40], Y_GLOBAL[:40],
+                               batch_size=32, label_name="softmax_label")
+        sh = dist.ShardedDataIter(it, rank=rank, num_shards=4)
+        sh.next()
+        return sh.next().pad
+
+    assert tail_pad(0) == 0
+    assert tail_pad(1) == 8
+    assert tail_pad(3) == 8
+
+
+# ----------------------------------------------------------- virtual hosts
+def test_virtual_cluster_partition_and_shrink():
+    c = dist.VirtualCluster(4)
+    assert c.n_hosts == 4 and c.device_count == 8
+    assert len(c.contexts()) == 8
+    s = c.shrink((1, 3))
+    assert s.n_hosts == 2 and s.device_count == 4
+    # survivors keep their own devices, in host order
+    assert s.devices == c.hosts[0] + c.hosts[2]
+    with pytest.raises(MXNetError):
+        c.shrink((9,))
+    with pytest.raises(MXNetError):
+        c.shrink((0, 1, 2, 3))
+
+
+def test_virtual_feed_assembly_bitwise():
+    """The per-host single-device-shard assembly delivers exactly the
+    bytes a plain global device_put would — the staging path changes
+    WHERE rows come from, never what they are."""
+    import jax
+    c = dist.VirtualCluster(4)
+    feed = c.feed(_iter())
+    batch = feed.next()
+    assembled = batch.data[0]._read()
+    assert isinstance(assembled, jax.Array)
+    ref = jax.device_put(X_GLOBAL[:B], c.batch_sharding())
+    np.testing.assert_array_equal(np.asarray(assembled), np.asarray(ref))
+    assert assembled.sharding.is_equivalent_to(ref.sharding, ref.ndim)
+    np.testing.assert_array_equal(
+        np.asarray(batch.label[0]._read()), Y_GLOBAL[:B])
+
+
+def test_virtual_fit_bitwise_vs_plain():
+    """fit through the virtual-host feed == plain fit, bit for bit."""
+    def run(feed):
+        c = dist.VirtualCluster(4)
+        mod = _module_factory(c)
+        data = c.feed(_iter(), module=mod) if feed else _iter()
+        mx.random.seed(3)
+        np.random.seed(3)
+        mod.fit(data, num_epoch=2, **FIT_KW)
+        return _digest(mod)
+
+    assert run(False) == run(True)
+
+
+# ------------------------------------------------------------------ elastic
+def _run_elastic(tmp, fault_at, dead_hosts=(2, 3), every=4, epochs=3):
+    mgr = CheckpointManager(os.path.join(tmp, "ckpt"))
+    cluster = dist.VirtualCluster(4)          # 4 hosts x 2 devices, dp=8
+    mx.random.seed(3)
+    np.random.seed(3)
+    tr = dist.ElasticTrainer(cluster, _module_factory, _data_factory, mgr,
+                             checkpoint_every_steps=every)
+    mod = tr.fit(num_epoch=epochs, inject_fault=(fault_at, dead_hosts),
+                 **FIT_KW)
+    return tr, mod, mgr
+
+
+def test_elastic_resume_bitwise_dp8_to_dp4(tmp_path):
+    """THE elastic contract: kill at step S under dp=8 (virtual hosts),
+    resume at dp=4 from the last committed step; params, optimizer
+    state, and num_update are bitwise equal to a continuous dp=4 run
+    started from that same checkpoint. The fault lands BETWEEN commits
+    (step 14, cadence 4) so the resume must replay steps 13-14 from the
+    deterministic stream (mid-epoch skip)."""
+    tmp = str(tmp_path)
+    tr, mod, mgr = _run_elastic(tmp, fault_at=14)
+    lost = [e for e in tr.transcript if e["event"] == "worker_lost"]
+    done = [e for e in tr.transcript if e["event"] == "finished"]
+    assert len(lost) == 1 and len(done) == 1
+    assert lost[0]["dp_width"] == 8 and done[0]["dp_width"] == 4
+    resume_step = done[0]["resume_step"]
+    assert resume_step == 12        # last committed before the fault
+    assert mod._optimizer.num_update == 24      # 3 epochs x 8 steps
+
+    # continuous dp=4 baseline from the SAME committed entry
+    src = os.path.join(tmp, "ckpt", "step_%08d" % resume_step)
+    dst_dir = os.path.join(tmp, "baseline")
+    shutil.copytree(src, os.path.join(dst_dir, "step_%08d" % resume_step))
+    cluster4 = dist.VirtualCluster(4).shrink((2, 3))
+    mod2 = _module_factory(cluster4)
+    mx.random.seed(99)              # must NOT matter: rng comes back
+    np.random.seed(99)              # from the checkpoint
+    mod2.fit(_data_factory(cluster4), num_epoch=3,
+             resume_from=CheckpointManager(dst_dir), **FIT_KW)
+    assert _digest(mod) == _digest(mod2)
+    assert mod2._optimizer.num_update == 24     # lr-schedule continuity
+    # optimizer state (momentum) bitwise too
+    sa, sb = mod._updater.states, mod2._updater.states
+    for k in sa:
+        if sa[k] is None:
+            assert sb[k] is None
+            continue
+        np.testing.assert_array_equal(sa[k].asnumpy(), sb[k].asnumpy())
+
+
+def test_elastic_checkpoint_metadata(tmp_path):
+    tr, mod, mgr = _run_elastic(str(tmp_path), fault_at=14)
+    meta = mgr.step_metadata()      # latest entry, no array loads
+    assert meta["num_update"] == 24 and meta["dp_width"] == 4
+    meta12 = mgr.step_metadata(12)
+    assert meta12["dp_width"] == 8 and meta12["num_update"] == 12
+    assert meta12["epoch"] == 1 and meta12["nbatch"] == 3
+
+
+def test_crash_between_commit_never_restores_partial(tmp_path):
+    """A step whose write was interrupted before the atomic rename must
+    be invisible: latest()/restore()/resume all ignore the .tmp-*
+    partial and land on the previous committed step."""
+    tmp = str(tmp_path)
+    tr, mod, mgr = _run_elastic(tmp, fault_at=14, epochs=2)
+    mgr.wait_until_finished()       # commit the final async save
+    committed = mgr.all_steps()
+    # plant a crashed partial for a LATER step: half-written files, no
+    # commit rename (exactly what a kill mid-write leaves behind)
+    partial = os.path.join(tmp, "ckpt", ".tmp-step_00000099-deadbeef")
+    os.makedirs(partial)
+    with open(os.path.join(partial, "a00000_s00.npy"), "wb") as f:
+        f.write(b"\x00" * 17)       # truncated garbage
+    assert mgr.latest() == committed[-1]        # partial invisible
+    meta = mgr.step_metadata()
+    assert meta["num_update"] == committed[-1]
+    with pytest.raises(MXNetError):
+        mgr.restore(99)             # never restorable
+    # a resumed fit also lands on the committed step, not the partial
+    cluster4 = dist.VirtualCluster(4).shrink((2, 3))
+    mod2 = _module_factory(cluster4)
+    mod2.fit(_data_factory(cluster4), num_epoch=2,
+             resume_from=CheckpointManager(os.path.join(tmp, "ckpt")),
+             **FIT_KW)
+    assert mod2._optimizer.num_update == 16     # 2 epochs x 8 steps
+
+
+def test_elastic_refuses_below_min_width(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cluster = dist.VirtualCluster(4)
+    tr = dist.ElasticTrainer(cluster, _module_factory, _data_factory, mgr,
+                             checkpoint_every_steps=4, min_dp_width=6)
+    with pytest.raises(MXNetError, match="min_dp_width"):
+        tr.fit(num_epoch=2, inject_fault=(6, (2, 3)), **FIT_KW)
+
+
+# ---------------------------------------------------------------- bootstrap
+def test_coordination_env_mapping():
+    dmlc = {"DMLC_NUM_WORKER": "4", "DMLC_WORKER_ID": "2",
+            "DMLC_PS_ROOT_URI": "10.0.0.1", "DMLC_PS_ROOT_PORT": "9999"}
+    got = dist.coordination_env(dmlc)
+    assert got == {"coordinator_address": "10.0.0.1:9999",
+                   "num_processes": 4, "process_id": 2,
+                   "heartbeat_timeout": 100, "source": "dmlc"}
+    # JAX-native spelling wins over DMLC when both are set
+    both = dict(dmlc, JAX_COORDINATOR_ADDRESS="10.0.0.2:1234",
+                JAX_NUM_PROCESSES="8", JAX_PROCESS_ID="5")
+    got = dist.coordination_env(both)
+    assert got["coordinator_address"] == "10.0.0.2:1234"
+    assert got["num_processes"] == 8 and got["source"] == "jax"
+    assert dist.coordination_env({})["source"] == "none"
+
+
+def test_bootstrap_retry_backoff(monkeypatch):
+    """Coordinator connect retries with bounded exponential backoff,
+    then gives up loudly."""
+    from mxnet_tpu.dist import bootstrap
+    calls, delays = [], []
+    monkeypatch.setattr(bootstrap.time, "sleep", delays.append)
+
+    def flaky(kwargs, heartbeat):
+        calls.append(kwargs)
+        if len(calls) < 3:
+            raise RuntimeError("connect refused")
+
+    monkeypatch.setattr(bootstrap, "_connect", flaky)
+    # the client probe must say "not initialized" for attempts to run
+    import jax._src.distributed as dstate
+    monkeypatch.setattr(dstate.global_state, "client", None,
+                        raising=False)
+    # barrier is a no-op (process_count is 1 in-process)
+    rt = dist.initialize(coordinator_address="127.0.0.1:1",
+                         num_processes=2, process_id=0,
+                         connect_retries=5, connect_backoff_s=0.25)
+    assert len(calls) == 3                      # two failures, one join
+    assert delays == [0.25, 0.5]                # exponential backoff
+    assert rt.rank == 0
+
+    calls.clear()
+    delays.clear()
+
+    def dead(kwargs, heartbeat):
+        calls.append(kwargs)
+        raise RuntimeError("connect refused")
+
+    monkeypatch.setattr(bootstrap, "_connect", dead)
+    with pytest.raises(RuntimeError, match="could not join"):
+        dist.initialize(coordinator_address="127.0.0.1:1",
+                        num_processes=2, process_id=0,
+                        connect_retries=2, connect_backoff_s=0.1)
+    assert len(calls) == 3                      # 1 try + 2 retries
+
+
+def test_runtime_metadata_in_telemetry():
+    import mxnet_tpu.telemetry as tel
+    dist.get_runtime()
+    snap = tel.registry().snapshot()["gauges"]
+    assert snap["dist.world_size"] == 1 and snap["dist.rank"] == 0
+    assert snap["dist.global_device_count"] == 8
+
+
+# ---------------------------------------------------------------- heartbeat
+class _FakeRuntime:
+    def __init__(self):
+        self.dead = 0
+
+    def num_dead_nodes(self, timeout=60):
+        return self.dead
+
+
+def test_heartbeat_monitor_fires_once_per_increase():
+    rt = _FakeRuntime()
+    seen = []
+    mon = dist.HeartbeatMonitor(runtime=rt, interval_s=3600,
+                                on_dead=seen.append)
+    assert mon._probe_once() == 0 and seen == []
+    rt.dead = 2
+    assert mon._probe_once() == 2 and seen == [2]
+    assert mon._probe_once() == 2 and seen == [2]      # no re-fire
+    rt.dead = 3
+    mon._probe_once()
+    assert seen == [2, 3]
+    assert mon.dead_count == 3
+    with mon:          # start/stop lifecycle joins the thread
+        pass
+    import mxnet_tpu.telemetry as tel
+    assert tel.registry().snapshot()["gauges"]["dist.dead_nodes"] == 3
+
+
+def test_elastic_recovers_from_heartbeat_detection(tmp_path):
+    """A heartbeat-DETECTED death (no injected fault) must be survivable:
+    the trainer acknowledges the death after shrinking, so the resumed
+    attempt does not re-trip on the same stale count and trains to
+    completion."""
+    rt = _FakeRuntime()
+    mon = dist.HeartbeatMonitor(runtime=rt, interval_s=3600)
+
+    fired = []
+
+    def flip_dead(param):
+        # simulate the monitor thread observing two deaths mid-epoch 0
+        if not fired and param.nbatch == 2:
+            rt.dead = 2
+            mon._probe_once()
+            fired.append(True)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cluster = dist.VirtualCluster(4)
+    mx.random.seed(3)
+    np.random.seed(3)
+    tr = dist.ElasticTrainer(cluster, _module_factory, _data_factory, mgr,
+                             checkpoint_every_steps=2)
+    mod = tr.fit(num_epoch=2, monitor=mon, batch_end_callback=[flip_dead],
+                 **FIT_KW)
+    events = [e["event"] for e in tr.transcript]
+    assert events == ["worker_lost", "finished"]
+    # heartbeats carry only a COUNT: the virtual cluster retires the
+    # trailing 2 hosts -> the resumed attempt runs at dp=4
+    assert tr.transcript[1]["dp_width"] == 4
+    assert mod._optimizer.num_update == 16      # completed both epochs
+    assert mon.unacknowledged == 0
+
+
+def test_elastic_checkpoint_cadence_under_batch_group(tmp_path):
+    """The commit cadence is a boundary-CROSSING rule: with
+    fit(batch_group=3) the update clock advances 3 per callback, so an
+    exact-modulo every=4 would only commit at multiples of 12; the
+    crossing rule commits at 6, 9, 12, ... (every 4-boundary crossed)."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cluster = dist.VirtualCluster(4)
+    mx.random.seed(3)
+    np.random.seed(3)
+    tr = dist.ElasticTrainer(cluster, _module_factory, _data_factory, mgr,
+                             checkpoint_every_steps=4)
+    tr.fit(num_epoch=1, batch_group=3, **FIT_KW)
+    mgr.wait_until_finished()
+    steps = mgr.all_steps()
+    assert steps, "no checkpoints committed under batch_group"
+    # 8 steps/epoch in groups of 3 -> num_update hits 3, 6, 8 (tail);
+    # 4-boundaries crossed at 6 and 8
+    assert steps == [6, 8], steps
+
+
+# ------------------------------------------------------------------ kvstore
+def test_kvstore_dist_routes_onto_new_runtime():
+    kv = mx.kv.create("dist_sync")
+    assert isinstance(kv._dist, dist.DistRuntime)
+    assert kv.rank == 0 and kv.num_workers == 1    # single-process degrade
+    kv.init(3, mx.nd.ones((2, 2)))
+    kv.push(3, mx.nd.ones((2, 2)) * 4)
+    out = mx.nd.zeros((2, 2))
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), np.full((2, 2), 4.0))
+    assert kv.get_num_dead_node(-1) == 0
+
+
+# ----------------------------------------------------------- updater states
+def test_updater_states_carry_num_update():
+    """The v2 state envelope restores the optimizer's update clock, so
+    lr schedules continue exactly across resume; legacy (bare dict)
+    payloads still load."""
+    import pickle
+    from mxnet_tpu import optimizer as opt
+    o = opt.SGD(momentum=0.9, learning_rate=0.1)
+    upd = opt.get_updater(o)
+    w = mx.nd.ones((4,))
+    for _ in range(5):
+        upd(0, mx.nd.ones((4,)) * 0.1, w)
+    assert o.num_update == 5
+    blob = upd.get_states()
+
+    o2 = opt.SGD(momentum=0.9, learning_rate=0.1)
+    upd2 = opt.get_updater(o2)
+    upd2.set_states(blob)
+    assert o2.num_update == 5
+    assert o2._index_update_count == {0: 5}
+    np.testing.assert_array_equal(upd2.states[0].asnumpy(),
+                                  upd.states[0].asnumpy())
+
+    # legacy payload: a bare states dict
+    o3 = opt.SGD(momentum=0.9)
+    upd3 = opt.get_updater(o3)
+    upd3.set_states(pickle.dumps({0: None}))
+    assert upd3.states == {0: None} and o3.num_update == 0

@@ -70,6 +70,10 @@ CASES = [
     ("image-classification/serve_cifar10.py",
      ["--num-epochs", "1", "--clients", "4", "--requests", "8",
       "--max-batch-size", "16"]),
+    # provisions its own 8-device virtual CPU platform (it is a
+    # multi-host demo; the harness's 1-device env is overridden inside)
+    ("distributed-training/elastic_virtual_hosts.py",
+     ["--num-epochs", "3"]),
 ]
 
 

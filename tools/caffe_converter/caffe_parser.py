@@ -17,8 +17,10 @@ _GEN = os.path.join(_HERE, "_gen")
 
 def _pb2():
     """Compile caffe_subset.proto once and import the generated module.
-    Falls back to an already-generated module when protoc is unavailable
-    (checkout mtimes are arbitrary; a stale-looking module still works)."""
+    Without a system protoc (or with a stale-looking checkout — mtimes
+    are arbitrary), the runtime-built descriptor classes
+    (caffe_subset_runtime.build_pb2, pure ``google.protobuf``) serve
+    the identical surface, so the converter has NO system dependency."""
     import shutil
     mod_path = os.path.join(_GEN, "caffe_subset_pb2.py")
     proto = os.path.join(_HERE, "caffe_subset.proto")
@@ -30,10 +32,11 @@ def _pb2():
             subprocess.run(
                 ["protoc", "--proto_path", _HERE, "--python_out", _GEN,
                  proto], check=True)
-        elif not os.path.exists(mod_path):
-            raise RuntimeError(
-                "protoc not found and no pre-generated caffe_subset_pb2 "
-                "module exists — install protoc to use the converter")
+        else:
+            if _HERE not in sys.path:
+                sys.path.insert(0, _HERE)
+            import caffe_subset_runtime
+            return caffe_subset_runtime.build_pb2()
     if _GEN not in sys.path:
         sys.path.insert(0, _GEN)
     import caffe_subset_pb2

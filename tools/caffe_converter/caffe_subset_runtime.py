@@ -1,0 +1,235 @@
+"""Runtime-built protobuf classes for caffe_subset.proto — no protoc.
+
+The converter environments this repo actually runs in (CI containers,
+TPU hosts) frequently lack a system ``protoc``; the python
+``google.protobuf`` package is always present (jax depends on it).
+This module builds the SAME message classes ``protoc --python_out``
+would generate for ``caffe_subset.proto`` by constructing the
+``FileDescriptorProto`` programmatically and asking the runtime
+message factory for classes — wire-compatible with upstream Caffe
+because field numbers, labels, types, defaults and packing below are
+transcribed 1:1 from ``caffe_subset.proto`` (which remains the source
+of truth; keep the two in sync when extending the subset).
+
+``caffe_parser._pb2`` prefers a real protoc when one exists (belt and
+braces: the generated module also pins descriptor-format skew) and
+falls back here.
+"""
+from __future__ import annotations
+
+_PKG = "caffe_subset"
+
+# (name, number, label, type, extra) — extra: default / packed /
+# message or enum type name. Labels: O=optional, R=repeated.
+_O, _R = "O", "R"
+
+_MESSAGES = {
+    "BlobShape": [
+        ("dim", 1, _R, "int64", {"packed": True}),
+    ],
+    "BlobProto": [
+        ("shape", 7, _O, "msg:BlobShape", {}),
+        ("data", 5, _R, "float", {"packed": True}),
+        ("double_data", 8, _R, "double", {"packed": True}),
+        ("num", 1, _O, "int32", {"default": "0"}),
+        ("channels", 2, _O, "int32", {"default": "0"}),
+        ("height", 3, _O, "int32", {"default": "0"}),
+        ("width", 4, _O, "int32", {"default": "0"}),
+    ],
+    "NetParameter": [
+        ("name", 1, _O, "string", {}),
+        ("input", 3, _R, "string", {}),
+        ("input_shape", 8, _R, "msg:BlobShape", {}),
+        ("input_dim", 4, _R, "int32", {}),
+        ("layer", 100, _R, "msg:LayerParameter", {}),
+    ],
+    "LayerParameter": [
+        ("name", 1, _O, "string", {}),
+        ("type", 2, _O, "string", {}),
+        ("bottom", 3, _R, "string", {}),
+        ("top", 4, _R, "string", {}),
+        ("phase", 10, _O, "enum:Phase", {}),
+        ("loss_weight", 5, _R, "float", {}),
+        ("blobs", 7, _R, "msg:BlobProto", {}),
+        ("batch_norm_param", 139, _O, "msg:BatchNormParameter", {}),
+        ("concat_param", 104, _O, "msg:ConcatParameter", {}),
+        ("convolution_param", 106, _O, "msg:ConvolutionParameter", {}),
+        ("dropout_param", 108, _O, "msg:DropoutParameter", {}),
+        ("eltwise_param", 110, _O, "msg:EltwiseParameter", {}),
+        ("flatten_param", 135, _O, "msg:FlattenParameter", {}),
+        ("inner_product_param", 117, _O, "msg:InnerProductParameter", {}),
+        ("input_param", 143, _O, "msg:InputParameter", {}),
+        ("lrn_param", 118, _O, "msg:LRNParameter", {}),
+        ("pooling_param", 121, _O, "msg:PoolingParameter", {}),
+        ("reshape_param", 133, _O, "msg:ReshapeParameter", {}),
+        ("scale_param", 142, _O, "msg:ScaleParameter", {}),
+        ("softmax_param", 125, _O, "msg:SoftmaxParameter", {}),
+    ],
+    "ReshapeParameter": [
+        ("shape", 1, _O, "msg:BlobShape", {}),
+        ("axis", 2, _O, "int32", {"default": "0"}),
+        ("num_axes", 3, _O, "int32", {"default": "-1"}),
+    ],
+    "ConcatParameter": [
+        ("axis", 2, _O, "int32", {"default": "1"}),
+        ("concat_dim", 1, _O, "uint32", {"default": "1"}),
+    ],
+    "BatchNormParameter": [
+        ("use_global_stats", 1, _O, "bool", {}),
+        ("moving_average_fraction", 2, _O, "float",
+         {"default": "0.999"}),
+        ("eps", 3, _O, "float", {"default": "1e-5"}),
+    ],
+    "ConvolutionParameter": [
+        ("num_output", 1, _O, "uint32", {}),
+        ("bias_term", 2, _O, "bool", {"default": "true"}),
+        ("pad", 3, _R, "uint32", {}),
+        ("kernel_size", 4, _R, "uint32", {}),
+        ("stride", 6, _R, "uint32", {}),
+        ("dilation", 18, _R, "uint32", {}),
+        ("pad_h", 9, _O, "uint32", {"default": "0"}),
+        ("pad_w", 10, _O, "uint32", {"default": "0"}),
+        ("kernel_h", 11, _O, "uint32", {}),
+        ("kernel_w", 12, _O, "uint32", {}),
+        ("stride_h", 13, _O, "uint32", {}),
+        ("stride_w", 14, _O, "uint32", {}),
+        ("group", 5, _O, "uint32", {"default": "1"}),
+    ],
+    "DropoutParameter": [
+        ("dropout_ratio", 1, _O, "float", {"default": "0.5"}),
+    ],
+    "EltwiseParameter": [
+        ("operation", 1, _O, "enum:EltwiseParameter.EltwiseOp",
+         {"default": "SUM"}),
+        ("coeff", 2, _R, "float", {}),
+    ],
+    "FlattenParameter": [
+        ("axis", 1, _O, "int32", {"default": "1"}),
+        ("end_axis", 2, _O, "int32", {"default": "-1"}),
+    ],
+    "InnerProductParameter": [
+        ("num_output", 1, _O, "uint32", {}),
+        ("bias_term", 2, _O, "bool", {"default": "true"}),
+        ("axis", 5, _O, "int32", {"default": "1"}),
+        ("transpose", 6, _O, "bool", {"default": "false"}),
+    ],
+    "InputParameter": [
+        ("shape", 1, _R, "msg:BlobShape", {}),
+    ],
+    "LRNParameter": [
+        ("local_size", 1, _O, "uint32", {"default": "5"}),
+        ("alpha", 2, _O, "float", {"default": "1"}),
+        ("beta", 3, _O, "float", {"default": "0.75"}),
+        ("k", 5, _O, "float", {"default": "1"}),
+    ],
+    "PoolingParameter": [
+        ("pool", 1, _O, "enum:PoolingParameter.PoolMethod",
+         {"default": "MAX"}),
+        ("pad", 4, _O, "uint32", {"default": "0"}),
+        ("pad_h", 9, _O, "uint32", {"default": "0"}),
+        ("pad_w", 10, _O, "uint32", {"default": "0"}),
+        ("kernel_size", 2, _O, "uint32", {}),
+        ("kernel_h", 5, _O, "uint32", {}),
+        ("kernel_w", 6, _O, "uint32", {}),
+        ("stride", 3, _O, "uint32", {"default": "1"}),
+        ("stride_h", 7, _O, "uint32", {}),
+        ("stride_w", 8, _O, "uint32", {}),
+        ("global_pooling", 12, _O, "bool", {"default": "false"}),
+    ],
+    "ScaleParameter": [
+        ("axis", 1, _O, "int32", {"default": "1"}),
+        ("num_axes", 2, _O, "int32", {"default": "1"}),
+        ("bias_term", 4, _O, "bool", {"default": "false"}),
+    ],
+    "SoftmaxParameter": [
+        ("axis", 2, _O, "int32", {"default": "1"}),
+    ],
+}
+
+# top-level and nested enums: owner None = file level
+_ENUMS = [
+    (None, "Phase", [("TRAIN", 0), ("TEST", 1)]),
+    ("EltwiseParameter", "EltwiseOp",
+     [("PROD", 0), ("SUM", 1), ("MAX", 2)]),
+    ("PoolingParameter", "PoolMethod",
+     [("MAX", 0), ("AVE", 1), ("STOCHASTIC", 2)]),
+]
+
+_SCALAR = {
+    "double": 1, "float": 2, "int64": 3, "int32": 5, "bool": 8,
+    "string": 9, "uint32": 13,
+}
+
+
+def _build_file_proto():
+    from google.protobuf import descriptor_pb2 as dp
+    fp = dp.FileDescriptorProto()
+    fp.name = "caffe_subset_runtime.proto"
+    fp.package = _PKG
+    fp.syntax = "proto2"
+    for owner, ename, values in _ENUMS:
+        if owner is None:
+            ed = fp.enum_type.add()
+            ed.name = ename
+            for vname, num in values:
+                v = ed.value.add()
+                v.name, v.number = vname, num
+    for mname, fields in _MESSAGES.items():
+        md = fp.message_type.add()
+        md.name = mname
+        for owner, ename, values in _ENUMS:
+            if owner == mname:
+                ed = md.enum_type.add()
+                ed.name = ename
+                for vname, num in values:
+                    v = ed.value.add()
+                    v.name, v.number = vname, num
+        for fname, num, label, ftype, extra in fields:
+            fd = md.field.add()
+            fd.name, fd.number = fname, num
+            fd.label = (dp.FieldDescriptorProto.LABEL_REPEATED
+                        if label == _R
+                        else dp.FieldDescriptorProto.LABEL_OPTIONAL)
+            if ftype.startswith("msg:"):
+                fd.type = dp.FieldDescriptorProto.TYPE_MESSAGE
+                fd.type_name = ".%s.%s" % (_PKG, ftype[4:])
+            elif ftype.startswith("enum:"):
+                fd.type = dp.FieldDescriptorProto.TYPE_ENUM
+                fd.type_name = ".%s.%s" % (_PKG, ftype[5:])
+            else:
+                fd.type = _SCALAR[ftype]
+            if "default" in extra:
+                fd.default_value = extra["default"]
+            if extra.get("packed"):
+                fd.options.packed = True
+    return fp
+
+
+class _Namespace(object):
+    """Duck-types the generated ``caffe_subset_pb2`` module surface."""
+
+
+_CACHE = None
+
+
+def build_pb2():
+    """The pb2-module equivalent (message classes + Phase constants)."""
+    global _CACHE
+    if _CACHE is not None:
+        return _CACHE
+    from google.protobuf import descriptor_pool, message_factory
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(_build_file_proto())
+    ns = _Namespace()
+    for mname in _MESSAGES:
+        desc = pool.FindMessageTypeByName("%s.%s" % (_PKG, mname))
+        try:
+            cls = message_factory.GetMessageClass(desc)
+        except AttributeError:   # older protobuf spelling
+            cls = message_factory.MessageFactory(pool).GetPrototype(desc)
+        setattr(ns, mname, cls)
+    phase = pool.FindEnumTypeByName("%s.Phase" % _PKG)
+    for v in phase.values:       # pb2 convention: TRAIN/TEST at module level
+        setattr(ns, v.name, v.number)
+    _CACHE = ns
+    return ns
