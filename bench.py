@@ -36,20 +36,13 @@ import time
 BASELINE_IMG_S = 181.53  # P100, reference perf.md
 FLOPS_PER_IMG_TRAIN = 3.8e9 * 3
 
-# per-chip peaks by device kind substring: (bf16 TFLOP/s, HBM GB/s)
-_PEAKS = [("v6", 918.0, 1640.0), ("trillium", 918.0, 1640.0),
-          ("v5p", 459.0, 2765.0),
-          ("v5e", 197.0, 819.0), ("v5 lite", 197.0, 819.0),
-          ("v5lite", 197.0, 819.0),
-          ("v4", 275.0, 1228.0), ("v3", 123.0, 900.0), ("v2", 45.0, 700.0)]
-
-
 def _peaks(device_kind, n_dev):
-    kind = device_kind.lower()
-    for sub, tf, bw in _PEAKS:
-        if sub in kind:
-            return tf * n_dev, bw * n_dev
-    return None, None
+    """n_dev-scaled (peak TFLOP/s, peak HBM GB/s). The per-chip table
+    lives in mxnet_tpu.telemetry.introspect (ONE copy shared with the
+    live roofline gauges, so bench and the gauges agree on peaks)."""
+    from mxnet_tpu.telemetry.introspect import device_peaks
+    tf, bw = device_peaks(device_kind)
+    return (tf * n_dev if tf else None, bw * n_dev if bw else None)
 
 
 class _DedupeLogFilter(object):
@@ -995,6 +988,7 @@ def _xla_cost(mod, fused, sec_per_step, peak_bw, n_dev):
         return out
     try:
         import numpy as np
+        from mxnet_tpu.telemetry.introspect import analyze_compiled
         eg = mod._exec_group
         upd_fl = upd_by = 0.0
         if getattr(eg, "_last_step", None) is None:
@@ -1007,10 +1001,12 @@ def _xla_cost(mod, fused, sec_per_step, peak_bw, n_dev):
         comp = compiled_step(eg)
         if comp is None:
             return out
-        ca = comp.cost_analysis()
-        ca = ca[0] if isinstance(ca, list) else ca
-        fl = float(ca.get("flops", 0.0)) * n_dev
-        by = float(ca.get("bytes accessed", 0.0)) * n_dev
+        # ONE shared extraction rule (telemetry.introspect) — the live
+        # roofline gauges and these offline fields read the same
+        # numbers, so the two can never drift (ci.sh introspection gate)
+        ca = analyze_compiled(comp)
+        fl = ca["flops"] * n_dev
+        by = ca["bytes_accessed"] * n_dev
         out["xla_flops_per_step_tf"] = round((fl + upd_fl) / 1e12, 3)
         out["xla_bytes_per_step_gb"] = round((by + upd_by) / 1e9, 3)
         if sec_per_step > 0:

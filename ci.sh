@@ -146,6 +146,52 @@ print("telemetry gate: bit-identical params (sha256 %s...), %d step "
 PY
 rm -rf "$PF_TMP"
 
+stage "introspection gate (program report + live roofline + bitwise params)"
+# program-introspection contract (docs/api/telemetry.md "Program
+# introspection"): a 2-epoch fit with the inventory + live roofline
+# live must (a) train to BIT-IDENTICAL params vs telemetry-off, (b)
+# emit a program report with nonzero XLA flops/bytes for the step AND
+# optimizer programs, (c) publish mfu/bound_by/achieved_hbm_gbps
+# gauges and stamp post-warmup step JSONL lines with the roofline
+# fields — with zero post-warmup retraces (asserted in-script).
+IN_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 2 --batch-size 128 --seed 7 \
+    --params-digest-out "$IN_TMP/digest_plain.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 2 --batch-size 128 --seed 7 \
+    --program-report "$IN_TMP/programs.json" \
+    --telemetry-jsonl "$IN_TMP/steps.jsonl" \
+    --params-digest-out "$IN_TMP/digest_introspect.txt" || FAILED=1
+python - "$IN_TMP/digest_plain.txt" "$IN_TMP/digest_introspect.txt" \
+    "$IN_TMP/programs.json" "$IN_TMP/steps.jsonl" <<'PY' || FAILED=1
+import json, sys
+a, b = (open(p).read().strip() for p in sys.argv[1:3])
+assert a and a == b, \
+    "introspection-on params digest %s != plain %s" % (b, a)
+rep = json.load(open(sys.argv[3]))
+kinds = {}
+for p in rep["programs"]:
+    if p.get("flops") and p.get("bytes_accessed"):
+        kinds.setdefault(p["kind"], []).append(p["name"])
+assert "train_step" in kinds, "no analyzed train_step: %r" % kinds
+assert "optimizer_update" in kinds, "no optimizer account: %r" % kinds
+steps = [json.loads(l) for l in open(sys.argv[4])
+         if json.loads(l).get("kind") == "step"]
+post = [s for s in steps if s["epoch"] >= 1]
+assert post and all("mfu" in s and "bound_by" in s
+                    and "achieved_hbm_gbps" in s for s in post), \
+    "post-warmup step lines lack roofline fields"
+print("introspection gate: bit-identical params (sha256 %s...), "
+      "%d programs (%s), %d post-warmup steps with live roofline "
+      "(bound_by=%s)" % (a[:16], rep["n_programs"],
+                         ",".join(sorted(kinds)), len(post),
+                         post[-1]["bound_by"]))
+PY
+rm -rf "$IN_TMP"
+
 stage "serving smoke gate (Predictor parity + frozen compiles under traffic)"
 # online-serving contract (docs/api/serving.md): train 1 epoch, stand up
 # an in-process Predictor + DynamicBatcher, fire concurrent mixed-size

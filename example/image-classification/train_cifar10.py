@@ -182,6 +182,17 @@ def main():
                              "Prometheus /metrics endpoint on this "
                              "port for the run's lifetime (0 picks a "
                              "free port)")
+    parser.add_argument("--program-report", default=None,
+                        help="enable telemetry's program introspection "
+                             "and write the compiled-program inventory "
+                             "(XLA FLOPs/bytes per program, argument/"
+                             "donation audit) as JSON after training; "
+                             "asserts in-process that the step AND "
+                             "optimizer programs report nonzero "
+                             "flops/bytes and (for multi-epoch runs) "
+                             "that the live mfu/bound_by roofline "
+                             "gauges were published (the CI "
+                             "introspection gate)")
     parser.add_argument("--serve-smoke", action="store_true",
                         help="after training, serve the model through "
                              "an in-process mxnet_tpu.serving stack "
@@ -192,7 +203,8 @@ def main():
                              "serving gate)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
-    telemetry_on = args.telemetry_jsonl or args.telemetry_port is not None
+    telemetry_on = (args.telemetry_jsonl or args.telemetry_port is not None
+                    or args.program_report)
     if telemetry_on:
         server = mx.telemetry.enable(jsonl=args.telemetry_jsonl,
                                      port=args.telemetry_port)
@@ -268,6 +280,29 @@ def main():
         logging.info("telemetry: %d step records; slowest: %r",
                      len(tl), tl.slowest(1))
         mx.telemetry.flush_metrics("train_cifar10 end")
+    if args.program_report:
+        report = mx.telemetry.dump_programs(args.program_report)
+        by_kind = {}
+        for prog in report["programs"]:
+            if prog.get("flops") and prog.get("bytes_accessed"):
+                by_kind.setdefault(prog["kind"], []).append(prog["name"])
+        assert any(k in by_kind for k in ("train_step",
+                                          "train_step_grouped")), (
+            "program report lacks an analyzed train-step program "
+            "with nonzero flops/bytes: %r" % (by_kind,))
+        assert "optimizer_update" in by_kind, (
+            "program report lacks the optimizer-update account: %r"
+            % (by_kind,))
+        gauges = mx.telemetry.registry().snapshot()["gauges"]
+        if args.num_epochs > 1:
+            # the live roofline resolves at the warmup boundary (end of
+            # the first epoch) — any later epoch must have published it
+            for g in ("train.mfu", "train.achieved_hbm_gbps",
+                      "train.bound_by"):
+                assert g in gauges, "roofline gauge %s missing: %r" \
+                    % (g, sorted(gauges))
+        logging.info("program report: %d programs -> %s",
+                     report["n_programs"], args.program_report)
     trained = mod._optimizer is not None and mod._optimizer.num_update > 0
     if args.batch_group and args.batch_group > 1 and trained:
         # the CI equivalence gate must FAIL, not trivially pass, if the

@@ -78,10 +78,11 @@ def evaluator_footprint(net, args, segmented):
 
     comp = jax.jit(jax.grad(loss)).lower(
         [vals[i] for i in p_idx]).compile()
-    ca = comp.cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    return (int(comp.memory_analysis().temp_size_in_bytes),
-            float(ca.get("flops", 0.0)))
+    # shared extraction rule: telemetry.introspect.analyze_compiled
+    # (same fields the live program inventory publishes)
+    from mxnet_tpu.telemetry.introspect import analyze_compiled
+    a = analyze_compiled(comp)
+    return int(a.get("temp_bytes", 0)), a["flops"]
 
 
 def module_step_footprint(net, args, remat, ctx):
@@ -104,10 +105,9 @@ def module_step_footprint(net, args, remat, ctx):
     eg = mod._exec_group
     fn, structs = eg._last_step
     comp = fn.lower(*structs).compile()
-    ca = comp.cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    return (int(comp.memory_analysis().temp_size_in_bytes),
-            float(ca.get("flops", 0.0)))
+    from mxnet_tpu.telemetry.introspect import analyze_compiled
+    a = analyze_compiled(comp)
+    return int(a.get("temp_bytes", 0)), a["flops"]
 
 
 def main():

@@ -229,7 +229,8 @@ class ElasticTrainer:
 
     def __init__(self, world, module_factory, data_factory, manager,
                  checkpoint_every_steps=1, save_optimizer_states=True,
-                 min_dp_width=1, max_restarts=4, logger=None):
+                 min_dp_width=1, max_restarts=4, logger=None,
+                 flight_recorder=None):
         from ..checkpoint import CheckpointManager
         if isinstance(manager, str):
             manager = CheckpointManager(manager)
@@ -243,6 +244,17 @@ class ElasticTrainer:
         self.max_restarts = int(max_restarts)
         self.logger = logger or logging.getLogger(__name__)
         self.transcript = []
+        # crash black box: every restart leaves a committed postmortem
+        # (tmp+rename, like checkpoint commits) next to the checkpoints,
+        # and the transcript records each dump's path. Pass your own
+        # armed recorder to direct dumps elsewhere.
+        if flight_recorder is None:
+            from .. import telemetry
+            flight_recorder = telemetry.flight_recorder()
+        self.recorder = flight_recorder
+        if not self.recorder.armed:
+            self.recorder.arm(os.path.join(self.manager.directory,
+                                           "blackbox"))
 
     # ------------------------------------------------------ callbacks
     def _checkpoint_callback(self, mod, world):
@@ -310,11 +322,35 @@ class ElasticTrainer:
         world = self.world
         attempt = 0
         fault = inject_fault
+        # SIGTERM / unhandled-exception postmortems while elastic
+        # training is live. Only uninstall what WE installed: when the
+        # hooks are already live (MXNET_TELEMETRY_BLACKBOX autostart),
+        # tearing them down here would silently disarm the env-armed
+        # black box for the rest of the process.
+        installed_here = not self.recorder.installed
+        if installed_here:
+            self.recorder.install()
+        try:
+            return self._fit_attempts(world, attempt, fault, num_epoch,
+                                      monitor, batch_end_callback,
+                                      fit_kwargs)
+        finally:
+            if installed_here:
+                self.recorder.uninstall()
+
+    def _fit_attempts(self, world, attempt, fault, num_epoch, monitor,
+                      batch_end_callback, fit_kwargs):
         while True:
             if world.device_count < self.min_dp_width:
                 raise MXNetError(
                     "surviving world (%d devices) below min_dp_width=%d"
                     % (world.device_count, self.min_dp_width))
+            self.recorder.set_state(attempt=attempt,
+                                    dp_width=world.device_count,
+                                    world=world.describe(),
+                                    resume_step=self.manager.latest())
+            self.recorder.note("elastic_attempt", attempt=attempt,
+                               dp_width=world.device_count)
             mod = self.module_factory(world)
             data = self.data_factory(world)
             cbs = [self._checkpoint_callback(mod, world)]
@@ -328,6 +364,9 @@ class ElasticTrainer:
             entry = {"attempt": attempt, "dp_width": world.device_count,
                      "resume_step": self.manager.latest(),
                      "world": world.describe()}
+            # a stale dump from an earlier attempt must not be
+            # mistaken for this attempt's fault postmortem
+            self.recorder.pop_last_dump()
             t0 = time.perf_counter()
             try:
                 mod.fit(data, num_epoch=num_epoch,
@@ -340,6 +379,18 @@ class ElasticTrainer:
                     "train_s": round(time.perf_counter() - t0, 3),
                     "at_num_update": mod._optimizer.num_update,
                 })
+                # the fit loop's except path already committed a
+                # postmortem for this fault (the recorder is armed);
+                # record its path — or dump here for raw loops that
+                # bypassed fit's hook
+                self.recorder.note("worker_lost", error=str(exc),
+                                   at_num_update=entry["at_num_update"])
+                try:
+                    entry["postmortem"] = self.recorder.pop_last_dump() \
+                        or self.recorder.dump("worker_lost: %s" % exc)
+                except Exception:  # noqa: BLE001 - recovery must proceed
+                    self.logger.exception("flight-recorder dump failed")
+                    entry["postmortem"] = None
                 self.transcript.append(entry)
                 # commit what finished writing; a failed in-flight save
                 # must not kill the recovery (its step is simply not the

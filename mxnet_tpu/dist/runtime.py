@@ -201,6 +201,14 @@ def _install_runtime(rt):
     return rt
 
 
+def active_runtime():
+    """The installed runtime singleton, or None — a NON-bootstrapping
+    peek (unlike :func:`get_runtime`). The telemetry exporters read
+    rank/world metadata through this so tagging an export line can
+    never initialize jax.distributed as a side effect."""
+    return _RUNTIME
+
+
 def reset_runtime():
     """Drop the cached runtime (tests / shutdown-restart cycles). Does
     NOT tear down jax.distributed — the coordination client outlives

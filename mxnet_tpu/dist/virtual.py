@@ -160,6 +160,11 @@ class VirtualFeed(DataIter):
         self.provide_label = data_iter.provide_label
         self._host_of = cluster.host_of_device()
         self._sharding_cache = None
+        # per-host feed clocks -> the straggler gauge: cumulative
+        # slice+transform wall time per simulated host, the virtual
+        # analog of per-rank step/host-wait clocks on a real pod
+        self._host_ms = [0.0] * cluster.n_hosts
+        self._straggler_gauge = None
 
     # ------------------------------------------------------- epochs
     def set_epoch(self, epoch):
@@ -199,7 +204,11 @@ class VirtualFeed(DataIter):
 
     def _host_parts(self, batch):
         """Per-host {data: [...], label: [...]} row slices, transformed
-        under the per-(host, batch) deterministic rng."""
+        under the per-(host, batch) deterministic rng. Each host's
+        slice+transform wall time folds into its cumulative feed clock
+        and the ``dist.straggler_ratio`` gauge
+        (:meth:`_publish_straggler`)."""
+        import time
         n = self._cluster.n_hosts
 
         def read(a):
@@ -207,6 +216,7 @@ class VirtualFeed(DataIter):
 
         parts = []
         for h in range(n):
+            t0 = time.perf_counter()
             part = {
                 "data": [shard_rows(read(d), h, n) for d in batch.data],
                 "label": [None if lb is None else shard_rows(read(lb), h, n)
@@ -216,8 +226,34 @@ class VirtualFeed(DataIter):
                 rng = onp.random.RandomState(batch_seed(
                     self._seed, self._epoch, self._nbatch, h))
                 part = self._transform(part, rng)
+            self._host_ms[h] += (time.perf_counter() - t0) * 1000.0
             parts.append(part)
+        self._publish_straggler()
         return parts
+
+    def host_clocks_ms(self):
+        """Cumulative per-host feed clocks (the dryrun report's
+        straggler block)."""
+        return list(self._host_ms)
+
+    def straggler_ratio(self):
+        """max/mean of the cumulative per-host feed clocks: 1.0 means
+        perfectly balanced hosts; >> 1 names a straggler. The same
+        shape of signal a real pod derives from per-rank step/host-wait
+        clocks (docs/api/dist.md)."""
+        mean = sum(self._host_ms) / max(len(self._host_ms), 1)
+        if mean <= 0.0:
+            return 1.0
+        return max(self._host_ms) / mean
+
+    def _publish_straggler(self):
+        """Fold the per-host clocks into the ``dist.straggler_ratio``
+        telemetry gauge — asserted by the MULTIHOST dryrun gate."""
+        from .. import telemetry
+        if self._straggler_gauge is None:
+            self._straggler_gauge = telemetry.registry().gauge(
+                "dist.straggler_ratio")
+        self._straggler_gauge.set(round(self.straggler_ratio(), 4))
 
     def _assemble(self, slices, like):
         from .staging import assemble_host_slices

@@ -157,6 +157,12 @@ class MeshExecutorGroup(object):
         self._repl = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P("dp"))
         self._platform = devices[0].platform
+        self._device_kind = getattr(devices[0], "device_kind",
+                                    self._platform)
+        # program-introspection identity: this group's programs publish
+        # into the process ProgramInventory under "<owner>.<kind>"
+        # (serving overrides the owner per bucket before warmup)
+        self._inventory_owner = "mod%d" % next(_STEP_TOKENS)
 
         # per-param NamedSharding from first-match rules
         # (parallel.tensor_parallel.shard_params_for_tp rule format)
@@ -347,6 +353,10 @@ class MeshExecutorGroup(object):
             if len(s) >= 1 and s[0] == self.batch_size else self._repl
             for s in out_shapes)
         self._jits = {}  # shardings changed; recompile
+        # introspection bookkeeping resets with the jits: stale aval
+        # skeletons from the previous bind must not be re-analyzed
+        self._program_notes = set()
+        self._program_names = {}
 
     def _out_structs(self):
         import jax
@@ -669,6 +679,112 @@ class MeshExecutorGroup(object):
         self._jits[key] = fn
         return fn
 
+    # -- program introspection -----------------------------------------
+    def _note_program(self, kind, fn, args, extra=None):
+        """Register this program with the process ProgramInventory
+        (telemetry.introspect) — once per jit kind per (re)bind.
+        Stores the call's aval skeleton so the inventory can later
+        re-acquire the ``Compiled`` through the jit trace cache
+        (analysis is lazy, off the step path, and runs under
+        CompileWatch suppression). Cost here: one set lookup per call,
+        one tree_map on the first."""
+        if kind in self._program_notes:
+            return
+        self._program_notes.add(kind)
+        try:
+            from .. import telemetry
+            avals = telemetry.aval_skeleton(args)
+            base = kind.split(":")[0]
+            meta = {"batch_size": self.batch_size,
+                    "mesh_axes": dict(self.mesh_axes)}
+            if extra:
+                meta.update(extra)
+            self._program_names[base] = telemetry.inventory().register(
+                "%s.%s" % (self._inventory_owner, base),
+                fn=fn, args_avals=avals, kind=base,
+                n_dev=int(self.mesh.devices.size),
+                device_kind=self._device_kind, meta=meta)
+        except Exception:  # noqa: BLE001 - introspection never breaks a step
+            pass
+
+    def _note_optimizer_analytic(self, states, triples):
+        """Register the optimizer-update traffic the FUSED train step
+        folds in, as an analytic inventory entry (the separate-program
+        accounting bench.py applies when ``_last_step`` is None): read
+        w/g + write w on f32 plus a read+write of every state leaf —
+        5 * 4 * n_params for sgd-momentum."""
+        if "optimizer_update" in self._program_notes:
+            return
+        self._program_notes.add("optimizer_update")
+        try:
+            from .. import telemetry
+
+            def leaves(t):
+                if t is None:
+                    return 0
+                if isinstance(t, (tuple, list)):
+                    return sum(leaves(s) for s in t)
+                return int(onp.prod(t.shape)) if hasattr(t, "shape") else 0
+
+            n_par = sum(int(onp.prod(self._param_dict[n].shape))
+                        for _k, n in triples)
+            n_state = sum(leaves(s) for s in states)
+            self._program_names["optimizer_update"] = \
+                telemetry.inventory().register(
+                    "%s.optimizer_update" % self._inventory_owner,
+                    kind="optimizer_update",
+                    flops=4.0 * n_par,
+                    bytes_accessed=4.0 * (3 * n_par + 2 * n_state),
+                    device_kind=self._device_kind,
+                    meta={"fused_into": "%s.train_step"
+                          % self._inventory_owner,
+                          "n_params": n_par, "n_state": n_state})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def program_basis(self, base_kinds):
+        """Analyzed per-STEP (flops, bytes) + n_dev-scaled peaks for
+        the first of ``base_kinds`` this group has registered, or None.
+        Grouped programs divide by their ``batch_group`` so the basis
+        is always one optimizer step's worth; callers re-scale by the
+        record's true group size."""
+        from .. import telemetry
+        inv = telemetry.inventory()
+        for base in base_kinds:
+            name = self._program_names.get(base)
+            if name is None:
+                continue
+            a = inv.analyze(name)
+            if not a or a.get("error") or not a.get("flops"):
+                continue
+            k = max(int(a.get("meta", {}).get("batch_group", 1)), 1)
+            pt, pb = telemetry.device_peaks(self._device_kind)
+            n_dev = max(int(a.get("n_dev", 1)), 1)
+            return {"program": name, "kind": base,
+                    "flops_per_step": a["flops"] / k,
+                    "bytes_per_step": a["bytes_accessed"] / k,
+                    "peak_tflops": pt * n_dev if pt else None,
+                    "peak_hbm_gbps": pb * n_dev if pb else None}
+        return None
+
+    def roofline_basis(self):
+        """FLOPs/bytes basis for the fit loop's live roofline gauges:
+        the analyzed one-program train step (grouped when the fit runs
+        grouped — already per-step, see :meth:`program_basis`); when
+        only the plain fwd+bwd program exists (optimizer updating as
+        its own program), the optimizer traffic is added analytically,
+        exactly as bench.py's offline ``_xla_cost`` accounts it."""
+        basis = self.program_basis(("train_step_grouped", "train_step"))
+        if basis is not None:
+            return basis
+        basis = self.program_basis(("fwd_bwd",))
+        if basis is not None:
+            n_par = sum(int(onp.prod(self._param_dict[n].shape))
+                        for n in self._grad_names)
+            basis["flops_per_step"] += 4.0 * n_par
+            basis["bytes_per_step"] += 5.0 * 4 * n_par
+        return basis
+
     # ------------------------------------------------------------------
     def set_params(self, arg_params, aux_params):
         # device_put straight from the source buffer (host OR device):
@@ -821,6 +937,8 @@ class MeshExecutorGroup(object):
         aux = {n: b._read() for n, b in self._aux_dict.items()}
         rng = _random.next_key() if self._needs_rng else \
             onp.zeros((2,), onp.uint32)
+        self._note_program("fwd_eval_stacked", fn,
+                           (params, aux, inputs, rng))
         return fn(params, aux, inputs, rng)
 
     def forward(self, data_batch, is_train=None):
@@ -852,6 +970,8 @@ class MeshExecutorGroup(object):
         # snapshot pre-forward aux so a later backward() re-runs from the
         # same moving statistics (no double BN-EMA update)
         self._last_aux = aux
+        self._note_program("fwd_train" if is_train else "fwd_eval", fn,
+                           (params, aux, inputs, rng))
         outs, new_aux = fn(params, aux, inputs, rng)
         self._write_outs(outs)
         if is_train:
@@ -886,6 +1006,7 @@ class MeshExecutorGroup(object):
             else {n: b._read() for n, b in self._aux_dict.items()}
         if out_grads is None:
             fn = self._get_jit("fwd_bwd")
+            self._note_program("fwd_bwd", fn, (params, aux, inputs, rng))
             outs, new_aux, grads = fn(params, aux, inputs, rng)
         else:
             import jax
@@ -986,9 +1107,10 @@ class MeshExecutorGroup(object):
             args = args + (self._metric_acc,)
         # aval skeleton for diagnostics (bench cost analysis) — the real
         # buffers are donated below and unusable afterwards
-        self._last_step = (fn, jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
-            if hasattr(a, "shape") else a, args))
+        from ..telemetry import aval_skeleton
+        self._last_step = (fn, aval_skeleton(args))
+        self._note_program(kind, fn, args)
+        self._note_optimizer_analytic(states, triples)
         if self._metric_stat is not None:
             (outs, new_aux, grads, new_params, new_states,
              self._metric_acc) = fn(*args)
@@ -1087,10 +1209,16 @@ class MeshExecutorGroup(object):
                     jax.device_put(onp.zeros(self._metric_slots,
                                              onp.int32), self._repl))
             args = args + (self._metric_acc,)
+            self._note_program(kind, fn, args,
+                               extra={"batch_group": K})
+            self._note_optimizer_analytic(states, triples)
             (outs, new_aux, grads, new_params, new_states,
              self._metric_acc) = fn(*args)
             self._metric_step_done = True
         else:
+            self._note_program(kind, fn, args,
+                               extra={"batch_group": K})
+            self._note_optimizer_analytic(states, triples)
             outs, new_aux, grads, new_params, new_states = fn(*args)
         self._write_outs(outs)
         self._write_aux(new_aux)
@@ -1229,6 +1357,8 @@ class MeshExecutorGroup(object):
             inputs = self._stage(batch)
             rng = _random.next_key() if self._needs_rng else \
                 onp.zeros((2,), onp.uint32)
+            self._note_program("fwd_eval_stat:m%d" % token, fn,
+                               (params, aux, inputs, rng, acc))
             acc = fn(params, aux, inputs, rng, acc)
             seen = nbatch + 1
         eval_metric.reset()

@@ -576,6 +576,20 @@ class Updater(object):
 
             self._fused_fns[key] = jax.jit(
                 step, donate_argnums=(0, 2) if donate else ())
+            # register the separate optimizer-update program with the
+            # process ProgramInventory (telemetry.introspect) — the
+            # fused Module path folds this into train_step instead
+            try:
+                from . import telemetry
+                avals = telemetry.aval_skeleton((ws, gs, ss, lrs, wds))
+                telemetry.inventory().register(
+                    "updater%d.optimizer_update" % id(self),
+                    fn=self._fused_fns[key], args_avals=avals,
+                    kind="optimizer_update", device_kind=str(dev),
+                    meta={"optimizer": type(opt).__name__,
+                          "n_tensors": len(ws)})
+            except Exception:  # noqa: BLE001 - introspection is optional
+                pass
 
         new_ws, new_ss = self._fused_fns[key](ws, gs, ss, lrs, wds)
 

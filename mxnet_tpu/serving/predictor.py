@@ -166,9 +166,16 @@ class Predictor:
                    shared_module=base)
             self._modules[b] = m
         self._base = base
-        for m in self._modules.values():
+        for b, m in self._modules.items():
             self._instrument(m)
+            grp = m._exec_group
+            if getattr(grp, "fused", False):
+                # name this bucket's programs in the process
+                # ProgramInventory (telemetry.introspect): the eval
+                # program registers at warmup as "serving.b<k>.fwd_eval"
+                grp._inventory_owner = "serving.b%d" % b
         self._warmed = False
+        self._roofline = {}   # bucket -> analyzed basis (set by warmup)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -303,7 +310,40 @@ class Predictor:
                          for name, shape in self._data_descs}
                 self._run_bucket(b, zeros, b, warmup=True)
             self._warmed = True
+            self._resolve_roofline()
         return self.stats()
+
+    def _resolve_roofline(self):
+        """Per-bucket FLOPs/bytes from the program inventory
+        (telemetry.introspect), resolved HERE in warmup — the analysis
+        pass lowers through the jit trace cache and must never run on
+        the request path. ``_run_bucket`` then publishes live
+        ``serving.<i>.b<bucket>.mfu`` / ``achieved_hbm_gbps`` /
+        ``bound_by`` gauges from pure host arithmetic — one triple PER
+        BUCKET, so mixed-size traffic stays attributable on a scrape
+        (a shared gauge would be last-launch-wins). Skipped (gauges
+        absent) when telemetry is disabled."""
+        from .. import telemetry
+        if not telemetry.enabled():
+            return
+        scope = self._stats.scope
+        self._roofline_gauges = {}
+        for b, m in self._modules.items():
+            basis_fn = getattr(m._exec_group, "program_basis", None)
+            if basis_fn is None:
+                continue
+            try:
+                basis = basis_fn(("fwd_eval",))
+            except Exception:  # noqa: BLE001 - diagnostics only
+                basis = None
+            if basis:
+                self._roofline[b] = basis
+                self._roofline_gauges[b] = {
+                    "mfu": scope.gauge("b%d.mfu" % b),
+                    "achieved_hbm_gbps": scope.gauge(
+                        "b%d.achieved_hbm_gbps" % b),
+                    "bound_by": scope.gauge("b%d.bound_by" % b),
+                }
 
     def predict(self, data):
         """Serve one request synchronously (no batching): pad to the
@@ -351,8 +391,23 @@ class Predictor:
             data=[nd.NDArray(pad_batch_rows(arrays[name], bucket))
                   for name, _ in self._data_descs],
             label=None, pad=bucket - rows)
+        basis = self._roofline.get(bucket) if not warmup else None
+        t0 = time.perf_counter() if basis else 0.0
         with telemetry.span("serving.launch", bucket=bucket, rows=rows):
             mod.forward(batch, is_train=False)
             outs = [o.asnumpy()[:rows] for o in mod.get_outputs()]
+        if basis:
+            # live serving roofline: the bucket program's analyzed
+            # FLOPs/bytes over this launch's wall clock (dispatch +
+            # readback — the honest served rate). Host arithmetic only.
+            r = telemetry.roofline(
+                basis["flops_per_step"], basis["bytes_per_step"],
+                time.perf_counter() - t0,
+                basis["peak_tflops"], basis["peak_hbm_gbps"])
+            gauges = self._roofline_gauges[bucket]
+            gauges["mfu"].set(round(r["mfu"], 6))
+            gauges["achieved_hbm_gbps"].set(
+                round(r["achieved_hbm_gbps"], 3))
+            gauges["bound_by"].set(r["bound_by_code"])
         self._stats.note_batch(bucket, rows, warmup=warmup)
         return outs

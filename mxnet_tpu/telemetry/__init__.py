@@ -40,6 +40,9 @@ import threading
 
 from .compile_watch import CompileWatch
 from .export import JsonlSink, MetricsServer, render_prometheus
+from .flight import FlightRecorder
+from .introspect import (ProgramInventory, analyze_compiled, aval_skeleton,
+                         device_peaks, roofline, BOUND_BY_CODES)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, Scope,
                        instrument_value, DEFAULT_MS_BUCKETS)
 from .timeline import StepTimeline
@@ -49,7 +52,10 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Scope",
     "instrument_value", "StepTimeline", "CompileWatch", "Span", "span",
     "JsonlSink", "MetricsServer", "render_prometheus",
-    "registry", "timeline", "compile_watch", "enable", "disable",
+    "ProgramInventory", "FlightRecorder", "analyze_compiled",
+    "aval_skeleton", "device_peaks", "roofline", "BOUND_BY_CODES",
+    "registry", "timeline", "compile_watch", "inventory",
+    "flight_recorder", "dump_programs", "enable", "disable",
     "enabled", "jsonl_sink", "metrics_server", "log_event",
     "flush_metrics",
     "serve_metrics", "trace_events", "clear_trace",
@@ -59,6 +65,8 @@ __all__ = [
 _REGISTRY = MetricsRegistry()
 _TIMELINE = StepTimeline()
 _WATCH = None
+_INVENTORY = None
+_FLIGHT = None
 _lock = threading.Lock()
 _state = {"enabled": False, "sink": None, "server": None,
           "active_pipeline": None}
@@ -82,6 +90,34 @@ def compile_watch():
         if _WATCH is None:
             _WATCH = CompileWatch()
         return _WATCH
+
+
+def inventory():
+    """The process-wide :class:`ProgramInventory` every compiled
+    program registers into (created on first use)."""
+    global _INVENTORY
+    with _lock:
+        if _INVENTORY is None:
+            _INVENTORY = ProgramInventory(registry=_REGISTRY)
+        return _INVENTORY
+
+
+def dump_programs(path=None):
+    """Analyze + dump the program inventory (see
+    :meth:`ProgramInventory.dump_programs`)."""
+    return inventory().dump_programs(path)
+
+
+def flight_recorder():
+    """The process-wide :class:`FlightRecorder` (created on first use;
+    unarmed — and therefore silent — until :meth:`FlightRecorder.arm`,
+    an :class:`~mxnet_tpu.dist.ElasticTrainer`, or
+    ``MXNET_TELEMETRY_BLACKBOX`` points it at a directory)."""
+    global _FLIGHT
+    with _lock:
+        if _FLIGHT is None:
+            _FLIGHT = FlightRecorder()
+        return _FLIGHT
 
 
 def enabled():
@@ -171,6 +207,11 @@ def active_pipeline():
 
 
 def _autostart():
+    blackbox = os.environ.get("MXNET_TELEMETRY_BLACKBOX")
+    if blackbox:
+        # arm the crash black box process-wide: fit faults, SIGTERM and
+        # unhandled exceptions leave an atomic postmortem in this dir
+        flight_recorder().arm(blackbox).install()
     if os.environ.get("MXNET_TELEMETRY", "0") != "1":
         return
     jsonl = os.environ.get("MXNET_TELEMETRY_JSONL") or None

@@ -30,6 +30,7 @@ pass — have compiled by then), boundary reset when fit returns.
 from __future__ import annotations
 
 import collections
+import contextlib
 import logging
 import threading
 import time
@@ -148,6 +149,22 @@ class CompileWatch(object):
                 "shapes %s — a steady-state loop should never compile; "
                 "check for shape drift, a fresh metric object, or a "
                 "missing warmup bucket", site, shapes)
+
+    @contextlib.contextmanager
+    def suppressed(self):
+        """Suppress retrace counting on this thread for the duration —
+        the introspection pass (``telemetry.inventory().analyze``)
+        re-acquires compiled handles through ``fn.lower(...)``, which
+        may legitimately re-enter the wrapped eval functions; an
+        analysis pass must never count as (or warn about) a
+        steady-state retrace. Same mechanism as the ``_out_structs``
+        eval_shape suppression above."""
+        prev = getattr(self._tls, "suppress", False)
+        self._tls.suppress = True
+        try:
+            yield self
+        finally:
+            self._tls.suppress = prev
 
     # -- warmup boundary ------------------------------------------------
     def mark_warmup_done(self):

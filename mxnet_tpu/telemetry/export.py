@@ -27,6 +27,23 @@ __all__ = ["JsonlSink", "render_prometheus", "MetricsServer"]
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 
+def _dist_labels():
+    """``{"rank", "process_count"}`` when this process is part of an
+    initialized multi-process job, else None. Reads the INSTALLED
+    ``mxnet_tpu.dist`` runtime singleton only (never bootstraps one —
+    an exporter must not initialize jax.distributed), so single-process
+    exports are byte-identical to a build without this hook (pinned by
+    tests/test_telemetry_introspect.py)."""
+    try:
+        from ..dist.runtime import active_runtime
+        rt = active_runtime()
+    except Exception:  # noqa: BLE001 - labels are best-effort metadata
+        return None
+    if rt is None or getattr(rt, "size", 1) <= 1:
+        return None
+    return {"rank": int(rt.rank), "process_count": int(rt.size)}
+
+
 class JsonlSink(object):
     """Append-only JSONL event log (one line per event, flushed
     immediately so a crash loses at most the in-progress line)."""
@@ -37,8 +54,14 @@ class JsonlSink(object):
         self._f = open(self.path, "a")
 
     def write(self, kind, payload):
-        """Append ``{"ts": now, "kind": kind, **payload}`` as one line."""
+        """Append ``{"ts": now, "kind": kind, **payload}`` as one line.
+        Multi-process jobs tag every line with ``rank`` /
+        ``process_count`` so merged per-host logs stay attributable;
+        single-process lines are unchanged."""
         rec = {"ts": round(time.time(), 6), "kind": str(kind)}
+        labels = _dist_labels()
+        if labels:
+            rec.update(labels)
         rec.update(payload)
         line = json.dumps(rec, sort_keys=True, default=str)
         with self._lock:
@@ -62,28 +85,40 @@ def render_prometheus(registry, prefix="mxtpu"):
     """The registry as Prometheus text exposition format (0.0.4).
     Dotted metric names sanitize to underscores (``serving.0.requests``
     -> ``mxtpu_serving_0_requests``); histograms render the standard
-    cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count`` triple."""
+    cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count`` triple.
+    Multi-process jobs label every sample with ``rank`` /
+    ``process_count`` (``dist.*`` runtime metadata) so per-host scrapes
+    aggregate cleanly; single-process output is byte-identical to
+    before the labels existed (pinned)."""
+    labels = _dist_labels()
+    lab = ""
+    extra = ""
+    if labels:
+        extra = ',rank="%d",process_count="%d"' % (labels["rank"],
+                                                   labels["process_count"])
+        lab = "{%s}" % extra[1:]
     lines = []
     snap = registry.snapshot()
     for name, value in snap["counters"].items():
         n = _prom_name(name, prefix)
         lines.append("# TYPE %s counter" % n)
-        lines.append("%s %s" % (n, repr(float(value))))
+        lines.append("%s%s %s" % (n, lab, repr(float(value))))
     for name, value in snap["gauges"].items():
         n = _prom_name(name, prefix)
         lines.append("# TYPE %s gauge" % n)
-        lines.append("%s %s" % (n, repr(float(value))))
+        lines.append("%s%s %s" % (n, lab, repr(float(value))))
     for name, h in snap["histograms"].items():
         n = _prom_name(name, prefix)
         lines.append("# TYPE %s histogram" % n)
         cum = 0
         for bound, cnt in zip(h["buckets"], h["counts"]):
             cum += cnt
-            lines.append('%s_bucket{le="%s"} %d' % (n, repr(bound), cum))
+            lines.append('%s_bucket{le="%s"%s} %d'
+                         % (n, repr(bound), extra, cum))
         cum += h["counts"][-1]
-        lines.append('%s_bucket{le="+Inf"} %d' % (n, cum))
-        lines.append("%s_sum %s" % (n, repr(float(h["sum"]))))
-        lines.append("%s_count %d" % (n, h["count"]))
+        lines.append('%s_bucket{le="+Inf"%s} %d' % (n, extra, cum))
+        lines.append("%s_sum%s %s" % (n, lab, repr(float(h["sum"]))))
+        lines.append("%s_count%s %d" % (n, lab, h["count"]))
     return "\n".join(lines) + "\n"
 
 

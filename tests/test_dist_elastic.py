@@ -32,6 +32,17 @@ B = 32          # global batch
 ROWS = 256      # synthetic dataset rows -> 8 steps/epoch
 
 
+@pytest.fixture(autouse=True)
+def _disarm_flight_recorder():
+    """ElasticTrainer arms the process flight recorder under its
+    checkpoint dir; disarm after every test so a later failing fit
+    in an unrelated suite cannot dump into a stale tmp_path."""
+    yield
+    from mxnet_tpu import telemetry
+    telemetry.flight_recorder().disarm()
+    telemetry.flight_recorder().pop_last_dump()
+
+
 def _data():
     rng = np.random.RandomState(0)
     X = rng.rand(ROWS, 16).astype(np.float32)
@@ -285,6 +296,56 @@ def test_crash_between_commit_never_restores_partial(tmp_path):
              resume_from=CheckpointManager(os.path.join(tmp, "ckpt")),
              **FIT_KW)
     assert mod2._optimizer.num_update == 16     # 2 epochs x 8 steps
+
+
+def test_flight_recorder_postmortem_on_fault(tmp_path):
+    """An injected WorkerLost leaves a COMMITTED flight-recorder
+    postmortem: the transcript records its path, the JSON parses, its
+    last step record IS the failing step (the record is written even
+    though the fault raised from the batch-end callback), and the
+    atomic tmp+rename commit left no stray ``.tmp-*``."""
+    import json as _json
+    from mxnet_tpu import telemetry
+    telemetry.timeline().clear()
+    telemetry.enable()
+    try:
+        tr, mod, mgr = _run_elastic(str(tmp_path), fault_at=14)
+    finally:
+        telemetry.disable()
+    lost = [e for e in tr.transcript if e["event"] == "worker_lost"][0]
+    path = lost["postmortem"]
+    assert path and os.path.exists(path)
+    assert os.path.dirname(path) == os.path.join(str(tmp_path), "ckpt",
+                                                 "blackbox")
+    with open(path) as f:
+        pm = _json.load(f)
+    assert pm["format"] == "flight-recorder-r1"
+    assert "WorkerLost" in pm["reason"]
+    # fault at num_update=14 over 8 steps/epoch -> epoch 1, nbatch 5;
+    # at_num_update in the transcript cross-checks the arithmetic
+    assert lost["at_num_update"] == 14
+    last = pm["steps"][-1]
+    assert last["epoch"] == 1 and last["nbatch"] == 5
+    assert last["epoch"] * 8 + last["nbatch"] + 1 == 14
+    # header state carries the attempt's world identity
+    assert pm["state"]["attempt"] == 0 and pm["state"]["dp_width"] == 8
+    # dist heartbeat/rank metadata rides along
+    assert "gauges" in pm["metrics"]["dist"]
+    # the commit was atomic: no torn file, no leftover staging tmp
+    assert not [f for f in os.listdir(os.path.dirname(path))
+                if ".tmp-" in f]
+
+
+def test_flight_recorder_postmortem_without_telemetry(tmp_path):
+    """Telemetry off: the postmortem still commits (armed recorder is
+    independent of the recording switch); it just has no step records."""
+    import json as _json
+    tr, mod, mgr = _run_elastic(str(tmp_path), fault_at=6, epochs=2)
+    lost = [e for e in tr.transcript if e["event"] == "worker_lost"][0]
+    assert lost["postmortem"] and os.path.exists(lost["postmortem"])
+    with open(lost["postmortem"]) as f:
+        pm = _json.load(f)
+    assert "WorkerLost" in pm["reason"]
 
 
 def test_elastic_refuses_below_min_width(tmp_path):

@@ -33,10 +33,18 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
+import sys
 import time
 from functools import partial
 
 import numpy as onp
+
+# the cost-analysis extraction rule is shared with the runtime
+# (mxnet_tpu.telemetry.introspect) — make the package importable when
+# the probe runs from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def _shapes():
@@ -215,9 +223,12 @@ def run_shape(shape, steps, relu=True, dtype="bfloat16"):
             continue
         fns[name] = jfn
         comp = jfn.lower(du, x, rstd, mean, scale, shift).compile()
-        ca = comp.cost_analysis()
-        if ca:
-            res[name + "_gb"] = round(ca.get("bytes accessed", 0.0) / 1e9, 3)
+        # shared extraction rule (telemetry.introspect) — same numbers
+        # the live roofline gauges publish
+        from mxnet_tpu.telemetry.introspect import analyze_compiled
+        by = analyze_compiled(comp)["bytes_accessed"]
+        if by:
+            res[name + "_gb"] = round(by / 1e9, 3)
 
     if "jnp" in fns and "pallas" in fns:
         o_j = fns["jnp"](du, x, rstd, mean, scale, shift)
