@@ -146,14 +146,18 @@ print("telemetry gate: bit-identical params (sha256 %s...), %d step "
 PY
 rm -rf "$PF_TMP"
 
-stage "introspection gate (program report + live roofline + bitwise params)"
+stage "introspection + health gate (program report + watchdog + bitwise params)"
 # program-introspection contract (docs/api/telemetry.md "Program
-# introspection"): a 2-epoch fit with the inventory + live roofline
-# live must (a) train to BIT-IDENTICAL params vs telemetry-off, (b)
-# emit a program report with nonzero XLA flops/bytes for the step AND
-# optimizer programs, (c) publish mfu/bound_by/achieved_hbm_gbps
-# gauges and stamp post-warmup step JSONL lines with the roofline
-# fields — with zero post-warmup retraces (asserted in-script).
+# introspection") plus the judgment layer (same doc, "Regression
+# watchdog"): a 2-epoch fit with the inventory + live roofline + the
+# regression watchdog live must (a) train to BIT-IDENTICAL params vs
+# telemetry-off, (b) emit a program report with nonzero XLA
+# flops/bytes for the step AND optimizer programs, (c) publish
+# mfu/bound_by/achieved_hbm_gbps gauges and stamp post-warmup step
+# JSONL lines with the roofline fields — with zero post-warmup
+# retraces (asserted in-script) — and (d) arm the watchdog at the
+# warmup boundary, self-calibrate a baseline, and report HEALTHY
+# (zero health incidents on the clean run).
 IN_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
     timeout 420 python example/image-classification/train_cifar10.py \
@@ -164,9 +168,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
     --network resnet-8 --num-epochs 2 --batch-size 128 --seed 7 \
     --program-report "$IN_TMP/programs.json" \
     --telemetry-jsonl "$IN_TMP/steps.jsonl" \
+    --health-report "$IN_TMP/health.json" \
     --params-digest-out "$IN_TMP/digest_introspect.txt" || FAILED=1
 python - "$IN_TMP/digest_plain.txt" "$IN_TMP/digest_introspect.txt" \
-    "$IN_TMP/programs.json" "$IN_TMP/steps.jsonl" <<'PY' || FAILED=1
+    "$IN_TMP/programs.json" "$IN_TMP/steps.jsonl" \
+    "$IN_TMP/health.json" <<'PY' || FAILED=1
 import json, sys
 a, b = (open(p).read().strip() for p in sys.argv[1:3])
 assert a and a == b, \
@@ -184,13 +190,33 @@ post = [s for s in steps if s["epoch"] >= 1]
 assert post and all("mfu" in s and "bound_by" in s
                     and "achieved_hbm_gbps" in s for s in post), \
     "post-warmup step lines lack roofline fields"
-print("introspection gate: bit-identical params (sha256 %s...), "
-      "%d programs (%s), %d post-warmup steps with live roofline "
-      "(bound_by=%s)" % (a[:16], rep["n_programs"],
+health = json.load(open(sys.argv[5]))
+assert health["armed"] and health["calibrated"], health
+assert health["healthy"] and health["incidents"] == [], \
+    "clean run produced health incidents: %r" % health["incidents"]
+assert health["baseline"] and "step_total_ms" in health["baseline"], \
+    "watchdog baseline missing step_total_ms: %r" % health["baseline"]
+print("introspection+health gate: bit-identical params (sha256 "
+      "%s...), %d programs (%s), %d post-warmup steps with live "
+      "roofline (bound_by=%s), watchdog armed+healthy (baseline "
+      "step %.1f ms)" % (a[:16], rep["n_programs"],
                          ",".join(sorted(kinds)), len(post),
-                         post[-1]["bound_by"]))
+                         post[-1]["bound_by"],
+                         health["baseline"]["step_total_ms"]))
 PY
 rm -rf "$IN_TMP"
+
+stage "serving SLO gate (burn-rate scope populated, no breach, request traces)"
+# judgment-layer serving contract (docs/api/serving.md "Request
+# traces" + docs/api/telemetry.md "Serving SLOs"): the demo serves a
+# concurrent mixed-size load through DynamicBatcher(slo=...) with
+# request tracing live — the slo.* gauge scope must be populated on
+# the Prometheus scrape with NO breach on the healthy smoke workload,
+# every request must carry a phase-decomposed trace, and the usual
+# parity + frozen-compile serving asserts still hold (all in-script).
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/serve_cifar10.py \
+    --num-epochs 1 --clients 4 --requests 8 --slo-report || FAILED=1
 
 stage "serving smoke gate (Predictor parity + frozen compiles under traffic)"
 # online-serving contract (docs/api/serving.md): train 1 epoch, stand up

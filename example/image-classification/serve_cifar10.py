@@ -53,6 +53,15 @@ def main():
                              "Prometheus /metrics endpoint alongside "
                              "the batcher (0 = pick a free port); the "
                              "demo scrapes it once and prints a sample")
+    parser.add_argument("--slo-report", action="store_true",
+                        help="attach an SLOTracker to the batcher "
+                             "(latency/error-rate/availability "
+                             "objectives over fast/slow burn-rate "
+                             "windows) plus per-request phase traces; "
+                             "after traffic, assert the slo.* gauge "
+                             "scope is populated with NO breach on the "
+                             "smoke workload and print the burn-rate "
+                             "report (the CI serving-SLO gate)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -93,9 +102,18 @@ def main():
                  pred.buckets, time.time() - t0)
 
     errs = []
+    slo = None
+    if args.slo_report:
+        # generous smoke objectives: the gate pins the PLUMBING (scope
+        # populated, burn math runs, no breach on a healthy workload),
+        # not a production latency budget for a CPU CI box
+        mx.telemetry.enable()   # request traces ride the same switch
+        slo = mx.telemetry.SLOTracker(
+            name="serve_cifar10", p99_ms=60_000.0, error_rate=1e-3,
+            availability=0.99)
     server = DynamicBatcher(pred, max_queue=4 * args.clients,
                             max_wait_ms=args.max_wait_ms,
-                            metrics_port=args.metrics_port)
+                            metrics_port=args.metrics_port, slo=slo)
     logging.info("Prometheus endpoint: %s", server.metrics_server.url)
 
     def client(i):
@@ -150,6 +168,36 @@ def main():
           % (lat["p50"], lat["p95"], lat["p99"], lat["max"]))
     print("compiles %d (all during warmup)  rejected %d  timeouts %d"
           % (s["compiles"], s["rejected"], s["timeouts"]))
+
+    if args.slo_report:
+        rep = slo.report()
+        state = rep["state"]
+        # the gate: objectives were judged over real traffic, the
+        # slo.* scope is populated, and the healthy smoke workload is
+        # NOT in breach (burn rates at/near zero, budget intact)
+        assert state["n_events"] >= s["completed"] > 0, (state, s)
+        assert not rep["breach"], "smoke workload breached SLO: %r" % rep
+        gauges = mx.telemetry.registry().snapshot()["gauges"]
+        slo_gauges = {g: v for g, v in gauges.items()
+                      if g.startswith("slo.serve_cifar10.")}
+        assert slo_gauges, "slo.* gauge scope not populated"
+        assert gauges["slo.serve_cifar10.breach"] == 0
+        assert gauges[
+            "slo.serve_cifar10.availability.budget_remaining"] == 1.0
+        assert "mxtpu_slo_serve_cifar10_breach" in prom, \
+            "slo gauges missing from the Prometheus scrape"
+        # request traces rode along: phase-decomposed, ids stable
+        traces = pred._stats.request_traces()
+        assert traces, "no request traces recorded"
+        ph = traces[-1]["phases"]
+        assert ph["device_ms"] > 0 and traces[-1]["outcome"] == "ok"
+        for obj in ("p99_ms", "error_rate", "availability"):
+            print("slo %-12s burn fast %.3f / slow %.3f, budget %.3f"
+                  % (obj, state[obj]["burn_rate_fast"],
+                     state[obj]["burn_rate_slow"],
+                     state[obj]["budget_remaining"]))
+        print("slo report OK: %d events, no breach, %d traces"
+              % (state["n_events"], len(traces)))
 
     assert not errs, errs[:3]
     assert s["compiles"] == len(pred.buckets), \

@@ -385,6 +385,15 @@ class ElasticTrainer:
                 # bypassed fit's hook
                 self.recorder.note("worker_lost", error=str(exc),
                                    at_num_update=entry["at_num_update"])
+                # the drift history that preceded the fault: any
+                # health incidents the watchdog emitted ride in the
+                # restart transcript next to the postmortem path
+                from .. import telemetry as _tel
+                wd = _tel.health_watchdog()
+                entry["health_incidents"] = [
+                    {k: i.get(k) for k in ("gauge", "value", "baseline",
+                                           "threshold", "ts")}
+                    for i in wd.incidents()] if wd.armed else []
                 try:
                     entry["postmortem"] = self.recorder.pop_last_dump() \
                         or self.recorder.dump("worker_lost: %s" % exc)

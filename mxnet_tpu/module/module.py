@@ -831,12 +831,25 @@ class Module(BaseModule):
             eval_metric = metric_mod.create(eval_metric)
             if reset:
                 eval_data.reset()
+            import time as _time
+
             from .. import telemetry
+            t0 = _time.perf_counter()
             with telemetry.span("score.device", epoch=epoch):
                 result = grp.score_device(eval_data, eval_metric,
                                           num_batch)
             if result is not None:
                 pairs, seen = result
+                if telemetry.enabled() and seen:
+                    # one eval record for the whole device-tallied pass
+                    # (batch_group = batches covered, mirroring the
+                    # grouped train records) so eval regressions reach
+                    # the health watchdog on this path too
+                    rec = telemetry.timeline().record(
+                        epoch, seen - 1,
+                        step_ms=(_time.perf_counter() - t0) * 1000.0,
+                        batch_group=seen, loop="eval")
+                    telemetry.log_event("eval_step", rec)
                 self._fire(score_end_callback, epoch, seen, eval_metric,
                            locals())
                 return pairs

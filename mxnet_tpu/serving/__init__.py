@@ -14,7 +14,15 @@ mesh Module + durable checkpoints). Three pieces:
   within a ``max_wait_ms`` window; queue-full rejection, per-request
   timeouts, graceful shutdown.
 * :class:`ServingStats` — one snapshot (``stats()``) of latency
-  p50/p95/p99, batch-fill ratio, queue depth, and compile counters.
+  p50/p95/p99 (deadline-missed requests included, by their queue age),
+  batch-fill ratio, queue depth, and compile counters; with telemetry
+  enabled it also retains per-request phase-decomposed traces
+  (``request_traces()`` — queue-wait / coalesce / pad / device /
+  resolve, exported as per-bucket histograms and Chrome-trace events).
+
+Judged by the telemetry layer: ``DynamicBatcher(slo=SLOTracker(...))``
+evaluates declared latency/error/availability objectives over
+multi-window burn rates (docs/api/telemetry.md "Serving SLOs").
 
 Quick start::
 

@@ -22,7 +22,23 @@ Overload degrades instead of OOMing:
 
 The batcher shares its Predictor's :class:`ServingStats`, so
 ``stats()`` shows queue depth, batch-fill ratio, and per-request
-latency percentiles for the whole stack.
+latency percentiles for the whole stack — percentiles that INCLUDE
+deadline-missed requests (an expired request's queue age is a latency
+sample, so p99 does not under-report exactly under overload).
+
+Judgment-layer hooks:
+
+* every request carries a stable id; with telemetry enabled its life
+  is recorded as a phase-decomposed trace (queue-wait, coalesce-wait,
+  pad, device, resolve) into the stats trace ring, the per-bucket
+  phase histograms, and the Chrome-trace span timeline — a p99 blowup
+  is attributable to queueing vs device time (docs/api/serving.md
+  "Request traces");
+* ``slo=`` attaches a :class:`mxnet_tpu.telemetry.SLOTracker`: every
+  outcome (ok / error / timeout / queue-full reject) is recorded
+  against the declared objectives and ``slo_breached()`` surfaces the
+  multi-window burn-rate breach state (the admission decision that
+  will consume it is a later PR).
 """
 from __future__ import annotations
 
@@ -37,14 +53,18 @@ __all__ = ["DynamicBatcher"]
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "future", "deadline", "t_submit")
+    __slots__ = ("arrays", "rows", "future", "deadline", "t_submit",
+                 "id", "t_popped")
 
-    def __init__(self, arrays, rows, future, deadline, t_submit):
+    def __init__(self, arrays, rows, future, deadline, t_submit,
+                 req_id=None):
         self.arrays = arrays
         self.rows = rows
         self.future = future
         self.deadline = deadline
         self.t_submit = t_submit
+        self.id = req_id
+        self.t_popped = t_submit   # set when the worker dequeues it
 
 
 class DynamicBatcher:
@@ -76,12 +96,20 @@ class DynamicBatcher:
         registry (``ServingStats`` is a view over it), so a scraper
         pointed here sees queue depth, latency histogram, batch fill,
         and compiles live.
+    slo : mxnet_tpu.telemetry.SLOTracker, optional
+        Declared serving objectives. The batcher records every request
+        outcome — completions with their latency, deadline misses with
+        their queue age, errors, queue-full rejects — so the tracker's
+        ``slo.*`` burn-rate gauges judge THIS batcher's traffic;
+        :meth:`slo_breached` surfaces the breach state.
     """
 
     def __init__(self, predictor, max_queue=256, max_wait_ms=2.0,
-                 timeout_ms=None, start=True, metrics_port=None):
+                 timeout_ms=None, start=True, metrics_port=None,
+                 slo=None):
         self._pred = predictor
         self._stats = predictor._stats
+        self.slo = slo
         self.metrics_server = None
         if metrics_port is not None:
             from .. import telemetry
@@ -125,18 +153,26 @@ class DynamicBatcher:
         limit = self._timeout if timeout_ms is None else \
             float(timeout_ms) / 1000.0
         req = _Request(arrays, rows, Future(),
-                       t + limit if limit is not None else None, t)
+                       t + limit if limit is not None else None, t,
+                       req_id=self._stats.new_request_id())
         with self._cond:
             if self._closed:
                 raise ServerClosed("batcher is shut down")
-            if len(self._queue) >= self._max_queue:
-                self._stats.note_reject()
-                raise QueueFull(
-                    "serving queue at capacity (%d requests) — shed "
-                    "load or retry with backoff" % self._max_queue)
-            self._queue.append(req)
-            self._stats.note_request()
-            self._cond.notify_all()
+            full = len(self._queue) >= self._max_queue
+            if not full:
+                self._queue.append(req)
+                self._stats.note_request()
+                self._cond.notify_all()
+        if full:
+            # accounting OUTSIDE the condition lock: the SLO record can
+            # trigger a bounded window scan, and overload — when rejects
+            # fire — is exactly when the worker must not stall behind it
+            self._stats.note_reject()
+            if self.slo is not None:
+                self.slo.record(outcome="reject")
+            raise QueueFull(
+                "serving queue at capacity (%d requests) — shed "
+                "load or retry with backoff" % self._max_queue)
         return req.future
 
     def predict(self, data, timeout=None, timeout_ms=None):
@@ -203,6 +239,7 @@ class DynamicBatcher:
                 # idle server parks instead of polling
                 self._cond.wait()
             reqs = [self._queue.popleft()]
+            reqs[0].t_popped = time.perf_counter()
             rows = reqs[0].rows
             window_end = reqs[0].t_submit + self._max_wait
             while rows < self._max_rows:
@@ -210,6 +247,7 @@ class DynamicBatcher:
                     if rows + self._queue[0].rows > self._max_rows:
                         break
                     nxt = self._queue.popleft()
+                    nxt.t_popped = time.perf_counter()
                     reqs.append(nxt)
                     rows += nxt.rows
                     continue
@@ -217,21 +255,41 @@ class DynamicBatcher:
                 if remaining <= 0 or self._closed:
                     break
                 self._cond.wait(remaining)
+        from .. import telemetry
         now = time.perf_counter()
         live = []
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
-                self._stats.note_timeout()
-                r.future.set_exception(RequestTimeout(
-                    "request expired after %.1f ms in queue"
-                    % ((now - r.t_submit) * 1000.0)))
+                age_ms = (now - r.t_submit) * 1000.0
+                # the miss IS a latency outcome: its age reaches the
+                # reservoir/histogram (p99 must reflect overload) and
+                # spends SLO error budget
+                self._stats.note_timeout(age_ms)
+                if self.slo is not None:
+                    self.slo.record(age_ms, "timeout")
+                if telemetry.enabled():
+                    self._stats.note_trace(
+                        r.id, r.rows, None,
+                        {"queue_wait_ms": age_ms}, outcome="timeout")
+                if r.future.set_running_or_notify_cancel():
+                    # guard like the live path: set_exception on a
+                    # caller-CANCELLED future raises InvalidStateError
+                    # and would kill the worker thread for good
+                    r.future.set_exception(RequestTimeout(
+                        "request %s expired after %.1f ms in queue"
+                        % (r.id, age_ms)))
             elif r.future.set_running_or_notify_cancel():
                 live.append(r)
         return live
 
     def _launch(self, reqs):
         import numpy as onp
+
+        from .. import telemetry
+        tracing = telemetry.enabled()
         total = sum(r.rows for r in reqs)
+        t_launch = time.perf_counter()
+        timing = {} if tracing else None
         try:
             if len(reqs) == 1:
                 arrays = reqs[0].arrays
@@ -239,16 +297,57 @@ class DynamicBatcher:
                 names = list(reqs[0].arrays)
                 arrays = {k: onp.concatenate([r.arrays[k] for r in reqs])
                           for k in names}
-            outs = self._pred._predict_rows(arrays, total)
+            outs = self._pred._predict_rows(arrays, total, timing=timing)
         except BaseException as e:  # noqa: B036 — futures must resolve
             for r in reqs:
                 self._stats.note_error()
+                if self.slo is not None:
+                    self.slo.record(outcome="error")
+                if tracing:
+                    self._trace(r, None, timing, t_launch,
+                                time.perf_counter(), outcome="error")
                 r.future.set_exception(e)
             return
+        t_outs = time.perf_counter()
         off = 0
-        now = time.perf_counter()
         for r in reqs:
             res = [o[off:off + r.rows] for o in outs]
             off += r.rows
             r.future.set_result(res[0] if len(res) == 1 else res)
-            self._stats.note_completed((now - r.t_submit) * 1000.0)
+            now = time.perf_counter()
+            lat_ms = (now - r.t_submit) * 1000.0
+            self._stats.note_completed(lat_ms)
+            if self.slo is not None:
+                self.slo.record(lat_ms, "ok")
+            if tracing:
+                self._trace(r, self._pred.bucket_for(total), timing,
+                            t_launch, t_outs, t_done=now)
+
+    def _trace(self, r, bucket, timing, t_launch, t_outs, t_done=None,
+               outcome="ok"):
+        """One request's phase decomposition. The shared launch phases
+        (pad, device) are what every coalesced request experienced;
+        queue/coalesce/resolve are the request's own clocks — so each
+        trace's phase sum tracks ITS end-to-end latency."""
+        timing = timing or {}
+        t_done = t_outs if t_done is None else t_done
+        phases = {
+            "queue_wait_ms": (r.t_popped - r.t_submit) * 1000.0,
+            "coalesce_wait_ms": (t_launch - r.t_popped) * 1000.0,
+            "pad_ms": timing.get("pad_ms", 0.0),
+            "device_ms": timing.get("device_ms", 0.0),
+            # normalize/concat overhead before the pad plus the
+            # slice-and-resolve after the outputs landed
+            "resolve_ms": max(
+                (t_done - t_launch) * 1000.0
+                - timing.get("pad_ms", 0.0)
+                - timing.get("device_ms", 0.0), 0.0),
+        }
+        self._stats.note_trace(r.id, r.rows, bucket, phases,
+                               outcome=outcome)
+
+    def slo_breached(self):
+        """Whether the attached :class:`SLOTracker` reports an active
+        multi-window burn-rate breach (False without one) — the signal
+        a later admission-control layer will act on."""
+        return self.slo is not None and self.slo.breached()

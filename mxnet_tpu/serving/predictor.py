@@ -352,17 +352,34 @@ class Predictor:
         Thread-safe; for concurrent callers prefer a
         :class:`DynamicBatcher`, which coalesces them into fewer,
         fuller launches."""
+        from .. import telemetry
+        tracing = telemetry.enabled()
         arrays, rows = self._normalize(data)
         t0 = time.perf_counter()
         self._stats.note_request()
-        outs = self._predict_rows(arrays, rows)
-        self._stats.note_completed((time.perf_counter() - t0) * 1000.0)
+        timing = {} if tracing else None
+        outs = self._predict_rows(arrays, rows, timing=timing)
+        t1 = time.perf_counter()
+        self._stats.note_completed((t1 - t0) * 1000.0)
+        if tracing:
+            # direct path: no queue, no coalescing — the trace is pad +
+            # device + the residual dispatch/slice overhead
+            self._stats.note_trace(
+                self._stats.new_request_id(), rows,
+                self.bucket_for(rows), {
+                    "pad_ms": timing.get("pad_ms", 0.0),
+                    "device_ms": timing.get("device_ms", 0.0),
+                    "resolve_ms": max(
+                        (t1 - t0) * 1000.0 - timing.get("pad_ms", 0.0)
+                        - timing.get("device_ms", 0.0), 0.0)})
         return outs[0] if len(outs) == 1 else outs
 
-    def _predict_rows(self, arrays, rows):
+    def _predict_rows(self, arrays, rows, timing=None):
         """Serve ``rows`` normalized rows; always returns the list of
         per-output numpy arrays. The batcher calls this directly (it
-        does its own request accounting)."""
+        does its own request accounting). ``timing`` (a dict) receives
+        accumulated ``pad_ms`` / ``device_ms`` clocks for the request
+        trace — chunked oversized requests accumulate across launches."""
         parts = []
         with self._lock:
             start = 0
@@ -373,29 +390,40 @@ class Predictor:
                                                          take < rows) \
                     else arrays
                 parts.append(self._run_bucket(self.bucket_for(take),
-                                              chunk, take))
+                                              chunk, take,
+                                              timing=timing))
                 start += take
         if len(parts) == 1:
             return parts[0]
         return [onp.concatenate([p[i] for p in parts])
                 for i in range(len(parts[0]))]
 
-    def _run_bucket(self, bucket, arrays, rows, warmup=False):
+    def _run_bucket(self, bucket, arrays, rows, warmup=False,
+                    timing=None):
         """One device launch at ``bucket``: zero-pad the request rows
         up to the bucket's bound shape (the same ``pad_batch_rows``
         rule the predict/score epoch-tail fix uses) and slice the
         outputs back to the real rows."""
         from .. import telemetry
         mod = self._modules[bucket]
+        t_pad = time.perf_counter() if timing is not None else 0.0
         batch = DataBatch(
             data=[nd.NDArray(pad_batch_rows(arrays[name], bucket))
                   for name, _ in self._data_descs],
             label=None, pad=bucket - rows)
         basis = self._roofline.get(bucket) if not warmup else None
-        t0 = time.perf_counter() if basis else 0.0
+        if timing is not None:
+            t0 = time.perf_counter()
+            timing["pad_ms"] = timing.get("pad_ms", 0.0) \
+                + (t0 - t_pad) * 1000.0
+        else:
+            t0 = time.perf_counter() if basis else 0.0
         with telemetry.span("serving.launch", bucket=bucket, rows=rows):
             mod.forward(batch, is_train=False)
             outs = [o.asnumpy()[:rows] for o in mod.get_outputs()]
+        if timing is not None:
+            timing["device_ms"] = timing.get("device_ms", 0.0) \
+                + (time.perf_counter() - t0) * 1000.0
         if basis:
             # live serving roofline: the bucket program's analyzed
             # FLOPs/bytes over this launch's wall clock (dispatch +

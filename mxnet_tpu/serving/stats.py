@@ -1,4 +1,5 @@
-"""Serving observability: counters, gauges, and latency percentiles.
+"""Serving observability: counters, gauges, latency percentiles, and
+per-request phase traces.
 
 One :class:`ServingStats` instance is shared by a ``Predictor`` and any
 ``DynamicBatcher`` built on it, so ``stats()`` is a single coherent
@@ -15,21 +16,49 @@ historical shape. The latency reservoir stays a local bounded ring of
 the most recent samples (exact percentiles over current behavior);
 each completion also lands in the scope's ``latency_ms`` histogram for
 export.
+
+Two additions from the judgment layer:
+
+* **deadline misses are latency samples.** A request expired at launch
+  time used to count only in the ``timeouts`` counter — its queue age
+  never reached the reservoir, so reported p50/p95/p99 excluded
+  exactly the worst outcomes and p99 *under-reported under overload*.
+  ``note_timeout(age_ms)`` now folds the expired request's age into
+  the reservoir and the ``latency_ms`` histogram (and a dedicated
+  ``timeout_age_ms`` histogram), so the reported tail includes the
+  requests that never made it.
+* **request traces.** When telemetry is enabled, every request gets a
+  stable id and a phase-decomposed trace — queue-wait, coalesce-wait,
+  pad, device, resolve — kept in a bounded ring
+  (:meth:`request_traces`), exported as Chrome-trace ``ph:X`` events
+  into the span timeline, and aggregated into per-phase, per-bucket
+  latency histograms (``serving.<i>.b<bucket>.phase_<name>_ms``) so a
+  p99 blowup is attributable to queueing vs device time per bucket.
+  Ring capacity rides ``MXNET_TELEMETRY_REQTRACE`` (0 disables).
 """
 from __future__ import annotations
 
+import collections
+import itertools
+import os
 import threading
+import time
 
 from .. import telemetry
 
 __all__ = ["ServingStats"]
 
+# request-trace phase names, in wall-clock order
+TRACE_PHASES = ("queue_wait_ms", "coalesce_wait_ms", "pad_ms",
+                "device_ms", "resolve_ms")
+
 
 class ServingStats:
     """Thread-safe serving counters over a telemetry-registry scope,
-    with a bounded latency reservoir."""
+    with a bounded latency reservoir and a request-trace ring."""
 
-    def __init__(self, latency_window=2048, scope=None):
+    def __init__(self, latency_window=2048, scope=None,
+                 trace_capacity=None):
         self._lock = threading.Lock()
         self._window = int(latency_window)
         self._lat = [0.0] * self._window
@@ -47,10 +76,19 @@ class ServingStats:
         self._c_padded_rows = c("padded_rows")  # bucket rows launched
         self._c_compiles = c("compiles")   # XLA traces through serving
         self._h_latency = self.scope.histogram("latency_ms")
+        self._h_timeout_age = self.scope.histogram("timeout_age_ms")
         self._g_queue = self.scope.gauge("queue_depth")
         self.compile_tracking = True
         self.bucket_hits = {}      # bucket size -> launch count
         self._queue_probe = None   # () -> current queue depth
+        if trace_capacity is None:
+            trace_capacity = int(
+                os.environ.get("MXNET_TELEMETRY_REQTRACE", "512"))
+        self._trace_capacity = int(trace_capacity)
+        self._traces = collections.deque(
+            maxlen=max(self._trace_capacity, 1))
+        self._req_ids = itertools.count()
+        self._phase_hists = {}     # (bucket, phase) -> Histogram
 
     # -- registry-backed counter values (internal + snapshot use) -------
     requests = telemetry.instrument_value("_c_requests")
@@ -80,8 +118,25 @@ class ServingStats:
     def note_reject(self):
         self._c_rejected.add()
 
-    def note_timeout(self):
+    def _reserve(self, latency_ms):
+        """One sample into the percentile reservoir + export histogram
+        — THE one rule for what the reported tail covers (completions
+        AND deadline misses)."""
+        self._h_latency.observe(latency_ms)
+        with self._lock:
+            self._lat[self._lat_n % self._window] = latency_ms
+            self._lat_n += 1
+
+    def note_timeout(self, age_ms=None):
+        """A request expired before launch. ``age_ms`` (its time in
+        queue) folds the miss into the latency reservoir/histogram —
+        reported p99 must reflect the requests that never made it —
+        plus the dedicated ``timeout_age_ms`` histogram."""
         self._c_timeouts.add()
+        if age_ms is not None:
+            age_ms = float(age_ms)
+            self._h_timeout_age.observe(age_ms)
+            self._reserve(age_ms)
 
     def note_error(self):
         self._c_errors.add()
@@ -100,16 +155,78 @@ class ServingStats:
     def note_completed(self, latency_ms):
         latency_ms = float(latency_ms)
         self._c_completed.add()
-        self._h_latency.observe(latency_ms)
-        with self._lock:
-            self._lat[self._lat_n % self._window] = latency_ms
-            self._lat_n += 1
+        self._reserve(latency_ms)
 
     def set_queue_probe(self, fn):
         """Install a ``() -> int`` gauge for the current queue depth
         (the batcher points this at its deque)."""
         self._queue_probe = fn
         self._g_queue.set_fn(fn)
+
+    # -- request traces --------------------------------------------------
+    def new_request_id(self):
+        """A stable per-instance request id (``r<seq>``) — stamped on
+        every submitted request and carried by its trace."""
+        return "r%08d" % next(self._req_ids)
+
+    def _phase_hist(self, bucket, phase):
+        key = (bucket, phase)
+        h = self._phase_hists.get(key)
+        if h is None:
+            h = self._phase_hists[key] = self.scope.histogram(
+                "b%d.phase_%s" % (bucket, phase))
+        return h
+
+    def note_trace(self, req_id, rows, bucket, phases, outcome="ok",
+                   ts_end=None):
+        """Record one request's phase-decomposed trace (callers gate on
+        ``telemetry.enabled()`` — one branch when off). ``phases`` maps
+        phase name (:data:`TRACE_PHASES`) to ms; missing phases are 0.
+        The trace lands in the bounded ring, each phase in its
+        per-bucket histogram, and (for served requests) as Chrome-trace
+        ``ph:X`` events in the span timeline — ``profiler.dump_profile``
+        renders the request next to the host spans."""
+        if self._trace_capacity <= 0:
+            return None
+        ts_end = time.time() if ts_end is None else float(ts_end)
+        phases = {p: round(float(phases.get(p, 0.0)), 3)
+                  for p in TRACE_PHASES}
+        total = round(sum(phases.values()), 3)
+        trace = {"id": str(req_id), "rows": int(rows),
+                 "bucket": int(bucket) if bucket else None,
+                 "outcome": str(outcome), "phases": phases,
+                 "total_ms": total,
+                 "ts": round(ts_end - total / 1000.0, 6)}
+        with self._lock:
+            self._traces.append(trace)
+        if bucket:
+            for p, ms in phases.items():
+                if ms or p in ("queue_wait_ms", "device_ms"):
+                    self._phase_hist(trace["bucket"], p).observe(ms)
+        # phase events laid out back-to-back ending at ts_end: the
+        # request renders as a contiguous bar decomposed by phase
+        events, t_us = [], (ts_end - total / 1000.0) * 1e6
+        tid = threading.get_ident()
+        for p in TRACE_PHASES:
+            dur_us = phases[p] * 1e3
+            if dur_us <= 0:
+                continue
+            events.append({
+                "name": "serving.req.%s" % p[:-3], "cat": "serving",
+                "ph": "X", "ts": t_us, "dur": dur_us, "pid": 0,
+                "tid": tid,
+                "args": {"id": trace["id"], "rows": trace["rows"],
+                         "bucket": trace["bucket"],
+                         "outcome": trace["outcome"]}})
+            t_us += dur_us
+        if events:
+            telemetry.record_events(events)
+        return trace
+
+    def request_traces(self):
+        """The retained request traces, oldest first."""
+        with self._lock:
+            return [dict(t) for t in self._traces]
 
     # -- snapshot -------------------------------------------------------
     @staticmethod
@@ -122,17 +239,21 @@ class ServingStats:
 
     def snapshot(self):
         """One coherent dict of every counter/gauge/percentile — the
-        ``stats()`` surface documented in docs/api/serving.md."""
+        ``stats()`` surface documented in docs/api/serving.md.
+        ``latency_ms.count`` counts reservoir samples: completions plus
+        deadline misses recorded with their queue age (so the
+        percentiles cover the worst outcomes, not only the served
+        ones)."""
         with self._lock:
-            n = min(self._lat_n, self._window)
+            lat_total = self._lat_n
+            n = min(lat_total, self._window)
             lats = sorted(self._lat[:n])
             bucket_hits = dict(self.bucket_hits)
-        completed = self.completed
         real_rows, padded_rows = self.real_rows, self.padded_rows
         fill = (real_rows / float(padded_rows)) if padded_rows else None
         out = {
             "requests": self.requests,
-            "completed": completed,
+            "completed": self.completed,
             "rejected": self.rejected,
             "timeouts": self.timeouts,
             "errors": self.errors,
@@ -143,7 +264,7 @@ class ServingStats:
             "compile_tracking": self.compile_tracking,
             "bucket_hits": bucket_hits,
             "latency_ms": {
-                "count": completed,
+                "count": lat_total,
                 "mean": round(sum(lats) / n, 3) if n else None,
                 "p50": self._pct(lats, 50),
                 "p95": self._pct(lats, 95),

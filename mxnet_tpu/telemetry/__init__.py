@@ -10,6 +10,15 @@ answers "why was step 412 slow" after the fact; the
 :class:`CompileWatch` attributes every XLA retrace to a call site and
 warns when one lands after the warmup boundary.
 
+On top of those instruments sits the judgment layer: an
+:class:`SLOTracker` evaluates declared serving objectives over
+multi-window rolling burn rates (``slo.*`` gauges, fed by
+``DynamicBatcher(slo=...)``), and the process
+:class:`RegressionWatchdog` (:func:`health_watchdog`) compares live
+step/eval windows against a pinned or self-calibrated baseline and
+emits warn-once ``health.*`` incidents (:func:`health_report`; also
+``GET /health`` on the MetricsServer).
+
 Quick start::
 
     from mxnet_tpu import telemetry
@@ -41,12 +50,15 @@ import threading
 from .compile_watch import CompileWatch
 from .export import JsonlSink, MetricsServer, render_prometheus
 from .flight import FlightRecorder
+from .health import RegressionWatchdog
 from .introspect import (ProgramInventory, analyze_compiled, aval_skeleton,
                          device_peaks, roofline, BOUND_BY_CODES)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, Scope,
                        instrument_value, DEFAULT_MS_BUCKETS)
+from .slo import SLOTracker
 from .timeline import StepTimeline
-from .tracing import NOOP_SPAN, Span, clear_trace, span, trace_events
+from .tracing import (NOOP_SPAN, Span, clear_trace, record_events, span,
+                      trace_events)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Scope",
@@ -54,11 +66,12 @@ __all__ = [
     "JsonlSink", "MetricsServer", "render_prometheus",
     "ProgramInventory", "FlightRecorder", "analyze_compiled",
     "aval_skeleton", "device_peaks", "roofline", "BOUND_BY_CODES",
+    "SLOTracker", "RegressionWatchdog",
     "registry", "timeline", "compile_watch", "inventory",
     "flight_recorder", "dump_programs", "enable", "disable",
     "enabled", "jsonl_sink", "metrics_server", "log_event",
-    "flush_metrics",
-    "serve_metrics", "trace_events", "clear_trace",
+    "flush_metrics", "health_watchdog", "health_report",
+    "serve_metrics", "trace_events", "clear_trace", "record_events",
     "set_active_pipeline", "active_pipeline", "DEFAULT_MS_BUCKETS",
 ]
 
@@ -67,6 +80,7 @@ _TIMELINE = StepTimeline()
 _WATCH = None
 _INVENTORY = None
 _FLIGHT = None
+_WATCHDOG = None
 _lock = threading.Lock()
 _state = {"enabled": False, "sink": None, "server": None,
           "active_pipeline": None}
@@ -118,6 +132,25 @@ def flight_recorder():
         if _FLIGHT is None:
             _FLIGHT = FlightRecorder()
         return _FLIGHT
+
+
+def health_watchdog():
+    """The process-wide :class:`RegressionWatchdog` (created on first
+    use; unarmed — and therefore silent — until ``Module.fit`` arms it
+    at the warmup boundary or :meth:`RegressionWatchdog.arm` is called
+    explicitly)."""
+    global _WATCHDOG
+    with _lock:
+        if _WATCHDOG is None:
+            _WATCHDOG = RegressionWatchdog(registry=_REGISTRY,
+                                           timeline=_TIMELINE)
+        return _WATCHDOG
+
+
+def health_report():
+    """The watchdog's health state as JSON (armed/baseline/incidents/
+    healthy) — also served as ``GET /health`` by the MetricsServer."""
+    return health_watchdog().report()
 
 
 def enabled():

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import collections
 import itertools
-import json
 import os
 import signal
 import sys
@@ -91,12 +90,21 @@ class FlightRecorder(object):
         with self._lock:
             self._state.update(kv)
 
+    def clear(self):
+        """Drop the retained event ring and state (test/bench plumbing
+        — a production black box keeps its history)."""
+        with self._lock:
+            self._events.clear()
+            self._state.clear()
+
     # -- dumping --------------------------------------------------------
     def snapshot(self, reason):
         """The postmortem payload: header + state + noted events + the
         telemetry substrate's retained rings (step records, span tail,
-        dist/compile metric scopes). Pure reads — safe from signal
-        handlers and except blocks."""
+        dist/compile/health/slo metric scopes — the watchdog's
+        incident notes are in the event ring, so a postmortem carries
+        the drift history that preceded the crash). Pure reads — safe
+        from signal handlers and except blocks."""
         import mxnet_tpu.telemetry as _tel
         with self._lock:
             events = list(self._events)
@@ -114,7 +122,9 @@ class FlightRecorder(object):
             "steps": steps,
             "spans": spans,
             "metrics": {"dist": reg.snapshot(prefix="dist"),
-                        "compile": reg.snapshot(prefix="compile")},
+                        "compile": reg.snapshot(prefix="compile"),
+                        "health": reg.snapshot(prefix="health"),
+                        "slo": reg.snapshot(prefix="slo")},
         }
 
     def dump(self, reason, path=None):
@@ -131,15 +141,9 @@ class FlightRecorder(object):
             path = os.path.join(
                 self._dir, "postmortem-%d-%03d.json"
                 % (os.getpid(), next(self._seq)))
-        path = str(path)
-        payload = json.dumps(self.snapshot(reason), sort_keys=True,
-                             default=str)
-        tmp = "%s.tmp-%d" % (path, os.getpid())
-        with open(tmp, "w") as f:
-            f.write(payload + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        from .export import atomic_json_dump
+        path = atomic_json_dump(path, self.snapshot(reason),
+                                indent=None, fsync=True)
         self.last_dump_path = path
         return path
 

@@ -35,7 +35,6 @@ the mesh size (``n_dev``) so totals compare against n_dev-scaled peaks.
 from __future__ import annotations
 
 import collections
-import json
 import os
 import threading
 import time
@@ -293,11 +292,6 @@ class ProgramInventory(object):
             "programs": self.report(),
         }
         if path is not None:
-            path = str(path)
-            tmp = "%s.tmp-%d" % (path, os.getpid())
-            with open(tmp, "w") as f:
-                json.dump(report, f, indent=1, sort_keys=True,
-                          default=str)
-                f.write("\n")
-            os.replace(tmp, path)
+            from .export import atomic_json_dump
+            atomic_json_dump(path, report)
         return report

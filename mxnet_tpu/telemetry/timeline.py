@@ -22,7 +22,13 @@ Record fields (also the docs/api/telemetry.md field table):
   lines are written BEFORE this fold, so the sink carries the cost as
   its own ``{"kind": "checkpoint"}`` event; ``to_jsonl``/``records``
   post-hoc reads see it folded in.
-* ``batch_group`` — K for grouped steps, 1 per-batch.
+* ``batch_group`` — K for grouped steps, 1 per-batch (eval records
+  from the device-score path use it for the number of batches the one
+  record covers).
+* ``loop`` — ``"train"`` for the fit loops, ``"eval"`` for the
+  ``Module.score``/eval-pass records (same shape, so the health
+  watchdog judges served/eval regressions on the same wire; the
+  streamed JSONL twin of an eval record is ``{"kind": "eval_step"}``).
 * ``recompile`` — True when the CompileWatch counter moved during this
   step (the "why was step 412 slow" answer).
 * ``total_ms`` / ``ts`` — the sum of the above clocks and the record's
@@ -56,13 +62,14 @@ class StepTimeline(object):
 
     def record(self, epoch, nbatch, host_wait_ms=0.0, step_ms=0.0,
                metric_cb_ms=0.0, checkpoint_ms=0.0, batch_group=1,
-               recompile=False):
+               recompile=False, loop="train"):
         """Append one step record; returns the record dict."""
         with self._lock:
             step = self._next_step
             self._next_step += 1
             rec = {
                 "step": step, "epoch": int(epoch), "nbatch": int(nbatch),
+                "loop": str(loop),
                 "host_wait_ms": round(float(host_wait_ms), 3),
                 "step_ms": round(float(step_ms), 3),
                 "metric_cb_ms": round(float(metric_cb_ms), 3),

@@ -20,7 +20,8 @@ import collections
 import threading
 import time
 
-__all__ = ["Span", "span", "trace_events", "clear_trace"]
+__all__ = ["Span", "span", "trace_events", "clear_trace",
+           "record_events"]
 
 _RING_CAPACITY = 16384
 _ring = collections.deque(maxlen=_RING_CAPACITY)
@@ -79,6 +80,16 @@ def span(name, **attrs):
     if not enabled():
         return NOOP_SPAN
     return Span(name, **attrs)
+
+
+def record_events(events):
+    """Append pre-built Chrome-trace complete events to the span ring —
+    how the serving request traces merge their phase events
+    (queue-wait / coalesce / pad / device / resolve) into the ONE
+    timeline ``profiler.dump_profile()`` renders. Each event must be a
+    ``ph:"X"`` dict with ``ts``/``dur`` in microseconds."""
+    with _lock:
+        _ring.extend(events)
 
 
 def trace_events():

@@ -1,0 +1,714 @@
+"""The telemetry judgment layer: request traces, SLO burn rates, and
+the regression watchdog.
+
+Pins the ISSUE-8 contracts: a request trace's phase sum tracks its
+end-to-end latency and decomposes a queue-bound vs device-bound tail;
+deadline-missed requests reach the reported p99 (the overload
+under-reporting fix); SLOTracker's multi-window burn-rate math is
+exact on synthetic event streams and breaches only when BOTH windows
+burn; the RegressionWatchdog self-calibrates from the first
+post-warmup window, fires EXACTLY ONE structured incident on an
+injected slowdown (visible in a FlightRecorder postmortem), stays
+silent on a clean run, and everything is a no-op / bitwise
+zero-perturbation when judged against the telemetry-off path.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.serving import DynamicBatcher, Predictor
+from mxnet_tpu.serving.errors import RequestTimeout
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Fresh telemetry state: disabled, empty rings, disarmed
+    watchdog/recorder — and the same on the way out."""
+    tel.disable()
+    tel.timeline().clear()
+    tel.clear_trace()
+    tel.health_watchdog().reset()
+    tel.flight_recorder().disarm()
+    tel.flight_recorder().clear()
+    yield
+    tel.disable()
+    tel.timeline().clear()
+    tel.clear_trace()
+    tel.health_watchdog().reset()
+    tel.flight_recorder().disarm()
+    tel.flight_recorder().clear()
+    tel.set_active_pipeline(None)
+
+
+def _mlp():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=64, seed=1, dim=6):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, dim).astype(np.float32),
+            rng.randint(0, 10, n).astype(np.float32))
+
+
+def _fit(X, y, seed=11, num_epoch=2, **kw):
+    mx.random.seed(seed)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0)])
+    it = NDArrayIter(X, y, batch_size=16, shuffle=False)
+    mod.fit(it, num_epoch=num_epoch,
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Uniform(0.07), **kw)
+    return mod
+
+
+def _params_bytes(mod):
+    arg, aux = mod.get_params()
+    return [np.ascontiguousarray(arg[k].asnumpy()).tobytes()
+            for k in sorted(arg)] + \
+           [np.ascontiguousarray(aux[k].asnumpy()).tobytes()
+            for k in sorted(aux or {})]
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One trained module + warmed Predictor shared by the serving
+    tests (compiles once for the whole file)."""
+    X, y = _data()
+    mx.random.seed(3)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0)])
+    mod.fit(NDArrayIter(X, y, batch_size=16), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Uniform(0.07))
+    pred = Predictor(mod, max_batch_size=8)
+    pred.warmup()
+    return mod, pred, X
+
+
+# ======================================================================
+# SLOTracker burn-rate math (synthetic streams, explicit clocks)
+# ======================================================================
+def test_slo_objective_parsing():
+    reg = tel.MetricsRegistry()
+    t = tel.SLOTracker(name="t", registry=reg, p99_ms=50.0,
+                       error_rate=1e-3, availability=0.999)
+    kinds = {o["key"]: o for o in t._objectives}
+    assert kinds["p99_ms"]["budget"] == pytest.approx(0.01)
+    assert kinds["error_rate"]["budget"] == pytest.approx(1e-3)
+    assert kinds["availability"]["budget"] == pytest.approx(0.001)
+    with pytest.raises(ValueError):
+        tel.SLOTracker(name="t2", registry=reg)        # no objectives
+    with pytest.raises(ValueError):
+        tel.SLOTracker(name="t3", registry=reg, p0_ms=1.0)
+    with pytest.raises(ValueError):
+        tel.SLOTracker(name="t4", registry=reg, frobnicate=1.0)
+    with pytest.raises(ValueError):
+        tel.SLOTracker(name="t5", registry=reg, availability=1.5)
+
+
+def test_slo_burn_rate_math_exact():
+    """burn = (bad fraction in window) / budget, per window; empty
+    windows burn 0; budget_remaining mirrors the slow window."""
+    reg = tel.MetricsRegistry()
+    t = tel.SLOTracker(name="m", registry=reg, error_rate=0.01,
+                       fast_window_s=60.0, slow_window_s=600.0)
+    t0 = 10_000.0
+    # 200 ok spread over 500 s, then 2 errors in the last 10 s
+    for i in range(200):
+        t.record(1.0, "ok", ts=t0 + i * 2.5)
+    t.record(outcome="error", ts=t0 + 495.0)
+    t.record(outcome="error", ts=t0 + 498.0)
+    s = t.evaluate(now=t0 + 500.0)
+    er = s["error_rate"]
+    # fast window [440, 500]: 24 ok + 2 errors -> 2/26 / 0.01
+    assert er["n_fast"] == 26 and er["bad_fast"] == 2
+    assert er["burn_rate_fast"] == pytest.approx(2 / 26 / 0.01,
+                                                 abs=1e-3)
+    # slow window: all 202 events -> 2/202 / 0.01
+    assert er["n_slow"] == 202 and er["bad_slow"] == 2
+    assert er["burn_rate_slow"] == pytest.approx(2 / 202 / 0.01,
+                                                 abs=1e-3)
+    assert er["budget_remaining"] == pytest.approx(
+        1.0 - 2 / 202 / 0.01, abs=1e-3)
+    # quiet tracker: no events in window -> burn 0, no breach
+    s2 = t.evaluate(now=t0 + 10_000.0)
+    assert s2["error_rate"]["burn_rate_fast"] == 0.0
+    assert s2["error_rate"]["breach"] is False
+
+
+def test_slo_multiwindow_breach_rule():
+    """A short spike trips the fast window but not the (diluted) slow
+    one -> NO breach; a sustained burn trips both -> breach. Gauges
+    publish through the shared-registry scope."""
+    reg = tel.MetricsRegistry()
+    t = tel.SLOTracker(name="w", registry=reg, error_rate=0.01,
+                       fast_window_s=60.0, slow_window_s=1800.0)
+    t0 = 50_000.0
+    for i in range(3000):                       # long healthy history
+        t.record(1.0, "ok", ts=t0 + i * 0.55)   # ~1650 s of traffic
+    now = t0 + 1650.0
+    for i in range(30):                         # spike in the last 30 s
+        t.record(outcome="error", ts=now - 30.0 + i)
+    s = t.evaluate(now=now)
+    assert s["error_rate"]["burn_rate_fast"] > 1.0
+    assert s["error_rate"]["burn_rate_slow"] < 1.0
+    assert s["error_rate"]["breach"] is False and s["breach"] is False
+    # sustain the failure: errors across the whole slow window
+    for i in range(60):
+        t.record(outcome="error", ts=t0 + i * 27.0)
+    s = t.evaluate(now=now)
+    assert s["error_rate"]["burn_rate_slow"] > 1.0
+    assert s["error_rate"]["breach"] is True and s["breach"] is True
+    assert t.breached(now=now) is True
+    g = reg.snapshot()["gauges"]
+    assert g["slo.w.error_rate.breach"] == 1
+    assert g["slo.w.breach"] == 1
+    assert g["slo.w.error_rate.burn_rate_fast"] > 1.0
+    rep = t.report(now=now)
+    assert rep["breach"] is True and rep["state"]["n_events"] > 0
+
+
+def test_slo_latency_objective_counts_misses():
+    """For a p<NN>_ms objective a deadline miss (or error) is bad even
+    without a latency sample, and a slow success is bad too."""
+    reg = tel.MetricsRegistry()
+    t = tel.SLOTracker(name="l", registry=reg, p95_ms=10.0,
+                       fast_window_s=60.0, slow_window_s=60.0)
+    t0 = 1000.0
+    for i in range(90):
+        t.record(2.0, "ok", ts=t0 + i * 0.1)
+    for i in range(6):
+        t.record(50.0, "ok", ts=t0 + 10 + i * 0.1)   # slow successes
+    t.record(outcome="timeout", ts=t0 + 12.0)        # never completed
+    s = t.evaluate(now=t0 + 13.0)
+    lat = s["p95_ms"]
+    assert lat["bad_fast"] == 7                      # 6 slow + 1 timeout
+    assert lat["burn_rate_fast"] == pytest.approx(7 / 97 / 0.05,
+                                                  abs=1e-2)
+    assert lat["breach"] is True
+
+
+# ======================================================================
+# Request traces + timeout accounting through the serving stack
+# ======================================================================
+def test_timeout_age_reaches_p99(served):
+    """The overload fix: an expired request's queue age lands in the
+    latency reservoir/histogram (p99 reflects the misses) and in the
+    dedicated timeout_age_ms histogram, and spends SLO error budget."""
+    _, pred, X = served
+    slo = tel.SLOTracker(name="to", registry=tel.MetricsRegistry(),
+                         error_rate=0.01, availability=0.9)
+    srv = DynamicBatcher(pred, max_queue=8, timeout_ms=20, start=False,
+                         slo=slo)
+    before = pred.stats()["latency_ms"]["count"]
+    futs = [srv.submit(X[:2]) for _ in range(3)]
+    time.sleep(0.12)            # expire in queue while worker is down
+    srv.start()
+    for f in futs:
+        with pytest.raises(RequestTimeout):
+            f.result(timeout=30)
+    srv.shutdown()
+    s = pred.stats()
+    assert s["latency_ms"]["count"] == before + 3   # misses ARE samples
+    assert s["latency_ms"]["p99"] >= 100.0          # their queue age
+    h = pred._stats.scope.snapshot()["histograms"]
+    assert h["timeout_age_ms"]["count"] >= 3
+    assert h["timeout_age_ms"]["sum"] >= 300.0
+    # ...and the SLO budget burned for every miss
+    st = slo.evaluate()
+    assert st["error_rate"]["bad_fast"] == 3
+    assert st["availability"]["bad_fast"] == 3
+
+
+def test_cancelled_expired_request_does_not_kill_worker(served):
+    """A caller-cancelled request whose deadline then passes must not
+    blow up the worker (set_exception on a cancelled future raises
+    InvalidStateError): the timeout branch guards like the live path
+    and the batcher keeps serving."""
+    _, pred, X = served
+    srv = DynamicBatcher(pred, max_queue=8, timeout_ms=10, start=False)
+    fut = srv.submit(X[:2])
+    assert fut.cancel()
+    time.sleep(0.05)                 # expire the cancelled request too
+    srv.start()
+    out = srv.predict(X[:3], timeout=60)   # worker survived
+    assert out.shape == (3, 10)
+    srv.shutdown()
+
+
+def test_bad_baseline_path_does_not_kill_fit(monkeypatch):
+    """A typo'd MXNET_TELEMETRY_BASELINE must not crash training at
+    the warmup boundary — fit logs and continues unwatched (the
+    diagnostics-never-fit-control rule)."""
+    monkeypatch.setenv("MXNET_TELEMETRY_BASELINE",
+                       "/nonexistent/baseline.json")
+    X, y = _data()
+    tel.enable()
+    mod = _fit(X, y)
+    assert mod._optimizer.num_update > 0
+    assert tel.health_watchdog().armed is False
+
+
+def test_request_trace_phase_sum(served):
+    """Every served request gets a stable id and a phase decomposition
+    whose sum tracks its end-to-end latency; phases export as
+    per-bucket histograms and Chrome-trace events."""
+    _, pred, X = served
+    tel.enable()
+    tel.clear_trace()
+    srv = DynamicBatcher(pred, max_queue=64, max_wait_ms=2)
+    t0 = time.perf_counter()
+    out = srv.predict(X[:3], timeout=60)
+    e2e_ms = (time.perf_counter() - t0) * 1000.0
+    srv.shutdown()
+    assert out.shape == (3, 10)
+    traces = pred._stats.request_traces()
+    assert traces, "no request trace recorded"
+    tr = traces[-1]
+    assert tr["outcome"] == "ok" and tr["rows"] == 3
+    assert tr["bucket"] == 4 and tr["id"].startswith("r")
+    phases = tr["phases"]
+    assert set(phases) == {"queue_wait_ms", "coalesce_wait_ms",
+                           "pad_ms", "device_ms", "resolve_ms"}
+    # the phase sum is the request's own end-to-end clock (equality up
+    # to the submit-side normalization outside the phase clocks)
+    assert tr["total_ms"] == pytest.approx(sum(phases.values()),
+                                           abs=0.01)
+    assert tr["total_ms"] <= e2e_ms + 1.0
+    assert tr["total_ms"] >= phases["device_ms"] > 0.0
+    # per-bucket per-phase histograms in the serving scope
+    h = pred._stats.scope.snapshot()["histograms"]
+    assert h["b4.phase_device_ms"]["count"] >= 1
+    assert h["b4.phase_queue_wait_ms"]["count"] >= 1
+    # Chrome-trace events merged into the span timeline
+    evs = [e for e in tel.trace_events()
+           if e["name"].startswith("serving.req.")]
+    assert evs and all(e["ph"] == "X" for e in evs)
+    assert any(e["args"]["id"] == tr["id"] for e in evs)
+
+
+def test_request_trace_direct_predict(served):
+    """The unbatched Predictor.predict path records a trace too —
+    zero queue/coalesce, pad+device+resolve only."""
+    _, pred, X = served
+    tel.enable()
+    before = len(pred._stats.request_traces())
+    pred.predict(X[:5])
+    traces = pred._stats.request_traces()
+    assert len(traces) == before + 1
+    tr = traces[-1]
+    assert tr["phases"]["queue_wait_ms"] == 0.0
+    assert tr["phases"]["coalesce_wait_ms"] == 0.0
+    assert tr["phases"]["device_ms"] > 0.0
+    assert tr["bucket"] == 8 and tr["rows"] == 5
+
+
+def test_request_trace_disabled_noop(served):
+    """Telemetry off: no traces, no phase histograms, no span events —
+    the one-branch disabled-mode contract."""
+    _, pred, X = served
+    before = len(pred._stats.request_traces())
+    hists_before = set(pred._stats.scope.snapshot()["histograms"])
+    srv = DynamicBatcher(pred, max_queue=16)
+    srv.predict(X[:3], timeout=60)
+    srv.shutdown()
+    pred.predict(X[:2])
+    assert len(pred._stats.request_traces()) == before
+    new = set(pred._stats.scope.snapshot()["histograms"]) - hists_before
+    assert not {n for n in new if "phase" in n}
+    assert not [e for e in tel.trace_events()
+                if e["name"].startswith("serving.req.")]
+
+
+def test_overload_tail_decomposes_queue_vs_device(served):
+    """Under overload (slow device, many waiters) the per-phase
+    histograms attribute the p99 blowup: queue-wait dominates the tail
+    while per-launch device time stays flat."""
+    _, pred, X = served
+    tel.enable()
+    inner = pred._predict_rows
+
+    def slow(arrays, rows, timing=None):
+        time.sleep(0.02)
+        return inner(arrays, rows, timing=timing)
+
+    pred._predict_rows = slow
+    try:
+        srv = DynamicBatcher(pred, max_queue=64, max_wait_ms=0)
+        futs = [srv.submit(X[i:i + 8]) for i in range(10)]
+        for f in futs:
+            f.result(timeout=60)
+        srv.shutdown()
+    finally:
+        pred._predict_rows = inner
+    traces = [t for t in pred._stats.request_traces()[-10:]]
+    qmax = max(t["phases"]["queue_wait_ms"] for t in traces)
+    dmax = max(t["phases"]["device_ms"] for t in traces)
+    # the 10th request waited ~9 launches; each launch's device share
+    # stays one launch long — the tail is attributable to QUEUEING
+    assert qmax > 3 * dmax, (qmax, dmax)
+    h = pred._stats.scope.snapshot()["histograms"]
+    qh = h["b8.phase_queue_wait_ms"]
+    assert qh["count"] >= 10 and qh["sum"] > 100.0
+
+
+def test_slo_through_batcher_clean_traffic(served):
+    """Healthy traffic through DynamicBatcher(slo=...): objectives
+    recorded, no breach, gauges live in the process registry."""
+    _, pred, X = served
+    slo = tel.SLOTracker(name="srv_t", p99_ms=60_000.0,
+                         error_rate=1e-3, availability=0.99)
+    srv = DynamicBatcher(pred, max_queue=64, max_wait_ms=1, slo=slo)
+    for i in range(6):
+        srv.predict(X[i:i + 2], timeout=60)
+    assert srv.slo_breached() is False
+    srv.shutdown()
+    st = slo.evaluate()
+    assert st["availability"]["n_fast"] >= 6
+    assert st["availability"]["bad_fast"] == 0
+    g = tel.registry().snapshot()["gauges"]
+    assert g["slo.srv_t.availability.budget_remaining"] == 1.0
+    assert g["slo.srv_t.breach"] == 0
+
+
+# ======================================================================
+# RegressionWatchdog (synthetic timelines, then the real fit)
+# ======================================================================
+def _feed(tl, n, total_ms, epoch=0, loop="train", mfu=None):
+    for i in range(n):
+        rec = tl.record(epoch, i, host_wait_ms=total_ms * 0.1,
+                        step_ms=total_ms * 0.9, loop=loop)
+        if mfu is not None:
+            rec["mfu"] = mfu
+
+
+def _watchdog(**kw):
+    reg = tel.MetricsRegistry()
+    timeline = tel.StepTimeline()
+    wd = tel.RegressionWatchdog(registry=reg, timeline=timeline, **kw)
+    return wd, reg, timeline
+
+
+def test_watchdog_self_calibrates_then_fires_once():
+    """First polled window becomes the baseline; a 10x slowdown fires
+    EXACTLY ONE incident (warn-once per gauge), with window stats and
+    threshold attached; health gauges flip."""
+    wd, reg, timeline = _watchdog()
+    wd.arm()
+    _feed(timeline, 8, 10.0)
+    assert wd.poll() == []                  # calibration window
+    assert wd.baseline["step_total_ms"] == pytest.approx(10.0, rel=0.01)
+    _feed(timeline, 8, 100.0)
+    incidents = wd.poll()
+    assert len(incidents) == 1
+    inc = incidents[0]
+    assert inc["gauge"] == "step_total_ms"
+    assert inc["value"] == pytest.approx(100.0, rel=0.01)
+    assert inc["baseline"] == pytest.approx(10.0, rel=0.01)
+    assert inc["window"]["n_train"] == 8
+    # step_ms co-moved and is consumed by the same incident
+    assert "step_ms" in inc["also"]
+    _feed(timeline, 8, 100.0)
+    assert wd.poll() == []                  # warn-once: no repeat
+    assert wd.healthy is False
+    snap = reg.snapshot()
+    assert snap["counters"]["health.incidents"] == 1
+    assert snap["gauges"]["health.healthy"] == 0
+    assert snap["gauges"]["health.armed"] == 1
+    rep = wd.report()
+    assert rep["armed"] and rep["calibrated"] and not rep["healthy"]
+    assert len(rep["incidents"]) == 1
+
+
+def test_watchdog_clean_windows_stay_silent():
+    wd, _, timeline = _watchdog()
+    wd.arm()
+    for _ in range(4):
+        _feed(timeline, 8, 10.0)
+        assert wd.poll() == []
+    assert wd.healthy and wd.report()["incidents"] == []
+
+
+def test_watchdog_small_absolute_deltas_are_noise():
+    """min_delta_ms: a 3x blowup of a sub-ms step is jitter, not an
+    incident."""
+    wd, _, timeline = _watchdog()
+    wd.arm()
+    _feed(timeline, 8, 1.0)
+    wd.poll()
+    _feed(timeline, 8, 3.0)                 # 3x but only +2 ms
+    assert wd.poll() == []
+
+
+def test_watchdog_pinned_baseline_roundtrip(tmp_path):
+    """A committed BASELINE.json-style snapshot pins the reference:
+    arm(path) never self-calibrates and judges the FIRST window."""
+    wd, _, timeline = _watchdog()
+    wd.arm()
+    _feed(timeline, 8, 10.0)
+    wd.poll()
+    path = str(tmp_path / "BASELINE.json")
+    wd.save_baseline(path)
+    assert json.load(open(path))["health_baseline"][
+        "step_total_ms"] == pytest.approx(10.0, rel=0.01)
+
+    wd2, _, tl2 = _watchdog()
+    wd2.arm(baseline=path)
+    assert wd2.report()["baseline_pinned"]
+    _feed(tl2, 8, 100.0)
+    incidents = wd2.poll()                  # first window already judged
+    assert len(incidents) == 1
+    assert incidents[0]["gauge"] == "step_total_ms"
+
+
+def test_watchdog_absolute_gauges():
+    """post_warmup_retraces > 0 and a straggling host are incidents on
+    their own — no baseline needed, and the retrace outranks."""
+    wd, reg, timeline = _watchdog()
+    wd.arm()
+    _feed(timeline, 8, 10.0)
+    wd.poll()
+    reg.gauge("dist.straggler_ratio").set(3.5)
+    _feed(timeline, 8, 10.0)
+    incidents = wd.poll()
+    assert len(incidents) == 1
+    assert incidents[0]["gauge"] == "dist.straggler_ratio"
+    assert incidents[0]["threshold"] == 2.0
+    reg.counter("compile.post_warmup_retraces").add(2)
+    _feed(timeline, 8, 10.0)
+    incidents = wd.poll()
+    assert [i["gauge"] for i in incidents] == \
+        ["compile.post_warmup_retraces"]
+    assert incidents[0]["value"] == 2
+
+
+def test_watchdog_watches_eval_records():
+    """loop="eval" records are judged on their own wire: an eval-only
+    regression fires even when the train windows stay healthy."""
+    wd, _, timeline = _watchdog()
+    wd.arm()
+    _feed(timeline, 8, 10.0)
+    _feed(timeline, 4, 5.0, loop="eval")
+    wd.poll()
+    _feed(timeline, 8, 10.0)
+    _feed(timeline, 4, 80.0, loop="eval")
+    incidents = wd.poll()
+    assert len(incidents) == 1
+    assert incidents[0]["gauge"] == "eval_step_ms"
+
+
+def test_watchdog_thin_windows_carry_forward():
+    """A stream trickling in below min_samples per poll (one eval
+    record per score() call under the daemon poller) is CARRIED into
+    the next window, not consumed: the records accumulate into an
+    adequate window that calibrates and then judges."""
+    wd, _, timeline = _watchdog()
+    wd.arm()
+    for _ in range(3):                       # 1 record/poll trickle
+        _feed(timeline, 1, 5.0, loop="eval")
+        assert wd.poll() == []
+    # the three carried records formed ONE adequate window -> baseline
+    assert "eval_step_ms" in (wd.baseline or {})
+    fired = []
+    for _ in range(3):                       # regression, same trickle
+        _feed(timeline, 1, 80.0, loop="eval")
+        fired += wd.poll()
+    assert len(fired) == 1
+    assert fired[0]["gauge"] == "eval_step_ms"
+
+
+def test_watchdog_mfu_regression():
+    wd, _, timeline = _watchdog()
+    wd.arm()
+    _feed(timeline, 8, 10.0, mfu=0.4)
+    wd.poll()
+    # throughput halved but time deltas masked below the ms floor
+    # would not fire; the roofline judge catches the MFU collapse
+    _feed(timeline, 8, 12.0, mfu=0.1)
+    incidents = wd.poll()
+    assert len(incidents) == 1
+    assert incidents[0]["gauge"] == "train.mfu"
+
+
+class _SlowLateIter(NDArrayIter):
+    """Delivers normally for the first epochs, then injects a
+    per-batch slowdown — the 'sleep in a transform' regression."""
+
+    def __init__(self, *a, slow_after_epoch=2, sleep_s=0.03, **kw):
+        super().__init__(*a, **kw)
+        self._epoch = 0
+        self._slow_after = slow_after_epoch
+        self._sleep_s = sleep_s
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+
+    def next(self):
+        if self._epoch >= self._slow_after:
+            time.sleep(self._sleep_s)
+        return super().next()
+
+
+def test_watchdog_fires_on_injected_fit_slowdown(tmp_path):
+    """The acceptance pin: a real fit with a slowdown injected from
+    epoch 2 produces EXACTLY ONE health incident — attributed to the
+    step-time/host-wait cluster — and the incident appears in a
+    FlightRecorder postmortem's event ring."""
+    X, y = _data()
+    tel.enable()
+    tel.flight_recorder().arm(str(tmp_path / "blackbox"))
+    it = _SlowLateIter(X, y, batch_size=16, shuffle=False,
+                       slow_after_epoch=2, sleep_s=0.03)
+    mx.random.seed(11)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0)])
+    mod.fit(it, num_epoch=4, optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Uniform(0.07))
+    wd = tel.health_watchdog()
+    incidents = wd.incidents()
+    assert len(incidents) == 1, incidents
+    assert incidents[0]["gauge"] in ("step_total_ms",
+                                     "host_wait_fraction")
+    assert wd.report()["healthy"] is False
+    # the incident is in the black box: a postmortem carries it
+    path = tel.flight_recorder().dump("test")
+    post = json.load(open(path))
+    noted = [e for e in post["events"] if e["kind"] == "health_incident"]
+    assert len(noted) == 1
+    assert noted[0]["gauge"] == incidents[0]["gauge"]
+    assert "health" in post["metrics"]
+    assert mod._optimizer.num_update > 0
+
+
+def test_watchdog_clean_fit_stays_silent():
+    """A clean multi-epoch run arms, calibrates, polls — and produces
+    ZERO incidents (the other half of the acceptance pin)."""
+    X, y = _data()
+    tel.enable()
+    _fit(X, y, num_epoch=3)
+    wd = tel.health_watchdog()
+    rep = wd.report()
+    assert rep["armed"] and rep["calibrated"]
+    assert rep["polls"] >= 2
+    assert rep["incidents"] == [] and rep["healthy"]
+
+
+def test_watchdog_env_optout(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_WATCHDOG", "0")
+    X, y = _data()
+    tel.enable()
+    _fit(X, y)
+    assert tel.health_watchdog().armed is False
+
+
+def test_watchdog_disabled_telemetry_noop():
+    """Telemetry off: fit never touches the watchdog, score writes no
+    eval records, health_report stays unarmed."""
+    X, y = _data()
+    mod = _fit(X, y)
+    val = NDArrayIter(X[:32], y[:32], batch_size=16)
+    mod.score(val, "acc")
+    assert tel.health_watchdog().armed is False
+    assert len(tel.timeline()) == 0
+    assert tel.health_report()["healthy"] is True
+
+
+# ======================================================================
+# score/eval StepTimeline records
+# ======================================================================
+def test_score_writes_eval_records(tmp_path):
+    X, y = _data()
+    mod = _fit(X, y)
+    tel.enable(jsonl=str(tmp_path / "run.jsonl"))
+    tel.timeline().clear()
+    val = NDArrayIter(X[:32], y[:32], batch_size=16)
+    mod.score(val, "acc")
+    recs = tel.timeline().records()
+    assert recs and all(r["loop"] == "eval" for r in recs)
+    # device-tallied pass: one record covering the batches; host loop:
+    # one per batch — either way the SAME record shape as fit's
+    covered = sum(r["batch_group"] for r in recs)
+    assert covered == 2
+    for f in ("step", "epoch", "nbatch", "host_wait_ms", "step_ms",
+              "metric_cb_ms", "total_ms", "recompile"):
+        assert f in recs[0], f
+    tel.disable()
+    lines = [json.loads(line) for line in open(tmp_path / "run.jsonl")]
+    evs = [ln for ln in lines if ln["kind"] == "eval_step"]
+    assert len(evs) == len(recs)
+    assert not [ln for ln in lines if ln["kind"] == "step"]
+
+
+def test_fit_eval_records_tagged(tmp_path):
+    """fit(eval_data=...) streams train records as "step" and eval
+    records as "eval_step" — the ci.sh gates' per-train-step JSONL
+    contract is untouched by the eval instrumentation."""
+    X, y = _data()
+    tel.enable(jsonl=str(tmp_path / "run.jsonl"))
+    val = NDArrayIter(X[:32], y[:32], batch_size=16)
+    _fit(X, y, eval_data=val)
+    tel.disable()
+    lines = [json.loads(line) for line in open(tmp_path / "run.jsonl")]
+    steps = [ln for ln in lines if ln["kind"] == "step"]
+    evs = [ln for ln in lines if ln["kind"] == "eval_step"]
+    assert len(steps) == 2 * 4                 # 2 epochs x 4 train steps
+    assert all(ln["loop"] == "train" for ln in steps)
+    assert evs and all(ln["loop"] == "eval" for ln in evs)
+
+
+# ======================================================================
+# endpoints + bitwise zero-perturbation
+# ======================================================================
+def test_metrics_server_programs_and_health_routes():
+    srv = tel.MetricsServer(tel.registry(), port=0)
+    try:
+        base = "http://%s:%d" % (srv.host, srv.port)
+        with urllib.request.urlopen(base + "/health", timeout=10) as r:
+            health = json.loads(r.read().decode())
+            assert r.headers["Content-Type"] == "application/json"
+        assert {"armed", "healthy", "incidents"} <= set(health)
+        with urllib.request.urlopen(base + "/programs", timeout=10) as r:
+            programs = json.loads(r.read().decode())
+        assert programs["format"] == "program-inventory-r1"
+        assert "programs" in programs
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.read() == b"ok\n"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert b"# TYPE" in r.read()
+    finally:
+        srv.close()
+
+
+def test_bitwise_zero_perturbation_with_judgment_layer(served):
+    """The PR's hard contract: fit params and served rows are bitwise
+    identical with request tracing + watchdog + eval records all live
+    vs telemetry off, with zero post-warmup retraces."""
+    X, y = _data()
+    val = NDArrayIter(X[:32], y[:32], batch_size=16)
+    ref_mod = _fit(X, y, num_epoch=3, eval_data=val)
+    ref = _params_bytes(ref_mod)
+
+    tel.enable()
+    val2 = NDArrayIter(X[:32], y[:32], batch_size=16)
+    mod = _fit(X, y, num_epoch=3, eval_data=val2)
+    assert tel.health_watchdog().armed
+    assert _params_bytes(mod) == ref
+    assert tel.compile_watch().post_warmup_count == 0
+
+    # serving: traced requests return bitwise what untraced ones do
+    _, pred, Xs = served
+    off = pred.predict(Xs[:5])
+    tel.clear_trace()
+    traced = pred.predict(Xs[:5])
+    assert len(pred._stats.request_traces()) > 0
+    assert np.array_equal(off, traced)
+    tel.disable()
