@@ -46,13 +46,20 @@ def _peaks(device_kind, n_dev):
 
 
 class _DedupeLogFilter(object):
-    """Drop repeated identical WARNING+ records.  The bench drives
-    fit/bind in timed windows, and each re-entry used to print its own
-    "Already binded"/"optimizer already initialized" notice —
-    BENCH_r05's JSON tail drowned in them.  One line per distinct
-    message keeps the output readable; INFO and below pass untouched
-    (progress lines legitimately repeat), which also bounds the seen
-    set."""
+    """Drop repeated identical WARNING+ records, and drop the module
+    re-entry advisories entirely.  The bench drives fit/bind in timed
+    windows — re-binding an already-driven module IS the methodology —
+    and each driver rep used to print its own "Already binded"/
+    "optimizer already initialized" pair through the root logger
+    (BENCH_r05's JSON tail drowned in them; the in-library once-per-
+    process dedupe cannot reach across the driver's repeat runs, so
+    the bench drops them outright).  Other warnings print one line per
+    distinct message; INFO and below pass untouched (progress lines
+    legitimately repeat), which also bounds the seen set."""
+
+    # advisories that are expected bench behavior, not signal
+    _DROP = ("Already binded, ignoring bind()",
+             "optimizer already initialized, ignoring")
 
     def __init__(self):
         self._seen = set()
@@ -61,7 +68,10 @@ class _DedupeLogFilter(object):
         import logging
         if record.levelno < logging.WARNING:
             return True
-        key = (record.levelno, record.getMessage())
+        msg = record.getMessage()
+        if any(d in msg for d in self._DROP):
+            return False
+        key = (record.levelno, msg)
         if key in self._seen:
             return False
         self._seen.add(key)
@@ -86,21 +96,79 @@ def _watchdog(seconds):
     signal.alarm(seconds)
 
 
-def _cached_feed_child(rec_path, step_batch, img, n, dev_aug):
+def _cached_feed_child(rec_path, step_batch, img, n, mode):
     """Subprocess body for the cached clean-window feed measurement:
     fresh process = fresh clean transport window (each window permits
     ONE completion-ordering readback).  Decode fills the RAM cache
-    untimed; the timed region feeds n batches through
-    ImageRecordIter(cache_decoded=True) and stops the clock only after
-    the window's single data-dependent readback, so the rate includes
-    device completion — enqueue-rate artifacts excluded.  dev_aug
-    selects the route: uint8-NHWC transfer + on-chip augment program
-    (the PCIe-host shape), or host assemble + f32 transfer (the route
-    that avoids this tunnel's put+compute interleave pathology)."""
+    untimed; the timed region feeds n batches and stops the clock only
+    after the window's single data-dependent readback, so the rate
+    includes device completion — enqueue-rate artifacts excluded.
+
+    mode selects the route:
+    * ``host`` — host assemble + f32 NCHW transfer (the route that
+      avoids this tunnel's put+compute interleave pathology);
+    * ``dev`` — uint8-NHWC transfer + a per-batch on-chip augment
+      program (the PCIe-host shape);
+    * ``devcache`` — the HBM-resident dataset cache
+      (mxnet_tpu.data.CachedDataset over ImageRecordIter
+      (device_augment="defer")): epoch 1 fills the device cache
+      untimed, then every timed batch is a device-side gather (the
+      only transfer is a (B,) int32 index array) + the same
+      in-program augment stage fit compiles into the train step —
+      ZERO image bytes over the transport."""
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.image import ImageRecordIter
 
+    if mode == "devcache":
+        it = ImageRecordIter(
+            rec_path, data_shape=(3, img, img), batch_size=step_batch,
+            shuffle=False, device_augment="defer", cache_decoded=True,
+            label_name="softmax_label")
+        spec = it.device_augment_spec["data"]
+        from mxnet_tpu.data import CachedDataset
+        cds = CachedDataset(it)
+
+        def next_batch():
+            try:
+                return next(cds)
+            except StopIteration:
+                cds.reset()
+                return next(cds)
+
+        # the augment program the train step would run, folded into the
+        # accumulating probe: u8 gather output -> cast/normalize ->
+        # scalar tap (is_train False = deterministic variant; the
+        # timed rate includes the in-program augment work)
+        def acc_body(d, s):
+            return s + spec.apply(d, None, None,
+                                  train=False).ravel()[0]
+
+        acc_fn = jax.jit(acc_body)
+        # drain the capture epoch (fills the device cache) untimed
+        while True:
+            try:
+                next(cds)
+            except StopIteration:
+                break
+        cds.reset()
+        b = next_batch()   # first gathered batch: compiles gather+acc
+        acc = acc_fn(b.data[0], jnp.float32(0.0))
+        t0 = time.time()
+        for _ in range(n):
+            acc = acc_fn(next_batch().data[0], acc)
+        float(acc)  # the window's one readback, inside the timed region
+        rate = n * step_batch / (time.time() - t0)
+        info = cds.cache_info()
+        print(json.dumps({
+            "pipeline_device_cached_img_per_sec": round(rate, 2),
+            "io_cache_placement": info["placement"],
+            "io_cache_bytes": info["bytes"],
+            # per-step transport cost in cached mode: the index array
+            "io_device_cached_staged_bytes_per_step": step_batch * 4}))
+        return
+
+    dev_aug = mode == "dev"
     it = ImageRecordIter(
         rec_path, data_shape=(3, img, img), batch_size=step_batch,
         shuffle=True, device_augment=dev_aug, cache_decoded=True,
@@ -124,7 +192,12 @@ def _cached_feed_child(rec_path, step_batch, img, n, dev_aug):
     rate = n * step_batch / (time.time() - t0)
     key = ("pipeline_cached_u8_img_per_sec" if dev_aug
            else "pipeline_cached_f32_img_per_sec")
-    print(json.dumps({key: round(rate, 2)}))
+    # staged bytes/step attribution for the streaming routes: u8 NHWC
+    # vs f32 NCHW is exactly the 4x the device-augment path exists for
+    nbytes = step_batch * img * img * 3 * (1 if dev_aug else 4)
+    print(json.dumps({key: round(rate, 2),
+                      ("io_staged_bytes_per_step_u8" if dev_aug else
+                       "io_staged_bytes_per_step_f32"): nbytes}))
 
 
 def main():
@@ -132,7 +205,7 @@ def main():
     if len(sys.argv) >= 7 and sys.argv[1] == "--cached-feed":
         _cached_feed_child(sys.argv[2], int(sys.argv[3]),
                            int(sys.argv[4]), int(sys.argv[5]),
-                           sys.argv[6] == "dev")
+                           sys.argv[6])
         return
 
     import logging
@@ -807,6 +880,13 @@ def _bench_pipeline_clean(mx, recs, step_batch, steps, img):
     threads, procs, dev_aug = _io_iter_opts()
     out = {"io_threads": threads, "io_processes": procs,
            "io_device_augment": dev_aug,
+           # wire-format attribution for the streaming measurements
+           # below: where the augment stage runs and what dtype
+           # actually crosses the transport per staged batch
+           "io_augment_placement": "device" if dev_aug else "host",
+           "io_staged_dtype": "uint8" if dev_aug else "float32",
+           "io_staged_bytes_per_step": step_batch * img * img * 3
+           * (1 if dev_aug else 4),
            "io_host_cores": os.cpu_count() or 1,
            "io_images": recs["_n_images"]}
     if "_jpeg_skipped" in recs:
@@ -826,7 +906,7 @@ def _bench_pipeline_clean(mx, recs, step_batch, steps, img):
     # region AFTER its own data-dependent readback, so the number
     # includes device completion (enqueue-rate artifacts excluded).
     import subprocess
-    for mode in ("host", "dev"):
+    for mode in ("host", "dev", "devcache"):
         try:
             cp = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),

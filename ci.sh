@@ -108,6 +108,37 @@ assert a and a == b, \
 print("device-feed gate: bit-identical params (sha256 %s...)" % a[:16])
 PY
 
+stage "device-augment gate (u8 wire + device augment + HBM cache == host reference)"
+# fed-input contract (docs/api/data.md "Device-side augmentation"):
+# training through the u8 device path — uint8 NHWC wire batches, the
+# augment compiled as a device program (random pad-crop + mirror +
+# normalize, draws keyed (seed, epoch, batch)), and the HBM-resident
+# dataset cache serving epoch >= 2 by device gather — must land on a
+# BIT-IDENTICAL params digest vs the numpy host-reference augment
+# path (DeviceAugment.apply_host) on the same stream.  The telemetry
+# run also asserts ZERO post-warmup retraces in-script, so the cache
+# handover at epoch 2 provably compiles nothing new.
+DA_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 2 --batch-size 128 --seed 7 \
+    --device-augment --cache-dataset \
+    --telemetry-jsonl "$DA_TMP/steps.jsonl" \
+    --params-digest-out "$DA_TMP/digest_device.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 2 --batch-size 128 --seed 7 \
+    --device-augment --augment-placement host \
+    --params-digest-out "$DA_TMP/digest_hostref.txt" || FAILED=1
+python - "$DA_TMP/digest_device.txt" "$DA_TMP/digest_hostref.txt" <<'PY' || FAILED=1
+import sys
+a, b = (open(p).read().strip() for p in sys.argv[1:3])
+assert a and a == b, \
+    "device-augment+cache params digest %s != host-reference %s" % (a, b)
+print("device-augment gate: bit-identical params (sha256 %s...)" % a[:16])
+PY
+rm -rf "$DA_TMP"
+
 stage "telemetry gate (telemetry-on fit == plain, bit-identical params + step JSONL)"
 # observability contract (docs/api/telemetry.md): a fit with the full
 # telemetry recording path live — step timeline, compile watch, one

@@ -204,6 +204,31 @@ def main():
                              "HEALTHY — zero incidents on a clean run "
                              "(the CI health gate, mirroring "
                              "--program-report)")
+    parser.add_argument("--device-augment", action="store_true",
+                        help="feed the u8 device-side input path: the "
+                             "iterator ships uint8 NHWC wire batches "
+                             "(4x fewer bytes than f32 NCHW) and "
+                             "random-crop/flip/normalize compile INTO "
+                             "the train-step program (mxnet_tpu.data"
+                             ".DeviceAugment); deterministic draws "
+                             "keyed (seed, epoch, batch)")
+    parser.add_argument("--augment-placement", default="device",
+                        choices=["device", "host"],
+                        help="where the augment stage runs: 'device' "
+                             "(in-program, the u8 wire path) or "
+                             "'host' (the numpy reference "
+                             "DeviceAugment.apply_host on the same "
+                             "draws — the CI gate pins both to bit-"
+                             "identical trained params)")
+    parser.add_argument("--cache-dataset", action="store_true",
+                        help="HBM-resident dataset cache (mxnet_tpu"
+                             ".data.CachedDataset): epoch 1 streams "
+                             "and captures the decoded u8 epoch, "
+                             "epochs >= 2 are served by device-side "
+                             "gather — zero image bytes over the "
+                             "transport, bit-identical params to "
+                             "streaming (implies the u8 augment "
+                             "pipeline)")
     parser.add_argument("--serve-smoke", action="store_true",
                         help="after training, serve the model through "
                              "an in-process mxnet_tpu.serving stack "
@@ -239,13 +264,49 @@ def main():
         Xtr, ytr = synthetic_cifar(rng)
         Xte, yte = Xtr[:512], ytr[:512]
 
-    train = mx.io.NDArrayIter(Xtr, ytr, batch_size=args.batch_size,
-                              shuffle=True)
-    val = mx.io.NDArrayIter(Xte, yte, batch_size=args.batch_size)
-
     net = models.get_symbol(args.network, num_classes=10,
                             image_shape=(3, 28, 28))
     mod = mx.mod.Module(net, context=ctx)
+
+    u8_pipeline = args.device_augment or args.cache_dataset
+    if u8_pipeline:
+        from mxnet_tpu.data import (CachedDataset, DeviceAugment,
+                                    DeviceAugmentIter)
+
+        def to_u8(x):
+            # f32 NCHW in [0, ~1] -> uint8 NHWC wire layout
+            return (np.clip(x, 0.0, 1.0) * 255.0).round() \
+                .astype(np.uint8).transpose(0, 2, 3, 1)
+
+        # pad-2 random crop + random mirror, normalize back to the f32
+        # [0, 1] range the plain path trains on (scale=1/255); draws
+        # are a pure function of (seed, epoch, batch index), so the
+        # device and host placements see the SAME stream
+        spec = DeviceAugment(shape=(3, 28, 28), rand_crop=True,
+                             rand_mirror=True, pad=2, mean=0.0,
+                             std=1.0, scale=1.0 / 255.0,
+                             seed=args.seed or 0)
+        train_src = mx.io.NDArrayIter(to_u8(Xtr), ytr,
+                                      batch_size=args.batch_size,
+                                      shuffle=True)
+        if args.cache_dataset:
+            train = CachedDataset(
+                train_src, augment=spec, module=mod,
+                augment_placement=args.augment_placement)
+        else:
+            train = DeviceAugmentIter(train_src, spec,
+                                      placement=args.augment_placement)
+        # eval variant: both placements score the identical
+        # deterministic center-cropped stream
+        val = DeviceAugmentIter(
+            mx.io.NDArrayIter(to_u8(Xte), yte,
+                              batch_size=args.batch_size),
+            spec, placement=args.augment_placement, train=False)
+    else:
+        train = mx.io.NDArrayIter(Xtr, ytr, batch_size=args.batch_size,
+                                  shuffle=True)
+        val = mx.io.NDArrayIter(Xte, yte, batch_size=args.batch_size)
+
     callbacks = []
     if args.model_prefix:
         callbacks.append(mx.callback.do_checkpoint(args.model_prefix))
@@ -342,6 +403,27 @@ def main():
             "--batch-group %d requested but the grouped train program "
             "never engaged (fit fell back to per-batch training)"
             % args.batch_group)
+    if u8_pipeline and trained:
+        if args.augment_placement == "device":
+            # structural contract: the augment stage really compiled
+            # into the step program (u8 wire batches, not a silent
+            # host fallback)
+            assert getattr(mod._exec_group, "_device_augment", None), (
+                "--device-augment requested but the bound program has "
+                "no in-program augment stage")
+            assert any(np.dtype(getattr(d, "dtype", np.float32))
+                       == np.uint8 for d in train.provide_data), (
+                "u8 pipeline requested but no uint8 wire input in %r"
+                % (train.provide_data,))
+        if args.cache_dataset and args.num_epochs > 1:
+            info = train.cache_info()
+            assert info["built_epoch"] is not None, (
+                "--cache-dataset ran %d epochs but never built the "
+                "cache: %r" % (args.num_epochs, info))
+            logging.info("dataset cache: %s, %d rows, %.1f MB, built "
+                         "after epoch %d", info["placement"],
+                         info["rows"], info["bytes"] / (1 << 20),
+                         info["built_epoch"])
     if args.params_digest_out:
         # digest BEFORE scoring: scoring must not (and does not)
         # change params, but the gate pins the trained state itself

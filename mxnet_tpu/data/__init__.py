@@ -19,9 +19,19 @@ transfer itself.  This package overlaps all three:
   no host concat; ``(K, B, ...)`` blocks through the executor group's
   ``stage_stacked`` for ``fit(batch_group=K)``) for batch i+1/i+2
   while the step for batch i runs.
-* :class:`PipelineStats` — host-wait ms per step, ring occupancy, and
-  stager throughput, so "input-bound" is a measured number in the
-  training log, not a guess.
+* :class:`PipelineStats` — host-wait ms per step, ring occupancy,
+  staged bytes/dtype, and stager throughput, so "input-bound" is a
+  measured number in the training log, not a guess.
+* :class:`DeviceAugment` / :class:`DeviceAugmentIter` — the u8 wire
+  path: uint8 NHWC batches (4x fewer transported bytes than f32
+  NCHW) with random crop/flip/normalize compiled as a DEVICE program
+  at staging, draws keyed ``(seed, epoch, batch)`` — bitwise
+  host-reference parity, replayable across resume.
+* :class:`CachedDataset` — the HBM-resident dataset cache: epoch 1
+  streams + captures the decoded u8 epoch, epochs >= 2 are served by
+  device-side gather (a ``(B,)`` index array is the whole per-batch
+  transfer), bit-identical to streaming and budget-gated with a
+  graceful host fallback.
 
 Batches delivered through the pipeline are BITWISE identical to plain
 iteration, so ``Module.fit(prefetch_to_device=2)`` trains to
@@ -43,8 +53,12 @@ See docs/api/data.md for semantics and the stats field reference.
 """
 from __future__ import annotations
 
+from .augment import DeviceAugment, DeviceAugmentIter, fold_seed
+from .cached import CachedDataset
 from .loader import DeviceLoader
 from .stats import PipelineStats
 from .transform import TransformIter
 
-__all__ = ["DeviceLoader", "TransformIter", "PipelineStats"]
+__all__ = ["DeviceLoader", "TransformIter", "PipelineStats",
+           "DeviceAugment", "DeviceAugmentIter", "CachedDataset",
+           "fold_seed"]

@@ -1,0 +1,386 @@
+"""CachedDataset — serve epochs >= 2 from an HBM-resident u8 cache.
+
+The streaming path decodes (or at best host-gathers) every image every
+epoch and pays a host->device transfer per batch.  But a decoded u8
+epoch is small — CIFAR-10 is ~150 MB, ImageNet-224 ~19 GB/shard-able —
+and after the first epoch its bytes never change.  CachedDataset
+captures the first full epoch it streams (pad rows stripped), places
+the decoded ``(N, H, W, C)`` uint8 block on DEVICE, and serves every
+later epoch as a device-side gather: one tiny ``(B,)`` index transfer
+per batch, ZERO image bytes over the transport, zero host decode.
+Augmentation still varies per epoch — the :class:`DeviceAugment`
+parameter draws are a pure function of ``(seed, epoch, batch_index)``
+and ride the same in-program augment stage as the streaming path, so
+cached-mode parameters are BIT-IDENTICAL to streaming-mode parameters
+(the ci.sh device-augment gate).
+
+Memory is a declared budget, not a hope: the cache sizes itself
+against ``budget_mb`` (default ``MXNET_DATA_CACHE_BUDGET_MB``, 1024)
+and falls back gracefully — host-RAM cache (decoded once, gathered on
+host, staged as u8) when the block exceeds the device budget, pure
+pass-through streaming when caching is disabled.  All three placements
+deliver bitwise-identical batch streams.
+
+Composes with the rest of the pipeline: the delivered device-resident
+batches pass through ``DeviceLoader``'s ring and
+``stage_stacked``'s grouped blocks without a readback, and the gather
+program is compiled at cache-finalize time (the end of the capture
+epoch — inside fit's warmup window), so steady-state training sees
+zero post-warmup retraces.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc, DataIter
+from .augment import (crop_input_name, fold_seed, mirror_input_name,
+                      _placement_default)
+
+__all__ = ["CachedDataset"]
+
+_PLACEMENTS = ("auto", "device", "host", "off")
+
+
+def _budget_bytes(budget_mb):
+    if budget_mb is None:
+        budget_mb = float(os.environ.get("MXNET_DATA_CACHE_BUDGET_MB",
+                                         "1024"))
+    return int(float(budget_mb) * (1 << 20))
+
+
+class CachedDataset(DataIter):
+    """Wrap a fixed-order u8 source; epoch 1 streams + captures, later
+    epochs serve from the cache.
+
+    Parameters
+    ----------
+    data_iter : DataIter
+        Source delivering ONE data entry per batch (the uint8 HWC
+        image block) plus labels, in the same order every epoch (a
+        non-reshuffling ``NDArrayIter``, ``ImageRecordIter(
+        shuffle=False)``, or ``ImageRecordIter(device_augment="defer",
+        cache_decoded=True)``).  Per-epoch order variation belongs to
+        THIS class (``shuffle=True``), which re-draws a row
+        permutation from ``(seed, epoch)`` — the source is never
+        touched again once the cache is built.
+    augment : DeviceAugment, optional
+        Augment spec attached to every delivered batch — parameter
+        draws keyed on ``(epoch, batch_index)`` exactly like
+        :class:`DeviceAugmentIter`, so streaming and cached epochs
+        draw identically.
+    module : Module, optional
+        When given (even pre-bind), the cache is placed with the
+        bound mesh group's shardings at finalize time: the u8 block
+        replicated, the gather output sharded like a staged batch —
+        ``Module.fit``'s own staging then no-ops on arrival.
+    placement : str, optional
+        ``"auto"`` (device if the block fits ``budget_mb``, else
+        host), ``"device"``, ``"host"``, or ``"off"`` (pure
+        pass-through streaming).  Default: the
+        ``MXNET_DATA_CACHE_PLACEMENT`` env var, else ``"auto"``.
+    budget_mb : float, optional
+        Device-cache budget; default ``MXNET_DATA_CACHE_BUDGET_MB``
+        (1024).
+    shuffle : bool
+        Re-permute rows every CACHED epoch (capture epoch delivers
+        source order).
+    seed : int
+        Shuffle-permutation seed.
+    """
+
+    def __init__(self, data_iter, augment=None, module=None,
+                 data_name=None, placement=None, budget_mb=None,
+                 shuffle=False, seed=0, augment_placement=None,
+                 logger=None):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self._iter = data_iter
+        self._name = data_name or data_iter.provide_data[0][0]
+        if augment is None:
+            # adopt the source's deferred spec (ImageRecordIter
+            # (device_augment="defer"), DeviceAugmentIter): the cache
+            # re-draws the SAME (seed, epoch, batch) stream per epoch
+            src_spec = getattr(data_iter, "device_augment_spec", None)
+            if src_spec:
+                augment = src_spec.get(self._name)
+        self._augment = augment
+        self._module = module
+        n_src = len(data_iter.provide_data)
+        n_ok = {1}
+        if augment is not None:
+            # a defer-mode source also carries the spec's param
+            # entries; only data[0] (the image block) is captured — the
+            # cache recomputes identical draws at delivery
+            n_ok.add(1 + len(augment.param_descs(self._name,
+                                                 self.batch_size)))
+        if n_src not in n_ok:
+            raise MXNetError(
+                "CachedDataset caches ONE image data entry; the source "
+                "provides %r — attach augment params via "
+                "CachedDataset(augment=...), not on the source"
+                % ([d[0] for d in data_iter.provide_data],))
+        self.placement = (placement
+                          or os.environ.get("MXNET_DATA_CACHE_PLACEMENT")
+                          or "auto")
+        if self.placement not in _PLACEMENTS:
+            raise MXNetError("placement must be one of %r (got %r)"
+                             % (_PLACEMENTS, self.placement))
+        self._budget = _budget_bytes(budget_mb)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.logger = logger or logging.getLogger(__name__)
+        self.augment_placement = (augment_placement
+                                  or _placement_default()) \
+            if augment is not None else None
+
+        b = self.batch_size
+        if augment is not None and self.augment_placement == "device":
+            self.provide_data = augment.data_descs(self._name, b)
+            self.device_augment_spec = {self._name: augment}
+        elif augment is not None:
+            self.provide_data = [DataDesc(self._name,
+                                          augment.model_shape(b))]
+            self.device_augment_spec = {}
+        else:
+            self.provide_data = list(data_iter.provide_data)
+            self.device_augment_spec = {}
+        self.provide_label = data_iter.provide_label
+        self._label_names = [d[0] for d in (self.provide_label or [])]
+
+        self._epoch = 0
+        self._seq = 0
+        # capture/cache state
+        self._pending = [] if self.placement != "off" else None
+        self._epoch_complete = False
+        self._cache_ready = False
+        self._rows = 0
+        self._images = None       # host u8 block (host placement only:
+        #                           freed after device placement — it
+        #                           would pin an epoch of host RAM for
+        #                           nothing)
+        self._labels = None       # list of host (N, ...) label blocks
+        self._dev_images = None   # device-resident block (device mode)
+        self._gather = None
+        self._order = None
+        self._order_epoch = None
+        self.cache_placement = None     # resolved at finalize
+        self.cache_built_epoch = None
+
+    # -- epoch coordinate ----------------------------------------------
+    @property
+    def epoch_coord(self):
+        return self._epoch
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+        self._seq = 0
+        self._order = None
+
+    def reset(self):
+        if not self._cache_ready:
+            if self._epoch_complete and self._pending is not None:
+                self._finalize()
+            else:
+                # partial epoch (or placement "off"): nothing usable
+                # was captured — stream the next epoch from the source
+                if self._pending is not None:
+                    self._pending = []
+                self._iter.reset()
+        self._epoch += 1
+        self._seq = 0
+        self._order = None
+        self._epoch_complete = False
+
+    # -- capture -> cache ----------------------------------------------
+    def _finalize(self):
+        """One full epoch captured: build the resident cache and
+        compile the gather program — this runs at the END of the
+        capture epoch, i.e. inside fit's warmup window, so cached
+        epochs add zero post-warmup retraces."""
+        imgs = onp.concatenate([e[0] for e in self._pending])
+        labels = None
+        if self._pending[0][1] is not None:
+            labels = [onp.concatenate([e[1][i] for e in self._pending])
+                      for i in range(len(self._pending[0][1]))]
+        self._pending = []
+        nbytes = imgs.nbytes + sum(l.nbytes for l in (labels or []))
+        placement = self.placement
+        if placement == "auto":
+            placement = "device" if nbytes <= self._budget else "host"
+            if placement == "host":
+                self.logger.warning(
+                    "CachedDataset: decoded epoch is %.1f MB > device "
+                    "budget %.1f MB (MXNET_DATA_CACHE_BUDGET_MB) — "
+                    "serving from the host-RAM cache instead",
+                    nbytes / (1 << 20), self._budget / (1 << 20))
+        self._images, self._labels = imgs, labels
+        self._rows = int(imgs.shape[0])
+        self.cache_bytes = nbytes
+        self.cache_built_epoch = self._epoch
+        if placement == "device":
+            try:
+                self._place_on_device(imgs)
+                # the host copy has no further reader — the device
+                # block is the authority; labels stay host (gathered
+                # host-side per batch)
+                self._images = None
+            except Exception as exc:  # noqa: BLE001 — graceful fallback
+                self.logger.warning(
+                    "CachedDataset: device placement of the %.1f MB "
+                    "cache failed (%s) — serving from the host-RAM "
+                    "cache instead", nbytes / (1 << 20), exc)
+                self._dev_images, self._gather = None, None
+                placement = "host"
+        self.cache_placement = placement
+        self._cache_ready = True
+
+    def _group(self):
+        grp = getattr(self._module, "_exec_group", None)
+        return grp if grp is not None and getattr(grp, "fused", False) \
+            else None
+
+    def _place_on_device(self, imgs):
+        import jax
+        import jax.numpy as jnp
+        grp = self._group()
+        if grp is not None:
+            self._dev_images = jax.device_put(imgs, grp._repl)
+            self._gather = jax.jit(
+                lambda c, i: jnp.take(c, i, axis=0),
+                out_shardings=grp._batch_sharding)
+        else:
+            self._dev_images = jax.device_put(imgs)
+            self._gather = jax.jit(lambda c, i: jnp.take(c, i, axis=0))
+        # compile NOW (still inside the warmup window) with the steady
+        # (B,) index aval, and block so a compile failure surfaces here
+        warm = self._gather(self._dev_images,
+                            jnp.zeros((self.batch_size,), jnp.int32))
+        warm.block_until_ready()
+
+    # -- delivery -------------------------------------------------------
+    def _epoch_order(self):
+        n = self._rows
+        if not self.shuffle:
+            return onp.arange(n)
+        rng = onp.random.RandomState(
+            fold_seed(self.seed ^ 0x5ca1ab1e, self._epoch, 0))
+        return rng.permutation(n)
+
+    def _attach(self, img, labels, pad):
+        """One delivered batch: augment params attached (device
+        placement) or the host-reference augment applied (host
+        placement) — draws keyed on (epoch, seq) either way."""
+        aug = self._augment
+        if aug is None:
+            self._seq += 1
+            return DataBatch(data=[img], label=labels, pad=pad)
+        # draws sized to the DELIVERED rows (a short capture-epoch tail
+        # has fewer than batch_size) — exactly DeviceAugmentIter's
+        # draw, so streaming and cached modes stay bit-identical
+        rows = int(img.shape[0])
+        params = aug.draw(self._name, self._epoch, self._seq, rows)
+        self._seq += 1
+        if self.augment_placement == "device":
+            data = [img] + [params[d.name] for d in
+                            aug.param_descs(self._name, rows)]
+        else:
+            img = img._read() if hasattr(img, "_read") else img
+            data = [aug.apply_host(
+                onp.asarray(img),
+                params.get(crop_input_name(self._name)),
+                params.get(mirror_input_name(self._name)), train=True)]
+        return DataBatch(data=data, label=labels, pad=pad)
+
+    def next(self):
+        if self._cache_ready:
+            return self._next_cached()
+        try:
+            batch = self._iter.next()
+        except StopIteration:
+            self._epoch_complete = True
+            raise
+        img = batch.data[0]
+        img = img._read() if hasattr(img, "_read") else img
+        img = onp.asarray(img)
+        labels = None
+        if batch.label:
+            labels = [onp.asarray(lb._read() if hasattr(lb, "_read")
+                                  else lb) for lb in batch.label]
+        if self._pending is not None:
+            pad = int(batch.pad or 0)
+            # pad rows are physically present only when the source
+            # wrapped the batch to full size (round-batch semantics);
+            # a SHORT tail (round_batch=False) sets pad but delivers
+            # real rows only — stripping there would lose data
+            keep = img.shape[0] - pad \
+                if pad and img.shape[0] == self.batch_size \
+                else img.shape[0]
+            self._pending.append(
+                (img[:keep].copy(),
+                 None if labels is None else
+                 [lb[:keep].copy() for lb in labels]))
+        return self._attach(img, labels, int(batch.pad or 0))
+
+    def _next_cached(self):
+        b = self.batch_size
+        if self._order is None or self._order_epoch != self._epoch:
+            self._order = self._epoch_order()
+            self._order_epoch = self._epoch
+        lo = self._seq * b
+        if lo >= len(self._order):
+            raise StopIteration
+        idxs = self._order[lo:lo + b]
+        pad = b - len(idxs)
+        if pad > 0:
+            # round-batch semantics: wrap the epoch head, report pad
+            idxs = onp.concatenate([idxs, self._order[:pad]])
+        idxs = onp.ascontiguousarray(idxs.astype(onp.int32))
+        if self._dev_images is not None:
+            import jax.numpy as jnp
+            img = self._gather(self._dev_images, jnp.asarray(idxs))
+        else:
+            img = self._images[idxs]
+        labels = None
+        if self._labels is not None:
+            labels = [lb[idxs] for lb in self._labels]
+        return self._attach(img, labels, pad)
+
+    def iter_next(self):
+        try:
+            self._current = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._current.data
+
+    def getlabel(self):
+        return self._current.label
+
+    def getpad(self):
+        return self._current.pad
+
+    def getindex(self):
+        return self._current.index
+
+    # -- introspection --------------------------------------------------
+    def cache_info(self):
+        """Resolved cache state: ``placement`` (None until built),
+        ``rows``, ``bytes``, ``built_epoch``."""
+        return {
+            "placement": self.cache_placement,
+            "rows": self._rows,
+            "bytes": getattr(self, "cache_bytes", 0),
+            "built_epoch": self.cache_built_epoch,
+        }
+
+    def close(self):
+        self._dev_images = None
+        self._gather = None
+        inner = getattr(self._iter, "close", None)
+        if callable(inner):
+            inner()

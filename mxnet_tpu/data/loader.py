@@ -46,6 +46,23 @@ def _host_value(arr):
     return arr._read() if hasattr(arr, "_read") else arr
 
 
+def _batch_wire_stats(batches):
+    """(bytes, dtype) a group of batches puts on the transport: the
+    sum of every HOST array's nbytes (a device-resident array — e.g.
+    a CachedDataset gather output — passes through ``device_put``
+    without a transfer and counts 0), and the IMAGE (first data
+    entry) dtype — uint8 on the u8 wire path, float32 on the classic
+    host-assemble path."""
+    total = 0
+    for b in batches:
+        for a in b.data:
+            v = _host_value(a)
+            if isinstance(v, onp.ndarray):
+                total += int(v.nbytes)
+    first = _host_value(batches[0].data[0])
+    return total, getattr(first, "dtype", None)
+
+
 class DeviceLoader(DataIter):
     """Wrap ``data_iter`` so every delivered batch is device-resident.
 
@@ -113,6 +130,18 @@ class DeviceLoader(DataIter):
             else:
                 self._group_handle = grp
         self._module = module
+        # wire-format attribution: where the augment stage runs for
+        # batches staged through this loader, and (set per stage) what
+        # dtype crossed the transport
+        grp = self._group_handle
+        self.pipeline_stats.augment_placement = \
+            "device" if grp is not None and \
+            getattr(grp, "_device_augment", None) else \
+            getattr(data_iter, "augment_placement", None) or "host"
+        # u8 pipelines advertise their spec; forward it so a manually
+        # built DeviceLoader can still be handed straight to fit()
+        self.device_augment_spec = getattr(data_iter,
+                                           "device_augment_spec", None)
 
         self._cond = threading.Condition()
         self._ring = []          # staged entries, delivery order
@@ -151,14 +180,20 @@ class DeviceLoader(DataIter):
         carrying the staged dict so ``Module._grouped_step`` can hand
         the block straight to the scanned program."""
         from ..module.base_module import stack_group_inputs
+        # default stacking rule: all-host batches form ONE contiguous
+        # numpy block (single device_put), device-resident batches
+        # (CachedDataset gathers) stack with jnp ON DEVICE — an
+        # onp.stack there would be K blocking readbacks
         stacked = stack_group_inputs(
-            batches, self._data_names, self._label_names,
-            stack=lambda arrs: onp.stack([onp.asarray(_host_value(a))
-                                          for a in arrs]))
+            batches, self._data_names, self._label_names)
         staged = self._group_handle.stage_stacked(stacked)
         out = []
         for j, b in enumerate(batches):
-            data = [nd.NDArray(staged[n][j]) for n in self._data_names]
+            # augmented groups: stage_stacked consumed the wire param
+            # arrays and replaced the u8 block with the f32 model view
+            # — the views carry whatever inputs the staged block kept
+            data = [nd.NDArray(staged[n][j]) for n in self._data_names
+                    if n in staged]
             label = None
             if b.label:
                 label = [nd.NDArray(staged[n][j]) if n in staged
@@ -187,6 +222,7 @@ class DeviceLoader(DataIter):
                     break
             if not pulled:
                 return _END
+            nbytes, dtype = _batch_wire_stats(pulled)
             t0 = time.perf_counter()
             with telemetry.span("data.stage_block", k=len(pulled)):
                 if self._group_handle is not None and len(pulled) > 0 and \
@@ -195,17 +231,20 @@ class DeviceLoader(DataIter):
                 else:
                     staged = [self._stage_batch(b) for b in pulled]
             rows = sum(b.data[0].shape[0] for b in staged)
-            self.pipeline_stats.note_staged(rows, time.perf_counter() - t0)
+            self.pipeline_stats.note_staged(rows, time.perf_counter() - t0,
+                                            nbytes, dtype)
             return staged
         try:
             batch = self._iter.next()
         except StopIteration:
             return _END
+        nbytes, dtype = _batch_wire_stats([batch])
         t0 = time.perf_counter()
         with telemetry.span("data.stage"):
             staged = self._stage_batch(batch)
         self.pipeline_stats.note_staged(staged.data[0].shape[0],
-                                        time.perf_counter() - t0)
+                                        time.perf_counter() - t0,
+                                        nbytes, dtype)
         return [staged]
 
     @staticmethod
@@ -356,6 +395,43 @@ class DeviceLoader(DataIter):
         if self._closed:
             raise MXNetError("DeviceLoader is closed")
         self._start_epoch(reset_source=True)
+
+    def set_epoch(self, epoch):
+        """Forward ``fit``'s epoch-coordinate pin to the source (the
+        seeded-stream iterators: DeviceAugmentIter, CachedDataset,
+        ShardedDataIter).  A no-op when the source is already at
+        ``epoch`` — the construction-time prefill stays valid; a real
+        rebase cancels the stager and drops any batches staged under
+        the stale coordinate (the stager restarts lazily)."""
+        if self._closed:
+            raise MXNetError("DeviceLoader is closed")
+        fwd = getattr(self._iter, "set_epoch", None)
+        if fwd is None:
+            return
+        coord = getattr(self._iter, "epoch_coord", None)
+        if coord is None:
+            # coordinate-less wrapper (e.g. a PrefetchingIter over
+            # non-pinnable sources): its set_epoch is a no-op by the
+            # protocol contract (sources that ACT on set_epoch expose
+            # epoch_coord), so forward the pin without paying a rebase
+            # — dropping the ring every epoch would defeat the prefill
+            fwd(epoch)
+            return
+        if coord == int(epoch):
+            return
+        self._stop_stager()
+        # the dropped ring batches were already PULLED from the source
+        # under the stale coordinate — rewind it before pinning, or the
+        # rebased epoch would start short by the prefilled batches
+        self._iter.reset()
+        fwd(epoch)
+        with self._cond:
+            self._ring = []
+            self._pending = []
+            self._stop = False
+            self._exhausted = False
+            self._noted_full = False
+            self._live_epoch += 1
 
     # -- lifecycle -----------------------------------------------------
     def close(self):

@@ -57,7 +57,14 @@ class PipelineStats:
         self._c_stage_ms = c("stage_ms")
         self._c_images_staged = c("images_staged")
         self._c_batches_staged = c("batches_staged")
+        self._c_bytes_staged = c("bytes_staged")
         self._c_ring_full_waits = c("ring_full_waits")
+        # wire-format attribution (the io_device_augment bench fields):
+        # what dtype actually crossed the transport and where the
+        # augment stage ran — plain attrs, not registry instruments
+        # (strings; exported through snapshot())
+        self.staged_dtype = None
+        self.augment_placement = None
         self._g_ring_depth = self.scope.gauge("ring_depth")
         self._g_ring_occupancy = self.scope.gauge("ring_occupancy")
         self._g_ring_high_water = self.scope.gauge("ring_high_water")
@@ -72,6 +79,7 @@ class PipelineStats:
     stage_ms = telemetry.instrument_value("_c_stage_ms")
     images_staged = telemetry.instrument_value("_c_images_staged")
     batches_staged = telemetry.instrument_value("_c_batches_staged")
+    bytes_staged = telemetry.instrument_value("_c_bytes_staged")
     ring_full_waits = telemetry.instrument_value("_c_ring_full_waits")
     ring_occupancy = telemetry.instrument_value("_g_ring_occupancy")
     ring_high_water = telemetry.instrument_value("_g_ring_high_water")
@@ -97,16 +105,20 @@ class PipelineStats:
         for inst in (self._c_batches_delivered, self._c_images_delivered,
                      self._c_host_wait_ms, self._c_stage_ms,
                      self._c_images_staged, self._c_batches_staged,
-                     self._c_ring_full_waits, self._g_ring_occupancy,
-                     self._g_ring_high_water):
+                     self._c_bytes_staged, self._c_ring_full_waits,
+                     self._g_ring_occupancy, self._g_ring_high_water):
             inst.reset()
         self._g_ring_depth.set(depth)
 
     # -- producer side -------------------------------------------------
-    def note_staged(self, rows, seconds):
+    def note_staged(self, rows, seconds, nbytes=0, dtype=None):
         self._c_batches_staged.add()
         self._c_images_staged.add(int(rows))
         self._c_stage_ms.add(seconds * 1000.0)
+        if nbytes:
+            self._c_bytes_staged.add(int(nbytes))
+        if dtype is not None:
+            self.staged_dtype = str(dtype)
 
     def note_ring(self, occupancy):
         occupancy = int(occupancy)
@@ -133,6 +145,8 @@ class PipelineStats:
         per_step = host_wait / batches if batches else 0.0
         stager_rate = (self.images_staged / (stage_ms / 1000.0)
                        if stage_ms > 0 else 0.0)
+        staged_batches = self.batches_staged
+        staged_bytes = self.bytes_staged
         return {
             "batches_delivered": batches,
             "images_delivered": self.images_delivered,
@@ -144,6 +158,12 @@ class PipelineStats:
             "ring_occupancy": self.ring_occupancy,
             "ring_high_water": self.ring_high_water,
             "ring_full_waits": self.ring_full_waits,
+            "staged_bytes": staged_bytes,
+            "staged_bytes_per_batch": round(
+                staged_bytes / staged_batches, 1) if staged_batches
+            else 0.0,
+            "staged_dtype": self.staged_dtype,
+            "augment_placement": self.augment_placement,
         }
 
     def __repr__(self):

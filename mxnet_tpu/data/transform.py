@@ -160,14 +160,12 @@ class TransformIter(DataIter):
         self._finish(epoch, seq, out)
 
     def _batch_seed(self, epoch, seq):
-        # SplitMix-style fold of (seed, epoch, seq): adjacent batches
-        # must land on unrelated streams, and the value is a function of
-        # the SEQUENCE position only — worker identity never enters
-        x = (self._seed * 0x9e3779b97f4a7c15
-             + epoch * 0xbf58476d1ce4e5b9
-             + seq * 0x94d049bb133111eb) & 0xffffffffffffffff
-        x ^= x >> 31
-        return x & 0x7fffffff
+        # THE SplitMix fold (data.augment.fold_seed, shared with the
+        # DeviceAugment draw machinery): adjacent batches must land on
+        # unrelated streams, and the value is a function of the
+        # SEQUENCE position only — worker identity never enters
+        from .augment import fold_seed
+        return fold_seed(self._seed, epoch, seq)
 
     def _finish(self, epoch, seq, value):
         with self._cond:

@@ -359,6 +359,16 @@ class ImageRecordIter(DataIter):
     the TPU-native replacement for iter_normalize.h. Wrap with
     PrefetchingIter (io.py) for background double-buffering like the
     reference's PrefetcherIter.
+
+    ``device_augment="defer"`` goes one step further: the iterator
+    emits raw uint8 NHWC wire batches plus deterministic per-batch
+    augment-parameter draws and exposes ``device_augment_spec`` — the
+    bound module then runs pad/crop/mirror/normalize as its own
+    compiled device program at staging time
+    (``mxnet_tpu.data.DeviceAugment``; kept separate from the train
+    step so the step program's numerics stay bitwise-identical to the
+    host-reference path), so random crop (``augment_pad``) composes
+    with ``cache_decoded`` and draws replay across resume.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
@@ -366,8 +376,9 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, scale=1.0, resize=-1, preprocess_threads=4,
                  preprocess_processes=0, device_augment=False,
-                 cache_decoded=False, round_batch=True, data_name="data",
-                 label_name="softmax_label", seed=0, **kwargs):
+                 augment_pad=0, cache_decoded=False, round_batch=True,
+                 data_name="data", label_name="softmax_label", seed=0,
+                 **kwargs):
         super().__init__(batch_size)
         self.rec = runtime.RecordFile(path_imgrec)
         self._path_imgrec = path_imgrec
@@ -385,6 +396,37 @@ class ImageRecordIter(DataIter):
         self.rng = random.Random(seed)
         self.device_augment = device_augment
         self._device_fn = None
+        # device_augment="defer": do NOT augment here at all — emit raw
+        # uint8 NHWC wire batches plus the per-batch augment-parameter
+        # draws of a DeviceAugment spec, and let the bound module
+        # compile crop/mirror/normalize INTO the train-step program
+        # (fit adopts device_augment_spec).  Decode geometry is then
+        # always deterministic (center), so it composes with
+        # cache_decoded AND rand_crop: crop randomness comes from the
+        # in-program pad+crop (augment_pad), not from decode.
+        self._defer = device_augment == "defer"
+        self._aug_spec = None
+        self._batch_seq = 0
+        if self._defer:
+            from .data.augment import DeviceAugment
+            c, th, tw = self.data_shape
+            if rand_crop and not augment_pad:
+                # decode geometry is deterministic in defer mode; with
+                # no pad the in-program crop window is 0x0 — rand_crop
+                # would silently become a center crop
+                raise ValueError(
+                    "rand_crop with device_augment='defer' needs "
+                    "augment_pad>0: crop randomness comes from the "
+                    "in-program pad-and-crop, not from decode")
+            self._aug_spec = DeviceAugment(
+                (c, th, tw), rand_crop=rand_crop,
+                rand_mirror=rand_mirror, pad=augment_pad,
+                mean=self.mean, std=self.std, scale=scale, seed=seed)
+            self.device_augment_spec = {data_name: self._aug_spec}
+        elif augment_pad:
+            raise ValueError(
+                "augment_pad is the in-program pad-and-crop knob; it "
+                "needs device_augment='defer'")
         if preprocess_processes > 0:
             import multiprocessing
             from concurrent.futures import ProcessPoolExecutor
@@ -411,11 +453,12 @@ class ImageRecordIter(DataIter):
         # needs fresh geometry per epoch and is rejected.
         self.cache_decoded = cache_decoded
         self._cache = None
-        if cache_decoded and rand_crop:
+        if cache_decoded and rand_crop and not self._defer:
             raise ValueError(
                 "cache_decoded caches one deterministic decode per "
                 "image; rand_crop needs fresh geometry every epoch — "
-                "use the streaming path for random-crop training")
+                "use the streaming path for random-crop training, or "
+                "device_augment='defer' (crop runs in-program)")
         self.seq = list(range(len(self.rec)))
         self.cur = 0
         # NOTE on staging: each batch gets a FRESH host buffer. A pooled
@@ -426,24 +469,61 @@ class ImageRecordIter(DataIter):
         # alias asynchronously). runtime.core.HostPool remains available
         # (and assemble_batch takes ``out=``) for callers that own the
         # buffer lifetime end-to-end.
-        self.provide_data = [DataDesc(data_name,
-                                      (batch_size,) + self.data_shape)]
+        # decode-time crop geometry: random only on the host-augment
+        # streaming path; "defer" decodes deterministically (the
+        # in-program pad+crop supplies the randomness)
+        self._decode_rand_crop = bool(rand_crop) and not self._defer
+        if self._defer:
+            self.provide_data = self._aug_spec.data_descs(data_name,
+                                                          batch_size)
+        else:
+            self.provide_data = [DataDesc(data_name,
+                                          (batch_size,) + self.data_shape)]
+        self._data_name = data_name
         self.provide_label = [DataDesc(label_name, (batch_size, label_width)
                                        if label_width > 1 else (batch_size,))]
         self.reset()
 
     def reset(self):
-        if self.shuffle:
-            self.rng.shuffle(self.seq)
-        self.cur = 0
         self._epoch = getattr(self, "_epoch", -1) + 1
+        self._reshuffle()
+        self.cur = 0
+        self._batch_seq = 0
+
+    def _reshuffle(self):
+        """Epoch k's order is a pure function of ``(seed, k)`` —
+        re-drawn from the FIXED base order, never cumulatively — so
+        ``set_epoch(k)`` replays it exactly regardless of how many
+        resets this process has seen (the resume-replay contract; a
+        cumulative ``rng.shuffle`` would depend on the reset COUNT)."""
+        if not self.shuffle:
+            return
+        from .data.augment import fold_seed
+        rs = onp.random.RandomState(
+            fold_seed(self.seed ^ 0x5bd1e995, self._epoch, 0))
+        self.seq = list(range(len(self.rec)))
+        rs.shuffle(self.seq)
+
+    def set_epoch(self, epoch):
+        """Pin the epoch coordinate (the resume-replay contract).
+
+        Both the deferred-augment draws and the shuffle order are
+        pure functions of the pinned coordinate, so a resumed fit
+        replays the uninterrupted run's stream exactly."""
+        self._epoch = int(epoch)
+        self._batch_seq = 0
+        self._reshuffle()
+
+    @property
+    def epoch_coord(self):
+        return self._epoch
 
     def _decode_one(self, idx):
         header, img_bytes = recordio.unpack(self.rec.read(idx))
         c, th, tw = self.data_shape
 
         def pick(h, w):
-            if not self.rand_crop:
+            if not self._decode_rand_crop:
                 return (h - th) // 2, (w - tw) // 2
             return self.rng.randint(0, h - th), self.rng.randint(0, w - tw)
 
@@ -527,8 +607,8 @@ class ImageRecordIter(DataIter):
         elif self._proc_mode:
             c, th, tw = self.data_shape
             ep_seed = self.seed ^ (self._epoch * 0x9e3779b1 & 0xffffffff)
-            work = [(i, self.resize, th, tw, self.rand_crop, ep_seed)
-                    for i in idxs]
+            work = [(i, self.resize, th, tw, self._decode_rand_crop,
+                     ep_seed) for i in idxs]
             results = list(self.pool.map(_proc_decode_one, work,
                                          chunksize=4))
         else:
@@ -536,12 +616,26 @@ class ImageRecordIter(DataIter):
         if not self.cache_decoded:
             imgs = onp.stack([r[0] for r in results])
             labels = onp.stack([r[1] for r in results])
+        label_out = labels if self.label_width > 1 else labels[:, 0]
+        if self._defer:
+            # raw uint8 NHWC wire batch + the spec's per-batch augment
+            # parameter draws, keyed (seed, epoch, batch index) — the
+            # bound program does crop/mirror/normalize in one fused
+            # stage (4x fewer staged bytes than f32 NCHW)
+            spec = self._aug_spec
+            params = spec.draw(self._data_name, self._epoch,
+                               self._batch_seq, imgs.shape[0])
+            self._batch_seq += 1
+            data = [imgs] + [
+                params[d.name]
+                for d in spec.param_descs(self._data_name,
+                                          imgs.shape[0])]
+            return DataBatch(data, [nd.array(label_out)], pad=pad)
         mirror = None
         if self.rand_mirror:
             mirror = onp.array(
                 [self.rng.random() < 0.5 for _ in range(len(idxs))],
                 onp.uint8)
-        label_out = labels if self.label_width > 1 else labels[:, 0]
         if self.device_augment:
             batch = nd.NDArray(self._device_preprocess(imgs, mirror))
         else:

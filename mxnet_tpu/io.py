@@ -121,6 +121,19 @@ class ResizeIter(DataIter):
         if self.reset_internal:
             self.data_iter.reset()
 
+    def set_epoch(self, epoch):
+        """Forward fit's epoch-coordinate pin to the wrapped iterator.
+
+        Seeded-stream sources then replay deterministically on
+        resume."""
+        fwd = getattr(self.data_iter, "set_epoch", None)
+        if fwd is not None:
+            fwd(epoch)
+
+    @property
+    def epoch_coord(self):
+        return getattr(self.data_iter, "epoch_coord", None)
+
     def iter_next(self):
         if self.cur == self.size:
             return False
@@ -269,6 +282,58 @@ class PrefetchingIter(DataIter):
             e.clear()
         for e in self.data_taken:
             e.set()
+
+    def set_epoch(self, epoch):
+        """Forward fit's epoch-coordinate pin to every source.
+
+        Cheap when nothing actually moves: sources already at
+        ``epoch``, and coordinate-less wrappers (whose ``set_epoch``
+        is a no-op by the protocol contract — sources that ACT on the
+        pin expose ``epoch_coord``) just receive the forward and the
+        prefetched batch stays valid.  A real rebase waits for the
+        in-flight prefetch, discards it, REWINDS every source (the
+        discarded batch was already pulled from all of them under the
+        stale coordinate) and pins the new epoch."""
+        if not self.started:
+            raise MXNetError("PrefetchingIter is closed")
+        fwds = [getattr(i, "set_epoch", None) for i in self.iters]
+        if not any(fwds):
+            return
+        if all(fwd is None
+               or getattr(i, "epoch_coord", None) in (None, int(epoch))
+               for i, fwd in zip(self.iters, fwds)):
+            # forward ONLY to coordinate-less wrappers (their pin is a
+            # no-op by contract).  A source already AT the epoch must
+            # NOT be re-pinned: reset()'s eager prefetch consumed its
+            # draw 0, and zeroing its sequence counter would make the
+            # next batch re-draw it
+            for i, fwd in zip(self.iters, fwds):
+                if fwd is not None and \
+                        getattr(i, "epoch_coord", None) is None:
+                    fwd(epoch)
+            return
+        for e in self.data_ready:
+            e.wait()
+        # the discarded in-flight batch was pulled from EVERY source:
+        # rewind them all (not just the pinnable ones), or co-iterated
+        # label/data streams would skew by one batch after the rebase
+        for i, fwd in zip(self.iters, fwds):
+            i.reset()
+            if fwd is not None:
+                fwd(epoch)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    @property
+    def epoch_coord(self):
+        """The sources' common epoch coordinate (None when mixed or
+        none are pinnable) — lets an outer DeviceLoader's no-op check
+        keep its prefill instead of rebasing spuriously."""
+        coords = {getattr(i, "epoch_coord", None) for i in self.iters}
+        coords.discard(None)
+        return coords.pop() if len(coords) == 1 else None
 
     def iter_next(self):
         if not self.started:

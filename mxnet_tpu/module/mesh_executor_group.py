@@ -92,7 +92,7 @@ class MeshExecutorGroup(object):
                  shared_group=None, logger=logging, fixed_param_names=None,
                  grad_req="write", compute_dtype=None, remat=None,
                  mesh_axes=None, param_sharding=None,
-                 pipeline_microbatches=None):
+                 pipeline_microbatches=None, device_augment=None):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -246,6 +246,13 @@ class MeshExecutorGroup(object):
         self._metric_live = None
         self._metric_acc = None
         self._metric_step_done = False
+        # device-side input augmentation (mxnet_tpu.data.DeviceAugment):
+        # {data input name: spec}.  The wire batch stages as uint8 NHWC
+        # (4x fewer bytes than f32 NCHW) plus tiny per-row parameter
+        # arrays; pad/crop/mirror/normalize/transpose run as their OWN
+        # compiled device program at staging (_augment_jit below) and
+        # the host never touches a float pixel.
+        self._device_augment = dict(device_augment or {})
 
         self.bind_exec(data_shapes, label_shapes)
 
@@ -339,6 +346,20 @@ class MeshExecutorGroup(object):
         self.label_shapes = [(x[0], tuple(x[1])) for x in label_shapes] \
             if label_shapes else None
         self._input_shapes = dict(self.data_shapes)
+        # device-augmented inputs: the symbol's shape world sees the
+        # MODEL view (B, C, H, W) f32; the wire view (uint8 NHWC block
+        # + crop/mirror parameter arrays) exists only in staging and in
+        # run_fwd's first stage.  data_shapes keeps the wire entries —
+        # _stage zips them against batch.data — while _input_shapes
+        # drives infer_shape.
+        for name, aug in getattr(self, "_device_augment", {}).items():
+            if name not in self._input_shapes:
+                raise MXNetError(
+                    "device_augment names input %r but the bind "
+                    "provides %r" % (name, list(self._input_shapes)))
+            for d in aug.param_descs(name, self.batch_size):
+                self._input_shapes.pop(d.name, None)
+            self._input_shapes[name] = aug.model_shape(self.batch_size)
         if self.label_shapes:
             self._input_shapes.update(dict(self.label_shapes))
         self.input_names = list(self._input_shapes)
@@ -847,7 +868,72 @@ class MeshExecutorGroup(object):
             off += size
 
     # ------------------------------------------------------------------
-    def _stage(self, batch):
+    # device-side input augmentation (mxnet_tpu.data.DeviceAugment)
+    #
+    # The augment runs as its OWN compiled device program at staging
+    # time, consuming the staged uint8 NHWC wire block + the tiny
+    # per-row parameter arrays and emitting the f32 NCHW model batch.
+    # Deliberately NOT fused into the train-step program: a different
+    # preamble changes how XLA compiles the whole step (layout/fusion
+    # choices shift the model's reduction rounding), which would break
+    # the bitwise host-reference parity contract.  Standalone, the
+    # augment is pure elementwise/gather work — no reductions — so its
+    # output bytes equal DeviceAugment.apply_host exactly for ANY
+    # batch shape, and the train-step program stays byte-identical to
+    # one fed pre-augmented f32 batches.  The wire still carries u8
+    # (the 4x transfer win); the cost is one extra launch per staged
+    # batch, amortized K-fold by grouped staging.
+    def _augment_jit(self, name, aug, train, grouped):
+        key = ("augment", name, bool(train), bool(grouped))
+        if key in self._jits:
+            return self._jits[key]
+        import jax
+
+        out_sh = self._stacked_sharding() if grouped \
+            else self._batch_sharding
+
+        def fn(x, crop, mirror):
+            if not grouped:
+                return aug.apply(x, crop, mirror, train=train)
+            # (K, B, ...) block: flatten the group axis, augment, and
+            # restore — elementwise ops, so the bytes match K per-batch
+            # launches exactly
+            k, b = x.shape[0], x.shape[1]
+            flat = aug.apply(
+                x.reshape((k * b,) + tuple(x.shape[2:])),
+                None if crop is None else
+                crop.reshape((k * b,) + tuple(crop.shape[2:])),
+                None if mirror is None else mirror.reshape((k * b,)),
+                train=train)
+            return flat.reshape((k, b) + tuple(flat.shape[1:]))
+
+        jitted = jax.jit(fn, out_shardings=out_sh,
+                         static_argnames=())
+        self._jits[key] = jitted
+        return jitted
+
+    def _apply_device_augment(self, inputs, is_train, grouped=False):
+        """Replace each augmented input's staged wire block (+ param
+        arrays, which are POPPED) with the augment program's f32 model
+        batch.  Already-model-view inputs (a classic f32 eval iterator
+        on an augment-bound module) pass through untouched."""
+        if not self._device_augment:
+            return inputs
+        from ..data.augment import crop_input_name, mirror_input_name
+        lead = 2 if grouped else 1
+        for name, aug in self._device_augment.items():
+            v = inputs.get(name)
+            if v is None:
+                continue
+            crop = inputs.pop(crop_input_name(name), None)
+            mirror = inputs.pop(mirror_input_name(name), None)
+            if tuple(v.shape[lead:]) != aug.wire_shape:
+                continue    # already the model view
+            fn = self._augment_jit(name, aug, is_train, grouped)
+            inputs[name] = fn(v, crop, mirror)
+        return inputs
+
+    def _stage(self, batch, is_train=False):
         """Shard the host batch onto the mesh ('dp' on axis 0).
 
         Every input rides THE staging rule
@@ -875,6 +961,7 @@ class MeshExecutorGroup(object):
             for name, arr in zip(self._label_names, batch.label):
                 if arr is not None:
                     inputs[name] = put(arr)
+        inputs = self._apply_device_augment(inputs, is_train)
         from ..dist.staging import stage_zeros
         bs = next(iter(inputs.values())).shape[0]
         for name in self._nonparam_names:
@@ -893,7 +980,7 @@ class MeshExecutorGroup(object):
             sharding = self._batch_sharding
         return NamedSharding(self.mesh, P(*((None,) + sharding.spec)))
 
-    def stage_stacked(self, stacked_data):
+    def stage_stacked(self, stacked_data, is_train=True):
         """Place a dict of name -> (K, batch, ...) blocks (host or
         device, NDArray or raw) onto the mesh — ONE ``device_put`` per
         block — and zero-fill bound inputs the block does not provide
@@ -916,6 +1003,8 @@ class MeshExecutorGroup(object):
             inputs[name] = stage_sharded(
                 arr, st_batch,
                 (K, self.batch_size) + tuple(arr.shape[2:]))
+        inputs = self._apply_device_augment(inputs, is_train,
+                                            grouped=True)
         from ..dist.staging import stage_zeros
         bs = next(iter(inputs.values())).shape[1]
         for name in self._nonparam_names:
@@ -931,7 +1020,7 @@ class MeshExecutorGroup(object):
         device). Returns a tuple of stacked (K, ...) output jax arrays.
         """
         self._materialize_backward()
-        inputs = self.stage_stacked(stacked_data)
+        inputs = self.stage_stacked(stacked_data, is_train=False)
         fn = self._get_jit("fwd_eval_stacked")
         params = {n: b._read() for n, b in self._param_dict.items()}
         aux = {n: b._read() for n, b in self._aux_dict.items()}
@@ -948,7 +1037,7 @@ class MeshExecutorGroup(object):
         # must run before its inputs are superseded — dropping it would
         # lose that batch's grads and BN-EMA side effects
         self._materialize_backward()
-        inputs = self._stage(data_batch)
+        inputs = self._stage(data_batch, is_train=bool(is_train))
         rng = _random.next_key() if self._needs_rng else \
             onp.zeros((2,), onp.uint32)
         self._pending = (inputs, bool(is_train), rng)

@@ -84,9 +84,14 @@ class Module(BaseModule):
                  context=None, work_load_list=None, fixed_param_names=None,
                  compute_dtype=None, remat=None, mesh_axes=None,
                  param_sharding=None, pipeline_microbatches=None,
-                 _allow_fused=True):
+                 device_augment=None, _allow_fused=True):
         super().__init__(logger=logger)
         self._compute_dtype = compute_dtype
+        # {data name: mxnet_tpu.data.DeviceAugment} — in-program input
+        # augmentation (u8 wire batches).  Usually adopted from the
+        # train iterator's device_augment_spec by fit(); settable here
+        # for manual bind flows.
+        self._device_augment = dict(device_augment or {})
         if mesh_axes is not None:
             mesh_axes = dict(mesh_axes)
             if "dp" not in mesh_axes:
@@ -401,7 +406,18 @@ class Module(BaseModule):
                 compute_dtype=self._compute_dtype, remat=self._remat,
                 mesh_axes=self._mesh_axes,
                 param_sharding=self._param_sharding,
-                pipeline_microbatches=self._pipeline_microbatches)
+                pipeline_microbatches=self._pipeline_microbatches,
+                device_augment=self._device_augment)
+        elif self._device_augment:
+            # the u8 wire layout + in-program augment stage exist only
+            # in the one-program mesh path; a silent classic fallback
+            # would hand the symbol uint8 NHWC blocks it cannot consume
+            raise ValueError(
+                "device_augment requires the fused mesh path, but this "
+                "bind is not fused-eligible (check MXNET_MODULE_FUSED, "
+                "batch divisibility by the dp axis, grad_req='write', "
+                "uniform work_load_list, distinct same-platform "
+                "devices)")
         elif shared_is_fused:
             raise ValueError(
                 "shared_module uses the fused mesh group but this bind is "
@@ -520,11 +536,12 @@ class Module(BaseModule):
                 "parameters are shared with another module; bind all "
                 "modules with MXNET_MODULE_FUSED=0 instead" % reason)
         if self._mesh_axes is not None or self._param_sharding or \
-                self._pipeline_microbatches:
+                self._pipeline_microbatches or self._device_augment:
             raise MXNetError(
                 "cannot fall back from the fused mesh group (%s): "
-                "mesh_axes/param_sharding/pipeline_microbatches have no "
-                "classic-path equivalent" % reason)
+                "mesh_axes/param_sharding/pipeline_microbatches/"
+                "device_augment have no classic-path equivalent"
+                % reason)
         if self._params_dirty:
             self._sync_params_from_devices()
         if self._compute_dtype is not None:

@@ -31,6 +31,12 @@ CASES = [
     ("image-classification/fine_tune.py", []),
     ("image-classification/train_cifar10.py",
      ["--num-epochs", "3"]),
+    # the u8 device-input path: uint8 wire batches, augment compiled
+    # as a device program, HBM-resident dataset cache from epoch 2 —
+    # the script self-asserts the structural contract (u8 wire desc,
+    # augment bound into the module, cache built)
+    ("image-classification/train_cifar10.py",
+     ["--num-epochs", "2", "--device-augment", "--cache-dataset"]),
     ("neural-style/neural_style.py", ["--iters", "200"]),
     ("warpctc/ctc_train.py", ["--num-epoch", "10"]),
     ("bayesian-methods/sgld.py",
