@@ -421,6 +421,22 @@ def main():
         except Exception as e:
             extra["prefetch_error"] = str(e)[:160]
 
+    if fused and os.environ.get("BENCH_PRECISION", "1") != "0":
+        # opt-in precision modes (mxnet_tpu.precision): the same raw
+        # step loop under BENCH_PRECISION_MODE (default "combined":
+        # bf16 optimizer state + dots_saveable remat) vs the headline
+        # f32 run — throughput ratio AND the analyze_compiled byte
+        # account, so the recorded delta attributes the win to bytes.
+        # Off in the CPU contract smoke (another full resnet-50
+        # train-step compile).
+        try:
+            extra.update(_bench_precision(
+                mx, net, ctxs, batch, img, steps, img_per_sec,
+                extra.get("xla_bytes_per_step_gb"), n_dev,
+                compute_dtype))
+        except Exception as e:
+            extra["precision_error"] = str(e)[:160]
+
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # online serving: bucketed Predictor + DynamicBatcher under
         # concurrent mixed-size requests (docs/api/serving.md) — the
@@ -733,6 +749,90 @@ def _bench_prefetch(mx, mod, batch, steps, step_img_per_sec):
         out["prefetch_vs_plain"] = round(
             pre_fields["prefetch_img_per_sec"]
             / plain_fields["prefetch_plain_img_per_sec"], 3)
+    return out
+
+
+def _bench_precision(mx, net, ctxs, batch, img, steps, f32_img_per_sec,
+                     f32_gb_per_step, n_dev, compute_dtype):
+    """Precision-mode window (mxnet_tpu.precision): a SECOND module on
+    the same symbol under ``BENCH_PRECISION_MODE`` (default "combined"
+    = bf16 optimizer state + dots_saveable remat), driven by the same
+    raw step loop and two-window slope as the headline number, plus the
+    shared ``analyze_compiled`` byte account of its one-program train
+    step.  ``precision_gb_vs_f32`` attributes the throughput delta to
+    bytes: <1.0 means the mode genuinely ships fewer bytes per step.
+    NOTE the byte realization is platform-dependent — bf16 state
+    streams shrink everywhere, but remat's temp-buffer win exists only
+    where XLA buffer assignment honors checkpoint boundaries (TPU/GPU,
+    not CPU), and a bf16 compute cast on XLA:CPU ADDS cast traffic
+    around f32 convs (docs/how_to/perf.md byte-count levers)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from bench_timing import two_window_slope
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.telemetry.introspect import analyze_compiled
+
+    mode = os.environ.get("BENCH_PRECISION_MODE", "combined")
+    pmod = mx.mod.Module(net, context=ctxs, compute_dtype=compute_dtype,
+                         precision=mode)
+    pmod.bind(data_shapes=[("data", (batch, 3, img, img))],
+              label_shapes=[("softmax_label", (batch,))])
+    pmod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                           factor_type="in", magnitude=2))
+    pmod.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9, "wd": 1e-4,
+                                          "rescale_grad": 1.0 / batch})
+
+    rng = np.random.RandomState(0)
+    sharding = pmod._exec_group._batch_sharding
+    batches = []
+    for _ in range(2):
+        X = rng.rand(batch, 3, img, img).astype(np.float32)
+        y = rng.randint(0, 1000, batch).astype(np.float32)
+        batches.append(DataBatch(
+            data=[mx.nd.NDArray(jax.device_put(X, sharding), ctx=ctxs[0])],
+            label=[mx.nd.NDArray(jax.device_put(y, sharding),
+                                 ctx=ctxs[0])]))
+
+    def step(i):
+        pmod.forward_backward(batches[i % 2])
+        pmod.update()
+
+    barrier = _make_barrier(pmod, True)
+    for i in range(3):
+        step(i)
+    barrier()
+
+    def _window(n):
+        t0 = time.time()
+        for i in range(n):
+            step(i)
+        barrier()
+        return time.time() - t0
+
+    steps_short = max(3, steps // 5)
+    sl = two_window_slope(_window, steps, steps_short, reps=3)
+    rate = sl["n_slope"] * batch / sl["dt"]
+    out = {"precision_mode": mode,
+           "precision_img_per_sec": round(rate, 2)}
+    if f32_img_per_sec:
+        out["precision_vs_f32"] = round(rate / f32_img_per_sec, 3)
+
+    comp = compiled_step(pmod._exec_group)
+    if comp is not None:
+        a = analyze_compiled(comp)
+        gb = a["bytes_accessed"] * n_dev / 1e9
+        out["precision_gb_per_step"] = round(gb, 3)
+        out["precision_argument_gb"] = round(
+            a.get("argument_bytes", 0) * n_dev / 1e9, 3)
+        out["precision_temp_gb"] = round(
+            a.get("temp_bytes", 0) * n_dev / 1e9, 3)
+        if f32_gb_per_step:
+            out["precision_gb_vs_f32"] = round(gb / f32_gb_per_step, 3)
     return out
 
 

@@ -94,6 +94,7 @@ PF_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
     timeout 420 python example/image-classification/train_cifar10.py \
     --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --acc-out "$PF_TMP/acc_plain.txt" \
     --params-digest-out "$PF_TMP/digest_plain.txt" || FAILED=1
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
     timeout 420 python example/image-classification/train_cifar10.py \
@@ -107,6 +108,39 @@ assert a and a == b, \
     "prefetch-device params digest %s != plain %s" % (b, a)
 print("device-feed gate: bit-identical params (sha256 %s...)" % a[:16])
 PY
+
+stage "precision gate (bf16 opt-state + remat: reproducible digest + accuracy vs f32)"
+# precision-mode contract (docs/api/precision.md): a mode is allowed to
+# CHANGE numerics vs f32, but must be exactly reproducible WITHIN the
+# mode — two seeded runs under bf16 optimizer state + dots_saveable
+# remat must land on the SAME sha256 params digest — and its final
+# accuracy must stay within the pinned tolerance of the f32 reference
+# (reusing the device-feed gate's plain run as the reference).
+PM_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --opt-state-dtype bf16 --remat dots_saveable \
+    --acc-out "$PM_TMP/acc_precision.txt" \
+    --params-digest-out "$PM_TMP/digest_a.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --opt-state-dtype bf16 --remat dots_saveable \
+    --params-digest-out "$PM_TMP/digest_b.txt" || FAILED=1
+python - "$PM_TMP/digest_a.txt" "$PM_TMP/digest_b.txt" \
+    "$PM_TMP/acc_precision.txt" "$PF_TMP/acc_plain.txt" <<'PY' || FAILED=1
+import sys
+a, b = (open(p).read().strip() for p in sys.argv[1:3])
+assert a and a == b, \
+    "precision-mode params digest not reproducible: %s != %s" % (a, b)
+pa, pf = (float(open(p).read()) for p in sys.argv[3:5])
+assert abs(pa - pf) <= 0.02, \
+    "precision-mode accuracy %.4f drifted >0.02 from f32 %.4f" % (pa, pf)
+print("precision gate: within-mode digest reproducible (sha256 %s...), "
+      "accuracy %.4f vs f32 %.4f" % (a[:16], pa, pf))
+PY
+rm -rf "$PM_TMP"
 
 stage "device-augment gate (u8 wire + device augment + HBM cache == host reference)"
 # fed-input contract (docs/api/data.md "Device-side augmentation"):

@@ -229,6 +229,19 @@ def main():
                              "transport, bit-identical params to "
                              "streaming (implies the u8 augment "
                              "pipeline)")
+    parser.add_argument("--precision", default=None,
+                        help="precision mode name (mxnet_tpu.precision "
+                             "MODES: f32, bf16, bf16_opt, combined, ...) "
+                             "— byte-count levers with per-mode "
+                             "reproducibility contracts")
+    parser.add_argument("--opt-state-dtype", default=None,
+                        help="optimizer-state storage dtype (float32 or "
+                             "bfloat16); composes into an ad-hoc "
+                             "PrecisionPolicy with --remat when "
+                             "--precision is not given")
+    parser.add_argument("--remat", default=None,
+                        help="remat policy for the train step (none, "
+                             "full, dots_saveable, offload_bn_stats)")
     parser.add_argument("--serve-smoke", action="store_true",
                         help="after training, serve the model through "
                              "an in-process mxnet_tpu.serving stack "
@@ -266,7 +279,17 @@ def main():
 
     net = models.get_symbol(args.network, num_classes=10,
                             image_shape=(3, 28, 28))
-    mod = mx.mod.Module(net, context=ctx)
+    precision = args.precision
+    if precision is None and (args.opt_state_dtype or args.remat):
+        precision = mx.precision.PrecisionPolicy(
+            opt_state_dtype=args.opt_state_dtype, remat=args.remat)
+    elif precision is not None and (args.opt_state_dtype or args.remat):
+        parser.error("--precision is a complete mode; do not combine it "
+                     "with --opt-state-dtype/--remat")
+    mod = mx.mod.Module(net, context=ctx, precision=precision)
+    if precision is not None:
+        logging.info("precision mode: %s (%r)", mod.precision_mode,
+                     mod._precision.describe())
 
     u8_pipeline = args.device_augment or args.cache_dataset
     if u8_pipeline:

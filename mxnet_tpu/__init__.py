@@ -62,6 +62,7 @@ from . import initializer
 from . import initializer as init
 from . import optimizer
 from . import optimizer as opt
+from . import precision
 from . import lr_scheduler
 from . import kvstore as kv
 from . import kvstore

@@ -186,8 +186,13 @@ def _build_eval_segmented(symbol, remat="full", n_segments=None):
 
     remat="dots" keeps matmul/conv outputs inside segments
     (``jax.checkpoint_policies.dots_saveable``); "full" recomputes
-    everything inside a segment. Training-mode only, no tap support
-    (the monitor path uses the per-node evaluator).
+    everything inside a segment; "bn_stats" additionally keeps the
+    ``checkpoint_name("bn_stats")``-tagged per-channel BatchNorm
+    statistics (ops/nn.py tags them) so the backward's segment replays
+    never redo the stat sweeps; a callable passes straight through as
+    the jax checkpoint policy (mxnet_tpu.precision's custom escape).
+    Training-mode only, no tap support (the monitor path uses the
+    per-node evaluator).
     """
     import math
 
@@ -246,12 +251,16 @@ def _build_eval_segmented(symbol, remat="full", n_segments=None):
         seg_plan.append((seg, tuple(in_slots), tuple(out_slots),
                          tuple(aux_updates)))
 
+    # policy object resolved ONCE at build time (mxnet_tpu.precision
+    # owns the name -> jax.checkpoint_policies mapping)
+    from .precision.policy import remat_checkpoint_policy
+    _ckpt_policy = remat_checkpoint_policy(remat)
+
     def eval_fn(arg_vals, aux_vals, rng, is_train, tap=None):
         import jax
 
         assert tap is None, "segmented remat has no monitor taps"
-        policy = (jax.checkpoint_policies.dots_saveable
-                  if remat == "dots" else None)
+        policy = _ckpt_policy
         env = {}
         for n, v in zip(arg_nodes, arg_vals):
             env[(id(n), 0)] = v

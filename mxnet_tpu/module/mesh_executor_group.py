@@ -53,6 +53,40 @@ def _tally_add(jnp, stat, labels, outs, acc):
     return sums, counts
 
 
+def _tree_where(jnp, pred, new, old):
+    """Per-leaf select over an optimizer-state tree (None passes
+    through) — the skipped-step selection of the dynamic loss scaler."""
+    if new is None:
+        return None
+    if isinstance(new, (tuple, list)):
+        return tuple(_tree_where(jnp, pred, a, b)
+                     for a, b in zip(new, old))
+    return jnp.where(pred, new, old)
+
+
+def _grads_finite(jnp, grads):
+    """Scalar bool: every gradient leaf is finite (the loss-scaler's
+    overflow probe, computed on device inside the step program)."""
+    finite = jnp.asarray(True)
+    for g in grads.values():
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return finite
+
+
+def _ls_update(jnp, cfg, scale, good, finite):
+    """The dynamic loss-scale transition (standard AMP rule, on
+    device): overflow halves the scale and zeroes the growth counter;
+    ``window`` consecutive finite steps double it, clamped to
+    [scale_min, scale_max]."""
+    grew = (good + 1) >= cfg["window"]
+    up = jnp.minimum(scale * 2.0, cfg["scale_max"])
+    down = jnp.maximum(scale * 0.5, cfg["scale_min"])
+    new_scale = jnp.where(finite, jnp.where(grew, up, scale), down)
+    new_good = jnp.where(finite, jnp.where(grew, 0, good + 1),
+                         0).astype(good.dtype)
+    return new_scale, new_good
+
+
 def _compiler_options():
     """TPU compiler options for the step programs, from
     ``MXNET_XLA_COMPILER_OPTIONS`` ("key=value,key=value").
@@ -92,7 +126,8 @@ class MeshExecutorGroup(object):
                  shared_group=None, logger=logging, fixed_param_names=None,
                  grad_req="write", compute_dtype=None, remat=None,
                  mesh_axes=None, param_sharding=None,
-                 pipeline_microbatches=None, device_augment=None):
+                 pipeline_microbatches=None, device_augment=None,
+                 precision=None):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -113,11 +148,24 @@ class MeshExecutorGroup(object):
         self.inputs_need_grad = False
         self.logger = logger
         self.fixed_param_names = fixed_param_names or []
+        # resolved PrecisionPolicy (mxnet_tpu.precision) or None; the
+        # compute_dtype/remat fields arrive already folded in by Module,
+        # the group consumes the policy for the input-seam act casts,
+        # the device-side loss scaler, and introspection provenance
+        self._precision = precision
         self.compute_dtype = compute_dtype
-        if remat not in (None, "full", "dots"):
+        if remat is not None and not callable(remat) and \
+                remat not in ("full", "dots", "bn_stats"):
             raise ValueError(
-                "remat must be None, 'full', or 'dots' (got %r)" % (remat,))
+                "remat must be None, 'full', 'dots', 'bn_stats' or a jax "
+                "checkpoint-policy callable (got %r)" % (remat,))
         self.remat = remat
+        # device-side dynamic loss scale state (narrow experimental
+        # modes): a (scale f32, good-steps i32) pair threaded through
+        # the fused step program — see precision.loss_scale_config
+        from ..precision.policy import loss_scale_config
+        self._ls_cfg = loss_scale_config(precision)
+        self._ls_state = None
         self._grad_names = [n for n in param_names
                             if n not in self.fixed_param_names] \
             if for_training and grad_req == "write" else []
@@ -410,6 +458,10 @@ class MeshExecutorGroup(object):
             jax_jit = jax.jit
 
         cdt = self.compute_dtype
+        pol = self._precision
+        act_cast = getattr(pol, "act_cast", None) if pol is not None \
+            else None
+        ls_cfg = self._ls_cfg
         label_names = set(self._label_names)
         grad_names = list(self._grad_names)
 
@@ -418,9 +470,21 @@ class MeshExecutorGroup(object):
                 return v.astype(cdt)
             return v
 
+        def cast_input(name, v):
+            v = cast(name, v)
+            if act_cast is not None and name not in label_names:
+                # experimental low-bit input seam
+                # (mxnet_tpu.precision.fake_cast): value-level round
+                # trip through int8/fp8 so eval and train forwards see
+                # the identical quantization
+                import jax.numpy as jnp
+                from ..precision.policy import fake_cast
+                v = fake_cast(jnp, v, act_cast)
+            return v
+
         def run_fwd(params, aux, inputs, rng, is_train):
             vals = [cast(n, params[n]) if n in params else
-                    cast(n, inputs[n]) for n in self.arg_names]
+                    cast_input(n, inputs[n]) for n in self.arg_names]
             # aux (BN moving stats) stay f32: BatchNorm's fcompute runs its
             # statistics math in f32 and casts its output to the activation
             # dtype, so mixed-precision dtype agreement is the op's job
@@ -449,7 +513,8 @@ class MeshExecutorGroup(object):
         psh = self._param_shardings            # dict pytree over params
         gsh = {n: psh[n] for n in grad_names}  # grads shard like params
 
-        def fwd_bwd_math(params, aux, inputs, rng, heads=None):
+        def fwd_bwd_math(params, aux, inputs, rng, heads=None,
+                         scale=None):
             def f(p):
                 outs, new_aux = run_fwd(p, aux, inputs, rng, True)
                 return tuple(outs), new_aux
@@ -459,9 +524,17 @@ class MeshExecutorGroup(object):
             hs = tuple(h.astype(o.dtype) for h, o in zip(heads, outs)) \
                 if heads is not None else \
                 tuple(jnp.ones_like(o) for o in outs)
+            if scale is not None:
+                # dynamic loss scaling (narrow modes): scale the head
+                # cotangents so the low-precision backward stays above
+                # the underflow floor, unscale the f32 grads after
+                hs = tuple(h * scale.astype(h.dtype) for h in hs)
             (grads,) = vjp_fn(hs)
             grads = {n: grads[n].astype(params[n].dtype)
                      for n in grad_names}
+            if scale is not None:
+                inv = 1.0 / scale
+                grads = {n: g * inv for n, g in grads.items()}
             outs = tuple(o.astype(onp.float32) for o in outs)
             return outs, new_aux, grads
 
@@ -537,34 +610,67 @@ class MeshExecutorGroup(object):
             mstat = self._metric_stat if ":m" in kind else None
             mlabels = list(self._label_names)
 
-            def step_math(params, aux, states, inputs, rng, lrs, wds):
+            def step_math(params, aux, states, inputs, rng, lrs, wds,
+                          ls=None):
                 import jax.numpy as jnp
-                outs, new_aux, grads = fwd_bwd_math(params, aux, inputs,
-                                                    rng)
+                if ls is None:
+                    outs, new_aux, grads = fwd_bwd_math(params, aux,
+                                                        inputs, rng)
+                    finite = None
+                else:
+                    # dynamic loss scaling rides the step: scaled heads,
+                    # unscaled grads, an on-device finite probe deciding
+                    # whether this step's update applies at all
+                    scale, good = ls
+                    outs, new_aux, grads = fwd_bwd_math(
+                        params, aux, inputs, rng, scale=scale)
+                    finite = _grads_finite(jnp, grads)
                 new_params = dict(params)
                 new_states = []
                 for k, n in enumerate(grad_names):
                     p, s = fa(jnp, params[n], grads[n], states[k],
                               lrs[k], wds[k])
+                    if finite is not None:
+                        # overflow: skip the whole update (params AND
+                        # state), the standard AMP skipped-step rule
+                        p = jnp.where(finite, p, params[n])
+                        s = _tree_where(jnp, finite, s, states[k])
                     new_params[n] = p
                     new_states.append(s)
-                return outs, new_aux, grads, new_params, tuple(new_states)
+                if ls is None:
+                    return (outs, new_aux, grads, new_params,
+                            tuple(new_states))
+                new_ls = _ls_update(jnp, ls_cfg, scale, good, finite)
+                return (outs, new_aux, grads, new_params,
+                        tuple(new_states), new_ls)
 
             # no donation on cpu: device_put is zero-copy there, so user-
             # visible host arrays can alias the param buffers (the classic
             # update path gates donation the same way)
             donate = (0, 2) if self._platform != "cpu" else ()
-            if mstat is None:
+            base_in = (psh, repl, None, batch, None, None, None)
+            base_out = (self._out_shardings, repl, gsh, psh, None)
+            ls_sh = (repl, repl)
+            if mstat is None and ls_cfg is None:
                 fn = jax_jit(
                     step_math,
                     # states: committed per-leaf in step_update (momentum
                     # etc. shard like their param); None = follow the arg
-                    in_shardings=(psh, repl, None, batch, None, None,
-                                  None),
-                    out_shardings=(self._out_shardings, repl, gsh, psh,
-                                   None),
+                    in_shardings=base_in,
+                    out_shardings=base_out,
                     donate_argnums=donate)
-            else:
+            elif mstat is None:
+                def train_step(params, aux, states, inputs, rng, lrs,
+                               wds, ls):
+                    return step_math(params, aux, states, inputs, rng,
+                                     lrs, wds, ls)
+
+                fn = jax_jit(
+                    train_step,
+                    in_shardings=base_in + (ls_sh,),
+                    out_shardings=base_out + (ls_sh,),
+                    donate_argnums=donate)
+            elif ls_cfg is None:
                 def train_step(params, aux, states, inputs, rng, lrs,
                                wds, macc):
                     import jax.numpy as jnp
@@ -579,10 +685,26 @@ class MeshExecutorGroup(object):
 
                 fn = jax_jit(
                     train_step,
-                    in_shardings=(psh, repl, None, batch, None, None,
-                                  None, (repl, repl)),
-                    out_shardings=(self._out_shardings, repl, gsh, psh,
-                                   None, (repl, repl)),
+                    in_shardings=base_in + ((repl, repl),),
+                    out_shardings=base_out + ((repl, repl),),
+                    donate_argnums=donate + ((7,) if donate else ()))
+            else:
+                def train_step(params, aux, states, inputs, rng, lrs,
+                               wds, macc, ls):
+                    import jax.numpy as jnp
+                    (outs, new_aux, grads, new_params, new_states,
+                     new_ls) = step_math(params, aux, states, inputs,
+                                         rng, lrs, wds, ls)
+                    new_macc = _tally_add(
+                        jnp, mstat, [inputs[n] for n in mlabels], outs,
+                        macc)
+                    return (outs, new_aux, grads, new_params, new_states,
+                            new_macc, new_ls)
+
+                fn = jax_jit(
+                    train_step,
+                    in_shardings=base_in + ((repl, repl), ls_sh),
+                    out_shardings=base_out + ((repl, repl), ls_sh),
                     donate_argnums=donate + ((7,) if donate else ()))
         elif kind.startswith("train_step_grouped:"):
             # K train steps as ONE XLA program (TPUEstimator's
@@ -600,7 +722,7 @@ class MeshExecutorGroup(object):
             out_structs = self._out_structs()
 
             def grouped_math(params, aux, states, inputs, rng, lrs, wds,
-                             macc):
+                             macc, ls=None):
                 import jax.numpy as jnp
                 K = lrs.shape[0]
                 if self._needs_rng:
@@ -613,22 +735,38 @@ class MeshExecutorGroup(object):
                     subs = jnp.broadcast_to(rng, (K,) + rng.shape)
 
                 def body(carry, xs):
-                    params, aux, states, _outs, _grads, macc = carry
+                    params, aux, states, _outs, _grads, macc, ls = carry
                     inp, lr_row, sub = xs
-                    outs, aux, grads = fwd_bwd_math(params, aux, inp, sub)
+                    if ls is None:
+                        outs, aux, grads = fwd_bwd_math(params, aux, inp,
+                                                        sub)
+                        finite = None
+                    else:
+                        # the loss-scale state rides the scan carry: each
+                        # scanned step sees the scale its predecessors
+                        # left, exactly as K sequential steps would
+                        scale, good = ls
+                        outs, aux, grads = fwd_bwd_math(
+                            params, aux, inp, sub, scale=scale)
+                        finite = _grads_finite(jnp, grads)
                     new_params = dict(params)
                     new_states = []
                     for k, n in enumerate(grad_names):
                         p, s = fa(jnp, params[n], grads[n], states[k],
                                   lr_row[k], wds[k])
+                        if finite is not None:
+                            p = jnp.where(finite, p, params[n])
+                            s = _tree_where(jnp, finite, s, states[k])
                         new_params[n] = p
                         new_states.append(s)
+                    if ls is not None:
+                        ls = _ls_update(jnp, ls_cfg, scale, good, finite)
                     if mstat is not None:
                         macc = _tally_add(jnp, mstat,
                                           [inp[n] for n in mlabels], outs,
                                           macc)
                     return (new_params, aux, tuple(new_states), outs,
-                            grads, macc), None
+                            grads, macc, ls), None
 
                 # last step's outs/grads ride the carry (stacking all K
                 # via scan ys would cost K x params of HBM for grads)
@@ -638,7 +776,7 @@ class MeshExecutorGroup(object):
                                            params[n].dtype)
                               for n in grad_names}
                 carry = (params, aux, states, zero_outs, zero_grads,
-                         macc)
+                         macc, ls)
                 # rolled loop, never unrolled: XLA:CPU runs while-loop
                 # bodies on a slow path (8-30x per-step on conv nets),
                 # but unrolling lets XLA fuse ACROSS steps and the
@@ -648,42 +786,70 @@ class MeshExecutorGroup(object):
                 # also keeps compile time and program size
                 # K-independent on accelerators, where loop bodies run
                 # at full speed anyway.
-                (params, aux, states, outs, grads, macc), _ = \
+                (params, aux, states, outs, grads, macc, ls), _ = \
                     jax.lax.scan(body, carry, (inputs, lrs, subs))
-                return outs, aux, grads, params, states, macc
+                return outs, aux, grads, params, states, macc, ls
 
             st_batch = self._stacked_sharding()
             donate = (0, 2) if self._platform != "cpu" else ()
-            if mstat is None:
+            base_in = (psh, repl, None, st_batch, None, None, None)
+            base_out = (self._out_shardings, repl, gsh, psh, None)
+            ls_sh = (repl, repl)
+            if mstat is None and ls_cfg is None:
                 def train_grouped(params, aux, states, inputs, rng, lrs,
                                   wds):
                     import jax.numpy as jnp
                     dummy = (jnp.zeros((0,), jnp.float32),
                              jnp.zeros((0,), jnp.int32))
-                    outs, aux, grads, params, states, _ = grouped_math(
-                        params, aux, states, inputs, rng, lrs, wds,
-                        dummy)
+                    outs, aux, grads, params, states, _, _ls = \
+                        grouped_math(params, aux, states, inputs, rng,
+                                     lrs, wds, dummy)
                     return outs, aux, grads, params, states
 
                 fn = jax_jit(
                     train_grouped,
-                    in_shardings=(psh, repl, None, st_batch, None, None,
-                                  None),
-                    out_shardings=(self._out_shardings, repl, gsh, psh,
-                                   None),
+                    in_shardings=base_in,
+                    out_shardings=base_out,
                     donate_argnums=donate)
-            else:
+            elif mstat is None:
                 def train_grouped(params, aux, states, inputs, rng, lrs,
-                                  wds, macc):
-                    return grouped_math(params, aux, states, inputs, rng,
-                                        lrs, wds, macc)
+                                  wds, ls):
+                    import jax.numpy as jnp
+                    dummy = (jnp.zeros((0,), jnp.float32),
+                             jnp.zeros((0,), jnp.int32))
+                    outs, aux, grads, params, states, _, new_ls = \
+                        grouped_math(params, aux, states, inputs, rng,
+                                     lrs, wds, dummy, ls)
+                    return outs, aux, grads, params, states, new_ls
 
                 fn = jax_jit(
                     train_grouped,
-                    in_shardings=(psh, repl, None, st_batch, None, None,
-                                  None, (repl, repl)),
-                    out_shardings=(self._out_shardings, repl, gsh, psh,
-                                   None, (repl, repl)),
+                    in_shardings=base_in + (ls_sh,),
+                    out_shardings=base_out + (ls_sh,),
+                    donate_argnums=donate)
+            elif ls_cfg is None:
+                def train_grouped(params, aux, states, inputs, rng, lrs,
+                                  wds, macc):
+                    (outs, aux, grads, params, states, macc, _ls) = \
+                        grouped_math(params, aux, states, inputs, rng,
+                                     lrs, wds, macc)
+                    return outs, aux, grads, params, states, macc
+
+                fn = jax_jit(
+                    train_grouped,
+                    in_shardings=base_in + ((repl, repl),),
+                    out_shardings=base_out + ((repl, repl),),
+                    donate_argnums=donate + ((7,) if donate else ()))
+            else:
+                def train_grouped(params, aux, states, inputs, rng, lrs,
+                                  wds, macc, ls):
+                    return grouped_math(params, aux, states, inputs, rng,
+                                        lrs, wds, macc, ls)
+
+                fn = jax_jit(
+                    train_grouped,
+                    in_shardings=base_in + ((repl, repl), ls_sh),
+                    out_shardings=base_out + ((repl, repl), ls_sh),
                     donate_argnums=donate + ((7,) if donate else ()))
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
@@ -733,7 +899,10 @@ class MeshExecutorGroup(object):
         folds in, as an analytic inventory entry (the separate-program
         accounting bench.py applies when ``_last_step`` is None): read
         w/g + write w on f32 plus a read+write of every state leaf —
-        5 * 4 * n_params for sgd-momentum."""
+        5 * 4 * n_params for f32 sgd-momentum. State leaves are
+        accounted at their STORAGE dtype: a bf16 opt-state mode
+        (mxnet_tpu.precision) halves the two state streams and this
+        analytic entry is exactly the witness that records it."""
         if "optimizer_update" in self._program_notes:
             return
         self._program_notes.add("optimizer_update")
@@ -747,19 +916,33 @@ class MeshExecutorGroup(object):
                     return sum(leaves(s) for s in t)
                 return int(onp.prod(t.shape)) if hasattr(t, "shape") else 0
 
+            def leaf_bytes(t):
+                if t is None:
+                    return 0
+                if isinstance(t, (tuple, list)):
+                    return sum(leaf_bytes(s) for s in t)
+                if not hasattr(t, "shape"):
+                    return 0
+                itemsize = onp.dtype(t.dtype).itemsize \
+                    if hasattr(t, "dtype") else 4
+                return int(onp.prod(t.shape)) * int(itemsize)
+
             n_par = sum(int(onp.prod(self._param_dict[n].shape))
                         for _k, n in triples)
             n_state = sum(leaves(s) for s in states)
+            state_bytes = sum(leaf_bytes(s) for s in states)
             self._program_names["optimizer_update"] = \
                 telemetry.inventory().register(
                     "%s.optimizer_update" % self._inventory_owner,
                     kind="optimizer_update",
                     flops=4.0 * n_par,
-                    bytes_accessed=4.0 * (3 * n_par + 2 * n_state),
+                    bytes_accessed=4.0 * 3 * n_par + 2.0 * state_bytes,
                     device_kind=self._device_kind,
                     meta={"fused_into": "%s.train_step"
                           % self._inventory_owner,
-                          "n_params": n_par, "n_state": n_state})
+                          "n_params": n_par, "n_state": n_state,
+                          "state_bytes": state_bytes,
+                          "precision_mode": self.precision_mode_name()})
         except Exception:  # noqa: BLE001
             pass
 
@@ -785,7 +968,11 @@ class MeshExecutorGroup(object):
                     "flops_per_step": a["flops"] / k,
                     "bytes_per_step": a["bytes_accessed"] / k,
                     "peak_tflops": pt * n_dev if pt else None,
-                    "peak_hbm_gbps": pb * n_dev if pb else None}
+                    "peak_hbm_gbps": pb * n_dev if pb else None,
+                    # provenance: the basis is resolved AFTER the policy
+                    # is applied (warmup boundary), so these bytes are
+                    # the mode's true byte basis — the roofline witness
+                    "precision_mode": self.precision_mode_name()}
         return None
 
     def roofline_basis(self):
@@ -1126,6 +1313,40 @@ class MeshExecutorGroup(object):
         inputs, rng = pend
         self._run_fwd_bwd(inputs, rng)
 
+    def precision_mode_name(self):
+        """Recorded precision-mode name for this group ('f32' when no
+        policy is bound) — the spelling checkpoint manifests and the
+        serving-side mode check compare."""
+        from ..precision.policy import mode_name
+        return mode_name(self._precision)
+
+    def _ls_current(self):
+        """The device-resident (scale, good-steps) loss-scale pair,
+        lazily initialized from the policy's config (None when the
+        policy does not scale). Lives across steps; the step programs
+        return its successor."""
+        if self._ls_cfg is None:
+            return None
+        if self._ls_state is None:
+            import jax
+            self._ls_state = (
+                jax.device_put(onp.float32(self._ls_cfg["init"]),
+                               self._repl),
+                jax.device_put(onp.int32(0), self._repl))
+        return self._ls_state
+
+    def loss_scale(self):
+        """Current dynamic loss scale as a host float (None when the
+        policy does not scale). Well-defined from bind onward: before
+        the first step the configured init is reported (without forcing
+        device-state allocation). Forces a device readback once the
+        state exists — monitoring only, never on the step path."""
+        if self._ls_cfg is None:
+            return None
+        if self._ls_state is None:
+            return float(self._ls_cfg["init"])
+        return float(self._ls_state[0])
+
     def step_update(self, updater, num_device=1):
         """Run the pending fwd+bwd AND the optimizer as one XLA program.
 
@@ -1194,16 +1415,26 @@ class MeshExecutorGroup(object):
                     jax.device_put(onp.zeros(self._metric_slots,
                                              onp.int32), self._repl))
             args = args + (self._metric_acc,)
+        ls = self._ls_current()
+        if ls is not None:
+            args = args + (ls,)
         # aval skeleton for diagnostics (bench cost analysis) — the real
         # buffers are donated below and unusable afterwards
         from ..telemetry import aval_skeleton
         self._last_step = (fn, aval_skeleton(args))
         self._note_program(kind, fn, args)
         self._note_optimizer_analytic(states, triples)
-        if self._metric_stat is not None:
+        if self._metric_stat is not None and ls is not None:
+            (outs, new_aux, grads, new_params, new_states,
+             self._metric_acc, self._ls_state) = fn(*args)
+            self._metric_step_done = True
+        elif self._metric_stat is not None:
             (outs, new_aux, grads, new_params, new_states,
              self._metric_acc) = fn(*args)
             self._metric_step_done = True
+        elif ls is not None:
+            (outs, new_aux, grads, new_params, new_states,
+             self._ls_state) = fn(*args)
         else:
             outs, new_aux, grads, new_params, new_states = fn(*args)
         self._write_outs(outs)
@@ -1298,16 +1529,23 @@ class MeshExecutorGroup(object):
                     jax.device_put(onp.zeros(self._metric_slots,
                                              onp.int32), self._repl))
             args = args + (self._metric_acc,)
-            self._note_program(kind, fn, args,
-                               extra={"batch_group": K})
-            self._note_optimizer_analytic(states, triples)
+        ls = self._ls_current()
+        if ls is not None:
+            args = args + (ls,)
+        self._note_program(kind, fn, args, extra={"batch_group": K})
+        self._note_optimizer_analytic(states, triples)
+        if self._metric_stat is not None and ls is not None:
+            (outs, new_aux, grads, new_params, new_states,
+             self._metric_acc, self._ls_state) = fn(*args)
+            self._metric_step_done = True
+        elif self._metric_stat is not None:
             (outs, new_aux, grads, new_params, new_states,
              self._metric_acc) = fn(*args)
             self._metric_step_done = True
+        elif ls is not None:
+            (outs, new_aux, grads, new_params, new_states,
+             self._ls_state) = fn(*args)
         else:
-            self._note_program(kind, fn, args,
-                               extra={"batch_group": K})
-            self._note_optimizer_analytic(states, triples)
             outs, new_aux, grads, new_params, new_states = fn(*args)
         self._write_outs(outs)
         self._write_aux(new_aux)

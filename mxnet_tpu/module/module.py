@@ -84,8 +84,24 @@ class Module(BaseModule):
                  context=None, work_load_list=None, fixed_param_names=None,
                  compute_dtype=None, remat=None, mesh_axes=None,
                  param_sharding=None, pipeline_microbatches=None,
-                 device_augment=None, _allow_fused=True):
+                 device_augment=None, precision=None, _allow_fused=True):
         super().__init__(logger=logger)
+        # precision mode (mxnet_tpu.precision): a mode name ("combined",
+        # "bf16_opt", ...) or PrecisionPolicy; None consults
+        # MXNET_PRECISION_MODE. The policy FOLDS into the existing
+        # compute_dtype/remat seams (explicit kwargs win over the
+        # policy's fields so old call sites keep their meaning) and
+        # additionally drives the optimizer-state storage dtype, the
+        # experimental act casts + loss scaler, and the recorded mode
+        # name checkpoints/serving compare.
+        from .. import precision as _precision_mod
+        self._precision = _precision_mod.resolve(precision)
+        if self._precision is not None:
+            pol = self._precision
+            if compute_dtype is None:
+                compute_dtype = pol.compute_dtype
+            if remat is None:
+                remat = pol.remat
         self._compute_dtype = compute_dtype
         # {data name: mxnet_tpu.data.DeviceAugment} — in-program input
         # augmentation (u8 wire batches).  Usually adopted from the
@@ -106,9 +122,16 @@ class Module(BaseModule):
             # the reference's activation-recompute switch
             # (docs/how_to/env_var.md:64-66, graph_executor.cc:210-223)
             remat = "full"
-        if remat not in (None, "full", "dots"):
-            raise ValueError(
-                "remat must be None, 'full', or 'dots' (got %r)" % (remat,))
+        if remat is not None and not callable(remat):
+            from ..base import MXNetError
+            from ..precision.policy import canon_remat
+            try:
+                remat = canon_remat(remat)  # accepts the docs' long names
+            except MXNetError:
+                raise ValueError(
+                    "remat must be None, 'full', 'dots'/'dots_saveable', "
+                    "'bn_stats'/'offload_bn_stats' or a jax checkpoint-"
+                    "policy callable (got %r)" % (remat,))
         self._remat = remat
         self._allow_fused = _allow_fused
         if context is None:
@@ -203,7 +226,23 @@ class Module(BaseModule):
                 "saved by Module.save_checkpoint(manager=...)"
                 % (ckpt.step, manager.directory))
         arg_np, aux_np = split_params(ckpt.params)
+        saved_mode = str(ckpt.extra.get("precision_mode", "f32"))
+        if "precision" not in kwargs and saved_mode != "f32":
+            # adopt the entry's recorded precision mode so the restored
+            # module (and its optimizer-state dtypes) continue under the
+            # numerics family the checkpoint was trained in; an explicit
+            # precision= kwarg wins (the Updater still refuses a state-
+            # dtype mismatch when optimizer states load)
+            kwargs["precision"] = Module._policy_from_manifest(
+                saved_mode, ckpt.extra.get("precision"))
         mod = Module(symbol=sym_mod.load_json(sym_json), **kwargs)
+        mod._ckpt_precision_mode = saved_mode
+        if mod.precision_mode != saved_mode:
+            logging.warning(
+                "checkpoint step %d was saved under precision mode %r "
+                "but the restored module runs %r — serving this module "
+                "will be refused (Predictor precision check)",
+                ckpt.step, saved_mode, mod.precision_mode)
         mod._arg_params = {k: nd.array(v, dtype=v.dtype)
                            for k, v in arg_np.items()}
         mod._aux_params = {k: nd.array(v, dtype=v.dtype)
@@ -217,6 +256,48 @@ class Module(BaseModule):
                     % (ckpt.step, manager.directory))
             mod._preload_opt_states = ckpt.optimizer_state
         return mod
+
+    @staticmethod
+    def _policy_from_manifest(mode, desc):
+        """Reconstruct a PrecisionPolicy from a checkpoint manifest's
+        recorded mode name + describe() dict. Named registry modes
+        resolve directly; ad-hoc policies rebuild from their canonical
+        fields (a custom remat CALLABLE cannot ride a manifest — pass
+        ``precision=`` explicitly to restore such a run)."""
+        from .. import precision as _precision_mod
+        from ..base import MXNetError
+        desc = dict(desc or {})
+        pol = _precision_mod.MODES.get(mode)
+        if pol is not None:
+            # a name hit alone is not provenance: register_mode()
+            # overwrites names and built-in modes can evolve, so the
+            # registry policy must still mean what the checkpoint
+            # recorded — on disagreement the RECORDED fields win (the
+            # numerics family the params were actually trained in)
+            if not desc or pol.describe() == desc:
+                return pol
+            logging.warning(
+                "checkpoint precision mode %r no longer matches the "
+                "registered mode's fields; restoring the policy the "
+                "checkpoint recorded (%r)", mode, desc)
+        if desc.get("remat") == "custom":
+            raise MXNetError(
+                "checkpoint was saved under an ad-hoc precision policy "
+                "with a custom remat callable (%r); callables cannot be "
+                "reconstructed from the manifest — pass the policy via "
+                "precision= when loading" % mode)
+
+        def _field(key):
+            v = desc.get(key)
+            return None if v in (None, "float32", "none") else v
+
+        return _precision_mod.PrecisionPolicy(
+            name=mode, compute_dtype=_field("compute_dtype"),
+            opt_state_dtype=_field("opt_state_dtype"),
+            remat=_field("remat"), act_cast=desc.get("act_cast"),
+            loss_scale=desc.get("loss_scale"),
+            loss_scale_window=desc.get("loss_scale_window"),
+            experimental=bool(desc.get("experimental")))
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
                         manager=None, async_save=True, extra=None):
@@ -253,7 +334,12 @@ class Module(BaseModule):
         if save_optimizer_states:
             assert self.optimizer_initialized
             opt_state = self._optimizer_state_bytes()
-        merged = {"epoch": int(step), "symbol": self._symbol.tojson()}
+        merged = {"epoch": int(step), "symbol": self._symbol.tojson(),
+                  # the entry's precision provenance: restores adopt the
+                  # mode, serving refuses a mismatch (docs/api/precision.md)
+                  "precision_mode": self.precision_mode}
+        if self._precision is not None:
+            merged["precision"] = self._precision.describe()
         if extra:
             merged.update(extra)
         manager.save(step, arrays, optimizer_state=opt_state, extra=merged,
@@ -407,7 +493,20 @@ class Module(BaseModule):
                 mesh_axes=self._mesh_axes,
                 param_sharding=self._param_sharding,
                 pipeline_microbatches=self._pipeline_microbatches,
-                device_augment=self._device_augment)
+                device_augment=self._device_augment,
+                precision=self._precision)
+        elif self._precision is not None and \
+                not self._precision.is_default():
+            # precision modes exist only on the one-program mesh path
+            # (opt-state dtype + act casts + loss scaler all live in the
+            # fused step program); a silent classic fallback would train
+            # a plain f32 model under a mode name that promises otherwise
+            raise ValueError(
+                "precision=%r requires the fused mesh path, but this "
+                "bind is not fused-eligible (check MXNET_MODULE_FUSED, "
+                "batch divisibility by the dp axis, grad_req='write', "
+                "uniform work_load_list, distinct same-platform devices)"
+                % self._precision.name)
         elif self._device_augment:
             # the u8 wire layout + in-program augment stage exist only
             # in the one-program mesh path; a silent classic fallback
@@ -455,6 +554,18 @@ class Module(BaseModule):
 
         if shared_module is not None and shared_module.optimizer_initialized:
             self.borrow_optimizer(shared_module)
+
+    @property
+    def precision_mode(self):
+        """Recorded precision-mode name ('f32' when no policy) — THE
+        spelling checkpoint manifests carry and serving compares."""
+        from ..precision.policy import mode_name
+        return mode_name(self._precision)
+
+    @property
+    def _opt_state_dtype(self):
+        return None if self._precision is None \
+            else self._precision.opt_state_dtype
 
     def _fused_eligible(self, shared_group, inputs_need_grad, grad_req):
         """Use the mesh-fused group when the bind maps onto one device mesh
@@ -542,6 +653,11 @@ class Module(BaseModule):
                 "mesh_axes/param_sharding/pipeline_microbatches/"
                 "device_augment have no classic-path equivalent"
                 % reason)
+        if self._precision is not None and not self._precision.is_default():
+            raise MXNetError(
+                "cannot fall back from the fused mesh group (%s): "
+                "precision=%r has no classic-path equivalent"
+                % (reason, self._precision.name))
         if self._params_dirty:
             self._sync_params_from_devices()
         if self._compute_dtype is not None:
@@ -617,11 +733,26 @@ class Module(BaseModule):
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
                 optimizer_params["rescale_grad"] = rescale_grad
+            if "state_dtype" not in optimizer_params and \
+                    self._opt_state_dtype is not None:
+                # the precision policy's optimizer-state storage dtype
+                # (bf16 moments, f32 master params + f32 update math)
+                optimizer_params["state_dtype"] = self._opt_state_dtype
             optimizer = opt.create(optimizer, sym=self.symbol,
                                    param_idx2name=idx2name,
                                    **optimizer_params)
         else:
             assert isinstance(optimizer, opt.Optimizer)
+            want = self._opt_state_dtype
+            have = getattr(optimizer, "state_dtype", None)
+            if want is not None and have is None:
+                optimizer.state_dtype = want
+            elif want is not None and have != want:
+                from ..base import MXNetError
+                raise MXNetError(
+                    "optimizer instance carries state_dtype=%r but the "
+                    "module's precision mode %r wants %r — drop one of "
+                    "the two settings" % (have, self.precision_mode, want))
 
         self._optimizer = optimizer
         self._kvstore = kvstore

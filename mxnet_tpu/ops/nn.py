@@ -595,6 +595,16 @@ def _batch_norm(attrs, ins, octx):
         c = jax.lax.stop_gradient(mmean.astype(f32))
         out, mean, var = _bn_train_core(x, gamma, beta, c, eps,
                                         bool(fix_gamma), fused_relu)
+        # remat tag (mxnet_tpu.precision "offload_bn_stats" policy):
+        # name the per-channel statistics so a segmented-checkpoint
+        # backward built with save_only_these_names("bn_stats") keeps
+        # them across segment boundaries instead of replaying the stat
+        # sweeps. Outside such a policy checkpoint_name is identity —
+        # bitwise-neutral for every other mode (pinned by the existing
+        # parity suites).
+        from jax.ad_checkpoint import checkpoint_name
+        mean = checkpoint_name(mean, "bn_stats")
+        var = checkpoint_name(var, "bn_stats")
         new_mmean = (mmean * mom +
                      jax.lax.stop_gradient(mean).astype(mmean.dtype) *
                      (1 - mom))

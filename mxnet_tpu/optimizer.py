@@ -49,7 +49,16 @@ class Optimizer(object):
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
-                 sym=None, begin_num_update=0):
+                 sym=None, begin_num_update=0, state_dtype=None):
+        # storage dtype of optimizer state leaves (mxnet_tpu.precision):
+        # None follows the weight dtype (the classic behavior);
+        # "bfloat16" stores momentum/moments as bf16 with f32 update
+        # math through the fused-apply wrapper (Updater). Set via
+        # Module(precision=...) -> init_optimizer, or directly here.
+        if state_dtype is not None:
+            from .precision.policy import canon_dtype
+            state_dtype = canon_dtype(state_dtype, "state_dtype")
+        self.state_dtype = state_dtype
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -74,6 +83,12 @@ class Optimizer(object):
 
     def create_state(self, index, weight):
         """Create optimizer state (momentum etc.) for a parameter."""
+
+    def _state_zeros_dtype(self, weight):
+        """The dtype new state leaves are allocated with: the weight's
+        dtype unless a precision policy narrowed ``state_dtype``."""
+        from .precision.policy import state_np_dtype
+        return state_np_dtype(self.state_dtype, weight.dtype)
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
@@ -145,7 +160,8 @@ class SGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return zeros(weight.shape, weight.context,
+                     dtype=self._state_zeros_dtype(weight))
 
     def _fused_apply(self, jnp, p, g, s, lr, wd):
         """Pure single-param step for the whole-tree fused update
@@ -266,8 +282,9 @@ class Adam(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+        dtype = self._state_zeros_dtype(weight)
+        return (zeros(weight.shape, weight.context, dtype=dtype),
+                zeros(weight.shape, weight.context, dtype=dtype))
 
     def _fused_lr(self, index):
         t = self._index_update_count[index]
@@ -454,6 +471,18 @@ class Updater(object):
         self._fused_fns = {}  # (device, shapes/dtypes) -> jitted step
 
     def __call__(self, index, grad, weight):
+        if getattr(self.optimizer, "state_dtype", None) is not None:
+            # the narrowed-state contract lives in the fused-apply
+            # wrapper (f32 master math, round back on exit); the classic
+            # per-param update() would run its arithmetic AT the storage
+            # dtype — a silently different numerics family
+            from .base import MXNetError
+            raise MXNetError(
+                "optimizer state_dtype=%r requires the fused one-program "
+                "update path (Module on the fused mesh group with a pure "
+                "_fused_apply optimizer); the classic per-param update "
+                "would compute in the storage dtype"
+                % self.optimizer.state_dtype)
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])
@@ -463,7 +492,10 @@ class Updater(object):
         update() must run (no _fused_apply, or a subclass overrode
         update() below the class defining _fused_apply — e.g. NAG
         overrides SGD.update but inherits SGD._fused_apply, whose
-        numerics would be wrong)."""
+        numerics would be wrong). A narrowed ``state_dtype``
+        (mxnet_tpu.precision) rides as a wrapper: state upcasts to f32
+        master math and rounds back to the storage dtype on the way
+        out."""
         opt = self.optimizer
         fa = getattr(opt, "_fused_apply", None)
         if fa is None:
@@ -478,6 +510,9 @@ class Updater(object):
         cf, cu = _defining("_fused_apply"), _defining("update")
         if cf is None or cu is None or not issubclass(cf, cu):
             return None
+        if getattr(opt, "state_dtype", None) is not None:
+            from .precision.policy import wrap_fused_apply
+            return wrap_fused_apply(fa, opt.state_dtype)
         return fa
 
     def read_state_tree(self, index, like=None):
@@ -597,6 +632,61 @@ class Updater(object):
             w._write(nw)
             self.write_state_tree(i, ns)
 
+    @staticmethod
+    def _leaf_dtypes(state):
+        """Nested per-leaf dtype names of one state tree (None leaves
+        stay None) — the v2 envelope's per-leaf dtype record."""
+        if state is None:
+            return None
+        if isinstance(state, (tuple, list)):
+            return [Updater._leaf_dtypes(s) for s in state]
+        return str(numpy.dtype(state.dtype)) if hasattr(state, "dtype") \
+            else None
+
+    @staticmethod
+    def _payload_state_dtype(payload):
+        """The state storage dtype a payload was saved under. New
+        payloads record it explicitly (``state_dtype``); older ones
+        are inferred from the leaves (pre-precision payloads are all
+        f32)."""
+        if "state_dtype" in payload:
+            return payload["state_dtype"] or "float32"
+
+        def scan(t):
+            if t is None:
+                return None
+            if isinstance(t, (tuple, list)):
+                for s in t:
+                    found = scan(s)
+                    if found:
+                        return found
+                return None
+            return str(numpy.dtype(t.dtype)) if hasattr(t, "dtype") \
+                else None
+
+        for st in payload.get("states", {}).values():
+            found = scan(st)
+            if found and found != "float32":
+                return found
+        return "float32"
+
+    def _check_state_dtype(self, payload):
+        """Refuse a storage-dtype mismatch LOUDLY: loading f32 states
+        into a bf16-mode Updater (or vice versa) would silently flip
+        the state dtype on the next write and break the within-mode
+        bitwise contract. Legacy f32 payloads load into an f32-mode
+        Updater unchanged."""
+        from .base import MXNetError
+        want = self.optimizer.state_dtype or "float32"
+        got = self._payload_state_dtype(payload)
+        if got != want:
+            raise MXNetError(
+                "optimizer-state payload was saved with state_dtype=%s "
+                "but this Updater runs state_dtype=%s — restore with a "
+                "module built under the matching precision mode "
+                "(mxnet_tpu.precision; e.g. Module(precision=...))"
+                % (got, want))
+
     def set_states(self, states):
         """Restore from :meth:`get_states` bytes. The v2 envelope also
         restores the optimizer's update clock (``num_update`` and the
@@ -605,14 +695,29 @@ class Updater(object):
         — the elastic-resume continuity contract
         (mxnet_tpu.dist.ElasticTrainer). Legacy payloads (a bare states
         dict) still load; the clock then restarts at
-        ``begin_num_update``, matching the old behavior."""
+        ``begin_num_update``, matching the old behavior. Payloads saved
+        under a different precision mode (state storage dtype) are
+        refused with a clear error."""
         payload = pickle.loads(states)
         if isinstance(payload, dict) and payload.get("__fmt__") == 2:
+            self._check_state_dtype(payload)
+            if "state_dtypes" in payload:
+                recorded = payload["state_dtypes"]
+                actual = {k: self._leaf_dtypes(st)
+                          for k, st in payload["states"].items()}
+                if actual != recorded:
+                    from .base import MXNetError
+                    raise MXNetError(
+                        "optimizer-state payload is internally "
+                        "inconsistent: the per-leaf dtype record does "
+                        "not match the state leaves (payload corrupted "
+                        "or hand-edited)")
             self.states = payload["states"]
             opt = self.optimizer
             opt.num_update = int(payload["num_update"])
             opt._index_update_count = dict(payload["index_update_count"])
         else:
+            self._check_state_dtype({"states": payload})
             self.states = payload
 
     def get_states(self):
@@ -622,6 +727,12 @@ class Updater(object):
             "states": self.states,
             "num_update": int(opt.num_update),
             "index_update_count": dict(opt._index_update_count),
+            # precision-mode provenance: the configured storage dtype
+            # plus the actual per-leaf dtypes, so a restore into the
+            # wrong mode fails loudly instead of silently widening
+            "state_dtype": opt.state_dtype,
+            "state_dtypes": {k: self._leaf_dtypes(st)
+                             for k, st in self.states.items()},
         })
 
 

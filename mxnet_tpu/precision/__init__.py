@@ -1,0 +1,21 @@
+"""mxnet_tpu.precision — opt-in precision modes with per-mode parity
+contracts (bf16 optimizer state, low-bit casts, named remat policies).
+
+Entry points::
+
+    mod = mx.mod.Module(net, precision="combined")      # named mode
+    mod = mx.mod.Module(net, precision=mx.precision.PrecisionPolicy(
+        opt_state_dtype="bfloat16", remat="dots_saveable"))
+
+See :mod:`mxnet_tpu.precision.policy` for the mode table and the
+contracts each mode carries (docs/api/precision.md).
+"""
+from .policy import (MODES, PrecisionPolicy, canon_dtype, canon_remat,
+                     fake_cast, loss_scale_config, mode_name,
+                     register_mode, remat_checkpoint_policy, resolve,
+                     state_np_dtype, wrap_fused_apply)
+
+__all__ = ["PrecisionPolicy", "MODES", "resolve", "register_mode",
+           "mode_name", "canon_dtype", "canon_remat", "state_np_dtype",
+           "wrap_fused_apply", "fake_cast", "remat_checkpoint_policy",
+           "loss_scale_config"]

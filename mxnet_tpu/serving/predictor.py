@@ -97,6 +97,22 @@ class Predictor:
                 "Predictor needs initialized parameters: bind+init the "
                 "module, or load it from params files / a "
                 "CheckpointManager first")
+        # precision-mode gate (mxnet_tpu.precision): a checkpoint
+        # trained under a mode (e.g. int8_act's quantized input seam)
+        # served through a module bound under a DIFFERENT policy would
+        # return silent garbage, not an error — refuse up front. The
+        # recorded mode rides the checkpoint manifest; live modules
+        # (never loaded from a manager entry) carry no recorded mode
+        # and their own policy is authoritative.
+        saved_mode = getattr(module, "_ckpt_precision_mode", None)
+        live_mode = getattr(module, "precision_mode", "f32")
+        if saved_mode is not None and saved_mode != live_mode:
+            raise MXNetError(
+                "refusing to serve: checkpoint was trained under "
+                "precision mode %r but the module to bind runs %r — "
+                "load with the matching precision= (or drop the "
+                "override so the recorded mode is adopted)"
+                % (saved_mode, live_mode))
         if data_shapes is None:
             if not module.binded:
                 raise MXNetError(
@@ -147,6 +163,22 @@ class Predictor:
             return [(name, (b,) + shape[1:])
                     for name, shape in self._data_descs]
 
+        # serve under the source policy's EVAL-visible fields only: the
+        # forward must see the same input casts (act_cast) and compute
+        # dtype the training forward saw, but training-only levers —
+        # remat, optimizer-state dtype, loss scaling — are stripped so
+        # an inference-only bucket never builds a segmented-remat
+        # evaluator or trips the fused-path requirement. The mode NAME
+        # is kept for telemetry/roofline attribution.
+        src_pol = getattr(module, "_precision", None)
+        serve_pol = None
+        if src_pol is not None:
+            from ..precision import PrecisionPolicy
+            serve_pol = PrecisionPolicy(
+                name=src_pol.name, compute_dtype=src_pol.compute_dtype,
+                act_cast=src_pol.act_cast,
+                experimental=src_pol.experimental)
+
         def _make(extra):
             return Module(symbol, data_names=module._data_names,
                           label_names=module._label_names,
@@ -154,6 +186,7 @@ class Predictor:
                           compute_dtype=module._compute_dtype,
                           mesh_axes=mesh_axes,
                           param_sharding=module._param_sharding,
+                          precision=serve_pol,
                           _allow_fused=module._allow_fused, **extra)
 
         base = _make({})
@@ -180,14 +213,22 @@ class Predictor:
     # ------------------------------------------------------------------
     @staticmethod
     def load(source, epoch=None, data_shapes=None, data_names=("data",),
-             label_names=("softmax_label",), context=None, **kwargs):
+             label_names=("softmax_label",), context=None, precision=None,
+             **kwargs):
         """Predictor straight from a checkpoint: ``source`` is a legacy
         prefix (``epoch`` required), a ``CheckpointManager``, or a
         checkpoint directory (``epoch`` then selects a committed step,
         default the latest). Routes through :meth:`Module.load`, so the
-        symbol rides in from the manifest on the manager path."""
+        symbol rides in from the manifest on the manager path — which
+        also adopts the entry's recorded precision mode; an explicit
+        ``precision=`` that mismatches the recorded mode is REFUSED at
+        Predictor construction (a wrong-mode serve is silent garbage)."""
+        mkw = {}
+        if precision is not None:
+            mkw["precision"] = precision
         mod = Module.load(source, epoch, data_names=list(data_names),
-                          label_names=list(label_names), context=context)
+                          label_names=list(label_names), context=context,
+                          **mkw)
         return Predictor(mod, data_shapes=data_shapes, context=context,
                          **kwargs)
 

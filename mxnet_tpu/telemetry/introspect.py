@@ -206,6 +206,14 @@ class ProgramInventory(object):
         with self._lock:
             return list(self._entries)
 
+    def clear(self):
+        """Drop every entry — test isolation (a process-global
+        inventory otherwise carries programs registered by earlier
+        suites, whose lazy analysis can dominate an unrelated
+        ``dump_programs``/``GET /programs``)."""
+        with self._lock:
+            self._entries.clear()
+
     def __len__(self):
         with self._lock:
             return len(self._entries)

@@ -37,6 +37,13 @@ CASES = [
     # augment bound into the module, cache built)
     ("image-classification/train_cifar10.py",
      ["--num-epochs", "2", "--device-augment", "--cache-dataset"]),
+    # precision mode (mxnet_tpu.precision): bf16 optimizer state +
+    # dots_saveable remat through the full fit path; the script's
+    # --min-accuracy assert doubles as the mode's accuracy gate (the
+    # within-mode digest-reproducibility contract runs in ci.sh)
+    ("image-classification/train_cifar10.py",
+     ["--num-epochs", "3", "--opt-state-dtype", "bf16",
+      "--remat", "dots_saveable", "--min-accuracy", "0.9"]),
     ("neural-style/neural_style.py", ["--iters", "200"]),
     ("warpctc/ctc_train.py", ["--num-epoch", "10"]),
     ("bayesian-methods/sgld.py",

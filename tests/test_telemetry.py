@@ -426,7 +426,10 @@ def test_pipeline_stats_snapshot_compat():
         "batches_delivered", "images_delivered", "host_wait_ms",
         "host_wait_ms_per_step", "stage_ms", "stager_img_per_sec",
         "ring_depth", "ring_occupancy", "ring_high_water",
-        "ring_full_waits"}
+        "ring_full_waits",
+        # staged-transport provenance (docs/api/data.md field table)
+        "staged_bytes", "staged_bytes_per_batch", "staged_dtype",
+        "augment_placement"}
     assert snap["batches_delivered"] == 1
     assert snap["images_delivered"] == 16
     assert snap["host_wait_ms"] == pytest.approx(1.0)

@@ -669,6 +669,11 @@ def test_fit_eval_records_tagged(tmp_path):
 # endpoints + bitwise zero-perturbation
 # ======================================================================
 def test_metrics_server_programs_and_health_routes():
+    # isolate from programs earlier suites registered in this process:
+    # /programs analyzes every inventory entry lazily, and e.g. the
+    # pipeline-parallel suite's programs take long enough to compile
+    # that the route would blow the client socket timeout
+    tel.inventory().clear()
     srv = tel.MetricsServer(tel.registry(), port=0)
     try:
         base = "http://%s:%d" % (srv.host, srv.port)
