@@ -293,6 +293,44 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
     --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
     --serve-smoke || FAILED=1
 
+stage "serving warm-start gate (persistent compile cache, two processes)"
+# replica warm-start contract (docs/api/serving.md "Persistent compile
+# cache"): two separate serving processes share one executable-cache
+# directory off one committed checkpoint. The first cold-starts
+# (compiles the bucket ladder, commits each entry atomically); the
+# second must WARM-start — every bucket deserialized, zero warmup XLA
+# compiles under CompileWatch (--expect-warm asserts both in-script) —
+# and both must serve bit-identical responses (sha256 over a fixed
+# serial request sweep).
+WS_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --checkpoint-dir "$WS_TMP/ckpt" --exit-after-epoch 1
+rc=$?
+if [ "$rc" -ne 66 ]; then
+    echo "expected simulated preemption exit 66, got $rc"
+    FAILED=1
+fi
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/serve_cifar10.py \
+    --checkpoint-dir "$WS_TMP/ckpt" --clients 4 --requests 8 \
+    --max-batch-size 16 --cache-dir "$WS_TMP/cache" \
+    --digest-out "$WS_TMP/digest_cold.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/serve_cifar10.py \
+    --checkpoint-dir "$WS_TMP/ckpt" --clients 4 --requests 8 \
+    --max-batch-size 16 --cache-dir "$WS_TMP/cache" \
+    --digest-out "$WS_TMP/digest_warm.txt" --expect-warm || FAILED=1
+python - "$WS_TMP/digest_cold.txt" "$WS_TMP/digest_warm.txt" <<'PY' || FAILED=1
+import sys
+a, b = (open(p).read().strip() for p in sys.argv[1:3])
+assert a and a == b, \
+    "warm-replica response digest %s != cold %s" % (b, a)
+print("warm-start gate: bit-identical responses (sha256 %s...)" % a[:16])
+PY
+rm -rf "$WS_TMP"
+
 stage "multi-chip dryrun (8 virtual devices)"
 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
     || FAILED=1

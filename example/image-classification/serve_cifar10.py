@@ -48,6 +48,27 @@ def main():
                         help="serve the latest committed step from this "
                              "CheckpointManager directory instead of "
                              "training in-process")
+    parser.add_argument("--cache-dir", default=None,
+                        help="persistent executable cache directory "
+                             "(docs/api/serving.md \"Persistent compile "
+                             "cache\"): warmup deserializes each "
+                             "bucket's compiled program from here or "
+                             "compiles + commits it for the next "
+                             "replica; a second run from the same "
+                             "directory warm-starts with zero XLA "
+                             "compiles")
+    parser.add_argument("--expect-warm", action="store_true",
+                        help="assert this replica WARM-started: every "
+                             "bucket deserialized from --cache-dir, "
+                             "zero warmup XLA compiles under "
+                             "CompileWatch (the ci.sh warm-start gate "
+                             "runs the demo twice in separate "
+                             "processes and passes this on the second)")
+    parser.add_argument("--digest-out", default=None,
+                        help="write the sha256 of a fixed serial "
+                             "request sweep's served responses to this "
+                             "file — the gate compares cold vs warm "
+                             "digests for bitwise equality")
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="expose the telemetry registry as a "
                              "Prometheus /metrics endpoint alongside "
@@ -97,9 +118,33 @@ def main():
     ref = mod.predict(val).asnumpy()
 
     t0 = time.time()
-    pred.warmup()
-    logging.info("warmup: buckets %s compiled in %.1fs",
-                 pred.buckets, time.time() - t0)
+    pred.warmup(cache_dir=args.cache_dir)
+    rep = pred.warmup_report()
+    logging.info("warmup: buckets %s ready in %.1fs (%s)",
+                 pred.buckets, time.time() - t0,
+                 ", ".join("b%d:%s %.0fms" % (b, r["source"],
+                                              r["warmup_ms"])
+                           for b, r in sorted(rep.items())))
+    # serving-scope compiles expected after warmup: one per bucket
+    # that did NOT deserialize a persistent-cache entry
+    expected_compiles = sum(1 for r in rep.values()
+                            if r["source"] != "deserialized")
+    if args.expect_warm:
+        # the warm-replica contract (the second process of the ci.sh
+        # warm-start gate): EVERY bucket came back as a deserialize,
+        # and the CompileWatch warmup stream recorded zero XLA compiles
+        assert args.cache_dir, "--expect-warm needs --cache-dir"
+        cold = {b: r["source"] for b, r in rep.items()
+                if r["source"] != "deserialized"}
+        assert not cold, \
+            "warm replica recompiled buckets %r" % cold
+        s0 = pred.stats()
+        assert s0["compiles"] == 0, s0
+        assert s0["cache_hits"] == len(pred.buckets), s0
+        assert mx.telemetry.compile_watch().warmup_compiles == 0
+        print("warm start OK: %d buckets deserialized in %.2fs, zero "
+              "warmup XLA compiles" % (len(pred.buckets),
+                                       time.time() - t0))
 
     errs = []
     slo = None
@@ -155,6 +200,23 @@ def main():
     for ln in sample:
         print("   ", ln)
 
+    n_digest_reqs = 0
+    if args.digest_out:
+        # a FIXED serial sweep through the live server: the responses
+        # are a pure function of the served params + programs, so cold
+        # and warm replicas of one checkpoint must produce the same
+        # digest bit for bit (the ci.sh warm-start gate compares them)
+        import hashlib
+        h = hashlib.sha256()
+        step = max(1, args.max_batch_size // 2)
+        for lo in range(0, 256, step):
+            out = server.predict(Xte[lo:lo + step], timeout=300)
+            h.update(np.ascontiguousarray(out).tobytes())
+            n_digest_reqs += 1
+        with open(args.digest_out, "w") as f:
+            f.write(h.hexdigest())
+        print("served-response digest: %s" % h.hexdigest())
+
     server.shutdown(drain=True)
     wall = time.time() - t0
 
@@ -200,14 +262,35 @@ def main():
               % (state["n_events"], len(traces)))
 
     assert not errs, errs[:3]
-    assert s["compiles"] == len(pred.buckets), \
-        "traffic triggered XLA compiles beyond warmup"
+    assert s["compiles"] == expected_compiles, \
+        "traffic triggered XLA compiles beyond warmup: %d != %d" \
+        % (s["compiles"], expected_compiles)
     # every attempt is accounted for: served, rejected (backpressure),
     # expired, or errored — nothing silently lost
-    total = args.clients * args.requests
+    total = args.clients * args.requests + n_digest_reqs
     assert s["completed"] + s["rejected"] + s["timeouts"] + \
         s["errors"] == total, (s, total)
     assert s["completed"] > 0, "no requests served"
+
+    if args.cache_dir and not args.expect_warm:
+        # in-process "second replica": a fresh Predictor (fresh jit
+        # objects, so nothing is trace-cached) warming from the cache
+        # this run just populated must deserialize every bucket and
+        # serve the same rows — the one-process spelling of the gate
+        warm = Predictor(mod, data_shapes=data_shapes,
+                         max_batch_size=args.max_batch_size)
+        warm.warmup(cache_dir=args.cache_dir)
+        wrep = warm.warmup_report()
+        assert all(r["source"] == "deserialized"
+                   for r in wrep.values()), wrep
+        assert warm.stats()["compiles"] == 0
+        k = args.max_batch_size
+        assert np.array_equal(warm.predict(Xte[:k]), ref[:k]), \
+            "warm-replica rows differ from the cold replica"
+        warm.release()
+        print("second replica warm-started: %d buckets deserialized, "
+              "zero XLA compiles, bitwise-equal rows"
+              % len(warm.buckets))
     print("serving demo OK: bitwise parity, zero post-warmup compiles")
 
 

@@ -16,11 +16,12 @@ The checkpoint subsystem (docs/api/checkpoint.md). Three layers:
 from __future__ import annotations
 
 from .manager import Checkpoint, CheckpointManager
+from .serialize import params_digest
 from . import serialize
 
 __all__ = ["Checkpoint", "CheckpointManager", "serialize",
            "pack_params", "split_params", "save_params_file",
-           "load_params_file"]
+           "load_params_file", "params_digest"]
 
 
 def pack_params(arg_params, aux_params):

@@ -35,7 +35,36 @@ FORMAT = "mxnet_tpu.checkpoint/v1"
 __all__ = ["FORMAT", "fsync_dir", "atomic_write_stream",
            "atomic_write_bytes", "write_bytes", "write_array",
            "read_array", "snapshot", "assemble", "write_json",
-           "read_json", "dump_rng", "load_rng"]
+           "read_json", "dump_rng", "load_rng", "params_digest"]
+
+
+def params_digest(symbol_json, arrays):
+    """Structural identity of a (symbol, parameter set) pair: sha256
+    over the symbol JSON plus every array's canonical
+    ``name|shape|dtype`` line, sorted by name.
+
+    THE one keying rule shared by checkpoint manifests
+    (``Module.save_checkpoint(manager=...)`` records it as
+    ``params_digest``) and the serving executable cache
+    (``mxnet_tpu.serving.cache`` keys AOT entries by it): a compiled
+    bucket program depends on the program structure and the parameter
+    shapes/dtypes — the parameter VALUES are runtime inputs, so two
+    checkpoints of the same architecture share executables while any
+    architecture drift (layer widths, added params, a dtype change)
+    produces a different digest and refuses a stale executable.
+
+    ``arrays`` maps name -> anything with ``shape``/``dtype`` (NDArray,
+    jax array, numpy). Scalars hash as shape ``()``.
+    """
+    import hashlib
+    h = hashlib.sha256()
+    h.update(str(symbol_json).encode("utf-8"))
+    for name in sorted(arrays):
+        v = arrays[name]
+        shape = tuple(getattr(v, "shape", ()))
+        dtype = onp.dtype(getattr(v, "dtype", onp.float32)).name
+        h.update(("\n%s|%s|%s" % (name, shape, dtype)).encode("utf-8"))
+    return h.hexdigest()
 
 
 def fsync_dir(path):

@@ -237,6 +237,11 @@ class Module(BaseModule):
                 saved_mode, ckpt.extra.get("precision"))
         mod = Module(symbol=sym_mod.load_json(sym_json), **kwargs)
         mod._ckpt_precision_mode = saved_mode
+        # recorded structural identity: the Predictor cross-checks it
+        # against the digest it recomputes from the restored params, so
+        # a post-load param swap cannot silently adopt a stale serving
+        # executable-cache entry (None for pre-digest checkpoints)
+        mod._ckpt_params_digest = ckpt.extra.get("params_digest")
         if mod.precision_mode != saved_mode:
             logging.warning(
                 "checkpoint step %d was saved under precision mode %r "
@@ -334,10 +339,17 @@ class Module(BaseModule):
         if save_optimizer_states:
             assert self.optimizer_initialized
             opt_state = self._optimizer_state_bytes()
+        from ..checkpoint import params_digest
         merged = {"epoch": int(step), "symbol": self._symbol.tojson(),
                   # the entry's precision provenance: restores adopt the
                   # mode, serving refuses a mismatch (docs/api/precision.md)
-                  "precision_mode": self.precision_mode}
+                  "precision_mode": self.precision_mode,
+                  # structural identity (symbol + param shapes/dtypes):
+                  # the serving executable cache keys AOT entries by
+                  # this same digest, so an operator can match a cache
+                  # directory to a checkpoint without loading either
+                  "params_digest": params_digest(self._symbol.tojson(),
+                                                 arrays)}
         if self._precision is not None:
             merged["precision"] = self._precision.describe()
         if extra:

@@ -12,7 +12,18 @@ mesh Module + durable checkpoints). Three pieces:
 * :class:`DynamicBatcher` — bounded request queue + background worker
   that coalesces concurrent requests into one bucket-padded launch
   within a ``max_wait_ms`` window; queue-full rejection, per-request
-  timeouts, graceful shutdown.
+  timeouts, graceful shutdown. Hosts several named :class:`Tenant`
+  models behind one queue (multi-model tenancy / canary rollout) with
+  SLO-driven admission: a tenant whose own burn windows breach is shed
+  (:class:`TenantShed`) while co-hosted tenants keep serving.
+* :mod:`~mxnet_tpu.serving.cache` — the persistent compile cache:
+  ``Predictor.warmup(cache_dir=...)`` serializes each bucket's
+  compiled program into an atomic, crc-verified entry keyed by
+  (params digest, precision mode, bucket, backend); a second replica
+  warming from the same directory deserializes every bucket with ZERO
+  XLA compiles and bitwise-identical served rows.
+  ``MXNET_COMPILE_CACHE_DIR`` wires jax's own persistent compilation
+  cache process-wide and doubles as the default AOT entry store.
 * :class:`ServingStats` — one snapshot (``stats()``) of latency
   p50/p95/p99 (deadline-missed requests included, by their queue age),
   batch-fill ratio, queue depth, and compile counters; with telemetry
@@ -40,10 +51,21 @@ See docs/api/serving.md for semantics and field reference.
 """
 from __future__ import annotations
 
+from . import cache
 from .batcher import DynamicBatcher
-from .errors import QueueFull, RequestTimeout, ServerClosed
+from .cache import ExecutableCache, enable_persistent_compile_cache
+from .errors import QueueFull, RequestTimeout, ServerClosed, TenantShed
 from .predictor import Predictor
 from .stats import ServingStats
+from .tenancy import Tenant
 
-__all__ = ["Predictor", "DynamicBatcher", "ServingStats",
-           "QueueFull", "RequestTimeout", "ServerClosed"]
+__all__ = ["Predictor", "DynamicBatcher", "ServingStats", "Tenant",
+           "ExecutableCache", "enable_persistent_compile_cache",
+           "QueueFull", "RequestTimeout", "ServerClosed", "TenantShed"]
+
+# process-wide persistent compilation cache: MXNET_COMPILE_CACHE_DIR
+# points jax's own cache (and the default AOT entry store Predictor
+# .warmup uses) at a shared directory — a new replica then warms by
+# deserializing instead of recompiling (docs/api/serving.md
+# "Persistent compile cache")
+cache._autowire()

@@ -1,14 +1,24 @@
 """DynamicBatcher — coalesce concurrent requests into full device
-launches, with backpressure.
+launches, with backpressure, multi-model tenancy, and SLO-driven
+admission.
 
 A device serving one request at a time runs at batch-1 utilization; a
 device serving whenever "enough" requests arrive runs near its training
 throughput. The batcher sits between the two: client threads ``submit``
 requests into a **bounded** queue and get a future back; a background
-worker coalesces whatever is queued — up to the Predictor's top bucket
-— within a ``max_wait_ms`` window measured from the first queued
-request, launches ONE bucket-padded device call through the Predictor,
-and routes each slice of the output back to its caller's future.
+worker coalesces whatever is queued — up to the tenant Predictor's top
+bucket — within a ``max_wait_ms`` window measured from the first queued
+request, launches ONE bucket-padded device call through that tenant's
+Predictor, and routes each slice of the output back to its caller's
+future.
+
+One batcher can host SEVERAL named models (:class:`Tenant` — or
+several checkpoint generations of one model, for canary rollout)
+behind the same queue: requests route by tenant name, launches
+coalesce within a tenant, the worker serves the highest-priority
+backlog first, and every tenant keeps its own ``serving.<i>.*`` stats
+scope and ``slo.<name>.*`` burn-rate gauges so a p99 regression stays
+attributable per tenant.
 
 Overload degrades instead of OOMing:
 
@@ -16,29 +26,33 @@ Overload degrades instead of OOMing:
   (backpressure; the request is never enqueued);
 * a request older than ``timeout_ms`` is dropped at launch time and its
   future carries :class:`RequestTimeout`;
+* a tenant whose own SLO fast+slow burn windows are in breach is SHED
+  (unless protected): new submits raise :class:`TenantShed`, queued
+  requests drop at dequeue time with their queue age traced — only the
+  breached tenant; co-hosted tenants keep serving
+  (tenancy module docstring has the full admission policy);
 * ``shutdown(drain=True)`` stops intake, serves out the queue, and
   joins the worker; ``drain=False`` fails pending futures with
   :class:`ServerClosed`.
 
-The batcher shares its Predictor's :class:`ServingStats`, so
-``stats()`` shows queue depth, batch-fill ratio, and per-request
-latency percentiles for the whole stack — percentiles that INCLUDE
-deadline-missed requests (an expired request's queue age is a latency
-sample, so p99 does not under-report exactly under overload).
+The single-tenant spelling is unchanged: ``DynamicBatcher(pred,
+slo=...)`` hosts one default tenant and ``stats()`` returns its
+Predictor's snapshot — percentiles that INCLUDE deadline-missed and
+worker-shed requests (their queue age is a latency sample, so p99 does
+not under-report exactly under overload).
 
 Judgment-layer hooks:
 
 * every request carries a stable id; with telemetry enabled its life
   is recorded as a phase-decomposed trace (queue-wait, coalesce-wait,
-  pad, device, resolve) into the stats trace ring, the per-bucket
-  phase histograms, and the Chrome-trace span timeline — a p99 blowup
-  is attributable to queueing vs device time (docs/api/serving.md
-  "Request traces");
-* ``slo=`` attaches a :class:`mxnet_tpu.telemetry.SLOTracker`: every
-  outcome (ok / error / timeout / queue-full reject) is recorded
-  against the declared objectives and ``slo_breached()`` surfaces the
-  multi-window burn-rate breach state (the admission decision that
-  will consume it is a later PR).
+  pad, device, resolve) into the tenant's stats trace ring, the
+  per-bucket phase histograms, and the Chrome-trace span timeline —
+  never-launched outcomes (timeout, shed) land their queue age in the
+  bucket-free ``phase_queue_wait_ms`` histogram;
+* ``slo=`` / per-tenant trackers record every outcome (ok / error /
+  timeout / queue-full reject) against the declared objectives;
+  ``slo_breached()`` surfaces the burn-rate breach state the admission
+  policy above consumes.
 """
 from __future__ import annotations
 
@@ -47,7 +61,8 @@ import threading
 import time
 from concurrent.futures import Future
 
-from .errors import QueueFull, RequestTimeout, ServerClosed
+from .errors import QueueFull, RequestTimeout, ServerClosed, TenantShed
+from .tenancy import Tenant
 
 __all__ = ["DynamicBatcher"]
 
@@ -68,20 +83,22 @@ class _Request:
 
 
 class DynamicBatcher:
-    """Bounded request queue + coalescing worker over a Predictor.
+    """Bounded request queue + coalescing worker over one or more
+    tenant Predictors.
 
     Parameters
     ----------
-    predictor : Predictor
-        The bucketed inference engine requests are served through.
+    predictor : Predictor, optional
+        Single-tenant spelling: hosts one ``"default"`` tenant.
+        Mutually exclusive with ``tenants=``.
     max_queue : int
-        Queue capacity in requests; beyond it ``submit`` rejects
-        (:class:`QueueFull`).
+        Queue capacity in requests, shared across tenants; beyond it
+        ``submit`` rejects (:class:`QueueFull`).
     max_wait_ms : float
-        Coalescing window measured from the FIRST queued request: the
-        worker launches as soon as the top bucket is full or the window
-        closes, whichever comes first. 0 serves whatever is queued
-        immediately (lowest latency, lowest fill).
+        Coalescing window measured from the FIRST queued request of a
+        launch: the worker launches as soon as the tenant's top bucket
+        is full or the window closes, whichever comes first. 0 serves
+        whatever is queued immediately (lowest latency, lowest fill).
     timeout_ms : float, optional
         Per-request deadline; requests still queued past it fail with
         :class:`RequestTimeout` instead of occupying a launch.
@@ -92,24 +109,61 @@ class DynamicBatcher:
         Serve the process-wide telemetry registry as a Prometheus
         ``GET /metrics`` endpoint (stdlib ``http.server``) for the
         batcher's lifetime — ``0`` picks a free port, readable as
-        ``.metrics_server.port``. The serving counters live in the
-        registry (``ServingStats`` is a view over it), so a scraper
-        pointed here sees queue depth, latency histogram, batch fill,
-        and compiles live.
+        ``.metrics_server.port``. Every tenant's serving counters live
+        in the registry, so a scraper pointed here sees queue depth,
+        latency histograms, batch fill, and compiles per tenant.
     slo : mxnet_tpu.telemetry.SLOTracker, optional
-        Declared serving objectives. The batcher records every request
-        outcome — completions with their latency, deadline misses with
-        their queue age, errors, queue-full rejects — so the tracker's
-        ``slo.*`` burn-rate gauges judge THIS batcher's traffic;
-        :meth:`slo_breached` surfaces the breach state.
+        Single-tenant spelling: objectives for the default tenant
+        (every outcome recorded; breach drives admission).
+    tenants : dict, optional
+        ``name -> Predictor | Tenant`` — the multi-model spelling.
+        Plain Predictors wrap as ``Tenant(name, predictor)``; pass
+        :class:`Tenant` objects to attach per-tenant SLOs, priorities,
+        and shed protection. Mutually exclusive with ``predictor``.
     """
 
-    def __init__(self, predictor, max_queue=256, max_wait_ms=2.0,
+    def __init__(self, predictor=None, max_queue=256, max_wait_ms=2.0,
                  timeout_ms=None, start=True, metrics_port=None,
-                 slo=None):
-        self._pred = predictor
-        self._stats = predictor._stats
-        self.slo = slo
+                 slo=None, tenants=None):
+        if tenants:
+            if predictor is not None or slo is not None:
+                raise ValueError(
+                    "pass either a single predictor (+ slo) or "
+                    "tenants=, not both")
+            resolved = collections.OrderedDict()
+            for name, spec in tenants.items():
+                if isinstance(spec, Tenant):
+                    if spec.name != str(name):
+                        raise ValueError(
+                            "tenant key %r names a Tenant(%r) — keys "
+                            "and Tenant names must agree"
+                            % (name, spec.name))
+                    resolved[str(name)] = spec
+                else:
+                    resolved[str(name)] = Tenant(name, spec)
+            seen = {}
+            for name, ten in resolved.items():
+                prev = seen.setdefault(id(ten.predictor), name)
+                if prev != name:
+                    raise ValueError(
+                        "tenants %r and %r share one Predictor "
+                        "instance — their stats scopes and queue "
+                        "gauge would silently merge; build one "
+                        "Predictor per tenant (two Predictors over "
+                        "one module share device params)"
+                        % (prev, name))
+            self._tenants = resolved
+        else:
+            if predictor is None:
+                raise ValueError(
+                    "DynamicBatcher needs a predictor (or tenants=)")
+            self._tenants = collections.OrderedDict(
+                [("default", Tenant("default", predictor, slo=slo))])
+        self._default = next(iter(self._tenants)) \
+            if len(self._tenants) == 1 else None
+        # single-tenant back-compat surface
+        self._pred = self._tenants[self._default].predictor \
+            if self._default else None
         self.metrics_server = None
         if metrics_port is not None:
             from .. import telemetry
@@ -119,14 +173,47 @@ class DynamicBatcher:
         self._max_wait = max(0.0, float(max_wait_ms)) / 1000.0
         self._timeout = (float(timeout_ms) / 1000.0
                          if timeout_ms is not None else None)
-        self._max_rows = predictor.max_batch_size
-        self._queue = collections.deque()
+        self._queues = {name: collections.deque()
+                        for name in self._tenants}
+        self._n_queued = 0
         self._cond = threading.Condition()
         self._closed = False
         self._thread = None
-        self._stats.set_queue_probe(lambda: len(self._queue))
+        for name, ten in self._tenants.items():
+            ten.stats.set_queue_probe(
+                lambda q=self._queues[name]: len(q))
         if start:
             self.start()
+
+    # ------------------------------------------------------------------
+    @property
+    def slo(self):
+        """The default tenant's SLOTracker (single-tenant back-compat;
+        None in multi-tenant mode — read per-tenant via
+        :meth:`tenant`)."""
+        return self._tenants[self._default].slo if self._default \
+            else None
+
+    def tenants(self):
+        """The hosted tenant names, in registration order."""
+        return list(self._tenants)
+
+    def tenant(self, name):
+        """The named :class:`Tenant` (KeyError for unknown names)."""
+        return self._tenants[name]
+
+    def _resolve(self, tenant):
+        if tenant is None:
+            if self._default is None:
+                raise ValueError(
+                    "this batcher hosts tenants %r — submit(..., "
+                    "tenant=<name>) must name one" % list(self._tenants))
+            return self._tenants[self._default]
+        try:
+            return self._tenants[tenant]
+        except KeyError:
+            raise ValueError("unknown tenant %r (hosted: %r)"
+                             % (tenant, list(self._tenants))) from None
 
     # ------------------------------------------------------------------
     def start(self):
@@ -141,48 +228,80 @@ class DynamicBatcher:
                 daemon=True)
             self._thread.start()
 
-    def submit(self, data, timeout_ms=None):
-        """Enqueue one request; returns a ``concurrent.futures.Future``
-        resolving to the request's outputs (single array for
-        single-output nets, else a list). Raises :class:`ServerClosed`
-        after shutdown and :class:`QueueFull` when the bounded queue is
-        at capacity — the backpressure signal. Malformed requests raise
+    def submit(self, data, timeout_ms=None, tenant=None):
+        """Enqueue one request for ``tenant`` (the sole tenant when
+        omitted); returns a ``concurrent.futures.Future`` resolving to
+        the request's outputs (single array for single-output nets,
+        else a list). Raises :class:`ServerClosed` after shutdown,
+        :class:`QueueFull` when the bounded queue is at capacity (the
+        backpressure signal), and :class:`TenantShed` while the
+        tenant's own SLO burn windows are in breach (admission sheds
+        the breached tenant only). Malformed requests raise
         ``ValueError`` here, on the caller's thread."""
-        arrays, rows = self._pred._normalize(data)
+        from .. import telemetry
+        ten = self._resolve(tenant)
+        arrays, rows = ten.predictor._normalize(data)
+        if self._closed:
+            # fast-path spelling of the locked check below: a dead
+            # server must answer ServerClosed (stop), never TenantShed
+            # (back off and retry), and must not mutate shed stats
+            raise ServerClosed("batcher is shut down")
+        if ten.shed_active():
+            # admission shed: decided before the queue, so the request
+            # costs the device nothing; the decision is still recorded
+            # (counter + trace) so a shed spike is attributable
+            ten.stats.note_shed()
+            if telemetry.enabled():
+                ten.stats.note_trace(ten.stats.new_request_id(), rows,
+                                     None, {}, outcome="shed")
+            raise TenantShed(
+                "tenant %r shed: its SLO fast+slow burn windows are in "
+                "breach — back off, or route to a protected tenant"
+                % ten.name)
         t = time.perf_counter()
         limit = self._timeout if timeout_ms is None else \
             float(timeout_ms) / 1000.0
         req = _Request(arrays, rows, Future(),
                        t + limit if limit is not None else None, t,
-                       req_id=self._stats.new_request_id())
+                       req_id=ten.stats.new_request_id())
         with self._cond:
             if self._closed:
                 raise ServerClosed("batcher is shut down")
-            full = len(self._queue) >= self._max_queue
+            full = self._n_queued >= self._max_queue
             if not full:
-                self._queue.append(req)
-                self._stats.note_request()
+                self._queues[ten.name].append(req)
+                self._n_queued += 1
+                ten.stats.note_request()
                 self._cond.notify_all()
         if full:
             # accounting OUTSIDE the condition lock: the SLO record can
             # trigger a bounded window scan, and overload — when rejects
             # fire — is exactly when the worker must not stall behind it
-            self._stats.note_reject()
-            if self.slo is not None:
-                self.slo.record(outcome="reject")
+            ten.stats.note_reject()
+            if ten.slo is not None:
+                ten.slo.record(outcome="reject")
             raise QueueFull(
                 "serving queue at capacity (%d requests) — shed "
                 "load or retry with backoff" % self._max_queue)
         return req.future
 
-    def predict(self, data, timeout=None, timeout_ms=None):
+    def predict(self, data, timeout=None, timeout_ms=None, tenant=None):
         """Blocking convenience: ``submit`` + ``Future.result``.
         ``timeout`` (seconds) bounds the caller-side wait; ``timeout_ms``
         overrides the batcher's per-request deadline."""
-        return self.submit(data, timeout_ms=timeout_ms).result(timeout)
+        return self.submit(data, timeout_ms=timeout_ms,
+                           tenant=tenant).result(timeout)
 
-    def stats(self):
-        return self._pred.stats()
+    def stats(self, tenant=None):
+        """The named tenant's stats snapshot; with one tenant and no
+        name, its snapshot (the historical single-tenant shape); with
+        several and no name, ``{tenant: snapshot}``."""
+        if tenant is not None:
+            return self._resolve(tenant).predictor.stats()
+        if self._default is not None:
+            return self._pred.stats()
+        return {name: ten.predictor.stats()
+                for name, ten in self._tenants.items()}
 
     # ------------------------------------------------------------------
     def shutdown(self, drain=True, timeout=None):
@@ -194,11 +313,14 @@ class DynamicBatcher:
             self._closed = True
             if not drain or self._thread is None:
                 # nobody will serve these — fail them out loud
-                while self._queue:
-                    req = self._queue.popleft()
-                    self._stats.note_error()
-                    req.future.set_exception(
-                        ServerClosed("batcher shut down before launch"))
+                for name, q in self._queues.items():
+                    ten = self._tenants[name]
+                    while q:
+                        req = q.popleft()
+                        self._n_queued -= 1
+                        ten.stats.note_error()
+                        req.future.set_exception(ServerClosed(
+                            "batcher shut down before launch"))
             self._cond.notify_all()
             thread, self._thread = self._thread, None
         if thread is not None and not already:
@@ -219,34 +341,59 @@ class DynamicBatcher:
     # ------------------------------------------------------------------
     def _worker(self):
         while True:
-            reqs = self._gather()
-            if reqs is None:
+            gathered = self._gather()
+            if gathered is None:
                 return
+            ten, reqs = gathered
             if reqs:
-                self._launch(reqs)
+                self._launch(ten, reqs)
+
+    def _pick_tenant(self):
+        """Name of the tenant to serve next: highest priority wins,
+        oldest head request breaks ties — priority orders service,
+        FIFO holds within a tenant. None when every queue is empty.
+        Caller holds the condition lock."""
+        best, best_key = None, None
+        for name, q in self._queues.items():
+            if not q:
+                continue
+            key = (-self._tenants[name].priority, q[0].t_submit)
+            if best_key is None or key < best_key:
+                best, best_key = name, key
+        return best
 
     def _gather(self):
-        """Block for the first request, then coalesce more until the
-        top bucket is full, the ``max_wait_ms`` window (from the first
-        request) closes, or the next request would overflow the bucket.
-        Returns the live (non-expired, non-cancelled) requests, or None
-        when shut down with an empty queue."""
+        """Block for the first request, pick its tenant, then coalesce
+        more of THAT tenant's requests until its top bucket is full,
+        the ``max_wait_ms`` window (from the first request) closes, or
+        the next request would overflow the bucket. Returns ``(tenant,
+        live requests)`` — live excludes expired, cancelled, and (for
+        a breached tenant) shed requests — or None when shut down with
+        an empty queue."""
         with self._cond:
-            while not self._queue:
+            while True:
+                name = self._pick_tenant()
+                if name is not None:
+                    break
                 if self._closed:
                     return None
                 # untimed: submit() and shutdown() both notify, so an
                 # idle server parks instead of polling
                 self._cond.wait()
-            reqs = [self._queue.popleft()]
-            reqs[0].t_popped = time.perf_counter()
-            rows = reqs[0].rows
-            window_end = reqs[0].t_submit + self._max_wait
-            while rows < self._max_rows:
-                if self._queue:
-                    if rows + self._queue[0].rows > self._max_rows:
+            ten = self._tenants[name]
+            q = self._queues[name]
+            first = q.popleft()
+            self._n_queued -= 1
+            first.t_popped = time.perf_counter()
+            reqs, rows = [first], first.rows
+            max_rows = ten.predictor.max_batch_size
+            window_end = first.t_submit + self._max_wait
+            while rows < max_rows:
+                if q:
+                    if rows + q[0].rows > max_rows:
                         break
-                    nxt = self._queue.popleft()
+                    nxt = q.popleft()
+                    self._n_queued -= 1
                     nxt.t_popped = time.perf_counter()
                     reqs.append(nxt)
                     rows += nxt.rows
@@ -256,7 +403,27 @@ class DynamicBatcher:
                     break
                 self._cond.wait(remaining)
         from .. import telemetry
+        tracing = telemetry.enabled()
         now = time.perf_counter()
+        if ten.shed_active():
+            # worker-side shed: the breach began (or was detected)
+            # after these queued; dropping them now keeps a breached
+            # tenant's backlog from occupying launches the healthy
+            # tenants need. The queue age is a latency outcome the
+            # client experienced — reservoir + shed histogram + trace.
+            for r in reqs:
+                age_ms = (now - r.t_submit) * 1000.0
+                ten.stats.note_shed(age_ms)
+                if tracing:
+                    ten.stats.note_trace(
+                        r.id, r.rows, None,
+                        {"queue_wait_ms": age_ms}, outcome="shed")
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(TenantShed(
+                        "request %s shed after %.1f ms in queue: "
+                        "tenant %r is in SLO breach"
+                        % (r.id, age_ms, ten.name)))
+            return ten, []
         live = []
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
@@ -264,11 +431,11 @@ class DynamicBatcher:
                 # the miss IS a latency outcome: its age reaches the
                 # reservoir/histogram (p99 must reflect overload) and
                 # spends SLO error budget
-                self._stats.note_timeout(age_ms)
-                if self.slo is not None:
-                    self.slo.record(age_ms, "timeout")
-                if telemetry.enabled():
-                    self._stats.note_trace(
+                ten.stats.note_timeout(age_ms)
+                if ten.slo is not None:
+                    ten.slo.record(age_ms, "timeout")
+                if tracing:
+                    ten.stats.note_trace(
                         r.id, r.rows, None,
                         {"queue_wait_ms": age_ms}, outcome="timeout")
                 if r.future.set_running_or_notify_cancel():
@@ -280,9 +447,9 @@ class DynamicBatcher:
                         % (r.id, age_ms)))
             elif r.future.set_running_or_notify_cancel():
                 live.append(r)
-        return live
+        return ten, live
 
-    def _launch(self, reqs):
+    def _launch(self, ten, reqs):
         import numpy as onp
 
         from .. import telemetry
@@ -297,14 +464,15 @@ class DynamicBatcher:
                 names = list(reqs[0].arrays)
                 arrays = {k: onp.concatenate([r.arrays[k] for r in reqs])
                           for k in names}
-            outs = self._pred._predict_rows(arrays, total, timing=timing)
+            outs = ten.predictor._predict_rows(arrays, total,
+                                               timing=timing)
         except BaseException as e:  # noqa: B036 — futures must resolve
             for r in reqs:
-                self._stats.note_error()
-                if self.slo is not None:
-                    self.slo.record(outcome="error")
+                ten.stats.note_error()
+                if ten.slo is not None:
+                    ten.slo.record(outcome="error")
                 if tracing:
-                    self._trace(r, None, timing, t_launch,
+                    self._trace(ten, r, None, timing, t_launch,
                                 time.perf_counter(), outcome="error")
                 r.future.set_exception(e)
             return
@@ -316,15 +484,15 @@ class DynamicBatcher:
             r.future.set_result(res[0] if len(res) == 1 else res)
             now = time.perf_counter()
             lat_ms = (now - r.t_submit) * 1000.0
-            self._stats.note_completed(lat_ms)
-            if self.slo is not None:
-                self.slo.record(lat_ms, "ok")
+            ten.stats.note_completed(lat_ms)
+            if ten.slo is not None:
+                ten.slo.record(lat_ms, "ok")
             if tracing:
-                self._trace(r, self._pred.bucket_for(total), timing,
-                            t_launch, t_outs, t_done=now)
+                self._trace(ten, r, ten.predictor.bucket_for(total),
+                            timing, t_launch, t_outs, t_done=now)
 
-    def _trace(self, r, bucket, timing, t_launch, t_outs, t_done=None,
-               outcome="ok"):
+    def _trace(self, ten, r, bucket, timing, t_launch, t_outs,
+               t_done=None, outcome="ok"):
         """One request's phase decomposition. The shared launch phases
         (pad, device) are what every coalesced request experienced;
         queue/coalesce/resolve are the request's own clocks — so each
@@ -343,11 +511,16 @@ class DynamicBatcher:
                 - timing.get("pad_ms", 0.0)
                 - timing.get("device_ms", 0.0), 0.0),
         }
-        self._stats.note_trace(r.id, r.rows, bucket, phases,
-                               outcome=outcome)
+        ten.stats.note_trace(r.id, r.rows, bucket, phases,
+                             outcome=outcome)
 
-    def slo_breached(self):
-        """Whether the attached :class:`SLOTracker` reports an active
-        multi-window burn-rate breach (False without one) — the signal
-        a later admission-control layer will act on."""
-        return self.slo is not None and self.slo.breached()
+    def slo_breached(self, tenant=None):
+        """Whether the named tenant's :class:`SLOTracker` reports an
+        active multi-window burn-rate breach — or, with no name,
+        whether ANY hosted tenant's does (False without trackers).
+        This is the state the admission policy sheds on."""
+        if tenant is not None:
+            ten = self._resolve(tenant)
+            return ten.slo is not None and ten.slo.breached()
+        return any(t.slo is not None and t.slo.breached()
+                   for t in self._tenants.values())

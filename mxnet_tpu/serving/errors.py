@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["QueueFull", "RequestTimeout", "ServerClosed"]
+__all__ = ["QueueFull", "RequestTimeout", "ServerClosed", "TenantShed"]
 
 
 class QueueFull(MXNetError):
@@ -20,6 +20,19 @@ class QueueFull(MXNetError):
     was never enqueued. Callers should shed load or retry with backoff;
     an unbounded queue here would turn overload into latency collapse
     and eventually host OOM."""
+
+
+class TenantShed(QueueFull):
+    """SLO-driven admission shed this tenant's request: the tenant's
+    own declared objectives are in multi-window burn-rate breach
+    (``SLOTracker.breached()``) and the tenant is not protected.
+
+    A subclass of :class:`QueueFull` so generic backoff handlers treat
+    it as shed load; raised synchronously at ``submit`` (the request is
+    never enqueued) and set on already-queued futures the worker drops
+    while the breach is active. Only the breached tenant is shed —
+    co-hosted tenants keep serving (pinned by
+    tests/test_serving_tenancy.py)."""
 
 
 class RequestTimeout(MXNetError, TimeoutError):

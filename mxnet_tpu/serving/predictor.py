@@ -33,6 +33,7 @@ training updates; rebuild the Predictor (or construct it from a
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as onp
@@ -119,6 +120,23 @@ class Predictor:
                     "data_shapes is required when the source module is "
                     "not bound (e.g. a Module.load result)")
             data_shapes = module.data_shapes
+        # structural identity for the persistent executable cache
+        # (serving.cache): symbol + param shapes/dtypes, the SAME
+        # digest rule checkpoint manifests record. A manager-restored
+        # module carries the recorded digest — a disagreement means the
+        # params were swapped after load, and adopting a cache entry
+        # keyed on either digest could serve a stale executable.
+        from ..checkpoint import pack_params, params_digest
+        self._params_digest = params_digest(
+            symbol.tojson(), pack_params(arg_params, aux_params))
+        recorded = getattr(module, "_ckpt_params_digest", None)
+        if recorded is not None and recorded != self._params_digest:
+            raise MXNetError(
+                "refusing to serve: the module's parameters no longer "
+                "match the checkpoint manifest's recorded params digest "
+                "(%s... != %s...) — the params were replaced after "
+                "load; rebuild the module from its checkpoint"
+                % (self._params_digest[:12], recorded[:12]))
         self._data_descs = [(name, tuple(shape))
                             for name, shape in data_shapes]
         contexts = list(context) if context is not None else \
@@ -340,19 +358,198 @@ class Predictor:
         return self._buckets[-1]
 
     # ------------------------------------------------------------------
-    def warmup(self):
-        """Run every bucket once (zero inputs) so all programs compile
-        BEFORE traffic; afterwards steady-state serving performs zero
-        XLA compiles (``stats()['compiles']`` stays frozen — pinned by
-        tests/test_serving.py). Returns the stats snapshot."""
-        with self._lock:
+    @property
+    def params_digest(self):
+        """Structural identity of (symbol, param shapes/dtypes) —
+        the executable-cache key component checkpoint manifests record
+        as ``params_digest``."""
+        return self._params_digest
+
+    def warmup_report(self):
+        """Per-bucket outcome of the last :meth:`warmup`:
+        ``{bucket: {"warmup_ms", "source"}}`` where ``source`` is
+        ``"deserialized"`` (persistent-cache hit, zero XLA work),
+        ``"compiled"`` (AOT compile + entry stored), or ``"jit"`` (no
+        cache directory — classic lazy trace)."""
+        return {b: dict(r) for b, r in
+                getattr(self, "_warmup_report", {}).items()}
+
+    def warmup(self, cache_dir=None):
+        """Bring every bucket to a launchable executable BEFORE
+        traffic; afterwards steady-state serving performs zero XLA
+        compiles (``stats()['compiles']`` stays frozen — pinned by
+        tests/test_serving.py). Returns the stats snapshot.
+
+        ``cache_dir`` activates the persistent executable cache
+        (module docstring of :mod:`mxnet_tpu.serving.cache`): each
+        bucket either DESERIALIZES a crc-verified cache entry keyed by
+        ``(params digest, precision mode, bucket, input signature,
+        backend)`` — zero XLA compiles, the replica warm start — or
+        compiles ahead-of-time and commits the entry atomically for
+        the next replica. Any key mismatch (drifted params digest,
+        wrong precision mode, different backend, corrupt or ``.tmp-*``
+        entry) falls back LOUDLY to a fresh compile; a stale
+        executable is never served silently. Defaults to
+        ``$MXNET_COMPILE_CACHE_DIR/aot`` when that env var is set;
+        explicit ``cache_dir`` values get an ``aot/`` subdirectory so
+        jax's own persistent-cache files can share the root.
+
+        Per-bucket compile/deserialize wall time publishes as
+        ``serving.<i>.b<bucket>.warmup_ms`` gauges (also in
+        ``stats()["warmup_ms"]``), hits/misses count into both the
+        serving scope and ``compile.cache_hits``/``cache_misses``, and
+        warmup traces are attributed to ``compile.warmup_compiles`` —
+        never the training ``compile.retraces`` stream."""
+        from .. import telemetry
+        from . import cache as _cache
+        if cache_dir is None:
+            root = os.environ.get("MXNET_COMPILE_CACHE_DIR")
+            cache_dir = os.path.join(root, "aot") if root else None
+        else:
+            cache_dir = os.path.join(str(cache_dir), "aot")
+        store = _cache.ExecutableCache(cache_dir) if cache_dir else None
+        watch = telemetry.compile_watch()
+        for m in self._modules.values():
+            watch.attach(m)
+        report = {}
+        with self._lock, watch.warmup_scope():
             for b in self._buckets:
+                t0 = time.perf_counter()
+                source = None
+                if store is not None:
+                    source = self._warm_bucket(b, store, watch)
                 zeros = {name: onp.zeros((b,) + shape[1:], onp.float32)
                          for name, shape in self._data_descs}
                 self._run_bucket(b, zeros, b, warmup=True)
+                ms = (time.perf_counter() - t0) * 1000.0
+                self._stats.note_warmup_bucket(b, ms, source)
+                report[b] = {"warmup_ms": round(ms, 3),
+                             "source": source or "jit"}
             self._warmed = True
             self._resolve_roofline()
+        self._warmup_report = report
         return self.stats()
+
+    def _warm_args(self, grp, bucket):
+        """The exact ``(params, aux, inputs, rng)`` call structure a
+        bucket launch uses — zeros staged through the SAME ``_stage``
+        rule as traffic, so the lowered avals/shardings match every
+        later request bitwise."""
+        zeros = {name: onp.zeros((bucket,) + shape[1:], onp.float32)
+                 for name, shape in self._data_descs}
+        batch = DataBatch(
+            data=[nd.NDArray(zeros[name])
+                  for name, _ in self._data_descs],
+            label=None, pad=0)
+        inputs = grp._stage(batch, is_train=False)
+        params = {n: buf._read() for n, buf in grp._param_dict.items()}
+        aux = {n: buf._read() for n, buf in grp._aux_dict.items()}
+        return params, aux, inputs, onp.zeros((2,), onp.uint32)
+
+    def _bucket_cache_key(self, grp, bucket):
+        from . import cache as _cache
+        backend = _cache.backend_signature(
+            mesh_axes=grp.mesh_axes, n_dev=int(grp.mesh.devices.size),
+            device_kind=grp._device_kind, platform=grp._platform)
+        return _cache.cache_key(
+            self._params_digest, grp.precision_mode_name(), bucket,
+            _cache.input_signature(self._data_descs), backend)
+
+    def _warm_bucket(self, bucket, store, watch):
+        """AOT-warm one bucket through the persistent executable
+        cache: deserialize the entry (``"deserialized"``) or compile
+        ahead-of-time and commit it (``"compiled"``). Either way the
+        resulting executable is INSTALLED as the bucket's program —
+        steady-state launches call it directly, with the jit wrapper
+        (and any chance of a re-trace) out of the request path."""
+        from . import cache as _cache
+        grp = self._modules[bucket]._exec_group
+        if not getattr(grp, "fused", False):
+            return None   # classic per-executor path: nothing to AOT
+        key = self._bucket_cache_key(grp, bucket)
+        loaded, source = None, "compiled"
+        try:
+            payload, in_tree, out_tree = store.load(key)
+            from jax.experimental import serialize_executable as _se
+            loaded = _se.deserialize_and_load(payload, in_tree,
+                                              out_tree)
+            source = "deserialized"
+        except _cache.CacheMiss as e:
+            log = self.logger.info if e.reason == "absent" \
+                else self.logger.warning
+            log("serving bucket %d: executable cache %s — falling "
+                "back to a fresh compile (%s)", bucket, e.reason,
+                e.detail or store.path_for(key))
+        except Exception as e:  # noqa: BLE001 - any deserialize failure
+            self.logger.warning(
+                "serving bucket %d: cached executable failed to "
+                "deserialize (%s) — falling back to a fresh compile",
+                bucket, e)
+        if loaded is None:
+            cached = grp._jits.get("fwd_eval")
+            if cached is not None and not hasattr(cached, "lower"):
+                # a previously installed (deserialized/AOT) executable
+                # can't be re-lowered; drop it so _get_jit rebuilds the
+                # traceable jit wrapper — re-warming after an evicted
+                # entry must fall back to a fresh compile, not crash
+                del grp._jits["fwd_eval"]
+            fn = grp._get_jit("fwd_eval")
+            # staged zeros + param reads are only needed to lower a
+            # fresh compile — building them above the cache load would
+            # add a device staging per bucket to every warm start
+            args = self._warm_args(grp, bucket)
+            # the lower() trace runs the instrumented evaluator body:
+            # the compile counts into stats()['compiles'] and (via the
+            # warmup scope) compile.warmup_compiles
+            compiled = fn.lower(*args).compile()
+            try:
+                from jax.experimental import serialize_executable as _se
+                payload, in_tree, out_tree = _se.serialize(compiled)
+                store.store(key, payload, in_tree, out_tree)
+            except Exception as e:  # noqa: BLE001 - cache is best-effort
+                self.logger.warning(
+                    "serving bucket %d: could not persist the compiled "
+                    "executable (%s) — the next replica will recompile",
+                    bucket, e)
+            loaded = compiled
+        grp._jits["fwd_eval"] = loaded
+        if source == "deserialized":
+            watch.note_cache_hit()
+        else:
+            watch.note_cache_miss()
+        self._register_warm_program(grp, bucket, loaded, key, source)
+        return source
+
+    def _register_warm_program(self, grp, bucket, compiled, key,
+                               source):
+        """Thread the warm bucket through the introspection inventory:
+        an ANALYTIC entry measured off the live executable (XLA cost
+        analysis works on deserialized executables too), carrying the
+        cache key + warm source in its meta — ``programs.*`` reports
+        and the serving roofline gauges keep working on a warm replica
+        whose jit handles never traced."""
+        try:
+            from .. import telemetry
+            analysis = telemetry.analyze_compiled(compiled)
+            name = telemetry.inventory().register(
+                "%s.fwd_eval" % grp._inventory_owner, kind="fwd_eval",
+                n_dev=int(grp.mesh.devices.size),
+                device_kind=grp._device_kind,
+                flops=analysis.get("flops"),
+                bytes_accessed=analysis.get("bytes_accessed"),
+                meta={"batch_size": bucket,
+                      "mesh_axes": dict(grp.mesh_axes),
+                      "warm_source": source, "cache_key": dict(key)})
+            grp._program_notes.add("fwd_eval")
+            grp._program_names["fwd_eval"] = name
+        except Exception:  # noqa: BLE001 - introspection never breaks warmup
+            pass
+
+    def release(self):
+        """Drop this Predictor's ``serving.<i>`` registry scope (see
+        :meth:`ServingStats.release`) — call when discarding a
+        Predictor in a long-lived multi-tenant process."""
+        self._stats.release()
 
     def _resolve_roofline(self):
         """Per-bucket FLOPs/bytes from the program inventory

@@ -75,8 +75,18 @@ class ServingStats:
         self._c_real_rows = c("real_rows")     # request rows served
         self._c_padded_rows = c("padded_rows")  # bucket rows launched
         self._c_compiles = c("compiles")   # XLA traces through serving
+        # persistent-executable-cache warm start (serving.cache):
+        # per-bucket hits (deserialized, zero XLA work) vs misses
+        # (fresh compile — absent, drifted key, or corrupt entry)
+        self._c_cache_hits = c("cache_hits")
+        self._c_cache_misses = c("cache_misses")
+        # SLO-driven admission: requests shed because the tenant's own
+        # burn windows are in breach (distinct from queue-full rejects)
+        self._c_sheds = c("sheds")
         self._h_latency = self.scope.histogram("latency_ms")
         self._h_timeout_age = self.scope.histogram("timeout_age_ms")
+        self._h_shed_age = self.scope.histogram("shed_age_ms")
+        self._warmup_ms = {}       # bucket -> compile/deserialize ms
         self._g_queue = self.scope.gauge("queue_depth")
         self.compile_tracking = True
         self.bucket_hits = {}      # bucket size -> launch count
@@ -101,6 +111,9 @@ class ServingStats:
     real_rows = telemetry.instrument_value("_c_real_rows")
     padded_rows = telemetry.instrument_value("_c_padded_rows")
     compiles = telemetry.instrument_value("_c_compiles")
+    cache_hits = telemetry.instrument_value("_c_cache_hits")
+    cache_misses = telemetry.instrument_value("_c_cache_misses")
+    sheds = telemetry.instrument_value("_c_sheds")
 
     def release(self):
         """Drop this instance's ``serving.<i>`` scope from the shared
@@ -140,6 +153,33 @@ class ServingStats:
 
     def note_error(self):
         self._c_errors.add()
+
+    def note_shed(self, age_ms=None):
+        """A request shed by SLO-driven admission (the tenant's own
+        burn windows in breach). A worker-side shed passes the queue
+        age — like a deadline miss it is a worst outcome the client
+        experienced, so it folds into the latency reservoir/histogram
+        (plus the dedicated ``shed_age_ms`` histogram); a submit-time
+        reject passes None (the request never waited)."""
+        self._c_sheds.add()
+        if age_ms is not None:
+            age_ms = float(age_ms)
+            self._h_shed_age.observe(age_ms)
+            self._reserve(age_ms)
+
+    def note_warmup_bucket(self, bucket, ms, source=None):
+        """One bucket's warmup wall time (compile OR deserialize) into
+        the ``b<bucket>.warmup_ms`` gauge; ``source`` tags the
+        executable-cache outcome (``"deserialized"`` counts a cache
+        hit, ``"compiled"`` a miss, None = cache not in play)."""
+        ms = round(float(ms), 3)
+        with self._lock:
+            self._warmup_ms[int(bucket)] = ms
+        self.scope.gauge("b%d.warmup_ms" % int(bucket)).set(ms)
+        if source == "deserialized":
+            self._c_cache_hits.add()
+        elif source == "compiled":
+            self._c_cache_misses.add()
 
     def note_batch(self, bucket, rows, warmup=False):
         if warmup:
@@ -203,6 +243,13 @@ class ServingStats:
             for p, ms in phases.items():
                 if ms or p in ("queue_wait_ms", "device_ms"):
                     self._phase_hist(trace["bucket"], p).observe(ms)
+        elif phases["queue_wait_ms"]:
+            # never-launched outcomes (timeout, admission shed) have no
+            # bucket but DID wait — their queue time lands in a
+            # bucket-free histogram so the decision stays attributable
+            # in this scope's phase view
+            self.scope.histogram("phase_queue_wait_ms").observe(
+                phases["queue_wait_ms"])
         # phase events laid out back-to-back ending at ts_end: the
         # request renders as a contiguous bar decomposed by phase
         events, t_us = [], (ts_end - total / 1000.0) * 1e6
@@ -249,6 +296,7 @@ class ServingStats:
             n = min(lat_total, self._window)
             lats = sorted(self._lat[:n])
             bucket_hits = dict(self.bucket_hits)
+            warmup_ms = dict(self._warmup_ms)
         real_rows, padded_rows = self.real_rows, self.padded_rows
         fill = (real_rows / float(padded_rows)) if padded_rows else None
         out = {
@@ -262,6 +310,10 @@ class ServingStats:
             "batch_fill": round(fill, 4) if fill is not None else None,
             "compiles": self.compiles,
             "compile_tracking": self.compile_tracking,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "sheds": self.sheds,
+            "warmup_ms": warmup_ms,
             "bucket_hits": bucket_hits,
             "latency_ms": {
                 "count": lat_total,

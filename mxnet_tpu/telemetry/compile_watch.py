@@ -62,6 +62,13 @@ class CompileWatch(object):
             scope = _tel.registry().scope("compile")
         self._c_retraces = scope.counter("retraces")
         self._c_post_warmup = scope.counter("post_warmup_retraces")
+        # serving warm-start accounting: bucket-warmup traces count into
+        # their own stream (not the training retrace stream a dashboard
+        # alerts on), and executable-cache hits/misses are tagged
+        # distinctly so the warm-start gate can assert on them directly
+        self._c_warmup = scope.counter("warmup_compiles")
+        self._c_cache_hits = scope.counter("cache_hits")
+        self._c_cache_misses = scope.counter("cache_misses")
         self.logger = logger or logging.getLogger("mxnet_tpu.telemetry")
         self._lock = threading.Lock()
         self._events = collections.deque(maxlen=int(max_events))
@@ -130,6 +137,18 @@ class CompileWatch(object):
             if i < len(vals):
                 shapes[name] = tuple(getattr(vals[i], "shape", ()))
         site = _call_site()
+        if getattr(self._tls, "warmup", False):
+            # a declared warmup compile (Predictor bucket warmup): its
+            # OWN stream — folding it into compile.retraces would make
+            # the training retrace counter unreadable the moment a
+            # serving replica warms in-process, and it must never fire
+            # the post-warmup warning
+            self._c_warmup.add()
+            with self._lock:
+                self._events.append({
+                    "time": time.time(), "site": site, "shapes": shapes,
+                    "post_warmup": False, "warmup": True})
+            return
         self._c_retraces.add()
         with self._lock:
             steady = self._steady
@@ -166,6 +185,32 @@ class CompileWatch(object):
         finally:
             self._tls.suppress = prev
 
+    @contextlib.contextmanager
+    def warmup_scope(self):
+        """Attribute traces on this thread to a declared warmup for the
+        duration: they count into ``compile.warmup_compiles`` instead
+        of ``compile.retraces`` and never warn. ``Predictor.warmup``
+        wraps its bucket ladder in this — the serving-side fix that
+        keeps bucket-warmup compiles out of the training retrace
+        stream."""
+        prev = getattr(self._tls, "warmup", False)
+        self._tls.warmup = True
+        try:
+            yield self
+        finally:
+            self._tls.warmup = prev
+
+    # -- executable-cache attribution ------------------------------------
+    def note_cache_hit(self):
+        """One serving bucket warmed by DESERIALIZING a persistent
+        executable-cache entry (zero XLA work)."""
+        self._c_cache_hits.add()
+
+    def note_cache_miss(self):
+        """One serving bucket warmed by a fresh compile (entry absent,
+        key drift, or corrupt — the loud fallback)."""
+        self._c_cache_misses.add()
+
     # -- warmup boundary ------------------------------------------------
     def mark_warmup_done(self):
         """Declare the warmup boundary: retraces from here on count as
@@ -187,6 +232,18 @@ class CompileWatch(object):
     @property
     def post_warmup_count(self):
         return self._c_post_warmup.value
+
+    @property
+    def warmup_compiles(self):
+        return self._c_warmup.value
+
+    @property
+    def cache_hits(self):
+        return self._c_cache_hits.value
+
+    @property
+    def cache_misses(self):
+        return self._c_cache_misses.value
 
     def events(self):
         """The newest retrace events: ``{"time", "site", "shapes",

@@ -103,6 +103,7 @@ class SLOTracker(object):
         self._events = collections.deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         self._last_eval = 0.0
+        self._last_breach = False
         if registry is None:
             import mxnet_tpu.telemetry as _tel
             registry = _tel.registry()
@@ -231,13 +232,24 @@ class SLOTracker(object):
             g["breach"].set(int(breach))
         out["breach"] = any_breach
         self._g_breach.set(int(any_breach))
+        self._last_breach = any_breach
         return out
 
     def breached(self, now=None):
         """Whether ANY objective is currently in multi-window breach —
-        the state a ``DynamicBatcher(slo=...)`` surfaces (the admission
-        decision itself is a later PR's)."""
+        the state a ``DynamicBatcher(slo=...)`` surfaces and its
+        admission policy acts on (shed/reject the breached tenant)."""
         return self.evaluate(now=now)["breach"]
+
+    def breached_cached(self, now=None):
+        """The breach state re-evaluated at most once per ``refresh_s``
+        — the admission-path spelling of :meth:`breached`: O(1) between
+        refreshes, so a per-submit admission check never pays a window
+        scan per request under load."""
+        now = time.time() if now is None else float(now)
+        if now - self._last_eval >= self.refresh_s:
+            self.evaluate(now=now)
+        return self._last_breach
 
     def report(self, now=None):
         """Objectives + current burn state as one JSON-able dict."""

@@ -109,6 +109,31 @@ def test_example_trains(script, args):
                                 proc.stderr[-2000:]))
 
 
+def test_serve_warm_start_flow(tmp_path):
+    """Serving warm-start flow (docs/api/serving.md "Persistent
+    compile cache"): serve_cifar10 --cache-dir cold-warms the ladder
+    (compile + atomic entry commit), then its in-script "second
+    replica" (fresh Predictor, fresh jit objects) must deserialize
+    every bucket with zero XLA compiles and serve bitwise-equal rows.
+    Per-run tmp cache dir — the true two-process warm start
+    (--expect-warm + response-digest compare) is the ci.sh gate."""
+    path = os.path.join(ROOT, "example",
+                        "image-classification", "serve_cifar10.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-u", path, "--num-epochs", "1",
+         "--clients", "4", "--requests", "8", "--max-batch-size", "16",
+         "--cache-dir", str(tmp_path / "cache")],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, (
+        "serve_cifar10 --cache-dir failed:\n%s\n%s"
+        % (proc.stdout[-2000:], proc.stderr[-2000:]))
+    assert "second replica warm-started" in proc.stdout, \
+        proc.stdout[-2000:]
+
+
 def test_transformer_lm_tp_on_mesh():
     """Module-reachable tensor parallelism: the transformer LM trains
     through Module.fit on a dp=2 x tp=4 mesh (example/transformer-lm/)

@@ -1,0 +1,308 @@
+"""Multi-model tenancy + SLO-driven admission (mxnet_tpu.serving):
+the isolation contracts.
+
+* Several named Predictors serve behind ONE DynamicBatcher queue;
+  requests route by tenant and each tenant's rows come back from ITS
+  model (bitwise vs that model's ``Module.predict``).
+* Two tenants with distinct SLOs: a burn-rate breach on one sheds ONLY
+  that tenant — submits raise :class:`TenantShed`, queued requests
+  drop with their queue age traced, the co-hosted tenant keeps
+  serving — and the tenant readmits itself once the bad events age
+  out of its windows.
+* Protected tenants (priority >= 1 / ``protected=True`` /
+  ``MXNET_SERVE_TENANT_PROTECTED``) keep serving through their own
+  breach; ``MXNET_SERVE_TENANT_SHED=0`` disables shedding entirely.
+* Per-tenant observability: each tenant's ``serving.<i>.*`` scope and
+  ``slo.<name>.*`` gauges stay attributable; shed decisions land in
+  the tenant's ``sheds`` counter, ``shed_age_ms`` histogram, and
+  trace ring.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.serving import (DynamicBatcher, Predictor, Tenant,
+                               TenantShed)
+
+DIM = 6
+
+
+def _net(hidden):
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, DIM).astype(np.float32),
+            rng.randint(0, 10, n).astype(np.float32))
+
+
+def _predictor(hidden, max_batch_size=8):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_net(hidden), context=[mx.cpu()])
+    X, y = _data()
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=8), num_epoch=1,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    ref = mod.predict(mx.io.NDArrayIter(X, None, batch_size=8)).asnumpy()
+    pred = Predictor(mod, max_batch_size=max_batch_size)
+    pred.warmup()
+    return pred, X, ref
+
+
+@pytest.fixture(scope="module")
+def two_models():
+    pA, X, refA = _predictor(16)
+    pB, _, refB = _predictor(24)
+    return pA, refA, pB, refB, X
+
+
+def _slo(name, **objectives):
+    objectives.setdefault("error_rate", 1e-3)
+    return mx.telemetry.SLOTracker(name, refresh_s=0.0, **objectives)
+
+
+def _breach(tracker, n=50):
+    """Drive the tracker into multi-window breach with real-time error
+    events (both windows cover 'now')."""
+    for _ in range(n):
+        tracker.record(outcome="error")
+    assert tracker.breached()
+
+
+# ---------------------------------------------------------------------
+# routing + per-tenant parity
+# ---------------------------------------------------------------------
+def test_tenants_route_to_their_own_model(two_models):
+    pA, refA, pB, refB, X = two_models
+    with DynamicBatcher(tenants={"a": pA, "b": pB},
+                        max_wait_ms=2) as srv:
+        assert srv.tenants() == ["a", "b"]
+        errs = []
+
+        def client(i):
+            n = 1 + (i % 5)
+            lo = (i * 3) % 40
+            name, ref = (("a", refA) if i % 2 else ("b", refB))
+            try:
+                out = srv.predict(X[lo:lo + n], timeout=60, tenant=name)
+                if not np.array_equal(out, ref[lo:lo + n]):
+                    errs.append("client %d got wrong tenant rows" % i)
+            except Exception as e:  # noqa: BLE001 — collected
+                errs.append("client %d: %r" % (i, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        sa, sb = srv.stats("a"), srv.stats("b")
+        assert sa["completed"] == 12 and sb["completed"] == 12
+        # multi-tenant submit must name a tenant
+        with pytest.raises(ValueError):
+            srv.submit(X[:2])
+        assert set(srv.stats()) == {"a", "b"}
+
+
+def test_single_tenant_spelling_unchanged(two_models):
+    pA, refA, _pB, _refB, X = two_models
+    with DynamicBatcher(pA, max_queue=16) as srv:
+        assert srv.tenants() == ["default"]
+        out = srv.predict(X[:3], timeout=30)
+        assert np.array_equal(out, refA[:3])
+        assert srv.stats()["completed"] >= 1   # historical shape
+
+
+# ---------------------------------------------------------------------
+# SLO-driven admission: breach on one sheds only that tenant
+# ---------------------------------------------------------------------
+def test_breach_sheds_only_that_tenant(two_models):
+    pA, refA, pB, refB, X = two_models
+    sloA = _slo("tenancy_a")
+    sloB = _slo("tenancy_b")
+    srv = DynamicBatcher(tenants={
+        "a": Tenant("a", pA, slo=sloA),
+        "b": Tenant("b", pB, slo=sloB)})
+    try:
+        assert np.array_equal(
+            srv.predict(X[:3], timeout=30, tenant="a"), refA[:3])
+        sheds0 = srv.stats("a")["sheds"]
+        _breach(sloA)
+        assert srv.slo_breached("a") and not srv.slo_breached("b")
+        with pytest.raises(TenantShed):
+            srv.submit(X[:2], tenant="a")
+        assert srv.stats("a")["sheds"] == sheds0 + 1
+        # the co-hosted tenant is untouched: serves, sheds nothing
+        assert np.array_equal(
+            srv.predict(X[:4], timeout=30, tenant="b"), refB[:4])
+        assert srv.stats("b")["sheds"] == 0
+        # TenantShed is a QueueFull: generic backoff handlers catch it
+        from mxnet_tpu.serving import QueueFull
+        assert issubclass(TenantShed, QueueFull)
+    finally:
+        srv.shutdown()
+
+
+def test_worker_side_shed_traces_queue_age(two_models):
+    pA, _refA, _pB, _refB, X = two_models
+    mx.telemetry.enable()
+    try:
+        slo = _slo("tenancy_worker_shed")
+        srv = DynamicBatcher(tenants={"a": Tenant("a", pA, slo=slo)},
+                             start=False)
+        sheds0 = srv.stats("a")["sheds"]
+        fut = srv.submit(X[:2], tenant="a")   # admitted while healthy
+        _breach(slo)                          # breach begins after
+        srv.start()
+        with pytest.raises(TenantShed):
+            fut.result(timeout=30)
+        s = srv.stats("a")
+        assert s["sheds"] == sheds0 + 1
+        # the shed decision is attributable: trace with outcome=shed
+        # carrying the request's queue age, which also reached the
+        # latency reservoir (a worst outcome the client experienced)
+        traces = pA._stats.request_traces()
+        shed = [t for t in traces if t["outcome"] == "shed"]
+        assert shed and shed[-1]["phases"]["queue_wait_ms"] > 0
+        assert shed[-1]["bucket"] is None
+        # ... and in the bucket-free queue-wait histogram
+        hists = mx.telemetry.registry().snapshot()["histograms"]
+        name = "%s.phase_queue_wait_ms" % pA._stats.scope.prefix
+        assert hists[name]["count"] >= 1
+        srv.shutdown()
+    finally:
+        mx.telemetry.disable()
+
+
+def test_tenant_readmits_after_burn_decays(two_models):
+    pA, refA, _pB, _refB, X = two_models
+    # a short fast window so the breach decays within the test: bad
+    # events age out -> burn 0 -> admission reopens (the control loop
+    # that makes shed-without-slo-feedback self-correcting)
+    slo = mx.telemetry.SLOTracker("tenancy_readmit", error_rate=1e-3,
+                                  fast_window_s=0.3, slow_window_s=0.3,
+                                  refresh_s=0.0)
+    srv = DynamicBatcher(tenants={"a": Tenant("a", pA, slo=slo)})
+    try:
+        _breach(slo, n=10)
+        with pytest.raises(TenantShed):
+            srv.submit(X[:2], tenant="a")
+        import time
+        time.sleep(0.4)           # the error burst ages out
+        assert not slo.breached()
+        out = srv.predict(X[:3], timeout=30, tenant="a")
+        assert np.array_equal(out, refA[:3])
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------
+# protection knobs
+# ---------------------------------------------------------------------
+def test_protected_tenant_serves_through_breach(two_models):
+    pA, refA, _pB, _refB, X = two_models
+    slo = _slo("tenancy_protected")
+    srv = DynamicBatcher(tenants={
+        "prod": Tenant("prod", pA, slo=slo, priority=1)})
+    try:
+        sheds0 = srv.stats("prod")["sheds"]
+        _breach(slo)
+        assert srv.slo_breached("prod")   # breach reported...
+        out = srv.predict(X[:3], timeout=30, tenant="prod")
+        assert np.array_equal(out, refA[:3])   # ...but never shed
+        assert srv.stats("prod")["sheds"] == sheds0
+    finally:
+        srv.shutdown()
+
+
+def test_env_protected_and_master_switch(two_models, monkeypatch):
+    pA, refA, _pB, _refB, X = two_models
+    slo = _slo("tenancy_env")
+    _breach(slo)
+    monkeypatch.setenv("MXNET_SERVE_TENANT_PROTECTED", "x, canary")
+    srv = DynamicBatcher(tenants={
+        "canary": Tenant("canary", pA, slo=slo)})
+    try:
+        assert srv.tenant("canary").protected
+        assert np.array_equal(
+            srv.predict(X[:2], timeout=30, tenant="canary"), refA[:2])
+    finally:
+        srv.shutdown()
+    monkeypatch.delenv("MXNET_SERVE_TENANT_PROTECTED")
+    monkeypatch.setenv("MXNET_SERVE_TENANT_SHED", "0")
+    srv = DynamicBatcher(tenants={
+        "canary": Tenant("canary", pA, slo=slo)})
+    try:
+        sheds0 = srv.stats("canary")["sheds"]
+        assert not srv.tenant("canary").protected
+        assert np.array_equal(
+            srv.predict(X[:2], timeout=30, tenant="canary"), refA[:2])
+        assert srv.stats("canary")["sheds"] == sheds0
+    finally:
+        srv.shutdown()
+
+
+def test_priority_orders_service(two_models):
+    """Both tenants have a backlog; the worker serves the
+    higher-priority tenant's requests first."""
+    pA, refA, pB, refB, X = two_models
+    srv = DynamicBatcher(tenants={
+        "low": Tenant("low", pA, priority=0),
+        "high": Tenant("high", pB, priority=1)}, start=False)
+    order = []
+    futs = []
+    for i in range(3):
+        f = srv.submit(X[:2], tenant="low")
+        f.add_done_callback(lambda _f: order.append("low"))
+        futs.append((f, refA))
+        g = srv.submit(X[:2], tenant="high")
+        g.add_done_callback(lambda _f: order.append("high"))
+        futs.append((g, refB))
+    srv.start()
+    for f, ref in futs:
+        assert np.array_equal(f.result(timeout=30), ref[:2])
+    srv.shutdown()
+    assert order[:3] == ["high", "high", "high"], order
+
+
+def test_tenant_validation(two_models):
+    pA, _refA, pB, _refB, _X = two_models
+    with pytest.raises(ValueError):
+        DynamicBatcher(pA, tenants={"a": pB})   # both spellings
+    with pytest.raises(ValueError):
+        DynamicBatcher(tenants={"a": Tenant("b", pA)})  # name clash
+    with pytest.raises(ValueError):
+        # one Predictor under two tenants would silently merge their
+        # stats scopes and queue gauge — refused at construction
+        DynamicBatcher(tenants={"a": pA, "b": pA})
+    with pytest.raises(TypeError):
+        Tenant("a", "not a predictor")
+    with pytest.raises(ValueError):
+        DynamicBatcher()
+    srv = DynamicBatcher(tenants={"a": pA}, start=False)
+    with pytest.raises(ValueError):
+        srv.submit(np.zeros((2, DIM), np.float32), tenant="nope")
+    srv.shutdown()
+
+
+def test_closed_batcher_answers_server_closed_not_shed(two_models):
+    """A dead server must answer ServerClosed (stop) — never TenantShed
+    (back off and retry forever) — and must not mutate shed stats."""
+    from mxnet_tpu.serving import ServerClosed
+    pA, _refA, _pB, _refB, X = two_models
+    slo = _slo("tenancy_closed")
+    _breach(slo)
+    srv = DynamicBatcher(tenants={"a": Tenant("a", pA, slo=slo)})
+    srv.shutdown()
+    sheds0 = srv.stats("a")["sheds"]
+    with pytest.raises(ServerClosed):
+        srv.submit(X[:2], tenant="a")
+    assert srv.stats("a")["sheds"] == sheds0
