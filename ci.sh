@@ -346,6 +346,46 @@ stage "multi-host dryrun (4 virtual hosts, elastic resume gate)"
 python -c "from __graft_entry__ import dryrun_multihost; dryrun_multihost(8, 4)" \
     || FAILED=1
 
+stage "chaos-soak gate (seeded FaultPlan over train + elastic resume + serve)"
+# fault-injection contract (docs/api/faults.md): one seeded FaultPlan —
+# transient transform/commit faults, a straggler delay, a planned
+# worker loss (dp=8 -> dp=4 elastic resume), a serving device
+# slowdown, a queue flood, a batcher worker death, and a poisoned
+# executable-cache entry — must (a) recover to the bitwise-identical
+# params digest of the fault-free continuous reference, (b) leave
+# EXACTLY the planned incidents in the plan transcript / FlightRecorder
+# / health scopes, (c) perform zero post-warmup retraces, and (d)
+# serve bitwise-correct rows after every serving fault. Emits
+# CHAOS_r01.json.
+python -c "from __graft_entry__ import dryrun_chaos; dryrun_chaos(8, 4)" \
+    || FAILED=1
+
+stage "chaos smoke (train_cifar10 --fault-plan: healed faults keep the digest)"
+# the smoke-sized spelling tests/test_examples.py shares: transient
+# staging faults healed by the shared bounded-backoff retry must leave
+# the trained params digest bitwise identical to the fault-free run
+CH_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --prefetch-device 2 \
+    --params-digest-out "$CH_TMP/digest_plain.txt" || FAILED=1
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+    timeout 420 python example/image-classification/train_cifar10.py \
+    --network resnet-8 --num-epochs 1 --batch-size 128 --seed 7 \
+    --prefetch-device 2 \
+    --fault-plan "data.device_put:transient@nth=5;data.stager:transient@nth=9" \
+    --params-digest-out "$CH_TMP/digest_chaos.txt" || FAILED=1
+python - "$CH_TMP/digest_plain.txt" "$CH_TMP/digest_chaos.txt" <<'PY' || FAILED=1
+import sys
+a, b = (open(p).read().strip() for p in sys.argv[1:3])
+assert a and a == b, \
+    "faulted-run params digest %s != fault-free %s" % (b, a)
+print("chaos smoke: bit-identical params under injected transient "
+      "faults (sha256 %s...)" % a[:16])
+PY
+rm -rf "$CH_TMP"
+
 echo
 if [ "$FAILED" -ne 0 ]; then
     echo "CI: FAILED"

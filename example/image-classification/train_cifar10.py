@@ -242,6 +242,18 @@ def main():
     parser.add_argument("--remat", default=None,
                         help="remat policy for the train step (none, "
                              "full, dots_saveable, offload_bn_stats)")
+    parser.add_argument("--fault-plan", default=None,
+                        help="arm a seeded mxnet_tpu.faults.FaultPlan "
+                             "for the run (grammar string, JSON list, "
+                             "or @file — docs/api/faults.md); after "
+                             "training the script asserts every "
+                             "deterministic rule actually fired and "
+                             "logs the incident transcript. Transient "
+                             "faults heal through the shared retry "
+                             "helper, so the trained params stay "
+                             "bitwise identical to a fault-free run "
+                             "(the ci.sh chaos-smoke gate compares "
+                             "digests)")
     parser.add_argument("--serve-smoke", action="store_true",
                         help="after training, serve the model through "
                              "an in-process mxnet_tpu.serving stack "
@@ -263,6 +275,13 @@ def main():
     if args.seed is not None:
         np.random.seed(args.seed)
         mx.random.seed(args.seed)
+    fault_plan = None
+    if args.fault_plan:
+        fault_plan = mx.faults.arm(args.fault_plan,
+                                   seed=args.seed or 0)
+        logging.info("fault plan armed (seed %d): %s", fault_plan.seed,
+                     "; ".join(r.describe()
+                               for r in fault_plan.rules))
 
     ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
         else [mx.cpu()]
@@ -415,6 +434,20 @@ def main():
         logging.info("health report: armed=%s healthy=%s polls=%d -> %s",
                      rep["armed"], rep["healthy"], rep["polls"],
                      args.health_report)
+    if fault_plan is not None:
+        # the chaos contract: a plan whose deterministic rules never
+        # fired silently missed its targets — that is a gate failure,
+        # not a pass; and every firing must be in the transcript
+        unfired = fault_plan.unfired()
+        assert not unfired, (
+            "fault plan rules never fired (workload missed their "
+            "trigger coordinates): %r" % (unfired,))
+        incidents = fault_plan.incidents()
+        logging.info("fault plan: %d incident(s) injected and "
+                     "recovered: %s", len(incidents),
+                     ", ".join("%s(%s)" % (i["site"], i["kind"])
+                               for i in incidents))
+        mx.faults.disarm()
     trained = mod._optimizer is not None and mod._optimizer.num_update > 0
     if args.batch_group and args.batch_group > 1 and trained:
         # the CI equivalence gate must FAIL, not trivially pass, if the

@@ -45,6 +45,7 @@ from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context
 from . import base
 from . import engine
 from . import random
+from . import faults
 from . import ops  # registers all operators
 from . import ndarray
 from . import ndarray as nd

@@ -43,6 +43,7 @@ import uuid
 from collections import namedtuple
 
 from .. import engine as _engine
+from .. import faults as _faults
 from .. import random as _random
 from .. import telemetry
 from ..base import MXNetError
@@ -193,13 +194,37 @@ class CheckpointManager(object):
         if opt_bytes is not None:
             n_bytes += len(opt_bytes)
 
+        def attempt():
+            # retryable unit: a retried transient fault (an injected
+            # TransientFault by default — pass your own retry policy
+            # for real flaky-storage classes) re-stages the WHOLE
+            # entry — the half-written tmp is dropped first, so a
+            # retry can never commit a torn mix of two attempts
+            shutil.rmtree(tmp, ignore_errors=True)
+            self._write_entry(tmp, step, snaps, opt_bytes, extra,
+                              rng_state, save_time)
+            if _faults.armed():
+                # kill-mid-commit seam: the entry is fully staged but
+                # the rename never happens — exactly what a process
+                # death here leaves behind
+                _faults.check("checkpoint.commit", step=step)
+            _commit_entry(tmp, final)
+
         def job():
             t0 = time.perf_counter()
             try:
                 with telemetry.span("checkpoint.save", step=step):
-                    self._write_entry(tmp, step, snaps, opt_bytes, extra,
-                                      rng_state, save_time)
-                    _commit_entry(tmp, final)
+                    _faults.retry(attempt, site="checkpoint.save",
+                                  seed=step)
+                if _faults.armed():
+                    # post-commit corruption seams: bit-flip a shard /
+                    # corrupt the manifest of the COMMITTED entry (a
+                    # storage fault after a clean commit) — restore()
+                    # must fall back to the previous verifiable entry
+                    _faults.corrupt_file("checkpoint.shard", final,
+                                         pattern="a*.npy", step=step)
+                    _faults.corrupt_file("checkpoint.manifest", final,
+                                         pattern=_MANIFEST, step=step)
                 self._gc()
                 # duration + bytes land in the shared registry: the
                 # telemetry story for "how much is checkpointing
@@ -303,15 +328,63 @@ class CheckpointManager(object):
 
     # ---------------------------------------------------------- restore
     def restore(self, step=None):
-        """Load a committed entry (default: :meth:`latest`) as a
-        :class:`Checkpoint`, re-assembling sharded arrays into global
-        host arrays regardless of the saving mesh layout."""
+        """Load a committed entry as a :class:`Checkpoint`,
+        re-assembling sharded arrays into global host arrays regardless
+        of the saving mesh layout.
+
+        With ``step=None`` (the resume path), restore walks BACK from
+        the newest committed entry to the newest entry that passes
+        verification: a latest entry whose manifest is unreadable or
+        whose shards fail their crc32/shape checks is skipped with ONE
+        loud warning per bad entry (plus a FlightRecorder
+        ``checkpoint_fallback`` note), and the previous committed entry
+        restores instead — losing the corrupt step's work beats losing
+        the job. Only when NO entry verifies does restore refuse.
+        An explicit ``step`` is an exact request and stays terminal on
+        corruption (the caller asked for those bytes)."""
         self.wait_until_finished()
-        if step is None:
-            step = self.latest()
-            if step is None:
-                raise MXNetError("no committed checkpoint in %s"
-                                 % self.directory)
+        if step is not None:
+            return self._restore_entry(int(step))
+        candidates = sorted(self.all_steps(), reverse=True)
+        if not candidates:
+            raise MXNetError("no committed checkpoint in %s"
+                             % self.directory)
+        log = logging.getLogger(__name__)
+        failures = []
+        for s in candidates:
+            try:
+                ckpt = self._restore_entry(s)
+            except Exception as exc:  # noqa: BLE001 — ANY failure to
+                # load this entry (crc refusal, torn JSON that still
+                # parsed, missing nested manifest keys) means it does
+                # not verify; the walkback's job is to reach an entry
+                # that does, logging what it skipped
+                failures.append((s, exc))
+                _TEL.counter("restore_fallbacks").add()
+                log.warning(
+                    "checkpoint step %d in %s failed verification (%s); "
+                    "falling back to the previous committed entry",
+                    s, self.directory, exc)
+                telemetry.flight_recorder().note(
+                    "checkpoint_fallback", step=s, error=str(exc))
+                continue
+            if failures:
+                log.warning(
+                    "restored checkpoint step %d after skipping %d "
+                    "corrupt newer entr%s", s, len(failures),
+                    "y" if len(failures) == 1 else "ies")
+            return ckpt
+        raise MXNetError(
+            "no checkpoint entry in %s passed verification (%d "
+            "candidate%s); newest failure: step %d: %s"
+            % (self.directory, len(failures),
+               "" if len(failures) == 1 else "s",
+               failures[0][0], failures[0][1]))
+
+    def _restore_entry(self, step):
+        """Load + verify ONE committed entry (crc32/shape/dtype per
+        shard); any corruption raises :class:`MXNetError` naming the
+        failing artifact."""
         step = int(step)
         t0 = time.perf_counter()
         entry = self._entry_dir(step)
@@ -319,16 +392,36 @@ class CheckpointManager(object):
         if not os.path.exists(manifest_path):
             raise MXNetError("checkpoint step %d is not committed in %s"
                              % (step, self.directory))
-        manifest = serialize.read_json(manifest_path)
+        try:
+            manifest = serialize.read_json(manifest_path)
+        except (ValueError, OSError) as exc:
+            raise MXNetError(
+                "checkpoint manifest %s is unreadable (corrupt or "
+                "truncated): %s" % (manifest_path, exc)) from exc
         if manifest.get("format") != serialize.FORMAT:
             raise MXNetError("unknown checkpoint format %r in %s"
                              % (manifest.get("format"), entry))
         params = {}
-        for name, meta in manifest["arrays"].items():
+        try:
+            array_items = list(manifest["arrays"].items())
+        except (KeyError, AttributeError) as exc:
+            raise MXNetError(
+                "checkpoint manifest %s has no arrays table (corrupt "
+                "or hand-edited)" % manifest_path) from exc
+        for name, meta in array_items:
             shards = []
             for smeta in meta["shards"]:
-                arr = serialize.read_array(
-                    os.path.join(entry, smeta["file"]), smeta)
+                try:
+                    arr = serialize.read_array(
+                        os.path.join(entry, smeta["file"]), smeta)
+                except (OSError, ValueError) as exc:
+                    # a missing/undecodable .npy is the same verdict a
+                    # crc mismatch gets: the entry does not verify
+                    raise MXNetError(
+                        "checkpoint shard %s is unreadable (corrupt or "
+                        "truncated): %s"
+                        % (os.path.join(entry, smeta["file"]),
+                           exc)) from exc
                 idx = smeta["index"]
                 shards.append((None if idx is None else
                                tuple((a, b) for a, b in idx), arr))

@@ -33,6 +33,7 @@ import time
 import numpy as onp
 
 from ..base import MXNetError
+from .. import faults as _faults
 from .. import ndarray as nd
 from ..io import DataBatch, DataIter
 from .stats import PipelineStats
@@ -99,11 +100,22 @@ class DeviceLoader(DataIter):
     """
 
     def __init__(self, data_iter, module=None, depth=2, batch_group=None,
-                 stats=None, close_source=False):
+                 stats=None, close_source=False, restart_on_error=None):
         super().__init__(getattr(data_iter, "batch_size", 0))
         depth = int(depth)
         if depth < 1:
             raise MXNetError("depth must be >= 1 (got %d)" % depth)
+        if restart_on_error is None:
+            import os
+            restart_on_error = os.environ.get(
+                "MXNET_FAULT_STAGER_RESTART", "0") == "1"
+        # error-propagation contract: a stager error is always
+        # delivered IN ORDER on the consumer thread; by default the
+        # epoch is then over (reset() recovers). With
+        # ``restart_on_error`` the stager instead relaunches after the
+        # delivery, so a consumer that catches the error keeps
+        # iterating the surviving stream (the chaos-soak posture).
+        self._restart_on_error = bool(restart_on_error)
         group = int(batch_group) if batch_group else 0
         if group == 1:
             group = 0
@@ -159,6 +171,18 @@ class DeviceLoader(DataIter):
 
         def put(arr):
             v = _host_value(arr)
+            if _faults.armed():
+                # transient transfer fault: healed by the shared
+                # bounded-backoff retry — the SAME bytes land on
+                # retry, so trained params stay bitwise identical.
+                # The retry scaffolding lives under the armed branch:
+                # unarmed staging pays one branch, nothing more.
+                def attempt():
+                    _faults.check("data.device_put")
+                    if sharding is not None:
+                        return jax.device_put(v, sharding)
+                    return jax.device_put(v)
+                return _faults.retry(attempt, site="data.device_put")
             if sharding is not None:
                 return jax.device_put(v, sharding)
             return jax.device_put(v)
@@ -186,7 +210,13 @@ class DeviceLoader(DataIter):
         # onp.stack there would be K blocking readbacks
         stacked = stack_group_inputs(
             batches, self._data_names, self._label_names)
-        staged = self._group_handle.stage_stacked(stacked)
+        if _faults.armed():
+            def attempt():
+                _faults.check("data.device_put", group=len(batches))
+                return self._group_handle.stage_stacked(stacked)
+            staged = _faults.retry(attempt, site="data.device_put")
+        else:
+            staged = self._group_handle.stage_stacked(stacked)
         out = []
         for j, b in enumerate(batches):
             # augmented groups: stage_stacked consumed the wire param
@@ -213,6 +243,14 @@ class DeviceLoader(DataIter):
         batches).  Returns _END at epoch end, an exception to re-raise
         in order, or the staged batches."""
         from .. import telemetry
+        if _faults.armed():
+            # stager-crash seam: raises BEFORE any source pull, so a
+            # restarted stager resumes the stream with nothing lost.
+            # Transient kinds heal in place through the shared retry;
+            # permanent kinds escape to the consumer as the crash.
+            _faults.retry(
+                lambda: _faults.check("data.stager", group=self._group),
+                site="data.stager")
         if self._group:
             pulled = []
             for _ in range(self._group):
@@ -316,6 +354,20 @@ class DeviceLoader(DataIter):
             name="mxtpu-device-stager", daemon=True)
         self._stager.start()
 
+    def _restart_stager(self):
+        """Recover from a delivered stager error: join the (already
+        returned) stager thread and rebase the epoch tag so a fresh
+        stager relaunches on the next ``next()``, continuing the
+        source stream from where the crash left it."""
+        from .. import telemetry
+        self._stop_stager()
+        with self._cond:
+            self._stop = False
+            self._exhausted = False
+            self._noted_full = False
+            self._live_epoch += 1
+        telemetry.registry().counter("data.stager_restarts").add()
+
     def _stop_stager(self):
         stager = self._stager
         if stager is None:
@@ -354,7 +406,8 @@ class DeviceLoader(DataIter):
                                      "while a next() was blocked")
                 self._cond.wait(0.05)
             entry = self._ring.pop(0)
-            if entry is _END or isinstance(entry, BaseException):
+            if entry is _END or (isinstance(entry, BaseException)
+                                 and not self._restart_on_error):
                 self._exhausted = True
             self.pipeline_stats.note_ring(len(self._ring))
             self._cond.notify_all()
@@ -362,6 +415,11 @@ class DeviceLoader(DataIter):
         if entry is _END:
             raise StopIteration
         if isinstance(entry, BaseException):
+            if self._restart_on_error:
+                # the stager exited when it delivered this error; join
+                # it and relaunch LAZILY so a consumer that catches the
+                # error keeps iterating the surviving stream
+                self._restart_stager()
             raise entry
         batch = entry[0]
         self._pending = list(entry[1:])

@@ -26,6 +26,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as onp
 
 from ..base import MXNetError
+from .. import faults as _faults
 from ..io import DataIter
 
 __all__ = ["TransformIter"]
@@ -61,11 +62,21 @@ class TransformIter(DataIter):
     """
 
     def __init__(self, data_iter, transform=None, num_workers=2,
-                 depth=None, seed=0):
+                 depth=None, seed=0, restart_on_error=None):
         super().__init__(getattr(data_iter, "batch_size", 0))
         if num_workers < 1:
             raise MXNetError("num_workers must be >= 1 (got %d)"
                              % num_workers)
+        if restart_on_error is None:
+            import os
+            restart_on_error = os.environ.get(
+                "MXNET_FAULT_STAGER_RESTART", "0") == "1"
+        # with restart_on_error a TRANSFORM error is delivered in order
+        # and the stream continues past the failed batch (the pool and
+        # sequencer are still alive); source errors stay terminal — the
+        # source iterator's state after its own exception is undefined
+        self._restart_on_error = bool(restart_on_error)
+        self._source_dead = False
         self._iter = data_iter
         self._transform = transform
         self._num_workers = int(num_workers)
@@ -101,6 +112,7 @@ class TransformIter(DataIter):
             self._next_get = 0      # next sequence number to deliver
             self._stop = False
             self._exhausted = False
+            self._source_dead = False
         self._epoch += 1
         with self._cond:
             # epoch tag: a straggler transform submitted before a
@@ -141,9 +153,14 @@ class TransformIter(DataIter):
             try:
                 batch = self._iter.next()
             except StopIteration:
+                # a normal epoch end is NOT a dead source: in-flight
+                # transform errors delivered after this point must
+                # still honor restart_on_error (the _END marker ends
+                # the epoch when ITS turn comes)
                 self._finish(epoch, seq, _END)
                 return
             except Exception as exc:  # surface on the consumer thread
+                self._source_dead = True
                 self._finish(epoch, seq, exc)
                 return
             if self._transform is None:
@@ -152,9 +169,16 @@ class TransformIter(DataIter):
                 self._pool.submit(self._run_transform, epoch, seq, batch)
 
     def _run_transform(self, epoch, seq, batch):
-        try:
+        def attempt():
+            if _faults.armed():
+                # transform-worker seam; the rng below re-seeds per
+                # attempt, so a healed retry delivers IDENTICAL bytes
+                _faults.check("data.transform", epoch=epoch, index=seq)
             rng = onp.random.RandomState(self._batch_seed(epoch, seq))
-            out = self._transform(batch, rng)
+            return self._transform(batch, rng)
+        try:
+            out = _faults.retry(attempt, site="data.transform",
+                                seed=self._seed)
         except Exception as exc:  # noqa: BLE001 — delivered in order
             out = exc
         self._finish(epoch, seq, out)
@@ -192,7 +216,9 @@ class TransformIter(DataIter):
                 self._cond.wait(0.05)
             value = self._results.pop(self._next_get)
             self._next_get += 1
-            if value is _END or isinstance(value, BaseException):
+            if value is _END or (isinstance(value, BaseException)
+                                 and not (self._restart_on_error
+                                          and not self._source_dead)):
                 self._exhausted = True
             self._cond.notify_all()
         if value is _END:

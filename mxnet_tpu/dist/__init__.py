@@ -49,7 +49,8 @@ __all__ = [
     "ShardedDataIter", "shard_rows", "batch_seed",
     "VirtualCluster", "VirtualFeed",
     "ElasticTrainer", "HeartbeatMonitor", "WorkerLost",
-    "RestartRequired", "ProcessWorld",
+    "RestartRequired", "ProcessWorld", "RELAUNCH_EXIT_CODE",
+    "request_relaunch", "run_with_relaunch", "virtual_world_from_env",
     "stage_sharded", "assemble_host_slices",
 ]
 
@@ -59,7 +60,9 @@ _LAZY = {
     "VirtualCluster": "virtual", "VirtualFeed": "virtual",
     "ElasticTrainer": "elastic", "HeartbeatMonitor": "elastic",
     "WorkerLost": "elastic", "RestartRequired": "elastic",
-    "ProcessWorld": "elastic",
+    "ProcessWorld": "elastic", "RELAUNCH_EXIT_CODE": "elastic",
+    "request_relaunch": "elastic", "run_with_relaunch": "elastic",
+    "virtual_world_from_env": "elastic",
     "stage_sharded": "staging", "assemble_host_slices": "staging",
     "staging": "staging", "virtual": "virtual", "elastic": "elastic",
     "sharded_iter": "sharded_iter",

@@ -150,24 +150,36 @@ def initialize(coordinator_address=None, num_processes=None,
         kwargs = dict(coordinator_address=coordinator_address,
                       num_processes=int(num_processes),
                       process_id=int(process_id))
-        attempt = 0
-        while True:
-            try:
-                _connect(kwargs, int(heartbeat_timeout))
-                break
-            except (RuntimeError, ConnectionError) as exc:
-                attempt += 1
-                if attempt > connect_retries:
-                    raise RuntimeError(
-                        "could not join coordinator %s after %d attempts"
-                        % (coordinator_address, attempt)) from exc
-                delay = connect_backoff_s * (2 ** (attempt - 1))
-                import logging
-                logging.getLogger(__name__).warning(
-                    "dist bootstrap: connect to %s failed (%s); "
-                    "retry %d/%d in %.1fs", coordinator_address, exc,
-                    attempt, connect_retries, delay)
-                time.sleep(delay)
+
+        from .. import faults as _faults
+
+        def attempt():
+            if _faults.armed():
+                # coordinator connect-flap seam: a transient fault here
+                # is exactly a worker racing a restarting coordinator
+                _faults.check("dist.connect",
+                              address=str(coordinator_address))
+            _connect(kwargs, int(heartbeat_timeout))
+        import logging
+        try:
+            # THE shared bounded-backoff idiom (faults.retry) — jitter
+            # pinned to 0 so the documented connect schedule
+            # (backoff * 2^k) is exact
+            _faults.retry(
+                attempt, retries=int(connect_retries),
+                backoff_s=float(connect_backoff_s),
+                max_backoff_s=float("inf"),   # the documented schedule
+                jitter=0.0,                   # is uncapped backoff*2^k
+                retry_on=(RuntimeError, ConnectionError,
+                          _faults.TransientFault),
+                site="dist.connect",
+                logger=logging.getLogger(__name__))
+        except (RuntimeError, ConnectionError,
+                _faults.TransientFault) as exc:
+            raise RuntimeError(
+                "could not join coordinator %s after %d attempts"
+                % (coordinator_address, int(connect_retries) + 1)) \
+                from exc
 
     # install as THE process singleton before the rendezvous: its
     # _barrier_n counter owns the coordination-service barrier ids, so

@@ -34,15 +34,25 @@ place, which is how CI exercises the whole loop.
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
+import sys
 import threading
 import time
 
 from ..base import MXNetError
 
 __all__ = ["WorkerLost", "RestartRequired", "HeartbeatMonitor",
-           "ElasticTrainer", "ProcessWorld"]
+           "ElasticTrainer", "ProcessWorld", "RELAUNCH_EXIT_CODE",
+           "request_relaunch", "run_with_relaunch",
+           "virtual_world_from_env"]
+
+# the launcher-relaunch contract (tools/launch.py --elastic): a process
+# that must be relaunched at a smaller world writes the surviving size
+# to $MXNET_RELAUNCH_FILE and exits with THIS code; the launcher loop
+# consumes the file and relaunches every rank at that size
+RELAUNCH_EXIT_CODE = 77
 
 
 class WorkerLost(MXNetError):
@@ -64,6 +74,59 @@ class RestartRequired(MXNetError):
     def __init__(self, msg, num_processes):
         super().__init__(msg)
         self.num_processes = int(num_processes)
+
+
+def request_relaunch(num_processes, path=None):
+    """Write the relaunch-request file the ``tools/launch.py
+    --elastic`` loop consumes: ``{"num_processes": N}`` committed
+    atomically at ``path`` (default ``$MXNET_RELAUNCH_FILE``).
+    Returns the path, or None when no file is configured (running
+    outside an elastic launcher)."""
+    path = path or os.environ.get("MXNET_RELAUNCH_FILE")
+    if not path:
+        return None
+    from ..checkpoint.serialize import atomic_write_bytes
+    atomic_write_bytes(path, json.dumps(
+        {"num_processes": int(num_processes),
+         "pid": os.getpid()}).encode("utf-8"))
+    return path
+
+
+def run_with_relaunch(fn, exit_fn=None, logger=None):
+    """Run ``fn()`` under the launcher-relaunch contract: a
+    :class:`RestartRequired` escaping it (a live multi-process backend
+    cannot shrink in place) writes the surviving world size via
+    :func:`request_relaunch` and exits with :data:`RELAUNCH_EXIT_CODE`
+    so the launcher relaunches every rank at that size — the training
+    script's whole elastic story is ``sys.exit(run_with_relaunch(main))``
+    wrapped around an :class:`ElasticTrainer`. Returns ``fn()``'s value
+    when no relaunch is needed."""
+    log = logger or logging.getLogger(__name__)
+    try:
+        return fn()
+    except RestartRequired as exc:
+        path = request_relaunch(exc.num_processes)
+        log.warning(
+            "relaunch required at %d process(es): %s (exit %d)",
+            exc.num_processes,
+            "request committed to %s" % path if path
+            else "no MXNET_RELAUNCH_FILE — the launcher cannot see "
+                 "the surviving size", RELAUNCH_EXIT_CODE)
+        (exit_fn or sys.exit)(RELAUNCH_EXIT_CODE)
+
+
+def virtual_world_from_env(default_hosts=None):
+    """The virtual-host world an elastic launcher child runs at:
+    ``MXNET_VIRTUAL_HOSTS`` (set per attempt by ``tools/launch.py
+    --elastic --virtual-hosts N``) names the CURRENT surviving host
+    count — attempt 0 gets N, a relaunch after losing k hosts gets
+    N-k. Returns a :class:`~mxnet_tpu.dist.VirtualCluster`, or None
+    when the variable is absent and no default is given."""
+    n = os.environ.get("MXNET_VIRTUAL_HOSTS", default_hosts)
+    if n is None:
+        return None
+    from .virtual import VirtualCluster
+    return VirtualCluster(int(n))
 
 
 class HeartbeatMonitor:
@@ -112,10 +175,16 @@ class HeartbeatMonitor:
             self._acked = self._dead
 
     def _probe_once(self):
+        from .. import faults as _faults
         from .. import telemetry
         scope = telemetry.registry().scope("dist")
         t0 = time.perf_counter()
         n = self._runtime.num_dead_nodes()
+        if _faults.armed():
+            # heartbeat-death seam (kind=value): the coordination
+            # service reports injected dead peers — the whole
+            # detection->ack->shrink chain downstream is the real one
+            n = int(_faults.value("dist.heartbeat", n))
         scope.counter("heartbeat_probe_ms").add(
             (time.perf_counter() - t0) * 1000.0)
         scope.gauge("dead_nodes").set(n)
@@ -288,6 +357,14 @@ class ElasticTrainer:
         training thread — the only place the loop can be unwound
         safely."""
         def _cb(param):
+            from .. import faults as _faults
+            if _faults.armed():
+                # plan-driven worker loss (kind=worker_lost): raises
+                # WorkerLost on the training thread at the planned
+                # step — the deterministic spelling of a peer death
+                _faults.check("dist.worker",
+                              num_update=mod._optimizer.num_update,
+                              epoch=param.epoch, nbatch=param.nbatch)
             if monitor is not None and monitor.unacknowledged:
                 # heartbeats know the COUNT of deaths, not identities —
                 # the shrink maps the count onto hosts (or, real mode,
@@ -354,7 +431,9 @@ class ElasticTrainer:
             mod = self.module_factory(world)
             data = self.data_factory(world)
             cbs = [self._checkpoint_callback(mod, world)]
-            if fault is not None or monitor is not None:
+            from .. import faults as _faults
+            if fault is not None or monitor is not None \
+                    or _faults.armed():
                 cbs.append(self._fault_callback(
                     fault[0] if fault else None,
                     fault[1] if fault else (), monitor, mod))

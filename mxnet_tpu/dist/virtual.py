@@ -214,9 +214,16 @@ class VirtualFeed(DataIter):
         def read(a):
             return a._read() if hasattr(a, "_read") else a
 
+        from .. import faults as _faults
         parts = []
         for h in range(n):
             t0 = time.perf_counter()
+            if _faults.armed():
+                # straggler seam (kind=delay): one host's feed stalls —
+                # the delay lands in that host's clock and moves the
+                # dist.straggler_ratio gauge, bytes untouched
+                _faults.check("dist.straggler", host=h,
+                              batch=self._nbatch, epoch=self._epoch)
             part = {
                 "data": [shard_rows(read(d), h, n) for d in batch.data],
                 "label": [None if lb is None else shard_rows(read(lb), h, n)

@@ -1,0 +1,245 @@
+"""mxnet_tpu.faults — deterministic fault injection + shared recovery.
+
+Failure is an input, not an accident: a :class:`FaultPlan` (a seeded
+list of ``(site, trigger, kind)`` rules — grammar in
+:mod:`mxnet_tpu.faults.plan`) is **armed** process-wide, and named
+injection seams threaded through the stack evaluate it —
+
+=====================  ===========  =================================
+site                   entry point  where it lives
+=====================  ===========  =================================
+``dist.connect``       check        bootstrap coordinator connect
+``dist.heartbeat``     value        HeartbeatMonitor dead-node probe
+``dist.straggler``     check        VirtualFeed per-host slice clock
+``dist.worker``        check        ElasticTrainer per-batch check
+``checkpoint.commit``  check        between entry write and rename
+``checkpoint.shard``   corrupt      a committed shard file
+``checkpoint.manifest``  corrupt    a committed manifest
+``data.transform``     check        TransformIter worker apply
+``data.stager``        check        DeviceLoader stage entry
+``data.device_put``    check        DeviceLoader device placement
+``serving.worker``     check        DynamicBatcher launch path
+``serving.device``     check        Predictor device launch
+``serving.queue_flood``  fires      DynamicBatcher submit
+``serving.cache``      corrupt      a committed executable entry
+=====================  ===========  =================================
+
+The discipline is ``telemetry.enabled()``'s: an UNARMED process pays
+one module-attribute branch per seam (``faults.armed()``) and is
+bitwise-identical to a build without the seams (pinned by
+tests/test_faults.py). Armed, every firing is recorded — the plan's
+incident transcript, the ``faults.*`` telemetry counters, and a
+FlightRecorder ``fault_injected`` event — so a chaos gate can assert
+the incidents that happened are EXACTLY the ones planned.
+
+:func:`retry` is the shared bounded jittered-backoff helper every
+transient seam heals through (the PR-6 connect idiom, extracted).
+
+Env: ``MXNET_FAULT_PLAN`` arms a plan at import (grammar string, JSON,
+or ``@file``); ``MXNET_FAULT_SEED`` seeds it; ``MXNET_FAULT_RETRIES``/
+``MXNET_FAULT_BACKOFF`` set the retry defaults.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import logging
+import os
+import threading
+
+from ..base import MXNetError
+from .plan import (FaultError, FaultPlan, FaultRule, InjectedFault,
+                   TransientFault, KINDS, RAISING_KINDS, VALUE_KINDS,
+                   FLOOD_KINDS, FILE_KINDS)
+from .retry import retry
+
+__all__ = ["FaultError", "InjectedFault", "TransientFault", "FaultRule",
+           "FaultPlan", "KINDS", "retry", "arm", "disarm", "armed",
+           "active", "check", "value", "fires", "corrupt_file",
+           "incidents"]
+
+_log = logging.getLogger("mxnet_tpu.faults")
+_PLAN = None
+_lock = threading.Lock()
+
+
+def armed():
+    """Whether a plan is armed — THE one branch an unarmed seam costs
+    (the ``telemetry.enabled()`` discipline)."""
+    return _PLAN is not None
+
+
+def active():
+    """The armed :class:`FaultPlan`, or None."""
+    return _PLAN
+
+
+def arm(plan, seed=None):
+    """Arm ``plan`` process-wide (a :class:`FaultPlan`, a grammar/JSON
+    string, or a ``@file`` path). Returns the armed plan. Re-arming
+    replaces the previous plan."""
+    global _PLAN
+    if not isinstance(plan, FaultPlan):
+        plan = FaultPlan.parse(plan, seed=int(seed or 0))
+    elif seed is not None:
+        plan.seed = int(seed)
+    with _lock:
+        _PLAN = plan
+    if plan.rules:
+        _log.warning("fault plan ARMED (seed %d): %s", plan.seed,
+                     "; ".join(r.describe() for r in plan.rules))
+    return plan
+
+
+def disarm():
+    """Disarm (idempotent); the previous plan stays readable for its
+    transcript."""
+    global _PLAN
+    with _lock:
+        prev, _PLAN = _PLAN, None
+    return prev
+
+
+def incidents():
+    """The armed plan's incident transcript ([] when unarmed)."""
+    plan = _PLAN
+    return plan.incidents() if plan is not None else []
+
+
+# ---------------------------------------------------------------------------
+# incident recording
+# ---------------------------------------------------------------------------
+def _note_retry(site, gave_up=False):
+    """Count one retry (or give-up) into the telemetry registry."""
+    from .. import telemetry
+    scope = telemetry.registry().scope("faults")
+    scope.counter("retry_giveups" if gave_up else "retries").add()
+
+
+def _record(incident):
+    """One fired rule -> telemetry counter + FlightRecorder event (the
+    'exactly the planned incidents' witness surface)."""
+    from .. import telemetry
+    telemetry.registry().scope("faults").counter("injected").add()
+    telemetry.flight_recorder().note(
+        "fault_injected", site=incident["site"],
+        fault_kind=incident["kind"], seq=incident["seq"],
+        ctx=incident["ctx"])
+    _log.warning("fault injected: %s (%s) ctx=%r", incident["site"],
+                 incident["kind"], incident["ctx"])
+
+
+# ---------------------------------------------------------------------------
+# seam entry points (each site uses exactly ONE — see the seam table)
+# ---------------------------------------------------------------------------
+def check(site, **ctx):
+    """Raising/delaying seam. Fired ``delay`` rules sleep; fired
+    ``error``/``transient``/``worker_lost`` rules raise. Returns the
+    fired incidents (usually ignored). No-op unless armed."""
+    plan = _PLAN
+    if plan is None:
+        return []
+    fired = plan.evaluate(site, ctx, RAISING_KINDS)
+    # record + apply delays for EVERY fired rule first: a raising rule
+    # must not leave a co-fired rule's incident unrecorded (the plan
+    # transcript and the FlightRecorder must stay 1:1)
+    out = []
+    for _rule, incident in fired:
+        _record(incident)
+        out.append(incident)
+    for rule, _incident in fired:
+        if rule.kind == "delay":
+            plan.sleep(float(rule.args.get("ms", 50)) / 1000.0)
+    for rule, _incident in fired:
+        if rule.kind == "delay":
+            continue
+        if rule.kind == "transient":
+            raise TransientFault(
+                "injected transient fault at %s (%s)"
+                % (site, rule.describe()))
+        if rule.kind == "worker_lost":
+            from ..dist.elastic import WorkerLost
+            raise WorkerLost(
+                "injected worker loss at %s (%s)"
+                % (site, rule.describe()),
+                dead_count=int(rule.args.get("dead", 1)))
+        raise InjectedFault(
+            "injected fault at %s (%s)" % (site, rule.describe()))
+    return out
+
+
+def value(site, default, **ctx):
+    """Value seam: the first fired ``value`` rule's injected value,
+    else ``default`` (the heartbeat dead-node count)."""
+    plan = _PLAN
+    if plan is None:
+        return default
+    fired = plan.evaluate(site, ctx, VALUE_KINDS)
+    for _rule, incident in fired:
+        # every fired rule records (transcript and FlightRecorder stay
+        # 1:1) even though only the first rule's value is returned
+        _record(incident)
+    if fired:
+        return fired[0][0].args.get("value", default)
+    return default
+
+
+def fires(site, **ctx):
+    """Boolean seam: True when a ``flood`` rule fired (the serving
+    queue then behaves as if at capacity)."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    fired = plan.evaluate(site, ctx, FLOOD_KINDS)
+    for _rule, incident in fired:
+        _record(incident)
+    return bool(fired)
+
+
+def corrupt_file(site, root, pattern="*", **ctx):
+    """Corruption seam: apply a fired ``bitflip``/``truncate`` rule to
+    one committed artifact file under ``root`` matching ``pattern``.
+    The target file and the flipped byte are plan-seeded draws — the
+    same plan poisons the same byte every run. Returns the mutated
+    path (or None)."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    fired = plan.evaluate(site, ctx, FILE_KINDS)
+    mutated = None
+    for rule, incident in fired:
+        _record(incident)
+        candidates = sorted(
+            p for p in _glob.glob(os.path.join(str(root), pattern))
+            if os.path.isfile(p))
+        if not candidates:
+            _log.warning("fault %s fired but no file matches %s/%s",
+                         site, root, pattern)
+            continue
+        path = candidates[plan.draw(incident["seq"], 1)
+                          % len(candidates)]
+        size = os.path.getsize(path)
+        if rule.kind == "truncate":
+            with open(path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+            _log.warning("fault: truncated %s to %d bytes", path,
+                         max(size // 2, 1))
+        else:
+            off = plan.draw(incident["seq"], 2) % max(size, 1)
+            with open(path, "r+b") as f:
+                f.seek(off)
+                byte = f.read(1)
+                f.seek(off)
+                f.write(bytes([(byte[0] if byte else 0) ^ 0xFF]))
+            _log.warning("fault: flipped byte %d of %s", off, path)
+        incident["target"] = os.path.basename(path)
+        mutated = path
+    return mutated
+
+
+def _autostart():
+    spec = os.environ.get("MXNET_FAULT_PLAN")
+    if spec:
+        arm(spec, seed=int(os.environ.get("MXNET_FAULT_SEED", "0")))
+
+
+_autostart()

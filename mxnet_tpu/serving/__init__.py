@@ -54,14 +54,16 @@ from __future__ import annotations
 from . import cache
 from .batcher import DynamicBatcher
 from .cache import ExecutableCache, enable_persistent_compile_cache
-from .errors import QueueFull, RequestTimeout, ServerClosed, TenantShed
+from .errors import (QueueFull, RequestTimeout, ServerClosed, TenantShed,
+                     WorkerCrashed)
 from .predictor import Predictor
 from .stats import ServingStats
 from .tenancy import Tenant
 
 __all__ = ["Predictor", "DynamicBatcher", "ServingStats", "Tenant",
            "ExecutableCache", "enable_persistent_compile_cache",
-           "QueueFull", "RequestTimeout", "ServerClosed", "TenantShed"]
+           "QueueFull", "RequestTimeout", "ServerClosed", "TenantShed",
+           "WorkerCrashed"]
 
 # process-wide persistent compilation cache: MXNET_COMPILE_CACHE_DIR
 # points jax's own cache (and the default AOT entry store Predictor

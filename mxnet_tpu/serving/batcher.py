@@ -57,11 +57,15 @@ Judgment-layer hooks:
 from __future__ import annotations
 
 import collections
+import logging
+import os
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
-from .errors import QueueFull, RequestTimeout, ServerClosed, TenantShed
+from .. import faults as _faults
+from .errors import (QueueFull, RequestTimeout, ServerClosed, TenantShed,
+                     WorkerCrashed)
 from .tenancy import Tenant
 
 __all__ = ["DynamicBatcher"]
@@ -179,6 +183,16 @@ class DynamicBatcher:
         self._cond = threading.Condition()
         self._closed = False
         self._thread = None
+        # worker supervision: requests the worker has popped for the
+        # CURRENT gather/launch cycle (worker thread only) — on an
+        # escaped exception these are the futures that would otherwise
+        # hang forever, so the supervisor fails them loudly and
+        # restarts the loop (bounded by MXNET_SERVE_MAX_WORKER_RESTARTS)
+        self._popped = []
+        self._popped_tenant = None
+        self._max_worker_restarts = int(os.environ.get(
+            "MXNET_SERVE_MAX_WORKER_RESTARTS", "100"))
+        self._logger = logging.getLogger("mxnet_tpu.serving")
         for name, ten in self._tenants.items():
             ten.stats.set_queue_probe(
                 lambda q=self._queues[name]: len(q))
@@ -264,10 +278,16 @@ class DynamicBatcher:
         req = _Request(arrays, rows, Future(),
                        t + limit if limit is not None else None, t,
                        req_id=ten.stats.new_request_id())
+        # queue-flood seam: a fired rule makes THIS submit see the
+        # queue at capacity — the deterministic stand-in for a burst
+        # arriving faster than the worker drains (clients must observe
+        # the same QueueFull backpressure either way)
+        flood = _faults.armed() and _faults.fires("serving.queue_flood",
+                                                  tenant=ten.name)
         with self._cond:
             if self._closed:
                 raise ServerClosed("batcher is shut down")
-            full = self._n_queued >= self._max_queue
+            full = flood or self._n_queued >= self._max_queue
             if not full:
                 self._queues[ten.name].append(req)
                 self._n_queued += 1
@@ -323,7 +343,10 @@ class DynamicBatcher:
                             "batcher shut down before launch"))
             self._cond.notify_all()
             thread, self._thread = self._thread, None
-        if thread is not None and not already:
+        if thread is not None and not already and \
+                thread is not threading.current_thread():
+            # the give-up path calls shutdown FROM the worker thread;
+            # a thread cannot join itself
             thread.join(timeout)
         server, self.metrics_server = self.metrics_server, None
         if server is not None:
@@ -340,13 +363,77 @@ class DynamicBatcher:
 
     # ------------------------------------------------------------------
     def _worker(self):
+        """The supervised worker loop. Device/model errors are handled
+        INSIDE :meth:`_launch` (each future gets the exception); this
+        loop guards against everything else — a bug or injected fault
+        escaping the gather/launch path used to kill the thread
+        silently, leaving every queued future hanging forever. Now the
+        implicated in-flight requests fail loudly with
+        :class:`WorkerCrashed`, the tenant's ``worker_restarts``
+        counter increments, and the loop restarts to serve the rest of
+        the queue; only after ``MXNET_SERVE_MAX_WORKER_RESTARTS``
+        consecutive crash cycles does the batcher give up and close."""
+        restarts = 0
         while True:
-            gathered = self._gather()
-            if gathered is None:
-                return
-            ten, reqs = gathered
-            if reqs:
-                self._launch(ten, reqs)
+            self._popped = []
+            self._popped_tenant = None
+            try:
+                gathered = self._gather()
+                if gathered is None:
+                    return
+                ten, reqs = gathered
+                if reqs:
+                    self._launch(ten, reqs)
+                restarts = 0
+            except BaseException as exc:  # noqa: BLE001 — supervised
+                if isinstance(exc, (SystemExit, KeyboardInterrupt)):
+                    raise
+                restarts += 1
+                self._on_worker_crash(exc, restarts)
+                if restarts >= self._max_worker_restarts:
+                    self._logger.critical(
+                        "serving worker crashed %d times; closing the "
+                        "batcher", restarts)
+                    self.shutdown(drain=False, timeout=0)
+                    return
+
+    def _on_worker_crash(self, exc, restarts):
+        """Fail the crash cycle's in-flight futures with a descriptive
+        error and count the restart — nothing a client holds may hang."""
+        ten = self._popped_tenant
+        self._logger.exception(
+            "serving worker crashed (restart %d, tenant %r, %d "
+            "in-flight request(s)): %r", restarts,
+            ten.name if ten is not None else None, len(self._popped),
+            exc)
+        if ten is not None:
+            ten.stats.note_worker_restart()
+        for r in self._popped:
+            fut = r.future
+            if not fut.done():
+                # queued-popped futures still need the PENDING->RUNNING
+                # transition; ones already RUNNING (the _gather live
+                # path did it) take set_exception directly. A
+                # concurrently cancelled/resolved future raises
+                # InvalidStateError below — it no longer hangs anyone.
+                if not fut.running():
+                    try:
+                        fut.set_running_or_notify_cancel()
+                    except (InvalidStateError, RuntimeError):
+                        pass
+                err = WorkerCrashed(
+                    "serving worker crashed while request %s was "
+                    "in flight (%r); the worker restarted — "
+                    "resubmit" % (r.id, exc))
+                err.__cause__ = exc   # the documented retryability probe
+                try:
+                    fut.set_exception(err)
+                except InvalidStateError:
+                    continue
+                if ten is not None:
+                    ten.stats.note_error()
+                    if ten.slo is not None:
+                        ten.slo.record(outcome="error")
 
     def _pick_tenant(self):
         """Name of the tenant to serve next: highest priority wins,
@@ -385,6 +472,10 @@ class DynamicBatcher:
             first = q.popleft()
             self._n_queued -= 1
             first.t_popped = time.perf_counter()
+            # once popped, only this worker can resolve the future —
+            # the supervision list is what the crash handler fails
+            self._popped_tenant = ten
+            self._popped.append(first)
             reqs, rows = [first], first.rows
             max_rows = ten.predictor.max_batch_size
             window_end = first.t_submit + self._max_wait
@@ -395,6 +486,7 @@ class DynamicBatcher:
                     nxt = q.popleft()
                     self._n_queued -= 1
                     nxt.t_popped = time.perf_counter()
+                    self._popped.append(nxt)
                     reqs.append(nxt)
                     rows += nxt.rows
                     continue
@@ -455,6 +547,12 @@ class DynamicBatcher:
         from .. import telemetry
         tracing = telemetry.enabled()
         total = sum(r.rows for r in reqs)
+        if _faults.armed():
+            # worker-death seam: raises OUTSIDE the per-launch error
+            # handling below, so the exception escapes to the
+            # supervisor exactly like an unexpected bug would
+            _faults.check("serving.worker", tenant=ten.name,
+                          rows=total, requests=len(reqs))
         t_launch = time.perf_counter()
         timing = {} if tracing else None
         try:

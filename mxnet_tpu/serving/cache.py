@@ -241,6 +241,15 @@ class ExecutableCache(object):
             os.fsync(f.fileno())
         os.replace(tmp, final)
         fsync_dir(self.directory)
+        from .. import faults as _faults
+        if _faults.armed():
+            # poisoned-entry seam: corrupt the COMMITTED entry (a
+            # storage fault after a clean commit) — the next replica's
+            # load must refuse it loudly (CacheMiss "corrupt") and
+            # fall back to a fresh compile, never serve stale bytes
+            _faults.corrupt_file("serving.cache", self.directory,
+                                 pattern=os.path.basename(final),
+                                 bucket=key["bucket"])
         return final
 
     # -- load -----------------------------------------------------------

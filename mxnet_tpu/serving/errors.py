@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["QueueFull", "RequestTimeout", "ServerClosed", "TenantShed"]
+__all__ = ["QueueFull", "RequestTimeout", "ServerClosed", "TenantShed",
+           "WorkerCrashed"]
 
 
 class QueueFull(MXNetError):
@@ -45,3 +46,16 @@ class RequestTimeout(MXNetError, TimeoutError):
 
 class ServerClosed(MXNetError):
     """The batcher has been shut down and accepts no new requests."""
+
+
+class WorkerCrashed(MXNetError):
+    """An unexpected exception escaped the batcher worker while this
+    request was in flight.
+
+    Before the supervision loop, an escaped exception silently killed
+    the worker thread and every queued future hung forever; now the
+    implicated requests fail with THIS error (carrying the original
+    exception as ``__cause__``), the tenant's
+    ``serving.<i>.worker_restarts`` counter increments, and the worker
+    restarts to serve the rest of the queue. Retrying the request is
+    safe — it never (completely) launched."""

@@ -618,6 +618,12 @@ class Predictor:
         does its own request accounting). ``timing`` (a dict) receives
         accumulated ``pad_ms`` / ``device_ms`` clocks for the request
         trace — chunked oversized requests accumulate across launches."""
+        from .. import faults as _faults
+        if _faults.armed():
+            # device-slowdown seam (kind=delay): a straggling or
+            # thermally-throttled device — the latency lands in the
+            # device_ms phase and the SLO burn windows, bytes unchanged
+            _faults.check("serving.device", rows=rows)
         parts = []
         with self._lock:
             start = 0

@@ -83,6 +83,10 @@ class ServingStats:
         # SLO-driven admission: requests shed because the tenant's own
         # burn windows are in breach (distinct from queue-full rejects)
         self._c_sheds = c("sheds")
+        # worker supervision: times the batcher worker loop was
+        # restarted after an unexpected exception escaped it (the
+        # implicated requests failed with WorkerCrashed, loudly)
+        self._c_worker_restarts = c("worker_restarts")
         self._h_latency = self.scope.histogram("latency_ms")
         self._h_timeout_age = self.scope.histogram("timeout_age_ms")
         self._h_shed_age = self.scope.histogram("shed_age_ms")
@@ -114,6 +118,7 @@ class ServingStats:
     cache_hits = telemetry.instrument_value("_c_cache_hits")
     cache_misses = telemetry.instrument_value("_c_cache_misses")
     sheds = telemetry.instrument_value("_c_sheds")
+    worker_restarts = telemetry.instrument_value("_c_worker_restarts")
 
     def release(self):
         """Drop this instance's ``serving.<i>`` scope from the shared
@@ -166,6 +171,11 @@ class ServingStats:
             age_ms = float(age_ms)
             self._h_shed_age.observe(age_ms)
             self._reserve(age_ms)
+
+    def note_worker_restart(self):
+        """The batcher worker crashed on this tenant's work and was
+        restarted (`serving.<i>.worker_restarts`)."""
+        self._c_worker_restarts.add()
 
     def note_warmup_bucket(self, bucket, ms, source=None):
         """One bucket's warmup wall time (compile OR deserialize) into
@@ -313,6 +323,7 @@ class ServingStats:
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
             "sheds": self.sheds,
+            "worker_restarts": self.worker_restarts,
             "warmup_ms": warmup_ms,
             "bucket_hits": bucket_hits,
             "latency_ms": {

@@ -49,7 +49,7 @@ import threading
 
 from .compile_watch import CompileWatch
 from .export import JsonlSink, MetricsServer, render_prometheus
-from .flight import FlightRecorder
+from .flight import FlightRecorder, load_postmortem
 from .health import RegressionWatchdog
 from .introspect import (ProgramInventory, analyze_compiled, aval_skeleton,
                          device_peaks, roofline, BOUND_BY_CODES)
@@ -64,7 +64,8 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Scope",
     "instrument_value", "StepTimeline", "CompileWatch", "Span", "span",
     "JsonlSink", "MetricsServer", "render_prometheus",
-    "ProgramInventory", "FlightRecorder", "analyze_compiled",
+    "ProgramInventory", "FlightRecorder", "load_postmortem",
+    "analyze_compiled",
     "aval_skeleton", "device_peaks", "roofline", "BOUND_BY_CODES",
     "SLOTracker", "RegressionWatchdog",
     "registry", "timeline", "compile_watch", "inventory",

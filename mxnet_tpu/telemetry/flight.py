@@ -36,7 +36,40 @@ import sys
 import threading
 import time
 
-__all__ = ["FlightRecorder"]
+__all__ = ["FlightRecorder", "load_postmortem"]
+
+
+def load_postmortem(path):
+    """Load + verify one committed postmortem.
+
+    The reading half of the atomic-commit contract: a truncated,
+    bit-flipped, or non-postmortem file refuses LOUDLY here (with the
+    failing path in the message) instead of feeding a torn JSON into
+    an incident review. ``.tmp-*`` partials — what a crash mid-dump
+    leaves — are refused by name, the same discipline as checkpoint
+    entries."""
+    import json
+
+    from ..base import MXNetError
+    name = os.path.basename(str(path))
+    if name.startswith(".tmp-") or ".tmp-" in name:
+        raise MXNetError(
+            "refusing postmortem %s: a .tmp-* file is an uncommitted "
+            "crash partial, never a postmortem" % path)
+    try:
+        with open(path, "rb") as f:
+            payload = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError) as exc:
+        raise MXNetError(
+            "postmortem %s is unreadable (corrupt or truncated): %s"
+            % (path, exc)) from exc
+    if not isinstance(payload, dict) or \
+            payload.get("format") != "flight-recorder-r1":
+        raise MXNetError(
+            "%s is not a flight-recorder postmortem (format %r)"
+            % (path, payload.get("format")
+               if isinstance(payload, dict) else type(payload).__name__))
+    return payload
 
 
 class FlightRecorder(object):

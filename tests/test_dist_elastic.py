@@ -261,6 +261,70 @@ def test_elastic_resume_bitwise_dp8_to_dp4(tmp_path):
         np.testing.assert_array_equal(sa[k].asnumpy(), sb[k].asnumpy())
 
 
+def test_elastic_kill_sweep_every_commit_boundary(tmp_path):
+    """The fault position is a PARAMETER, not a hand-picked step: kill
+    at EVERY step k of a short run (after the first commit) — exactly
+    at a commit boundary, one past it, mid-interval, and on the final
+    step — and every resume must be bitwise equal to the continuous
+    dp=4 reference from the same committed entry. Generalizes the
+    single fault@14 test above into the sweep the chaos archetype
+    demands (one test function so the compiled programs are shared
+    across the sweep)."""
+    import hashlib
+
+    rng = np.random.RandomState(1)
+    Xs = rng.rand(128, 16).astype(np.float32)   # 4 steps/epoch at B=32
+    ys = rng.randint(0, 10, 128).astype(np.float32)
+
+    def small_iter():
+        return mx.io.NDArrayIter(Xs, ys, batch_size=B,
+                                 label_name="softmax_label")
+
+    def data_factory(world):
+        return world.feed(small_iter())
+
+    def digest(mod):
+        h = hashlib.sha256()
+        args, auxs = mod.get_params()
+        for k in sorted(args):
+            h.update(args[k].asnumpy().tobytes())
+        for k in sorted(auxs):
+            h.update(auxs[k].asnumpy().tobytes())
+        return h.hexdigest()
+
+    EVERY, EPOCHS, STEPS = 3, 2, 8      # commits cross at 3, 6, 8
+    for k in range(EVERY, STEPS + 1):   # 3..8: every post-commit step
+        tmp = os.path.join(str(tmp_path), "k%d" % k)
+        mgr = CheckpointManager(os.path.join(tmp, "ckpt"))
+        cluster = dist.VirtualCluster(4)
+        mx.random.seed(3)
+        np.random.seed(3)
+        tr = dist.ElasticTrainer(cluster, _module_factory, data_factory,
+                                 mgr, checkpoint_every_steps=EVERY)
+        mod = tr.fit(num_epoch=EPOCHS, inject_fault=(k, (2, 3)),
+                     **FIT_KW)
+        done = [e for e in tr.transcript if e["event"] == "finished"][0]
+        resume = done["resume_step"]
+        assert resume is not None and resume <= k, (k, resume)
+        assert mod._optimizer.num_update == STEPS, (k, tr.transcript)
+
+        # continuous dp=4 reference from the SAME committed entry
+        base = os.path.join(tmp, "baseline")
+        shutil.copytree(
+            os.path.join(tmp, "ckpt", "step_%08d" % resume),
+            os.path.join(base, "step_%08d" % resume))
+        cluster4 = dist.VirtualCluster(4).shrink((2, 3))
+        mod2 = _module_factory(cluster4)
+        mx.random.seed(99)
+        np.random.seed(99)              # must not matter
+        mod2.fit(data_factory(cluster4), num_epoch=EPOCHS,
+                 resume_from=CheckpointManager(base), **FIT_KW)
+        assert digest(mod) == digest(mod2), (
+            "kill at step %d (resume %d) diverged from the continuous "
+            "reference" % (k, resume))
+        assert mod2._optimizer.num_update == STEPS
+
+
 def test_elastic_checkpoint_metadata(tmp_path):
     tr, mod, mgr = _run_elastic(str(tmp_path), fault_at=14)
     meta = mgr.step_metadata()      # latest entry, no array loads

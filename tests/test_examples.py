@@ -44,6 +44,14 @@ CASES = [
     ("image-classification/train_cifar10.py",
      ["--num-epochs", "3", "--opt-state-dtype", "bf16",
       "--remat", "dots_saveable", "--min-accuracy", "0.9"]),
+    # chaos smoke (mxnet_tpu.faults): a seeded plan injects transient
+    # staging faults through the prefetch path; the shared retry heals
+    # them and the script asserts every planned rule actually fired
+    # (the bitwise digest-vs-fault-free compare runs in ci.sh)
+    ("image-classification/train_cifar10.py",
+     ["--num-epochs", "1", "--seed", "7", "--prefetch-device", "2",
+      "--fault-plan",
+      "data.device_put:transient@nth=5;data.stager:transient@nth=9"]),
     ("neural-style/neural_style.py", ["--iters", "200"]),
     ("warpctc/ctc_train.py", ["--num-epoch", "10"]),
     ("bayesian-methods/sgld.py",

@@ -1,0 +1,560 @@
+"""mxnet_tpu.faults — the deterministic fault-injection plane.
+
+The contracts (docs/api/faults.md, ci.sh chaos-soak gate):
+
+* plans are seed-deterministic: the same plan + seed over the same
+  workload produces the same incident transcript (triggers, prob
+  draws, corruption offsets — no wall time, no global RNG);
+* an UNARMED process is bitwise-identical to a build without the
+  seams, and an armed plan whose transient faults all heal through
+  ``faults.retry`` is bitwise-identical too (retries change WHEN bytes
+  move, never which bytes);
+* every recovery seam the injector exposes actually recovers: batcher
+  worker death fails in-flight futures loudly (``WorkerCrashed``) and
+  restarts the worker; stager/transform errors propagate in order with
+  optional restart; the elastic trainer consumes plan-driven worker
+  loss; ``RestartRequired`` routes through the launcher-relaunch
+  contract.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.faults import (FaultPlan, FaultRule, InjectedFault,
+                              TransientFault)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------- grammar
+def test_rule_grammar_roundtrip():
+    r = FaultRule.parse("checkpoint.commit:transient@step=8,count=2")
+    assert r.site == "checkpoint.commit" and r.kind == "transient"
+    assert r.match == {"step": 8} and r.count == 2
+    assert r.describe() == "checkpoint.commit:transient@count=2,step=8" \
+        or "step=8" in r.describe()
+    r2 = FaultRule.parse("serving.device:delay@nth=3,ms=25")
+    assert r2.nth == 3 and r2.args == {"ms": 25}
+    plan = FaultPlan.parse(
+        "a.b:error@nth=1; c.d:transient@prob=0.5", seed=4)
+    assert len(plan.rules) == 2 and plan.seed == 4
+    # JSON spelling parses to the same rules
+    plan2 = FaultPlan.parse(json.dumps(
+        [{"site": "a.b", "kind": "error", "nth": 1},
+         "c.d:transient@prob=0.5"]), seed=4)
+    assert [r.describe() for r in plan2.rules] == \
+        [r.describe() for r in plan.rules]
+
+
+def test_rule_grammar_rejections():
+    with pytest.raises(MXNetError, match="does not parse"):
+        FaultRule.parse("no-kind-here")
+    with pytest.raises(MXNetError, match="unknown fault kind"):
+        FaultRule.parse("a.b:frobnicate@nth=1")
+    with pytest.raises(MXNetError, match="exclusive"):
+        FaultRule(site="a.b", kind="error", nth=1, prob=0.5)
+    with pytest.raises(MXNetError, match="1-based"):
+        FaultRule(site="a.b", kind="error", nth=0)
+    with pytest.raises(MXNetError, match="key=value"):
+        FaultRule.parse("a.b:error@nth")
+
+
+# ------------------------------------------------------------ triggers
+def test_nth_trigger_fires_exactly_once():
+    faults.arm("s.x:transient@nth=3")
+    hits = []
+    for i in range(6):
+        try:
+            faults.check("s.x")
+        except TransientFault:
+            hits.append(i)
+    assert hits == [2]          # 3rd evaluation, once
+
+
+def test_context_match_trigger():
+    faults.arm("s.x:error@step=12")
+    faults.check("s.x", step=11)
+    with pytest.raises(InjectedFault, match="s.x"):
+        faults.check("s.x", step=12)
+    # count=1 by default: the same coordinate does not re-fire
+    faults.check("s.x", step=12)
+
+
+def test_probability_trigger_is_seed_deterministic():
+    def pattern(seed):
+        plan = faults.arm("s.x:error@prob=0.5,count=0", seed=seed)
+        fired = []
+        for i in range(64):
+            try:
+                faults.check("s.x")
+                fired.append(0)
+            except InjectedFault:
+                fired.append(1)
+        faults.disarm()
+        assert plan.incidents()  # p=0.5 over 64: fires some
+        return fired
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b               # same seed -> same draw sequence
+    assert a != c               # the seed is live
+
+
+def test_incident_transcript_deterministic():
+    spec = ("s.x:transient@nth=2; s.y:error@step=5,count=0; "
+            "s.z:delay@nth=1,ms=0")
+
+    def run():
+        plan = faults.arm(spec, seed=3)
+        for i in range(4):
+            try:
+                faults.check("s.x", step=i)
+            except TransientFault:
+                pass
+            try:
+                faults.check("s.y", step=5 if i == 2 else i)
+            except InjectedFault:
+                pass
+            faults.check("s.z")
+        out = plan.incidents()
+        faults.disarm()
+        return out
+
+    assert run() == run()       # seq, site, kind, ctx — all equal
+
+
+def test_unfired_names_missed_rules():
+    plan = faults.arm("s.x:error@nth=50; s.y:error@prob=0.001")
+    faults.check("s.x")
+    # the nth rule never reached its trigger; prob rules are exempt
+    assert plan.unfired() == ["s.x:error@nth=50"]
+
+
+# --------------------------------------------------------------- retry
+def test_retry_unarmed_default_is_a_passthrough():
+    """The seam-cost discipline applies to the wrapper: with the
+    default retry_on and NO armed plan, retry() is one branch + the
+    call — no env parsing, no retry loop (a TransientFault could only
+    have come from an injection, so nothing to heal)."""
+    assert not faults.armed()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) == 1:
+            raise TransientFault("impossible unarmed")
+        return "ok"
+
+    with pytest.raises(TransientFault):
+        faults.retry(fn)
+    assert len(calls) == 1      # no loop entered
+    # explicit retry_on still loops unarmed (bootstrap's spelling)
+    assert faults.retry(fn, retry_on=(TransientFault,), retries=1,
+                        backoff_s=0.0, sleep=lambda s: None) == "ok"
+
+
+def test_retry_heals_transient_with_pinned_backoff():
+    faults.arm(FaultPlan([], seed=0))    # armed: the full retry loop
+    calls, delays = [], []
+
+    def attempt():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientFault("flaky")
+        return "ok"
+
+    out = faults.retry(attempt, retries=4, backoff_s=0.25, jitter=0.0,
+                       sleep=delays.append)
+    assert out == "ok" and len(calls) == 3
+    assert delays == [0.25, 0.5]            # exponential, exact
+
+
+def test_retry_jitter_is_deterministic():
+    faults.arm(FaultPlan([], seed=0))
+
+    def delays_for(seed):
+        out = []
+
+        def attempt():
+            if len(out) < 3:
+                raise TransientFault("flaky")
+            return None
+
+        faults.retry(attempt, retries=5, backoff_s=0.1, jitter=0.5,
+                     seed=seed, site="t", sleep=out.append)
+        return out
+
+    a, b, c = delays_for(1), delays_for(1), delays_for(2)
+    assert a == b and a != c
+    # each delay within base*2^k scaled by 1 +/- jitter
+    assert all(0.0 <= d <= 0.1 * (2 ** i) * 1.5 + 1e-9
+               for i, d in enumerate(a))
+
+
+def test_retry_gives_up_and_reraises_last():
+    faults.arm(FaultPlan([], seed=0))
+
+    def attempt():
+        raise TransientFault("always")
+
+    with pytest.raises(TransientFault, match="always"):
+        faults.retry(attempt, retries=2, backoff_s=0.0, jitter=0.0,
+                     sleep=lambda s: None)
+
+
+def test_retry_never_touches_permanent_faults():
+    faults.arm(FaultPlan([], seed=0))
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise InjectedFault("permanent")
+
+    with pytest.raises(InjectedFault):
+        faults.retry(attempt, retries=5, backoff_s=0.0,
+                     sleep=lambda s: None)
+    assert len(calls) == 1      # never retried
+
+
+# ----------------------------------------------------- unarmed == off
+def test_unarmed_seams_are_noops():
+    assert not faults.armed()
+    assert faults.check("any.site") == []
+    assert faults.value("any.site", 41) == 41
+    assert faults.fires("any.site") is False
+    assert faults.corrupt_file("any.site", "/nonexistent") is None
+    assert faults.incidents() == []
+
+
+def _fit_digest():
+    import hashlib
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, 16).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.float32)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mx.random.seed(5)
+    np.random.seed(5)
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=32,
+                              label_name="softmax_label"),
+            num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            prefetch_to_device=2)
+    h = hashlib.sha256()
+    args, auxs = mod.get_params()
+    for k in sorted(args):
+        h.update(args[k].asnumpy().tobytes())
+    return h.hexdigest()
+
+
+def test_armed_transients_and_unarmed_are_bitwise_identical():
+    """THE zero-perturbation contract: unarmed == armed-empty-plan ==
+    armed-with-healed-transients, bit for bit (the prefetch path
+    traverses the data.device_put/data.stager seams)."""
+    d_unarmed = _fit_digest()
+    faults.arm(FaultPlan([], seed=1))
+    d_empty = _fit_digest()
+    faults.disarm()
+    faults.arm("data.device_put:transient@nth=3;"
+               "data.stager:transient@nth=2", seed=1)
+    d_healed = _fit_digest()
+    plan = faults.active()
+    assert plan.unfired() == []
+    assert d_unarmed == d_empty == d_healed
+
+
+# ------------------------------------------------------ layer seams
+def test_heartbeat_value_seam_drives_monitor():
+    from mxnet_tpu import dist
+
+    class _RT:
+        def num_dead_nodes(self, timeout=60):
+            return 0
+
+    faults.arm("dist.heartbeat:value@nth=2,value=2")
+    seen = []
+    mon = dist.HeartbeatMonitor(runtime=_RT(), interval_s=3600,
+                                on_dead=seen.append)
+    assert mon._probe_once() == 0
+    assert mon._probe_once() == 2       # injected death count
+    assert seen == [2] and mon.dead_count == 2
+
+
+def test_elastic_consumes_plan_driven_worker_loss(tmp_path):
+    """A worker_lost rule at a planned num_update drives the FULL
+    elastic chain — WorkerLost on the training thread, shrink by the
+    rule's dead count, resume from the last committed step — with no
+    inject_fault plumbing."""
+    from mxnet_tpu import dist
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, 16).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.float32)
+
+    def module_factory(world):
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return mx.mod.Module(net, context=world.contexts())
+
+    def data_factory(world):
+        return world.feed(mx.io.NDArrayIter(
+            X, y, batch_size=32, label_name="softmax_label"))
+
+    faults.arm("dist.worker:worker_lost@num_update=6,dead=2", seed=1)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cluster = dist.VirtualCluster(4)
+    mx.random.seed(3)
+    np.random.seed(3)
+    tr = dist.ElasticTrainer(cluster, module_factory, data_factory,
+                             mgr, checkpoint_every_steps=2)
+    mod = tr.fit(num_epoch=2, optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1},
+                 initializer=mx.initializer.Xavier())
+    events = [e["event"] for e in tr.transcript]
+    assert events == ["worker_lost", "finished"]
+    assert tr.transcript[0]["at_num_update"] == 6
+    assert tr.transcript[1]["dp_width"] == 4     # dead=2 hosts retired
+    assert mod._optimizer.num_update == 16
+    assert faults.active().unfired() == []
+    from mxnet_tpu import telemetry
+    telemetry.flight_recorder().disarm()
+    telemetry.flight_recorder().pop_last_dump()
+
+
+def test_corrupt_file_is_plan_deterministic(tmp_path):
+    def poison(seed):
+        d = tmp_path / ("d%d" % seed)
+        d.mkdir(exist_ok=True)
+        for name in ("a.bin", "b.bin", "c.bin"):
+            (d / name).write_bytes(bytes(range(64)))
+        faults.arm("x.files:bitflip@nth=1", seed=seed)
+        path = faults.corrupt_file("x.files", str(d), pattern="*.bin")
+        faults.disarm()
+        return os.path.basename(path), open(path, "rb").read()
+
+    name1, bytes1 = poison(9)
+    # re-create and re-run: same file, same byte
+    import shutil
+    shutil.rmtree(str(tmp_path / "d9"))
+    name2, bytes2 = poison(9)
+    assert (name1, bytes1) == (name2, bytes2)
+    assert bytes1 != bytes(range(64))           # something DID flip
+    name3, bytes3 = poison(10)
+    assert (name3, bytes3) != (name1, bytes1)   # the seed is live
+
+
+def test_truncate_kind(tmp_path):
+    target = tmp_path / "artifact.bin"
+    target.write_bytes(b"\xab" * 100)
+    faults.arm("x.files:truncate@nth=1")
+    faults.corrupt_file("x.files", str(tmp_path), pattern="*.bin")
+    assert target.stat().st_size == 50
+
+
+# ------------------------------------------- stager / transform errors
+def test_device_loader_stager_restart_continues_stream():
+    """restart_on_error: the stager crash is delivered in order, the
+    consumer catches it, and the SAME stream continues — no batch lost
+    (the crash seam fires before any source pull)."""
+    from mxnet_tpu.data import DeviceLoader
+    rng = np.random.RandomState(0)
+    X = rng.rand(128, 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, None, batch_size=16)
+    faults.arm("data.stager:error@nth=4", seed=1)
+    loader = DeviceLoader(it, depth=2, restart_on_error=True)
+    rows, crashes = [], 0
+    while True:
+        try:
+            b = loader.next()
+        except StopIteration:
+            break
+        except InjectedFault:
+            crashes += 1
+            continue
+        rows.append(np.asarray(b.data[0]._read()))
+    loader.close()
+    assert crashes == 1
+    np.testing.assert_array_equal(np.concatenate(rows), X)
+
+
+def test_device_loader_default_error_still_terminal():
+    from mxnet_tpu.data import DeviceLoader
+    X = np.zeros((64, 4), np.float32)
+    it = mx.io.NDArrayIter(X, None, batch_size=16)
+    faults.arm("data.stager:error@nth=2", seed=1)
+    loader = DeviceLoader(it, depth=2)
+    loader.next()
+    with pytest.raises(InjectedFault):
+        loader.next()
+    with pytest.raises(StopIteration):   # epoch over (pre-existing
+        loader.next()                    # contract), reset() recovers
+    loader.reset()
+    assert loader.next() is not None
+    loader.close()
+
+
+def test_transform_iter_restart_skips_failed_batch():
+    from mxnet_tpu.data import TransformIter
+    X = np.arange(128, dtype=np.float32).reshape(32, 4)
+    it = mx.io.NDArrayIter(X, None, batch_size=8)
+    faults.arm("data.transform:error@index=1", seed=1)
+    ti = TransformIter(it, transform=lambda b, rng: b, num_workers=2,
+                       restart_on_error=True)
+    got, errors = [], 0
+    while True:
+        try:
+            b = ti.next()
+        except StopIteration:
+            break
+        except InjectedFault:
+            errors += 1
+            continue
+        got.append(np.asarray(b.data[0].asnumpy()))
+    ti.close()
+    assert errors == 1
+    # batch index 1 was skipped; the stream continued past it
+    np.testing.assert_array_equal(
+        np.concatenate(got), np.concatenate([X[:8], X[16:]]))
+
+
+# --------------------------------------------------- batcher recovery
+def _predictor():
+    from mxnet_tpu.serving import Predictor
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 8).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=16,
+                              label_name="softmax_label"),
+            num_epoch=1, optimizer="sgd",
+            initializer=mx.initializer.Xavier())
+    ref = mod.predict(mx.io.NDArrayIter(X, None,
+                                        batch_size=16)).asnumpy()
+    pred = Predictor(mod, max_batch_size=16)
+    pred.warmup()
+    return pred, X, ref
+
+
+def test_batcher_worker_crash_fails_futures_and_restarts():
+    """THE satellite contract: a worker death no longer hangs queued
+    futures — the in-flight request fails with WorkerCrashed naming
+    the cause, ``worker_restarts`` counts 1, and the restarted worker
+    serves the next request bitwise."""
+    from mxnet_tpu.serving import DynamicBatcher, WorkerCrashed
+    pred, X, ref = _predictor()
+    faults.arm("serving.worker:error@nth=2", seed=1)
+    srv = DynamicBatcher(pred, max_wait_ms=0)
+    out = srv.predict(X[:4], timeout=60)         # launch 1: clean
+    np.testing.assert_array_equal(out, ref[:4])
+    with pytest.raises(WorkerCrashed,
+                       match="worker crashed while request") as e:
+        srv.predict(X[4:8], timeout=60)          # launch 2: crash
+    # the documented retryability probe: the original exception chains
+    assert isinstance(e.value.__cause__, InjectedFault)
+    out = srv.predict(X[4:8], timeout=60)        # worker restarted
+    np.testing.assert_array_equal(out, ref[4:8])
+    stats = pred.stats()
+    assert stats["worker_restarts"] == 1
+    assert stats["errors"] >= 1
+    srv.shutdown(drain=True)
+
+
+def test_batcher_worker_crash_tenancy_path():
+    """Multi-tenant: a crash on tenant A's launch fails only A's
+    in-flight request and counts into A's ``worker_restarts``; tenant
+    B keeps serving through the restarted worker."""
+    from mxnet_tpu.serving import DynamicBatcher, WorkerCrashed
+    pred_a, X, ref_a = _predictor()
+    pred_b, _, ref_b = _predictor()
+    faults.arm("serving.worker:error@tenant=a", seed=1)
+    srv = DynamicBatcher(tenants={"a": pred_a, "b": pred_b},
+                         max_wait_ms=0)
+    with pytest.raises(WorkerCrashed):
+        srv.predict(X[:4], timeout=60, tenant="a")
+    out = srv.predict(X[:4], timeout=60, tenant="b")
+    np.testing.assert_array_equal(out, ref_b[:4])
+    assert pred_a.stats()["worker_restarts"] == 1
+    assert pred_b.stats()["worker_restarts"] == 0
+    out = srv.predict(X[:4], timeout=60, tenant="a")  # A recovered
+    np.testing.assert_array_equal(out, ref_a[:4])
+    srv.shutdown(drain=True)
+
+
+def test_batcher_gives_up_after_restart_budget():
+    from mxnet_tpu.serving import (DynamicBatcher, ServerClosed,
+                                   WorkerCrashed)
+    pred, X, _ = _predictor()
+    faults.arm("serving.worker:error@count=0", seed=1)   # every launch
+    srv = DynamicBatcher(pred, max_wait_ms=0)
+    srv._max_worker_restarts = 3
+    crashes = 0
+    with pytest.raises((WorkerCrashed, ServerClosed)):
+        for _ in range(8):
+            try:
+                srv.predict(X[:4], timeout=60)
+            except WorkerCrashed:
+                crashes += 1
+    # budget 3: three crash cycles (each failing its request loudly),
+    # then the batcher closes itself
+    assert crashes == 3
+    with pytest.raises(ServerClosed):
+        srv.submit(X[:4])
+    srv.shutdown(drain=False)
+
+
+def test_batcher_queue_flood_seam_backpressures():
+    from mxnet_tpu.serving import DynamicBatcher, QueueFull
+    pred, X, ref = _predictor()
+    faults.arm("serving.queue_flood:flood@nth=1", seed=1)
+    srv = DynamicBatcher(pred, max_wait_ms=0)
+    with pytest.raises(QueueFull):
+        srv.predict(X[:4], timeout=60)
+    out = srv.predict(X[:4], timeout=60)         # burst passed
+    np.testing.assert_array_equal(out, ref[:4])
+    assert pred.stats()["rejected"] == 1
+    srv.shutdown(drain=True)
+
+
+# --------------------------------------------------- relaunch contract
+def test_run_with_relaunch_contract(tmp_path, monkeypatch):
+    from mxnet_tpu import dist
+    relaunch = tmp_path / "relaunch.json"
+    monkeypatch.setenv("MXNET_RELAUNCH_FILE", str(relaunch))
+    codes = []
+
+    def fn():
+        raise dist.RestartRequired("cannot shrink in place", 3)
+
+    dist.run_with_relaunch(fn, exit_fn=codes.append)
+    assert codes == [dist.RELAUNCH_EXIT_CODE] == [77]
+    assert json.load(open(str(relaunch)))["num_processes"] == 3
+    # no RestartRequired -> plain return value, no exit
+    codes.clear()
+    assert dist.run_with_relaunch(lambda: "done",
+                                  exit_fn=codes.append) == "done"
+    assert codes == []
+
+
+def test_virtual_world_from_env(monkeypatch):
+    from mxnet_tpu import dist
+    monkeypatch.delenv("MXNET_VIRTUAL_HOSTS", raising=False)
+    assert dist.virtual_world_from_env() is None
+    monkeypatch.setenv("MXNET_VIRTUAL_HOSTS", "4")
+    world = dist.virtual_world_from_env()
+    assert world.n_hosts == 4 and world.device_count == 8

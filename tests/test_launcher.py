@@ -120,6 +120,71 @@ done
     assert "distributedshell" in proc.stderr
 
 
+ELASTIC_CHILD = r'''
+import json, os, sys
+attempt = int(os.environ["MXNET_ELASTIC_ATTEMPT"])
+hosts = int(os.environ.get("MXNET_VIRTUAL_HOSTS", "0"))
+os.write(1, (json.dumps({"attempt": attempt, "hosts": hosts})
+             + chr(10)).encode())
+if attempt == 0:
+    # mxnet_tpu.dist.run_with_relaunch's exact contract, spelled with
+    # the stdlib so the subprocess stays import-light: commit the
+    # surviving world size, exit RELAUNCH_EXIT_CODE (77)
+    with open(os.environ["MXNET_RELAUNCH_FILE"], "w") as f:
+        json.dump({"num_processes": hosts - 2}, f)
+    sys.exit(77)
+sys.exit(0)
+'''
+
+
+def test_elastic_virtual_relaunch_loop():
+    """ROADMAP item 5(a)'s loop, CPU-pinned: --elastic --virtual-hosts
+    runs ONE process simulating N hosts; a run that exits
+    RELAUNCH_EXIT_CODE with a committed $MXNET_RELAUNCH_FILE is
+    relaunched at the surviving world size (the file's
+    num_processes), with the attempt index in MXNET_ELASTIC_ATTEMPT.
+    The dist-side half (RestartRequired -> request_relaunch -> exit
+    77) is pinned in-process by tests/test_faults.py."""
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "--elastic", "--virtual-hosts", "4",
+         sys.executable, "-c", ELASTIC_CHILD],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    runs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.startswith("{")]
+    assert runs == [{"attempt": 0, "hosts": 4},
+                    {"attempt": 1, "hosts": 2}], proc.stdout
+    assert "relaunching at 2 process(es)" in proc.stderr
+
+
+def test_elastic_max_restarts_bounds_the_loop():
+    """A job that requests a relaunch every attempt must die loudly
+    with the relaunch exit code once --max-restarts is exhausted, not
+    thrash forever."""
+    child = ELASTIC_CHILD.replace("if attempt == 0:", "if True:")
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "--elastic", "--virtual-hosts", "16",
+         "--max-restarts", "2", sys.executable, "-c", child],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert proc.returncode == 77, (proc.stdout, proc.stderr)
+    runs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.startswith("{")]
+    assert [r["attempt"] for r in runs] == [0, 1, 2]
+    assert "exceeded --max-restarts 2" in proc.stderr
+
+
+def test_elastic_refuses_cluster_launchers():
+    """--elastic owns the restart loop only for local/virtual runs;
+    combining it with a cluster scheduler must error instead of
+    silently running every rank on the launch machine."""
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "ssh",
+         "--elastic", sys.executable, "-c", "pass"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert proc.returncode != 0
+    assert "only support the local launcher" in proc.stderr
+
+
 def test_ssh_mode(tmp_path):
     # fake ssh: run the remote command locally (the round-2 smoke shape)
     _fake(tmp_path, "ssh", '''

@@ -401,9 +401,11 @@ def test_serving_stats_snapshot_compat():
         "requests", "completed", "rejected", "timeouts", "errors",
         "batches", "warmup_batches", "batch_fill", "compiles",
         "compile_tracking", "bucket_hits", "latency_ms", "queue_depth",
-        "cache_hits", "cache_misses", "sheds", "warmup_ms"}
+        "cache_hits", "cache_misses", "sheds", "warmup_ms",
+        "worker_restarts"}
     assert snap["cache_hits"] == 0 and snap["cache_misses"] == 0
     assert snap["sheds"] == 0 and snap["warmup_ms"] == {}
+    assert snap["worker_restarts"] == 0
     assert snap["requests"] == 3 and snap["completed"] == 2
     assert snap["batches"] == 1 and snap["warmup_batches"] == 1
     assert snap["batch_fill"] == 0.75 and snap["bucket_hits"] == {4: 1}

@@ -14,6 +14,17 @@ mpi (one mpirun, ranks mapped from OMPI_COMM_WORLD_RANK via the
 (distributed-shell submission). The cluster schedulers only place
 processes; the DMLC_* env contract (and jax.distributed underneath)
 is identical in every mode.
+
+``--elastic`` adds the relaunch loop `ElasticTrainer` was designed
+against: a job that loses a worker cannot shrink a live XLA backend in
+place, so the surviving ranks exit with code 77 (``RELAUNCH_EXIT_CODE``)
+after committing ``{"num_processes": K}`` to ``$MXNET_RELAUNCH_FILE``
+(``mxnet_tpu.dist.run_with_relaunch`` does both); the launcher then
+relaunches EVERY rank at the surviving world size K, bounded by
+``--max-restarts``, and ``fit(resume_from=)`` picks up the last
+committed checkpoint. ``--virtual-hosts N`` runs the same loop over ONE
+process simulating N hosts (``MXNET_VIRTUAL_HOSTS``) — how CPU CI pins
+the loop without multi-process collectives.
 """
 import argparse
 import json
@@ -22,9 +33,14 @@ import random
 import shlex
 import subprocess
 import sys
+import tempfile
+
+# keep in sync with mxnet_tpu.dist.elastic.RELAUNCH_EXIT_CODE (the
+# launcher must not import the package it launches)
+RELAUNCH_EXIT_CODE = 77
 
 
-def launch_local(n, cmd, port):
+def launch_local(n, cmd, port, extra_env=None):
     procs = []
     env_base = dict(os.environ)
     env_base.update({
@@ -33,16 +49,76 @@ def launch_local(n, cmd, port):
         "DMLC_PS_ROOT_URI": "127.0.0.1",
         "DMLC_PS_ROOT_PORT": str(port),
     })
+    env_base.update(extra_env or {})
     for rank in range(n):
         env = dict(env_base)
         env["DMLC_WORKER_ID"] = str(rank)
         env["DMLC_ROLE"] = "worker"
         procs.append(subprocess.Popen(cmd, env=env))
-    code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
-    return code
+    codes = [p.wait() or p.returncode for p in procs]
+    # a relaunch request outranks ordinary failures: when ANY rank
+    # asked for a relaunch, the launcher loop must see 77 (survivors
+    # of a dead peer exit 77; the dead peer's own code is noise)
+    if RELAUNCH_EXIT_CODE in codes:
+        return RELAUNCH_EXIT_CODE
+    return next((c for c in codes if c), 0)
+
+
+def launch_virtual(n_hosts, cmd, extra_env=None):
+    """One process simulating ``n_hosts`` (MXNET_VIRTUAL_HOSTS; the
+    script builds a VirtualCluster from it via
+    ``mxnet_tpu.dist.virtual_world_from_env``) — the CPU-CI spelling
+    of a world, sharing the elastic relaunch loop with real modes."""
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    env["MXNET_VIRTUAL_HOSTS"] = str(n_hosts)
+    return subprocess.call(cmd, env=env)
+
+
+def launch_elastic(n, cmd, port, max_restarts=4, virtual=False):
+    """The relaunch loop (module docstring): run the world, and while
+    a run exits RELAUNCH_EXIT_CODE with a committed relaunch request,
+    relaunch at the surviving size. Returns the final exit code."""
+    import shutil
+    workdir = tempfile.mkdtemp(prefix="mxnet_elastic_")
+    attempt = 0
+    try:
+        while True:
+            relaunch_file = os.path.join(workdir,
+                                         "relaunch-%d.json" % attempt)
+            extra = {"MXNET_RELAUNCH_FILE": relaunch_file,
+                     "MXNET_ELASTIC_ATTEMPT": str(attempt)}
+            if virtual:
+                code = launch_virtual(n, cmd, extra_env=extra)
+            else:
+                code = launch_local(n, cmd, port, extra_env=extra)
+            if code != RELAUNCH_EXIT_CODE:
+                return code
+            try:
+                with open(relaunch_file) as f:
+                    survivors = int(json.load(f)["num_processes"])
+            except (OSError, ValueError, KeyError) as exc:
+                sys.stderr.write(
+                    "launcher: exit %d without a readable relaunch "
+                    "request (%s); giving up\n" % (code, exc))
+                return code
+            attempt += 1
+            if attempt > max_restarts:
+                sys.stderr.write(
+                    "launcher: exceeded --max-restarts %d; giving up\n"
+                    % max_restarts)
+                return code
+            if survivors < 1:
+                sys.stderr.write(
+                    "launcher: relaunch request names %d processes; "
+                    "giving up\n" % survivors)
+                return code
+            sys.stderr.write(
+                "launcher: relaunching at %d process(es) "
+                "(attempt %d/%d)\n" % (survivors, attempt, max_restarts))
+            n = survivors
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def launch_ssh(hosts, n, cmd, port):
@@ -144,7 +220,7 @@ def launch_yarn(n, cmd, port, yarn="yarn"):
 
 def main():
     parser = argparse.ArgumentParser(description="launch a dist job")
-    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-n", "--num-workers", type=int, default=None)
     parser.add_argument("-s", "--num-servers", type=int, default=0,
                         help="ignored: no server processes under XLA "
                              "collectives (kept for compat)")
@@ -152,9 +228,36 @@ def main():
     parser.add_argument("--launcher", default="local",
                         choices=["local", "ssh", "mpi", "sge", "yarn"])
     parser.add_argument("--sge-queue", default=None)
+    parser.add_argument("--elastic", action="store_true",
+                        help="consume RestartRequired relaunches: when "
+                             "a run exits %d with a committed "
+                             "$MXNET_RELAUNCH_FILE, relaunch every "
+                             "rank at the surviving world size "
+                             "(local/virtual modes)"
+                             % RELAUNCH_EXIT_CODE)
+    parser.add_argument("--max-restarts", type=int, default=4,
+                        help="elastic relaunch budget (a job losing "
+                             "workers faster than it resumes must die "
+                             "loudly, not thrash)")
+    parser.add_argument("--virtual-hosts", type=int, default=None,
+                        help="elastic virtual mode: ONE process "
+                             "simulating this many hosts "
+                             "(MXNET_VIRTUAL_HOSTS) — the CPU-CI "
+                             "spelling of the relaunch loop")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
+    if args.num_workers is None and args.virtual_hosts is None:
+        parser.error("-n/--num-workers is required (or --virtual-hosts)")
+    if (args.elastic or args.virtual_hosts) and args.launcher != "local":
+        parser.error("--elastic/--virtual-hosts only support the local "
+                     "launcher (cluster schedulers own their own "
+                     "restart policies)")
     port = random.randint(9100, 9899)
+    if args.elastic or args.virtual_hosts:
+        n = args.virtual_hosts or args.num_workers
+        sys.exit(launch_elastic(n, args.command, port,
+                                max_restarts=args.max_restarts,
+                                virtual=args.virtual_hosts is not None))
     if args.launcher == "mpi":
         sys.exit(launch_mpi(args.num_workers, args.command, port))
     if args.launcher == "sge":
