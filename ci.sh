@@ -360,6 +360,18 @@ stage "chaos-soak gate (seeded FaultPlan over train + elastic resume + serve)"
 python -c "from __graft_entry__ import dryrun_chaos; dryrun_chaos(8, 4)" \
     || FAILED=1
 
+stage "chaos-soak numeric stage (training guardian heals NaN + loss spike)"
+# guardian contract (docs/api/guardian.md): a seeded plan poisons one
+# mid-train batch with NaN and spikes a later one; the device-resident
+# health sentinel detects both at the epoch boundary and rollback-and-
+# skip must (a) finish with params bitwise-equal to a clean guarded
+# run trained on the same stream with the two batches excluded,
+# (b) leave exactly the planned incidents + one guardian_rollback
+# flight event per heal, (c) perform zero post-warmup retraces, and
+# (d) keep the SDC parity probe silent throughout. Emits CHAOS_r02.json.
+python -c "from __graft_entry__ import dryrun_chaos_numeric; dryrun_chaos_numeric(8)" \
+    || FAILED=1
+
 stage "chaos smoke (train_cifar10 --fault-plan: healed faults keep the digest)"
 # the smoke-sized spelling tests/test_examples.py shares: transient
 # staging faults healed by the shared bounded-backoff retry must leave

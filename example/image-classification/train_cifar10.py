@@ -254,6 +254,21 @@ def main():
                              "bitwise identical to a fault-free run "
                              "(the ci.sh chaos-smoke gate compares "
                              "digests)")
+    parser.add_argument("--guardian", action="store_true",
+                        help="arm the training guardian "
+                             "(mxnet_tpu.guardian): device-resident "
+                             "numeric-health sentinels on the train "
+                             "step, epoch-boundary polling, and "
+                             "rollback-and-skip recovery for NaN / "
+                             "loss-spike / SDC verdicts. Shares the "
+                             "--checkpoint-dir manager when given "
+                             "(recommended — rollback can then "
+                             "truncate a poisoned trajectory), else "
+                             "uses a run-local directory. With a "
+                             "--fault-plan carrying numeric rules "
+                             "(module.step / guardian.sdc sites) the "
+                             "script asserts the guardian actually "
+                             "rolled back")
     parser.add_argument("--serve-smoke", action="store_true",
                         help="after training, serve the model through "
                              "an in-process mxnet_tpu.serving stack "
@@ -370,6 +385,16 @@ def main():
                 os._exit(66)
 
         callbacks.append(_preempt)
+    guard = None
+    if args.guardian:
+        import tempfile
+        guard = mx.guardian.Guardian(
+            manager if manager is not None
+            else tempfile.mkdtemp(prefix="cifar_guardian_"))
+        logging.info("guardian armed: window=%d threshold=%g "
+                     "max_rollbacks=%d sdc_period=%d",
+                     guard.spike_window, guard.spike_threshold,
+                     guard.max_rollbacks, guard.sdc_probe_period)
     mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
             kvstore=args.kv_store,
             initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
@@ -380,7 +405,8 @@ def main():
             epoch_end_callback=callbacks or None,
             resume_from=manager if args.resume else None,
             batch_group=args.batch_group,
-            prefetch_to_device=args.prefetch_device)
+            prefetch_to_device=args.prefetch_device,
+            guardian=guard)
     if manager is not None:
         manager.wait_until_finished()
     if telemetry_on:
@@ -434,6 +460,23 @@ def main():
         logging.info("health report: armed=%s healthy=%s polls=%d -> %s",
                      rep["armed"], rep["healthy"], rep["polls"],
                      args.health_report)
+    if guard is not None:
+        st = guard.stats()
+        logging.info(
+            "guardian: rollbacks=%d skipped=%r sdc_checks=%d "
+            "sdc_mismatches=%d", st["rollbacks"], st["skipped"],
+            st["sdc_checks"], st["sdc_mismatches"])
+        numeric_rules = [r.describe() for r in
+                         (fault_plan.rules if fault_plan else [])
+                         if r.site in ("module.step", "guardian.sdc")]
+        if numeric_rules:
+            # the robustness contract: a planned numeric fault MUST
+            # have been healed by rollback-and-skip, and training must
+            # have reached the end anyway (which reaching this line
+            # proves)
+            assert st["rollbacks"] >= 1, (
+                "numeric fault(s) %r were planned but the guardian "
+                "never rolled back" % (numeric_rules,))
     if fault_plan is not None:
         # the chaos contract: a plan whose deterministic rules never
         # fired silently missed its targets — that is a gate failure,

@@ -69,6 +69,7 @@ from . import kvstore as kv
 from . import kvstore
 from . import model
 from . import checkpoint
+from . import guardian
 from . import module
 from . import module as mod
 from . import serving

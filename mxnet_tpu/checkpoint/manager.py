@@ -381,6 +381,96 @@ class CheckpointManager(object):
                "" if len(failures) == 1 else "s",
                failures[0][0], failures[0][1]))
 
+    def restore_before(self, predicate, verify=None):
+        """Walk committed entries newest -> oldest and restore the
+        newest one that (a) satisfies ``predicate(step, extra)`` over
+        its manifest metadata, (b) passes the per-entry artifact
+        verification (crc32/shape/manifest), and (c) passes the
+        optional ``verify(ckpt) -> None | reason-str`` hook on the
+        loaded payload.
+
+        This is the training guardian's restore-to-step-before-
+        coordinate primitive (:mod:`mxnet_tpu.guardian`): ``predicate``
+        excludes entries that already trained the poisoned data
+        coordinate, and ``verify`` lets the caller reject entries whose
+        BYTES verify but whose VALUES are unusable (non-finite
+        parameters from a read-path SDC). Every skipped entry logs one
+        loud warning and counts into ``checkpoint.restore_fallbacks``
+        (the :meth:`restore` walk-back discipline). Raises when no
+        entry qualifies."""
+        self.wait_until_finished()
+        log = logging.getLogger(__name__)
+        candidates = sorted(self.all_steps(), reverse=True)
+        skipped = 0
+        for s in candidates:
+            try:
+                extra = dict(serialize.read_json(os.path.join(
+                    self._entry_dir(s), _MANIFEST)).get("extra", {}))
+                qualifies = bool(predicate(s, extra))
+            except Exception as exc:  # noqa: BLE001 — unreadable or
+                # garbage metadata (and a predicate that chokes on it)
+                # means the entry's POSITION is unknowable: it must be
+                # SKIPPED like a corrupt entry, never restored — an
+                # entry inside the poisoned trajectory would otherwise
+                # slip through on a torn manifest extra
+                skipped += 1
+                _TEL.counter("restore_fallbacks").add()
+                log.warning(
+                    "checkpoint step %d in %s has unusable metadata "
+                    "for rollback (%s); falling back to the previous "
+                    "committed entry", s, self.directory, exc)
+                telemetry.flight_recorder().note(
+                    "checkpoint_fallback", step=s, error=str(exc))
+                continue
+            if not qualifies:
+                continue
+            try:
+                ckpt = self._restore_entry(s)
+                reason = verify(ckpt) if verify is not None else None
+            except Exception as exc:  # noqa: BLE001
+                reason = str(exc)
+                ckpt = None
+            if ckpt is not None and not reason:
+                if skipped:
+                    log.warning(
+                        "restored checkpoint step %d after skipping %d "
+                        "unusable newer entr%s", s, skipped,
+                        "y" if skipped == 1 else "ies")
+                return ckpt
+            skipped += 1
+            _TEL.counter("restore_fallbacks").add()
+            log.warning(
+                "checkpoint step %d in %s is unusable for rollback "
+                "(%s); falling back to the previous committed entry",
+                s, self.directory, reason)
+            telemetry.flight_recorder().note(
+                "checkpoint_fallback", step=s, error=str(reason))
+        raise MXNetError(
+            "no checkpoint entry in %s both precedes the requested "
+            "coordinate and passes verification (%d candidate%s)"
+            % (self.directory, len(candidates),
+               "" if len(candidates) == 1 else "s"))
+
+    def discard_after(self, step):
+        """Delete committed entries NEWER than ``step`` (the rollback
+        truncation: after the guardian restores to a pre-poison entry,
+        every newer entry belongs to the poisoned trajectory — keeping
+        them would both resurrect bad state on the next resume and
+        collide with the replay's re-commits at the same step ids).
+        Returns the discarded step list."""
+        self.wait_until_finished()
+        step = int(step)
+        dropped = [s for s in self.all_steps() if s > step]
+        for s in dropped:
+            shutil.rmtree(self._entry_dir(s), ignore_errors=True)
+        if dropped:
+            logging.getLogger(__name__).warning(
+                "discarded %d checkpoint entr%s after step %d (%s)",
+                len(dropped), "y" if len(dropped) == 1 else "ies",
+                step, dropped)
+            _TEL.counter("discarded_entries").add(len(dropped))
+        return dropped
+
     def _restore_entry(self, step):
         """Load + verify ONE committed entry (crc32/shape/dtype per
         shard); any corruption raises :class:`MXNetError` naming the
@@ -442,6 +532,14 @@ class CheckpointManager(object):
         if manifest.get("rng"):
             rng = serialize.load_rng(
                 os.path.join(entry, manifest["rng"]["file"]))
+        if _faults.armed():
+            # restore hand-off SDC seam (kind=param_bitflip): corrupt
+            # one element of the ASSEMBLED params after the crc checks
+            # passed — a silent read-path corruption the bytes-level
+            # verification structurally cannot catch; the guardian's
+            # value-level verify / param sentinel is what must
+            _faults.corrupt_params("checkpoint.params", params,
+                                   step=step)
         _TEL.counter("restores").add()
         _TEL.counter("restore_ms").add((time.perf_counter() - t0) * 1000.0)
         _TEL.counter("bytes_read").add(

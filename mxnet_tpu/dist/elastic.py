@@ -326,7 +326,7 @@ class ElasticTrainer:
                                            "blackbox"))
 
     # ------------------------------------------------------ callbacks
-    def _checkpoint_callback(self, mod, world):
+    def _checkpoint_callback(self, mod, world, guardian=None):
         """Batch-end callback committing a durable step entry whenever
         ``num_update`` CROSSES a ``self.every`` boundary (not only on
         exact multiples — under ``fit(batch_group=K)`` the clock
@@ -343,6 +343,19 @@ class ElasticTrainer:
             crossed = n // self.every > state["prev"] // self.every
             state["prev"] = n
             if not crossed:
+                return
+            if guardian is not None and guardian.tainted():
+                # the guardian's commit-boundary poll: the sentinel
+                # has already seen a bad step this window — persisting
+                # this state would just hand the rollback walk one
+                # more entry to reject. Skip the commit; the epoch-end
+                # verdict restores a pre-poison entry.
+                from .. import telemetry
+                telemetry.registry().scope("guardian").counter(
+                    "tainted_commit_skips").add()
+                self.logger.warning(
+                    "guardian: skipping checkpoint commit at "
+                    "num_update=%d (health sentinel tainted)", n)
                 return
             mod.save_checkpoint(
                 None, n, save_optimizer_states=self.save_optimizer_states,
@@ -415,8 +428,43 @@ class ElasticTrainer:
             if installed_here:
                 self.recorder.uninstall()
 
+    def _guardian_entry(self, guardian, start):
+        """Per-attempt guardian attribution for a restart-transcript
+        entry (mirrors the ``health_incidents`` plumbing): rollback /
+        skip / SDC counts SINCE the attempt started, so a chaos report
+        can tell which layer healed what. None when no guardian rode
+        the fit."""
+        if guardian is None or start is None:
+            return None
+        cur = guardian.stats()
+        return {
+            "rollbacks": cur["rollbacks"] - start["rollbacks"],
+            "skipped": cur["skipped"],
+            "sdc_checks": cur["sdc_checks"] - start["sdc_checks"],
+            "sdc_mismatches": cur["sdc_mismatches"]
+            - start["sdc_mismatches"],
+        }
+
     def _fit_attempts(self, world, attempt, fault, num_epoch, monitor,
                       batch_end_callback, fit_kwargs):
+        # resolve the guardian ONCE for the whole elastic run: every
+        # attempt then shares one Guardian — its convicted-coordinate
+        # skip set and rollback budget span restarts, and the
+        # transcript can attribute per-attempt recovery counts
+        from .. import guardian as guardian_mod
+        guardian = guardian_mod.resolve(fit_kwargs.get("guardian"))
+        if guardian is not None:
+            fit_kwargs["guardian"] = guardian
+            if guardian.manager.directory != self.manager.directory:
+                # a rollback truncates the poisoned trajectory's newer
+                # entries in the GUARDIAN's store; if the trainer
+                # commits into a different one, the replay's
+                # re-commits collide with stale poisoned entries
+                self.logger.warning(
+                    "guardian manager (%s) differs from the elastic "
+                    "checkpoint directory (%s); share one manager so "
+                    "rollback can truncate the poisoned trajectory",
+                    guardian.manager.directory, self.manager.directory)
         while True:
             if world.device_count < self.min_dp_width:
                 raise MXNetError(
@@ -430,7 +478,8 @@ class ElasticTrainer:
                                dp_width=world.device_count)
             mod = self.module_factory(world)
             data = self.data_factory(world)
-            cbs = [self._checkpoint_callback(mod, world)]
+            cbs = [self._checkpoint_callback(mod, world,
+                                             guardian=guardian)]
             from .. import faults as _faults
             if fault is not None or monitor is not None \
                     or _faults.armed():
@@ -443,6 +492,7 @@ class ElasticTrainer:
             entry = {"attempt": attempt, "dp_width": world.device_count,
                      "resume_step": self.manager.latest(),
                      "world": world.describe()}
+            gstart = guardian.stats() if guardian is not None else None
             # a stale dump from an earlier attempt must not be
             # mistaken for this attempt's fault postmortem
             self.recorder.pop_last_dump()
@@ -473,6 +523,8 @@ class ElasticTrainer:
                     {k: i.get(k) for k in ("gauge", "value", "baseline",
                                            "threshold", "ts")}
                     for i in wd.incidents()] if wd.armed else []
+                entry["guardian"] = self._guardian_entry(guardian,
+                                                         gstart)
                 try:
                     entry["postmortem"] = self.recorder.pop_last_dump() \
                         or self.recorder.dump("worker_lost: %s" % exc)
@@ -508,6 +560,7 @@ class ElasticTrainer:
                 "event": "finished",
                 "train_s": round(time.perf_counter() - t0, 3),
                 "final_num_update": mod._optimizer.num_update,
+                "guardian": self._guardian_entry(guardian, gstart),
             })
             self.transcript.append(entry)
             self.world = world

@@ -22,6 +22,9 @@ site                   entry point  where it lives
 ``serving.device``     check        Predictor device launch
 ``serving.queue_flood``  fires      DynamicBatcher submit
 ``serving.cache``      corrupt      a committed executable entry
+``module.step``        poison       fit step boundary (numeric seam)
+``checkpoint.params``  corrupt_params  restore hand-off (read SDC)
+``guardian.sdc``       value        SDC probe's second launch
 =====================  ===========  =================================
 
 The discipline is ``telemetry.enabled()``'s: an UNARMED process pays
@@ -49,13 +52,13 @@ import threading
 from ..base import MXNetError
 from .plan import (FaultError, FaultPlan, FaultRule, InjectedFault,
                    TransientFault, KINDS, RAISING_KINDS, VALUE_KINDS,
-                   FLOOD_KINDS, FILE_KINDS)
+                   FLOOD_KINDS, FILE_KINDS, NUMERIC_KINDS, PARAM_KINDS)
 from .retry import retry
 
 __all__ = ["FaultError", "InjectedFault", "TransientFault", "FaultRule",
            "FaultPlan", "KINDS", "retry", "arm", "disarm", "armed",
            "active", "check", "value", "fires", "corrupt_file",
-           "incidents"]
+           "poison", "corrupt_params", "incidents"]
 
 _log = logging.getLogger("mxnet_tpu.faults")
 _PLAN = None
@@ -234,6 +237,74 @@ def corrupt_file(site, root, pattern="*", **ctx):
         incident["target"] = os.path.basename(path)
         mutated = path
     return mutated
+
+
+def poison(site, **ctx):
+    """Numeric seam (the :mod:`mxnet_tpu.guardian` drivers): the batch
+    multiplier a fired numeric rule injects at the step boundary —
+    ``float('nan')`` for ``grad_nonfinite`` (non-finite loss/grads/
+    params downstream), the rule's ``value=`` (default 1000) for
+    ``loss_spike`` (a finite but poisonous batch) — or None when
+    nothing fired. The fit loops apply the factor to the step's first
+    floating data input. No-op unless armed."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    fired = plan.evaluate(site, ctx, NUMERIC_KINDS)
+    factor = None
+    for rule, incident in fired:
+        # every fired rule records (transcript and FlightRecorder stay
+        # 1:1) even though only the first rule's factor applies
+        _record(incident)
+        if factor is None:
+            factor = float("nan") if rule.kind == "grad_nonfinite" \
+                else float(rule.args.get("value", 1000.0))
+    return factor
+
+
+def corrupt_params(site, params, **ctx):
+    """Restore-hand-off SDC seam: a fired ``param_bitflip`` rule
+    corrupts ONE element of one restored float parameter array IN
+    PLACE — the element's bit pattern is forced to a quiet-NaN, the
+    deterministic spelling of a silent read-path corruption the
+    guardian's param sentinel (or its post-restore verification) must
+    catch. Target array and element are plan-seeded draws. Returns the
+    corrupted array name (or None)."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    fired = plan.evaluate(site, ctx, PARAM_KINDS)
+    target = None
+    import numpy as onp
+    for _rule, incident in fired:
+        _record(incident)
+        names = sorted(n for n, a in params.items()
+                       if hasattr(a, "dtype")
+                       and onp.issubdtype(onp.dtype(a.dtype),
+                                          onp.floating)
+                       and getattr(a, "size", 0) > 0)
+        if not names:
+            _log.warning("fault %s fired but no float param to corrupt",
+                         site)
+            continue
+        name = names[plan.draw(incident["seq"], 1) % len(names)]
+        arr = params[name]
+        idx = plan.draw(incident["seq"], 2) % arr.size
+        flat = arr.reshape(-1)
+        if flat.dtype == onp.float32:
+            # force a quiet-NaN bit pattern (exponent all-ones +
+            # mantissa MSB) — guaranteed non-finite whatever the
+            # element held, unlike a single-bit flip
+            bits = flat.view(onp.uint32)
+            bits[idx] |= onp.uint32(0x7FC00000)
+        else:
+            flat[idx] = onp.nan
+        incident["target"] = name
+        incident["element"] = int(idx)
+        _log.warning("fault: corrupted %s[%d] of restored params",
+                     name, idx)
+        target = name
+    return target
 
 
 def _autostart():

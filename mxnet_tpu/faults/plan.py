@@ -45,6 +45,9 @@ docs/api/faults.md):
 ``flood``      boolean fire — the serving queue treats itself as full
 ``bitflip``    flip one byte of a committed artifact file
 ``truncate``   truncate a committed artifact file to half its size
+``grad_nonfinite``  poison one step's batch with NaN (numeric seam)
+``loss_spike``      scale one step's batch by ``value=`` (default 1000)
+``param_bitflip``   corrupt one restored parameter element (read SDC)
 =============  ==========================================================
 
 Every firing appends one incident to the plan's transcript (and, via
@@ -61,18 +64,28 @@ import time
 from ..base import MXNetError
 
 __all__ = ["FaultError", "InjectedFault", "TransientFault", "FaultRule",
-           "FaultPlan", "KINDS"]
+           "FaultPlan", "KINDS", "NUMERIC_KINDS", "PARAM_KINDS"]
 
 KINDS = ("error", "transient", "delay", "value", "worker_lost", "flood",
-         "bitflip", "truncate")
+         "bitflip", "truncate", "grad_nonfinite", "loss_spike",
+         "param_bitflip")
 
 # which kinds each seam entry point (faults.check/value/fires/
-# corrupt_file) dispatches — a rule whose kind the site's entry point
-# does not honor simply never fires there (documented in the seam table)
+# corrupt_file/poison/corrupt_params) dispatches — a rule whose kind
+# the site's entry point does not honor simply never fires there
+# (documented in the seam table)
 RAISING_KINDS = ("error", "transient", "worker_lost", "delay")
 VALUE_KINDS = ("value",)
 FLOOD_KINDS = ("flood",)
 FILE_KINDS = ("bitflip", "truncate")
+# numeric seams (the training-guardian drivers, mxnet_tpu.guardian):
+# grad_nonfinite poisons a step's batch with NaN (non-finite
+# loss/grads/params downstream); loss_spike scales it by a large
+# finite factor (``value=``, default 1000) — a finite-but-poisonous
+# batch; param_bitflip corrupts one restored parameter element's bit
+# pattern at the checkpoint-restore hand-off (a read-path SDC)
+NUMERIC_KINDS = ("grad_nonfinite", "loss_spike")
+PARAM_KINDS = ("param_bitflip",)
 
 # behavior/trigger keys that are NOT context matches
 _RESERVED = ("nth", "prob", "count", "ms", "value", "dead")

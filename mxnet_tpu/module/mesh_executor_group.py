@@ -87,6 +87,94 @@ def _ls_update(jnp, cfg, scale, good, finite):
     return new_scale, new_good
 
 
+def _ls_step(jnp, cfg, ls, finite):
+    """One device loss-scale transition over the threaded
+    ``(scale f32, good i32, skips i32)`` triple: the AMP rule on
+    (scale, good) plus a skipped-update count — the witness the
+    ``precision.scale_skips`` telemetry satellite polls off-path
+    alongside :meth:`MeshExecutorGroup.loss_scale`."""
+    scale, good, skips = ls
+    new_scale, new_good = _ls_update(jnp, cfg, scale, good, finite)
+    new_skips = skips + jnp.where(finite, 0, 1).astype(skips.dtype)
+    return new_scale, new_good, new_skips
+
+
+# guardian health-word flag bits (mxnet_tpu.guardian reads these):
+HEALTH_LOSS_NONFINITE = 1
+HEALTH_GRAD_NONFINITE = 2
+HEALTH_PARAM_NONFINITE = 4
+HEALTH_SDC_MISMATCH = 8
+
+
+def _health_update(jnp, cfg, health, inputs, outs, grads, new_params,
+                   grad_names, label_names):
+    """Fold one step's numeric-health observation into the threaded
+    guardian word ``(flags i32, first_bad i32, count i32, ring f32)``
+    — pure reads of values the step already computed, so the params
+    math is untouched. ``flags`` accumulates the sentinel bitmask
+    (loss/grad/param non-finite), ``first_bad`` pins the step ordinal
+    (within the polling window, i.e. since the last ``health_reset``)
+    of the FIRST bad observation, ``count`` counts steps, and ``ring``
+    is a rolling per-step loss-scalar window the host-side spike judge
+    reads at the epoch/commit boundary. Zero step-path readbacks: the
+    word lives on device and is polled off-path."""
+    flags, first_bad, count, ring = health
+    loss_fin = jnp.all(jnp.isfinite(outs[0].astype(jnp.float32)))
+    grad_fin = _grads_finite(jnp, grads)
+    par_fin = jnp.asarray(True)
+    for n in grad_names:
+        par_fin = jnp.logical_and(
+            par_fin, jnp.all(jnp.isfinite(new_params[n])))
+    bad = (jnp.where(loss_fin, 0, HEALTH_LOSS_NONFINITE)
+           | jnp.where(grad_fin, 0, HEALTH_GRAD_NONFINITE)
+           | jnp.where(par_fin, 0,
+                       HEALTH_PARAM_NONFINITE)).astype(jnp.int32)
+    new_flags = flags | bad
+    first_bad = jnp.where((flags == 0) & (new_flags != 0), count,
+                          first_bad)
+    stat = cfg.get("stat")
+    if stat is not None:
+        # the guardian's loss-like scalar: the spike metric's fused
+        # statistic over this batch (sum/count of its first slot —
+        # for the default cross-entropy stat, the batch's mean loss)
+        rows = stat(jnp, [inputs[n] for n in label_names], outs)
+        if isinstance(rows, tuple):
+            rows = [rows]
+        s, c = rows[0]
+        scalar = jnp.asarray(s, jnp.float32) / jnp.maximum(
+            jnp.asarray(c, jnp.float32), 1.0)
+    else:
+        # no labels / no fusable spike metric: finiteness sentinels
+        # still work; the ring carries a coarse output mean (the spike
+        # judge is only as meaningful as this scalar — documented)
+        scalar = jnp.mean(outs[0].astype(jnp.float32))
+    ring = ring.at[count % int(cfg["window"])].set(scalar)
+    return new_flags, first_bad, count + 1, ring
+
+
+def _sdc_fold(jnp, a_params, b_params, health, grad_names):
+    """Fold an SDC parity-probe verdict into the health word: compare
+    the two launches' updated params BITWISE (integer bitcast — a NaN
+    payload must compare equal to itself) and set the SDC flag on any
+    mismatch. Under the repo's bitwise-determinism contracts two
+    launches of the same program on the same inputs are byte-equal,
+    so a mismatch is a true hardware/silent-corruption signal."""
+    from jax import lax
+    flags, first_bad, count, ring = health
+    neq = jnp.asarray(False)
+    for n in grad_names:
+        ai = lax.bitcast_convert_type(a_params[n], jnp.int32)
+        bi = lax.bitcast_convert_type(b_params[n], jnp.int32)
+        neq = jnp.logical_or(neq, jnp.any(ai != bi))
+    new_flags = flags | jnp.where(neq, HEALTH_SDC_MISMATCH,
+                                  0).astype(jnp.int32)
+    # the probed step already counted (its health update ran inside
+    # the launch): the offending ordinal is count - 1
+    first_bad = jnp.where((flags == 0) & (new_flags != 0),
+                          jnp.maximum(count - 1, 0), first_bad)
+    return new_flags, first_bad, count, ring
+
+
 def _compiler_options():
     """TPU compiler options for the step programs, from
     ``MXNET_XLA_COMPILER_OPTIONS`` ("key=value,key=value").
@@ -166,6 +254,14 @@ class MeshExecutorGroup(object):
         from ..precision.policy import loss_scale_config
         self._ls_cfg = loss_scale_config(precision)
         self._ls_state = None
+        # guardian numeric-health sentinel (mxnet_tpu.guardian): when
+        # armed via enable_health(), a (flags, first_bad, count, ring)
+        # device word rides the train-step programs exactly like the
+        # loss-scale pair above — unarmed, every seam below is one
+        # attribute branch and the programs are byte-identical
+        self._health_cfg = None
+        self._health_state = None
+        self._probe_count = 0
         self._grad_names = [n for n in param_names
                             if n not in self.fixed_param_names] \
             if for_training and grad_req == "write" else []
@@ -606,9 +702,14 @@ class MeshExecutorGroup(object):
             # ':m<token>' kinds fold the metric statistic into the same
             # program: macc rides along as a donated (n_slots, 2) tally,
             # so a real fit(eval_metric=...) loop costs zero extra
-            # launches and zero per-batch readbacks (VERDICT r4 #1)
+            # launches and zero per-batch readbacks (VERDICT r4 #1).
+            # ':h<token>' kinds thread the guardian health word the
+            # same way; a ':probe' suffix compiles the NON-donating
+            # variant the SDC parity probe launches twice.
             mstat = self._metric_stat if ":m" in kind else None
             mlabels = list(self._label_names)
+            hcfg = self._health_cfg if ":h" in kind else None
+            probe = kind.endswith(":probe")
 
             def step_math(params, aux, states, inputs, rng, lrs, wds,
                           ls=None):
@@ -621,7 +722,7 @@ class MeshExecutorGroup(object):
                     # dynamic loss scaling rides the step: scaled heads,
                     # unscaled grads, an on-device finite probe deciding
                     # whether this step's update applies at all
-                    scale, good = ls
+                    scale = ls[0]
                     outs, new_aux, grads = fwd_bwd_math(
                         params, aux, inputs, rng, scale=scale)
                     finite = _grads_finite(jnp, grads)
@@ -640,72 +741,72 @@ class MeshExecutorGroup(object):
                 if ls is None:
                     return (outs, new_aux, grads, new_params,
                             tuple(new_states))
-                new_ls = _ls_update(jnp, ls_cfg, scale, good, finite)
+                new_ls = _ls_step(jnp, ls_cfg, ls, finite)
                 return (outs, new_aux, grads, new_params,
                         tuple(new_states), new_ls)
 
+            # optional trailing args (metric tally / loss-scale triple /
+            # guardian health word) COMPOSE: each is threaded in and out
+            # with its own sharding by one generic wrapper instead of a
+            # 2^3 variant matrix. Order is fixed — macc, ls, health —
+            # so the metric tally keeps its historical argnum 7
+            # donation slot.
+            extra_names, extra_sh = [], []
+            if mstat is not None:
+                extra_names.append("macc")
+                extra_sh.append((repl, repl))
+            if ls_cfg is not None:
+                extra_names.append("ls")
+                extra_sh.append((repl, repl, repl))
+            if hcfg is not None:
+                extra_names.append("health")
+                extra_sh.append((repl, repl, repl, repl))
+            grad_names_t = tuple(grad_names)
+
+            def train_step(params, aux, states, inputs, rng, lrs, wds,
+                           *extras):
+                import jax.numpy as jnp
+                ex = dict(zip(extra_names, extras))
+                ls = ex.get("ls")
+                sm = step_math(params, aux, states, inputs, rng, lrs,
+                               wds, ls)
+                if ls is None:
+                    outs, new_aux, grads, new_params, new_states = sm
+                    new_ls = None
+                else:
+                    (outs, new_aux, grads, new_params, new_states,
+                     new_ls) = sm
+                res = [outs, new_aux, grads, new_params, new_states]
+                if mstat is not None:
+                    res.append(_tally_add(
+                        jnp, mstat, [inputs[n] for n in mlabels], outs,
+                        ex["macc"]))
+                if new_ls is not None:
+                    res.append(new_ls)
+                if hcfg is not None:
+                    res.append(_health_update(
+                        jnp, hcfg, ex["health"], inputs, outs, grads,
+                        new_params, grad_names_t, mlabels))
+                return tuple(res)
+
             # no donation on cpu: device_put is zero-copy there, so user-
             # visible host arrays can alias the param buffers (the classic
-            # update path gates donation the same way)
-            donate = (0, 2) if self._platform != "cpu" else ()
+            # update path gates donation the same way). The probe
+            # variant never donates: the SDC parity probe launches it
+            # TWICE from the same argument buffers.
+            donate = (0, 2) if self._platform != "cpu" and not probe \
+                else ()
             base_in = (psh, repl, None, batch, None, None, None)
             base_out = (self._out_shardings, repl, gsh, psh, None)
-            ls_sh = (repl, repl)
-            if mstat is None and ls_cfg is None:
-                fn = jax_jit(
-                    step_math,
-                    # states: committed per-leaf in step_update (momentum
-                    # etc. shard like their param); None = follow the arg
-                    in_shardings=base_in,
-                    out_shardings=base_out,
-                    donate_argnums=donate)
-            elif mstat is None:
-                def train_step(params, aux, states, inputs, rng, lrs,
-                               wds, ls):
-                    return step_math(params, aux, states, inputs, rng,
-                                     lrs, wds, ls)
-
-                fn = jax_jit(
-                    train_step,
-                    in_shardings=base_in + (ls_sh,),
-                    out_shardings=base_out + (ls_sh,),
-                    donate_argnums=donate)
-            elif ls_cfg is None:
-                def train_step(params, aux, states, inputs, rng, lrs,
-                               wds, macc):
-                    import jax.numpy as jnp
-                    outs, new_aux, grads, new_params, new_states = \
-                        step_math(params, aux, states, inputs, rng, lrs,
-                                  wds)
-                    new_macc = _tally_add(
-                        jnp, mstat, [inputs[n] for n in mlabels], outs,
-                        macc)
-                    return (outs, new_aux, grads, new_params, new_states,
-                            new_macc)
-
-                fn = jax_jit(
-                    train_step,
-                    in_shardings=base_in + ((repl, repl),),
-                    out_shardings=base_out + ((repl, repl),),
-                    donate_argnums=donate + ((7,) if donate else ()))
-            else:
-                def train_step(params, aux, states, inputs, rng, lrs,
-                               wds, macc, ls):
-                    import jax.numpy as jnp
-                    (outs, new_aux, grads, new_params, new_states,
-                     new_ls) = step_math(params, aux, states, inputs,
-                                         rng, lrs, wds, ls)
-                    new_macc = _tally_add(
-                        jnp, mstat, [inputs[n] for n in mlabels], outs,
-                        macc)
-                    return (outs, new_aux, grads, new_params, new_states,
-                            new_macc, new_ls)
-
-                fn = jax_jit(
-                    train_step,
-                    in_shardings=base_in + ((repl, repl), ls_sh),
-                    out_shardings=base_out + ((repl, repl), ls_sh),
-                    donate_argnums=donate + ((7,) if donate else ()))
+            if donate and mstat is not None:
+                donate = donate + (7,)   # macc is always the first extra
+            fn = jax_jit(
+                train_step,
+                # states: committed per-leaf in step_update (momentum
+                # etc. shard like their param); None = follow the arg
+                in_shardings=base_in + tuple(extra_sh),
+                out_shardings=base_out + tuple(extra_sh),
+                donate_argnums=donate)
         elif kind.startswith("train_step_grouped:"):
             # K train steps as ONE XLA program (TPUEstimator's
             # iterations_per_loop, reconstructed): lax.scan of the same
@@ -719,10 +820,13 @@ class MeshExecutorGroup(object):
             fa = self._step_fa
             mstat = self._metric_stat if ":m" in kind else None
             mlabels = list(self._label_names)
+            hcfg = self._health_cfg if ":h" in kind else None
+            probe = kind.endswith(":probe")
             out_structs = self._out_structs()
+            grad_names_t = tuple(grad_names)
 
             def grouped_math(params, aux, states, inputs, rng, lrs, wds,
-                             macc, ls=None):
+                             macc, ls=None, health=None):
                 import jax.numpy as jnp
                 K = lrs.shape[0]
                 if self._needs_rng:
@@ -735,7 +839,8 @@ class MeshExecutorGroup(object):
                     subs = jnp.broadcast_to(rng, (K,) + rng.shape)
 
                 def body(carry, xs):
-                    params, aux, states, _outs, _grads, macc, ls = carry
+                    (params, aux, states, _outs, _grads, macc, ls,
+                     health) = carry
                     inp, lr_row, sub = xs
                     if ls is None:
                         outs, aux, grads = fwd_bwd_math(params, aux, inp,
@@ -745,7 +850,7 @@ class MeshExecutorGroup(object):
                         # the loss-scale state rides the scan carry: each
                         # scanned step sees the scale its predecessors
                         # left, exactly as K sequential steps would
-                        scale, good = ls
+                        scale = ls[0]
                         outs, aux, grads = fwd_bwd_math(
                             params, aux, inp, sub, scale=scale)
                         finite = _grads_finite(jnp, grads)
@@ -760,13 +865,21 @@ class MeshExecutorGroup(object):
                         new_params[n] = p
                         new_states.append(s)
                     if ls is not None:
-                        ls = _ls_update(jnp, ls_cfg, scale, good, finite)
+                        ls = _ls_step(jnp, ls_cfg, ls, finite)
                     if mstat is not None:
                         macc = _tally_add(jnp, mstat,
                                           [inp[n] for n in mlabels], outs,
                                           macc)
+                    if health is not None:
+                        # the guardian word rides the same carry
+                        # discipline as the loss-scale triple: each
+                        # scanned step observes and counts like K
+                        # sequential per-batch steps would
+                        health = _health_update(
+                            jnp, hcfg, health, inp, outs, grads,
+                            new_params, grad_names_t, mlabels)
                     return (new_params, aux, tuple(new_states), outs,
-                            grads, macc, ls), None
+                            grads, macc, ls, health), None
 
                 # last step's outs/grads ride the carry (stacking all K
                 # via scan ys would cost K x params of HBM for grads)
@@ -776,7 +889,7 @@ class MeshExecutorGroup(object):
                                            params[n].dtype)
                               for n in grad_names}
                 carry = (params, aux, states, zero_outs, zero_grads,
-                         macc, ls)
+                         macc, ls, health)
                 # rolled loop, never unrolled: XLA:CPU runs while-loop
                 # bodies on a slow path (8-30x per-step on conv nets),
                 # but unrolling lets XLA fuse ACROSS steps and the
@@ -786,71 +899,56 @@ class MeshExecutorGroup(object):
                 # also keeps compile time and program size
                 # K-independent on accelerators, where loop bodies run
                 # at full speed anyway.
-                (params, aux, states, outs, grads, macc, ls), _ = \
-                    jax.lax.scan(body, carry, (inputs, lrs, subs))
-                return outs, aux, grads, params, states, macc, ls
+                (params, aux, states, outs, grads, macc, ls, health), \
+                    _ = jax.lax.scan(body, carry, (inputs, lrs, subs))
+                return outs, aux, grads, params, states, macc, ls, health
+
+            # same composable-extras wrapper as the per-batch step
+            # (macc, ls, health in fixed order)
+            extra_names, extra_sh = [], []
+            if mstat is not None:
+                extra_names.append("macc")
+                extra_sh.append((repl, repl))
+            if ls_cfg is not None:
+                extra_names.append("ls")
+                extra_sh.append((repl, repl, repl))
+            if hcfg is not None:
+                extra_names.append("health")
+                extra_sh.append((repl, repl, repl, repl))
+
+            def train_grouped(params, aux, states, inputs, rng, lrs,
+                              wds, *extras):
+                import jax.numpy as jnp
+                ex = dict(zip(extra_names, extras))
+                macc = ex.get("macc")
+                if macc is None:
+                    macc = (jnp.zeros((0,), jnp.float32),
+                            jnp.zeros((0,), jnp.int32))
+                (outs, new_aux, grads, new_params, new_states, new_macc,
+                 new_ls, new_health) = grouped_math(
+                    params, aux, states, inputs, rng, lrs, wds, macc,
+                    ex.get("ls"), ex.get("health"))
+                res = [outs, new_aux, grads, new_params, new_states]
+                if mstat is not None:
+                    res.append(new_macc)
+                if new_ls is not None:
+                    res.append(new_ls)
+                if hcfg is not None:
+                    res.append(new_health)
+                return tuple(res)
 
             st_batch = self._stacked_sharding()
-            donate = (0, 2) if self._platform != "cpu" else ()
+            donate = (0, 2) if self._platform != "cpu" and not probe \
+                else ()
             base_in = (psh, repl, None, st_batch, None, None, None)
             base_out = (self._out_shardings, repl, gsh, psh, None)
-            ls_sh = (repl, repl)
-            if mstat is None and ls_cfg is None:
-                def train_grouped(params, aux, states, inputs, rng, lrs,
-                                  wds):
-                    import jax.numpy as jnp
-                    dummy = (jnp.zeros((0,), jnp.float32),
-                             jnp.zeros((0,), jnp.int32))
-                    outs, aux, grads, params, states, _, _ls = \
-                        grouped_math(params, aux, states, inputs, rng,
-                                     lrs, wds, dummy)
-                    return outs, aux, grads, params, states
-
-                fn = jax_jit(
-                    train_grouped,
-                    in_shardings=base_in,
-                    out_shardings=base_out,
-                    donate_argnums=donate)
-            elif mstat is None:
-                def train_grouped(params, aux, states, inputs, rng, lrs,
-                                  wds, ls):
-                    import jax.numpy as jnp
-                    dummy = (jnp.zeros((0,), jnp.float32),
-                             jnp.zeros((0,), jnp.int32))
-                    outs, aux, grads, params, states, _, new_ls = \
-                        grouped_math(params, aux, states, inputs, rng,
-                                     lrs, wds, dummy, ls)
-                    return outs, aux, grads, params, states, new_ls
-
-                fn = jax_jit(
-                    train_grouped,
-                    in_shardings=base_in + (ls_sh,),
-                    out_shardings=base_out + (ls_sh,),
-                    donate_argnums=donate)
-            elif ls_cfg is None:
-                def train_grouped(params, aux, states, inputs, rng, lrs,
-                                  wds, macc):
-                    (outs, aux, grads, params, states, macc, _ls) = \
-                        grouped_math(params, aux, states, inputs, rng,
-                                     lrs, wds, macc)
-                    return outs, aux, grads, params, states, macc
-
-                fn = jax_jit(
-                    train_grouped,
-                    in_shardings=base_in + ((repl, repl),),
-                    out_shardings=base_out + ((repl, repl),),
-                    donate_argnums=donate + ((7,) if donate else ()))
-            else:
-                def train_grouped(params, aux, states, inputs, rng, lrs,
-                                  wds, macc, ls):
-                    return grouped_math(params, aux, states, inputs, rng,
-                                        lrs, wds, macc, ls)
-
-                fn = jax_jit(
-                    train_grouped,
-                    in_shardings=base_in + ((repl, repl), ls_sh),
-                    out_shardings=base_out + ((repl, repl), ls_sh),
-                    donate_argnums=donate + ((7,) if donate else ()))
+            if donate and mstat is not None:
+                donate = donate + (7,)
+            fn = jax_jit(
+                train_grouped,
+                in_shardings=base_in + tuple(extra_sh),
+                out_shardings=base_out + tuple(extra_sh),
+                donate_argnums=donate)
         else:  # fused forward+backward, grads all-reduced to replicated
             with_heads = kind == "fwd_bwd_heads"
 
@@ -1321,10 +1419,10 @@ class MeshExecutorGroup(object):
         return mode_name(self._precision)
 
     def _ls_current(self):
-        """The device-resident (scale, good-steps) loss-scale pair,
-        lazily initialized from the policy's config (None when the
-        policy does not scale). Lives across steps; the step programs
-        return its successor."""
+        """The device-resident (scale, good-steps, skipped-updates)
+        loss-scale triple, lazily initialized from the policy's config
+        (None when the policy does not scale). Lives across steps; the
+        step programs return its successor."""
         if self._ls_cfg is None:
             return None
         if self._ls_state is None:
@@ -1332,6 +1430,7 @@ class MeshExecutorGroup(object):
             self._ls_state = (
                 jax.device_put(onp.float32(self._ls_cfg["init"]),
                                self._repl),
+                jax.device_put(onp.int32(0), self._repl),
                 jax.device_put(onp.int32(0), self._repl))
         return self._ls_state
 
@@ -1346,6 +1445,190 @@ class MeshExecutorGroup(object):
         if self._ls_state is None:
             return float(self._ls_cfg["init"])
         return float(self._ls_state[0])
+
+    def scale_skips(self):
+        """Total loss-scaler skipped updates (non-finite-grad steps
+        whose param/state update was suppressed) as a host int, or
+        None when the policy does not scale. Same off-path readback
+        discipline as :meth:`loss_scale` — fit polls it at the epoch
+        boundary into the ``precision.scale_skips`` gauge so a
+        pathological skip storm is visible to the watchdog."""
+        if self._ls_cfg is None:
+            return None
+        if self._ls_state is None:
+            return 0
+        return int(self._ls_state[2])
+
+    # -- guardian numeric-health sentinel (mxnet_tpu.guardian) ---------
+    def enable_health(self, window=32, stat_metric=None, probe_period=0):
+        """Arm the device-resident health word: subsequent train-step
+        programs thread a ``(flags, first_bad, count, loss-ring)``
+        carry (the loss-scale pair's discipline — zero step-path
+        readbacks, polled off-path via :meth:`health_poll`).
+        ``stat_metric`` (an EvalMetric with a fused statistic, e.g.
+        CrossEntropy) defines the ring's per-step loss scalar; None
+        falls back to the first output's mean. ``probe_period=N`` also
+        runs every N-th step twice through a non-donating program and
+        compares the updated params bitwise on device (the SDC parity
+        probe). Must be armed before the step programs compile (fit
+        arms at its entry, inside the warmup window)."""
+        stat = None
+        token = 0
+        if stat_metric is not None and self._label_names:
+            stat = stat_metric.fused_stat()
+            if stat is not None:
+                # metric-token protocol (enable_device_metric): the
+                # SAME metric object re-arms onto the SAME compiled
+                # program instead of retracing
+                token = getattr(stat_metric, "_mxtpu_tally_token", None)
+                if token is None:
+                    token = stat_metric._mxtpu_tally_token = \
+                        next(_STEP_TOKENS)
+        self._health_cfg = {"window": int(window), "stat": stat,
+                            "probe_period": int(probe_period or 0),
+                            "token": int(token)}
+        self._health_state = None
+        self._probe_count = 0
+
+    def disable_health(self):
+        self._health_cfg = None
+        self._health_state = None
+
+    def _health_kind_tag(self):
+        """The jit-cache tag an armed health word adds to a step
+        program's kind (window + stat identity — the program's shape
+        depends on both)."""
+        cfg = self._health_cfg
+        if cfg is None:
+            return ""
+        return ":h%d.%d" % (cfg["window"], cfg["token"])
+
+    def _health_current(self):
+        """The device health word, lazily (re)initialized: flags 0,
+        first_bad -1, count 0, ring NaN-filled."""
+        if self._health_cfg is None:
+            return None
+        if self._health_state is None:
+            import jax
+            w = self._health_cfg["window"]
+            self._health_state = (
+                jax.device_put(onp.int32(0), self._repl),
+                jax.device_put(onp.int32(-1), self._repl),
+                jax.device_put(onp.int32(0), self._repl),
+                jax.device_put(onp.full((w,), onp.nan, onp.float32),
+                               self._repl))
+        return self._health_state
+
+    def health_poll(self):
+        """Read the health word back to host (OFF the step path — the
+        guardian calls this at the epoch/commit boundary only).
+        Returns ``{"flags", "first_bad", "count", "ring"}`` or None
+        when unarmed / no step has run."""
+        if self._health_cfg is None or self._health_state is None:
+            return None
+        flags, first_bad, count, ring = self._health_state
+        return {"flags": int(flags), "first_bad": int(first_bad),
+                "count": int(count),
+                "ring": onp.asarray(ring, onp.float32)}
+
+    def health_reset(self):
+        """Zero the health word (guardian epoch-boundary bracket):
+        the next step re-initializes it, so ``count`` is the executed-
+        step ordinal within the polling window."""
+        self._health_state = None
+
+    def _step_extras(self):
+        """The optional trailing step-program arguments in their fixed
+        order — metric tally, loss-scale triple, health word — lazily
+        initializing each (the one arg-assembly rule the per-batch and
+        grouped launches share)."""
+        import jax
+        extras = ()
+        if self._metric_stat is not None:
+            if self._metric_acc is None:
+                self._metric_acc = (
+                    jax.device_put(onp.zeros(self._metric_slots,
+                                             onp.float32), self._repl),
+                    jax.device_put(onp.zeros(self._metric_slots,
+                                             onp.int32), self._repl))
+            extras += (self._metric_acc,)
+        ls = self._ls_current()
+        if ls is not None:
+            extras += (ls,)
+        health = self._health_current()
+        if health is not None:
+            extras += (health,)
+        return extras
+
+    def _commit_step_extras(self, out):
+        """Unpack one step program's outputs: commit the trailing
+        extras (tally / loss scale / health word) back into their
+        device-state slots and return the fixed five-tuple."""
+        idx = 5
+        if self._metric_stat is not None:
+            self._metric_acc = out[idx]
+            self._metric_step_done = True
+            idx += 1
+        if self._ls_cfg is not None:
+            self._ls_state = out[idx]
+            idx += 1
+        if self._health_cfg is not None:
+            self._health_state = out[idx]
+            idx += 1
+        return out[0], out[1], out[2], out[3], out[4]
+
+    def _launch_step_program(self, kind, fn, args):
+        """Launch a train-step program — or, on an SDC-probe step,
+        launch the non-donating variant TWICE on the identical
+        arguments and fold the bitwise params comparison into the
+        health word. Two separate launches (not one program computing
+        the step twice): XLA would CSE a duplicated pure computation
+        back into one, which is exactly what a parity probe must not
+        let happen."""
+        hcfg = self._health_cfg
+        if not hcfg or not hcfg.get("probe_period"):
+            return fn(*args)
+        n = self._probe_count
+        self._probe_count += 1
+        if n % int(hcfg["probe_period"]):
+            return fn(*args)
+        from .. import faults as _faults
+        from .. import telemetry
+        fnp = self._get_jit(kind + ":probe")
+        out1 = fnp(*args)
+        args2 = args
+        if _faults.armed():
+            # guardian.sdc seam (kind=value): perturb the second
+            # launch's host lr row by the injected relative delta — a
+            # deterministic way to make the parity compare fail, so
+            # the whole detect->rollback chain downstream is the real
+            # one (a real SDC needs real flaky silicon)
+            delta = _faults.value("guardian.sdc", None, probe=n)
+            if delta is not None:
+                args2 = args[:5] + (args[5] * (1.0 + float(delta)),) \
+                    + args[6:]
+        out2 = fnp(*args2)
+        telemetry.registry().scope("guardian").counter(
+            "sdc_checks").add()
+        health = self._sdc_fold_jit()(out1[3], out2[3], out1[-1])
+        return out1[:-1] + (health,)
+
+    def _sdc_fold_jit(self):
+        """The tiny device comparator folding an SDC probe verdict
+        into the health word (cached like every other program)."""
+        fn = self._jits.get("sdc_fold")
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            grad_names = tuple(self._grad_names)
+
+            def fold(a_params, b_params, health):
+                return _sdc_fold(jnp, a_params, b_params, health,
+                                 grad_names)
+
+            fn = self._jits["sdc_fold"] = jax.jit(
+                fold, out_shardings=(self._repl,) * 4)
+        return fn
 
     def step_update(self, updater, num_device=1):
         """Run the pending fwd+bwd AND the optimizer as one XLA program.
@@ -1398,6 +1681,7 @@ class MeshExecutorGroup(object):
         kind = "train_step:%s:%d" % (type(opt).__name__, token)
         if self._metric_stat is not None:
             kind += ":m%d" % self._metric_token
+        kind += self._health_kind_tag()
         fn = self._get_jit(kind)
         params = {n: b._read() for n, b in self._param_dict.items()}
         # pre-forward aux snapshot (same contract as _run_fwd_bwd): if the
@@ -1407,36 +1691,16 @@ class MeshExecutorGroup(object):
             else {n: b._read() for n, b in self._aux_dict.items()}
         args = (params, aux, tuple(states), inputs, rng,
                 np.asarray(lrs, np.float32), np.asarray(wds, np.float32))
-        if self._metric_stat is not None:
-            if self._metric_acc is None:
-                self._metric_acc = (
-                    jax.device_put(onp.zeros(self._metric_slots,
-                                             onp.float32), self._repl),
-                    jax.device_put(onp.zeros(self._metric_slots,
-                                             onp.int32), self._repl))
-            args = args + (self._metric_acc,)
-        ls = self._ls_current()
-        if ls is not None:
-            args = args + (ls,)
+        args = args + self._step_extras()
         # aval skeleton for diagnostics (bench cost analysis) — the real
         # buffers are donated below and unusable afterwards
         from ..telemetry import aval_skeleton
         self._last_step = (fn, aval_skeleton(args))
         self._note_program(kind, fn, args)
         self._note_optimizer_analytic(states, triples)
-        if self._metric_stat is not None and ls is not None:
-            (outs, new_aux, grads, new_params, new_states,
-             self._metric_acc, self._ls_state) = fn(*args)
-            self._metric_step_done = True
-        elif self._metric_stat is not None:
-            (outs, new_aux, grads, new_params, new_states,
-             self._metric_acc) = fn(*args)
-            self._metric_step_done = True
-        elif ls is not None:
-            (outs, new_aux, grads, new_params, new_states,
-             self._ls_state) = fn(*args)
-        else:
-            outs, new_aux, grads, new_params, new_states = fn(*args)
+        out = self._launch_step_program(kind, fn, args)
+        outs, new_aux, grads, new_params, new_states = \
+            self._commit_step_extras(out)
         self._write_outs(outs)
         self._write_aux(new_aux)
         for n, g in grads.items():
@@ -1515,38 +1779,19 @@ class MeshExecutorGroup(object):
         kind = "train_step_grouped:%s:%d" % (type(opt).__name__, token)
         if self._metric_stat is not None:
             kind += ":m%d" % self._metric_token
+        kind += self._health_kind_tag()
         fn = self._get_jit(kind)
         params = {n: b._read() for n, b in self._param_dict.items()}
         aux = {n: b._read() for n, b in self._aux_dict.items()}
         rng = _random.next_key() if self._needs_rng else \
             onp.zeros((2,), onp.uint32)
         args = (params, aux, tuple(states), inputs, rng, lrs, wds)
-        if self._metric_stat is not None:
-            if self._metric_acc is None:
-                self._metric_acc = (
-                    jax.device_put(onp.zeros(self._metric_slots,
-                                             onp.float32), self._repl),
-                    jax.device_put(onp.zeros(self._metric_slots,
-                                             onp.int32), self._repl))
-            args = args + (self._metric_acc,)
-        ls = self._ls_current()
-        if ls is not None:
-            args = args + (ls,)
+        args = args + self._step_extras()
         self._note_program(kind, fn, args, extra={"batch_group": K})
         self._note_optimizer_analytic(states, triples)
-        if self._metric_stat is not None and ls is not None:
-            (outs, new_aux, grads, new_params, new_states,
-             self._metric_acc, self._ls_state) = fn(*args)
-            self._metric_step_done = True
-        elif self._metric_stat is not None:
-            (outs, new_aux, grads, new_params, new_states,
-             self._metric_acc) = fn(*args)
-            self._metric_step_done = True
-        elif ls is not None:
-            (outs, new_aux, grads, new_params, new_states,
-             self._ls_state) = fn(*args)
-        else:
-            outs, new_aux, grads, new_params, new_states = fn(*args)
+        out = self._launch_step_program(kind, fn, args)
+        outs, new_aux, grads, new_params, new_states = \
+            self._commit_step_extras(out)
         self._write_outs(outs)
         self._write_aux(new_aux)
         for n, g in grads.items():

@@ -29,7 +29,9 @@ incident per distinct regression.
   - ``eval_step_ms`` — the eval/score loop's records (``loop="eval"``),
     so a served/eval regression trips the same wire;
   - ``compile.post_warmup_retraces`` — any value > 0 is an incident;
-  - ``dist.straggler_ratio`` — a straggling host past the threshold.
+  - ``dist.straggler_ratio`` — a straggling host past the threshold;
+  - ``precision.scale_skips`` — a loss-scaler skip storm (more than
+    ``scale_skip_threshold`` skipped updates between two polls).
 
 * **Incidents** — at most ONE per poll (the highest-priority new
   finding; co-occurring signals ride in its ``also`` list) and at most
@@ -60,7 +62,7 @@ __all__ = ["RegressionWatchdog"]
 _PRIORITY = ("compile.post_warmup_retraces", "step_total_ms", "step_ms",
              "host_wait_fraction", "train.mfu",
              "train.achieved_hbm_gbps", "eval_step_ms",
-             "dist.straggler_ratio")
+             "dist.straggler_ratio", "precision.scale_skips")
 
 
 def _median(vals):
@@ -88,6 +90,11 @@ class RegressionWatchdog(object):
     straggler_threshold : float
         ``dist.straggler_ratio`` (max/mean host clock) above this is an
         incident on its own (no baseline needed).
+    scale_skip_threshold : int
+        Loss-scaler skipped updates accumulated BETWEEN two polls
+        above this is an incident (a pathological skip storm — the
+        scaler halving forever on persistently non-finite grads —
+        used to be invisible). Absolute judge, no baseline needed.
     min_samples : int
         A window with fewer records than this is skipped, not judged.
     """
@@ -95,7 +102,8 @@ class RegressionWatchdog(object):
     def __init__(self, registry=None, timeline=None, tolerance=1.0,
                  mfu_tolerance=0.5, min_delta_ms=5.0,
                  host_wait_margin=0.3, straggler_threshold=2.0,
-                 min_samples=3, max_incidents=64, logger=None):
+                 scale_skip_threshold=8, min_samples=3,
+                 max_incidents=64, logger=None):
         if registry is None or timeline is None:
             import mxnet_tpu.telemetry as _tel
             registry = registry or _tel.registry()
@@ -107,6 +115,8 @@ class RegressionWatchdog(object):
         self.min_delta_ms = float(min_delta_ms)
         self.host_wait_margin = float(host_wait_margin)
         self.straggler_threshold = float(straggler_threshold)
+        self.scale_skip_threshold = int(scale_skip_threshold)
+        self._scale_skips_seen = None   # gauge value at the last poll
         self.min_samples = int(min_samples)
         self.logger = logger or logging.getLogger("mxnet_tpu.telemetry")
         self._lock = threading.Lock()
@@ -282,6 +292,21 @@ class RegressionWatchdog(object):
             found["dist.straggler_ratio"] = {
                 "value": round(float(strag), 4), "baseline": None,
                 "threshold": self.straggler_threshold}
+        # loss-scaler skip storm: judge the DELTA between polls of the
+        # precision.scale_skips gauge fit publishes at each epoch
+        # boundary — occasional overflow skips are the scaler working,
+        # a burst above the threshold per poll window is pathology.
+        # The FIRST observation only calibrates (warmup's intentional
+        # init-scale halving skips are not a storm), and the marker
+        # always tracks the gauge so a later fit's smaller cumulative
+        # value re-calibrates instead of masking its real storms
+        skips = self._registry.gauge("precision.scale_skips").value or 0
+        prev, self._scale_skips_seen = self._scale_skips_seen, skips
+        if prev is not None and \
+                skips - prev > self.scale_skip_threshold:
+            found["precision.scale_skips"] = {
+                "value": int(skips), "baseline": int(prev),
+                "threshold": self.scale_skip_threshold}
         return found
 
     # -- polling --------------------------------------------------------

@@ -52,6 +52,14 @@ CASES = [
      ["--num-epochs", "1", "--seed", "7", "--prefetch-device", "2",
       "--fault-plan",
       "data.device_put:transient@nth=5;data.stager:transient@nth=9"]),
+    # training guardian (mxnet_tpu.guardian): a planned NaN batch
+    # mid-train is detected by the device health sentinel, healed by
+    # rollback-and-skip, and the run completes; the script asserts the
+    # rollback actually happened (the bitwise parity contract runs in
+    # ci.sh / tests/test_guardian.py)
+    ("image-classification/train_cifar10.py",
+     ["--num-epochs", "2", "--seed", "11", "--guardian",
+      "--fault-plan", "module.step:grad_nonfinite@epoch=1,nbatch=3"]),
     ("neural-style/neural_style.py", ["--iters", "200"]),
     ("warpctc/ctc_train.py", ["--num-epoch", "10"]),
     ("bayesian-methods/sgld.py",

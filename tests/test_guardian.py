@@ -1,0 +1,461 @@
+"""mxnet_tpu.guardian — the training guardian's contracts.
+
+* **guardian-off bitwise no-op** — ``fit(guardian=None)`` digests
+  bitwise-equal to an armed-clean run AND an armed-with-SDC-probe run,
+  all with zero post-warmup retraces under CompileWatch (the sentinel
+  reads values the step already computes; the probe's canonical launch
+  is the committed one).
+* **rollback-and-skip bitwise parity** — a planned
+  ``grad_nonfinite``/``loss_spike`` fault mid-fit rolls back to the
+  newest verifiable pre-poison state and finishes with params
+  bitwise-equal to a clean run trained on the same stream with the
+  poisoned batch excluded (the acceptance gate).
+* the restore walk is value-verified: a ``param_bitflip`` read-path
+  SDC on the newest entry falls back to an older clean one and the
+  parity contract still holds;
+* the SDC parity probe convicts a perturbed second launch and the
+  rollback heals it; escalation is bounded and terminal.
+"""
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, guardian, telemetry
+from mxnet_tpu.guardian import (Guardian, UnrecoverableNumericError,
+                                Verdict, spike_judge)
+from mxnet_tpu.io import DataIter
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.disarm()
+    telemetry.disable()
+
+
+rng = np.random.RandomState(0)
+X = rng.rand(256, 16).astype(np.float32)
+y = rng.randint(0, 10, 256).astype(np.float32)
+
+
+def _make_mod():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    return mx.mod.Module(net)
+
+
+def _iter():
+    return mx.io.NDArrayIter(X, y, batch_size=32,
+                             label_name="softmax_label")
+
+
+class SkippingIter(DataIter):
+    """The wrapped stream with given (epoch, nbatch) coordinates
+    dropped — the clean-reference spelling of rollback-and-skip."""
+
+    def __init__(self, source, skips):
+        super().__init__()
+        self.source = source
+        self.skips = set(skips)
+        self.epoch = 0
+        self.nbatch = -1
+
+    @property
+    def provide_data(self):
+        return self.source.provide_data
+
+    @property
+    def provide_label(self):
+        return self.source.provide_label
+
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)
+        if hasattr(self.source, "set_epoch"):
+            self.source.set_epoch(epoch)
+
+    def reset(self):
+        self.nbatch = -1
+        self.source.reset()
+
+    def next(self):
+        while True:
+            batch = self.source.next()
+            self.nbatch += 1
+            if (self.epoch, self.nbatch) not in self.skips:
+                return batch
+
+
+def _digest(mod):
+    h = hashlib.sha256()
+    args, auxs = mod.get_params()
+    for k in sorted(args):
+        h.update(args[k].asnumpy().tobytes())
+    for k in sorted(auxs):
+        h.update(auxs[k].asnumpy().tobytes())
+    return h.hexdigest()
+
+
+def _fit(mod, data, g=None, num_epoch=3, batch_group=None,
+         epoch_end_callback=None):
+    mx.random.seed(5)
+    np.random.seed(5)
+    mod.fit(data, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), guardian=g,
+            batch_group=batch_group,
+            epoch_end_callback=epoch_end_callback)
+
+
+# ----------------------------------------------------------- units
+def test_ls_step_counts_skips():
+    """The loss-scale triple's third element counts skipped updates
+    (the precision.scale_skips witness); the (scale, good) transition
+    is untouched."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.module.mesh_executor_group import _ls_step
+
+    cfg = {"window": 2, "scale_max": 2.0 ** 24, "scale_min": 1.0}
+    ls = (jnp.float32(1024.0), jnp.int32(0), jnp.int32(0))
+    ls = _ls_step(jnp, cfg, ls, jnp.asarray(True))
+    assert float(ls[0]) == 1024.0 and int(ls[2]) == 0
+    ls = _ls_step(jnp, cfg, ls, jnp.asarray(False))
+    assert float(ls[0]) == 512.0 and int(ls[2]) == 1
+    ls = _ls_step(jnp, cfg, ls, jnp.asarray(False))
+    assert int(ls[2]) == 2
+
+
+def test_spike_judge_causal_and_one_sided():
+    healthy = [(i, 2.0 + 0.05 * (i % 3)) for i in range(10)]
+    assert spike_judge(healthy, threshold=8) is None
+    # a spike poisons its aftermath: the whole-window median would
+    # absorb it, the causal judge convicts the ONSET
+    spiked = healthy + [(10, 14.0), (11, 11.0), (12, 12.0)]
+    hit = spike_judge(spiked, threshold=8)
+    assert hit is not None and hit[0] == 10 and hit[1] == 14.0
+    # one-sided: a loss CLIFF downward (schedule change) never convicts
+    cliff = healthy + [(10, 0.2), (11, 0.21)]
+    assert spike_judge(cliff, threshold=8) is None
+    # below min_samples nothing is judged; a prior baseline fixes that
+    short = [(0, 2.0), (1, 2.1), (2, 50.0)]
+    assert spike_judge(short, threshold=8, min_samples=8) is None
+    assert spike_judge(short, threshold=8, min_samples=8,
+                       prior=[2.0] * 8)[0] == 2
+    # non-finite values are the sentinels' business, not the judge's
+    assert spike_judge([(0, float("nan"))] * 12, threshold=8) is None
+
+
+def test_restore_before_and_discard_after(tmp_path):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    for step, epoch in ((1, 0), (2, 1), (3, 2)):
+        mgr.save(step, {"w": np.full((4,), float(step), np.float32)},
+                 extra={"epoch": epoch}, async_save=False)
+
+    def before_epoch2(_step, extra):
+        return (extra["epoch"] + 1, -1) < (2, 0)
+
+    ckpt = mgr.restore_before(before_epoch2)
+    assert ckpt.step == 2
+    # a value-level verify rejection walks further back
+    ckpt = mgr.restore_before(before_epoch2,
+                              verify=lambda c: "too new"
+                              if c.step == 2 else None)
+    assert ckpt.step == 1
+    with pytest.raises(MXNetError, match="precedes the requested"):
+        mgr.restore_before(lambda s, e: False)
+    assert mgr.discard_after(1) == [2, 3]
+    assert mgr.all_steps() == [1]
+
+
+def test_escalation_is_bounded_and_repeat_coordinate_terminal(tmp_path):
+    g = Guardian(str(tmp_path), max_rollbacks=0)
+    v = Verdict(kind="nonfinite", epoch=0, nbatch=1, flags=2, detail={})
+    with pytest.raises(UnrecoverableNumericError, match="budget"):
+        g.rollback(None, v)
+    g2 = Guardian(str(tmp_path))
+    g2.skips.add((0, 1))
+    with pytest.raises(UnrecoverableNumericError, match="state"):
+        g2.rollback(None, v)
+
+
+def test_resolve_env_knobs(tmp_path, monkeypatch):
+    assert guardian.resolve(None) is None
+    monkeypatch.setenv("MXNET_GUARDIAN", "1")
+    assert guardian.resolve(None) is None     # no dir -> warn + off
+    monkeypatch.setenv("MXNET_GUARDIAN_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_GUARDIAN_SPIKE_WINDOW", "16")
+    monkeypatch.setenv("MXNET_GUARDIAN_SPIKE_THRESHOLD", "5")
+    monkeypatch.setenv("MXNET_GUARDIAN_MAX_ROLLBACKS", "2")
+    monkeypatch.setenv("MXNET_GUARDIAN_SDC_PERIOD", "7")
+    g = guardian.resolve(None)
+    assert g is not None and g.spike_window == 16
+    assert g.spike_threshold == 5.0 and g.max_rollbacks == 2
+    assert g.sdc_probe_period == 7
+    assert guardian.resolve(g) is g
+
+
+# ---------------------------------------------- off == armed bitwise
+def test_guardian_off_and_armed_clean_bitwise(tmp_path):
+    """fit(guardian=None) == armed-clean == armed-with-probe, bit for
+    bit, with ZERO post-warmup retraces — arming the guardian must
+    never change what a healthy run trains."""
+    telemetry.enable()
+    retr = telemetry.registry().counter("compile.post_warmup_retraces")
+    before = retr.value
+    m0 = _make_mod()
+    _fit(m0, _iter())
+    d_off = _digest(m0)
+    g1 = Guardian(str(tmp_path / "a"))
+    m1 = _make_mod()
+    _fit(m1, _iter(), g1)
+    g2 = Guardian(str(tmp_path / "b"), sdc_probe_period=3)
+    m2 = _make_mod()
+    _fit(m2, _iter(), g2)
+    assert retr.value == before        # zero post-warmup retraces
+    assert g1.rollbacks == 0 and g2.rollbacks == 0
+    assert g2.stats()["sdc_checks"] > 0
+    assert g2.stats()["sdc_mismatches"] == 0
+    assert d_off == _digest(m1) == _digest(m2)
+
+
+# ------------------------------------------- rollback-and-skip parity
+def test_grad_nonfinite_rollback_bitwise_parity(tmp_path):
+    """THE acceptance gate: a planned NaN batch mid-fit -> guardian
+    rollback-and-skip -> final params bitwise-equal to a clean run on
+    the same stream with that batch excluded; the rollback leaves a
+    guardian_rollback flight event and zero post-warmup retraces."""
+    telemetry.enable()
+    telemetry.flight_recorder().clear()
+    retr = telemetry.registry().counter("compile.post_warmup_retraces")
+    before = retr.value
+    faults.arm("module.step:grad_nonfinite@epoch=1,nbatch=2", seed=1)
+    g = Guardian(str(tmp_path / "g"))
+    m = _make_mod()
+    _fit(m, _iter(), g)
+    plan = faults.active()
+    assert plan.unfired() == []
+    faults.disarm()
+    assert g.rollbacks == 1 and (1, 2) in g.skips
+    assert retr.value == before
+    events = [e for e in telemetry.flight_recorder().snapshot(
+        "t")["events"] if e["kind"] == "guardian_rollback"]
+    assert len(events) == 1
+    assert events[0]["epoch"] == 1 and events[0]["nbatch"] == 2
+    assert events[0]["verdict_kind"] == "nonfinite"
+    # the offending step's timeline record rides the event
+    assert events[0]["step_record"]["nbatch"] == 2
+
+    ref = _make_mod()
+    _fit(ref, SkippingIter(_iter(), {(1, 2)}),
+         Guardian(str(tmp_path / "r")))
+    assert _digest(m) == _digest(ref)
+
+
+def test_loss_spike_rollback_bitwise_parity(tmp_path):
+    faults.arm("module.step:loss_spike@epoch=2,nbatch=4,value=100000",
+               seed=1)
+    g = Guardian(str(tmp_path / "g"))
+    m = _make_mod()
+    _fit(m, _iter(), g)
+    assert faults.active().unfired() == []
+    faults.disarm()
+    assert g.rollbacks == 1 and (2, 4) in g.skips
+    ref = _make_mod()
+    _fit(ref, SkippingIter(_iter(), {(2, 4)}),
+         Guardian(str(tmp_path / "r")))
+    assert _digest(m) == _digest(ref)
+
+
+def test_param_bitflip_restore_walkback_heals(tmp_path):
+    """A read-path SDC on the newest pre-poison entry (param_bitflip
+    at the restore hand-off): the value-level verify rejects it, the
+    walk falls back to the arm-time baseline, and the parity contract
+    STILL holds."""
+    faults.arm("checkpoint.params:param_bitflip@nth=1;"
+               "module.step:grad_nonfinite@epoch=1,nbatch=2", seed=3)
+    fallbacks = telemetry.registry().counter(
+        "checkpoint.restore_fallbacks")
+    before = fallbacks.value
+    mgr_dir = str(tmp_path / "g")
+    g = Guardian(mgr_dir)
+    m = _make_mod()
+    # an epoch-end checkpoint callback gives the walk a newest entry
+    # to find corrupted
+    cb = mx.callback.module_checkpoint(m, manager=g.manager)
+    _fit(m, _iter(), g, epoch_end_callback=cb)
+    assert faults.active().unfired() == []
+    faults.disarm()
+    assert g.rollbacks == 1
+    assert fallbacks.value > before   # the poisoned read was rejected
+    ref = _make_mod()
+    _fit(ref, SkippingIter(_iter(), {(1, 2)}),
+         Guardian(str(tmp_path / "r")))
+    assert _digest(m) == _digest(ref)
+
+
+def test_sdc_probe_mismatch_triggers_rollback(tmp_path):
+    """An injected divergence between the probe's two launches is
+    detected by the device-side bitwise compare and healed by
+    rollback-and-skip."""
+    faults.arm("guardian.sdc:value@nth=2,value=0.25", seed=2)
+    g = Guardian(str(tmp_path / "g"), sdc_probe_period=3)
+    m = _make_mod()
+    _fit(m, _iter(), g)
+    assert faults.active().unfired() == []
+    faults.disarm()
+    st = g.stats()
+    assert st["sdc_mismatches"] >= 1
+    assert g.rollbacks == 1
+    # the convicted coordinate is the probed step (2nd probe = the
+    # 4th executed step of epoch 0)
+    assert (0, 3) in g.skips
+    ref = _make_mod()
+    _fit(ref, SkippingIter(_iter(), {(0, 3)}),
+         Guardian(str(tmp_path / "r"), sdc_probe_period=3))
+    assert _digest(m) == _digest(ref)
+
+
+def test_long_epoch_window_poll_convicts_early_spike(tmp_path):
+    """An epoch much longer than the spike window: the window-boundary
+    poll judges each full ring in place, so an early spike is
+    convicted at its TRUE coordinate instead of scrolling out of the
+    ring by the epoch boundary (and the parity contract holds)."""
+    def it8():
+        return mx.io.NDArrayIter(X, y, batch_size=8,
+                                 label_name="softmax_label")
+
+    def fit8(mod, data, g):
+        mx.random.seed(5)
+        np.random.seed(5)
+        mod.fit(data, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1,
+                                  "momentum": 0.9},
+                initializer=mx.initializer.Xavier(), guardian=g)
+
+    faults.arm("module.step:loss_spike@epoch=1,nbatch=3,value=100000",
+               seed=1)
+    g = Guardian(str(tmp_path / "g"), spike_window=8)
+    m = _make_mod()
+    fit8(m, it8(), g)       # 32 batches/epoch >> window of 8
+    assert faults.active().unfired() == []
+    faults.disarm()
+    assert g.rollbacks == 1 and (1, 3) in g.skips
+    ref = _make_mod()
+    fit8(ref, SkippingIter(it8(), {(1, 3)}),
+         Guardian(str(tmp_path / "r"), spike_window=8))
+    assert _digest(m) == _digest(ref)
+
+
+def test_max_rollbacks_escalates_from_fit(tmp_path):
+    faults.arm("module.step:grad_nonfinite@epoch=0,nbatch=1", seed=1)
+    g = Guardian(str(tmp_path), max_rollbacks=0)
+    m = _make_mod()
+    with pytest.raises(UnrecoverableNumericError, match="budget"):
+        _fit(m, _iter(), g)
+    faults.disarm()
+
+
+def test_grouped_fit_guardian_parity(tmp_path):
+    """The health word rides the grouped scan carry: armed-clean ==
+    off (grouped vs grouped), and rollback-and-skip keeps bitwise
+    parity with the skipped-stream reference (the delivered-batch
+    sequence re-tiles into the same groups on both sides)."""
+    m0 = _make_mod()
+    _fit(m0, _iter(), num_epoch=2, batch_group=4)
+    d_off = _digest(m0)
+    m1 = _make_mod()
+    g1 = Guardian(str(tmp_path / "a"))
+    _fit(m1, _iter(), g1, num_epoch=2, batch_group=4)
+    assert g1.rollbacks == 0
+    assert _digest(m1) == d_off
+    faults.arm("module.step:grad_nonfinite@epoch=1,nbatch=2", seed=1)
+    g2 = Guardian(str(tmp_path / "b"))
+    m2 = _make_mod()
+    _fit(m2, _iter(), g2, num_epoch=2, batch_group=4)
+    faults.disarm()
+    assert g2.rollbacks == 1 and (1, 2) in g2.skips
+    ref = _make_mod()
+    _fit(ref, SkippingIter(_iter(), {(1, 2)}),
+         Guardian(str(tmp_path / "c")), num_epoch=2, batch_group=4)
+    assert _digest(m2) == _digest(ref)
+
+
+def test_elastic_transcript_guardian_field(tmp_path):
+    """Restart-transcript entries attribute recovery to the guardian
+    (rollback/skip/SDC counts per attempt), mirroring the
+    health_incidents plumbing."""
+    from mxnet_tpu import dist
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    def module_factory(world):
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return mx.mod.Module(net, context=world.contexts())
+
+    def data_factory(world):
+        return world.feed(mx.io.NDArrayIter(
+            X, y, batch_size=32, label_name="softmax_label"))
+
+    faults.arm("module.step:grad_nonfinite@epoch=1,nbatch=1", seed=1)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cluster = dist.VirtualCluster(4)
+    mx.random.seed(3)
+    np.random.seed(3)
+    tr = dist.ElasticTrainer(cluster, module_factory, data_factory,
+                             mgr, checkpoint_every_steps=4)
+    skips_c = telemetry.registry().scope("guardian").counter(
+        "tainted_commit_skips")
+    skips_before = skips_c.value
+    mod = tr.fit(num_epoch=2, optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1},
+                 initializer=mx.initializer.Xavier(),
+                 guardian=Guardian(mgr))
+    faults.disarm()
+    assert [e["event"] for e in tr.transcript] == ["finished"]
+    ge = tr.transcript[0]["guardian"]
+    assert ge["rollbacks"] == 1
+    assert ge["skipped"] == [(1, 1)] or ge["skipped"] == [[1, 1]]
+    # one batch excluded: 2 epochs x 8 batches - 1
+    assert mod._optimizer.num_update == 15
+    # the commit-boundary poll refused to persist poisoned state (the
+    # mid-epoch crossing between the NaN step and the epoch-end
+    # verdict), and every committed entry that remains is finite
+    assert skips_c.value > skips_before
+    for s in mgr.all_steps():
+        ckpt = mgr.restore(s)
+        for name, arr in ckpt.params.items():
+            assert np.isfinite(arr).all(), (s, name)
+
+
+def test_watchdog_scale_skip_storm_incident():
+    from mxnet_tpu.telemetry.health import RegressionWatchdog
+    from mxnet_tpu.telemetry.registry import MetricsRegistry
+    from mxnet_tpu.telemetry.timeline import StepTimeline
+
+    reg = MetricsRegistry()
+    wd = RegressionWatchdog(registry=reg, timeline=StepTimeline(),
+                            scale_skip_threshold=8)
+    wd.arm()
+    # the FIRST observation calibrates, never fires — warmup's
+    # intentional init-scale halving skips are not a storm
+    reg.gauge("precision.scale_skips").set(20)
+    assert wd.poll() == []
+    reg.gauge("precision.scale_skips").set(25)
+    assert wd.poll() == []            # +5 is the scaler working
+    reg.gauge("precision.scale_skips").set(60)
+    incidents = wd.poll()             # +35 between polls is a storm
+    assert len(incidents) == 1
+    assert incidents[0]["gauge"] == "precision.scale_skips"
+    # warn-once: the same storm does not re-fire
+    reg.gauge("precision.scale_skips").set(600)
+    assert wd.poll() == []
