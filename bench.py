@@ -460,6 +460,18 @@ def main():
             import shutil
             shutil.rmtree(pipe_tmp, ignore_errors=True)
         extra.update(_pipeline_verdict(extra))
+
+    if os.environ.get("BENCH_SHARDED_CACHE", "1") != "0":
+        # pod-sharded dataset cache (mxnet_tpu.data.ShardedCachedDataset):
+        # per-tier gather feed rates over the local devices partitioned
+        # into virtual hosts — the per-batch transfer on the hbm tier is
+        # a (B,) int32 index; the host tier pays the staged rows back.
+        # Off in the CPU contract smoke (its own gather/augment compiles
+        # would eat the tier-1 budget).
+        try:
+            extra.update(_bench_sharded_cache(mx, batch, extra))
+        except Exception as e:
+            extra["sharded_cache_error"] = str(e)[:160]
     _emit(img_per_sec, extra)
 
 
@@ -989,6 +1001,106 @@ def _io_iter_opts():
     # the bench defaults to the host-assemble path here
     dev_aug = os.environ.get("BENCH_IO_DEVICE_AUG", "0") != "0"
     return threads, procs, dev_aug
+
+
+def _bench_sharded_cache(mx, step_batch, seen_extra=None):
+    """Pod-sharded dataset cache feed rates, one field per tier.
+
+    Builds a synthetic u8 epoch over the local devices partitioned
+    into virtual hosts (the CPU-CI harness IS the measurement rig —
+    on a real pod the same class rides
+    ``make_array_from_process_local_data`` per process) and times the
+    epoch->=2 serve path for each tier:
+
+    * ``sharded_cache_hbm_img_per_sec`` — the dp-sharded device cache,
+      jitted global gather, (B,) int32 index per batch;
+    * ``sharded_cache_host_img_per_sec`` — the spill tier: rows
+      gathered host-side and staged per batch;
+    * ``sharded_cache_single_img_per_sec`` — the single-shard
+      (CachedDataset-equivalent) device gather, for the N-way
+      comparison.
+
+    Also records ``io_cache_tier``/``io_cache_shard_bytes``/
+    ``io_cache_global_rows``/``io_cache_n_shards`` from the resolved
+    hbm run, and fills ``pipeline_device_cached_img_per_sec`` from the
+    single-shard rate when the fed-pipeline stage did not record one
+    (tagged ``io_cache_source`` so the two methodologies are never
+    conflated)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu import dist
+    from mxnet_tpu.data import CachedDataset, ShardedCachedDataset
+
+    n_dev = len(jax.devices())
+    n_hosts = next((h for h in (4, 2, 1)
+                    if h <= n_dev and n_dev % h == 0), 1)
+    side = int(os.environ.get("BENCH_SHARDED_CACHE_SIDE", "64"))
+    rows = 8 * step_batch
+    rng = np.random.RandomState(0)
+    Xu8 = rng.randint(0, 256, (rows, side, side, 3)).astype(np.uint8)
+    y = rng.randint(0, 1000, rows).astype(np.float32)
+
+    def make_iter():
+        return mx.io.NDArrayIter(Xu8, y, batch_size=step_batch,
+                                 label_name="softmax_label")
+
+    def _val(a):
+        return a._read() if hasattr(a, "_read") else a
+
+    def feed_rate(ds, n=16):
+        while True:                 # capture epoch, untimed
+            try:
+                next(ds)
+            except StopIteration:
+                break
+        ds.reset()
+        acc_fn = jax.jit(
+            lambda d, s: s + d.ravel()[0].astype(jnp.float32))
+
+        def next_batch():
+            try:
+                return next(ds)
+            except StopIteration:
+                ds.reset()
+                return next(ds)
+
+        acc = acc_fn(_val(next_batch().data[0]), jnp.float32(0.0))
+        t0 = time.time()
+        for _ in range(n):
+            acc = acc_fn(_val(next_batch().data[0]), acc)
+        float(acc)                  # completion-ordering readback
+        return n * step_batch / (time.time() - t0)
+
+    out = {"io_cache_rows_shape": [rows, side, side, 3]}
+    cluster = dist.VirtualCluster(n_hosts) if n_hosts > 1 else None
+
+    hbm = ShardedCachedDataset(make_iter(), cluster=cluster, tier="hbm")
+    out["sharded_cache_hbm_img_per_sec"] = round(feed_rate(hbm), 2)
+    info = hbm.cache_info()
+    out.update({"io_cache_tier": info["tier"],
+                "io_cache_shard_bytes": info["shard_bytes"],
+                "io_cache_global_rows": info["rows"],
+                "io_cache_n_shards": info["num_shards"]})
+    hbm.close()
+
+    host = ShardedCachedDataset(make_iter(), cluster=cluster,
+                                tier="host")
+    out["sharded_cache_host_img_per_sec"] = round(feed_rate(host), 2)
+    host.close()
+
+    single = CachedDataset(make_iter())
+    rate1 = round(feed_rate(single), 2)
+    single_info = single.cache_info()
+    out["sharded_cache_single_img_per_sec"] = rate1
+    single.close()
+    if not (seen_extra or {}).get("pipeline_device_cached_img_per_sec"):
+        out["pipeline_device_cached_img_per_sec"] = rate1
+        out["io_cache_source"] = "sharded_cache_stage"
+        out["io_cache_placement"] = single_info["placement"]
+        out["io_cache_bytes"] = single_info["bytes"]
+    return out
 
 
 def _bench_pipeline_clean(mx, recs, step_batch, steps, img):

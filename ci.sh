@@ -346,6 +346,20 @@ stage "multi-host dryrun (4 virtual hosts, elastic resume gate)"
 python -c "from __graft_entry__ import dryrun_multihost; dryrun_multihost(8, 4)" \
     || FAILED=1
 
+stage "sharded-cache dryrun (pod-sharded HBM dataset cache gate)"
+# pod-sharded cache contract (docs/api/data.md "Pod-sharded cache"):
+# a dp=4 virtual-host fit through ShardedCachedDataset — each host
+# capturing only its shard_rows block, epochs >= 2 served by the
+# jitted gather over the P('dp') cache pytree — must train BITWISE
+# equal to the single-host CachedDataset fit AND the streaming fit
+# with zero post-warmup retraces; each host's cache bytes must be
+# 1/4 of the single-host capture; the global shuffle order must be
+# dp-width-stable (two shard widths draw the identical order and
+# train to identical params); and one shard forced onto the host
+# spill tier must stay bit-identical. Emits SHARDCACHE_r01.json.
+python -c "from __graft_entry__ import dryrun_sharded_cache; dryrun_sharded_cache(8, 4)" \
+    || FAILED=1
+
 stage "chaos-soak gate (seeded FaultPlan over train + elastic resume + serve)"
 # fault-injection contract (docs/api/faults.md): one seeded FaultPlan —
 # transient transform/commit faults, a straggler delay, a planned

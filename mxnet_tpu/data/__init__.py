@@ -32,6 +32,14 @@ transfer itself.  This package overlaps all three:
   device-side gather (a ``(B,)`` index array is the whole per-batch
   transfer), bit-identical to streaming and budget-gated with a
   graceful host fallback.
+* :class:`ShardedCachedDataset` — the pod-sharded spelling: each host
+  captures only its ``shard_rows`` block, the cache is one global
+  ``P('dp')``-sharded pytree (N x the dataset budget per pod, zero
+  duplicated bytes), spill tiers (HBM -> pinned host -> recordio
+  re-decode) resolve per shard under one budget knob, and the
+  per-epoch global shuffle is a pure function of ``(seed, epoch)``
+  (:func:`global_shuffle_order`) — dp-width-stable across elastic
+  resume.
 
 Batches delivered through the pipeline are BITWISE identical to plain
 iteration, so ``Module.fit(prefetch_to_device=2)`` trains to
@@ -54,11 +62,13 @@ See docs/api/data.md for semantics and the stats field reference.
 from __future__ import annotations
 
 from .augment import DeviceAugment, DeviceAugmentIter, fold_seed
-from .cached import CachedDataset
+from .cached import CachedDataset, global_shuffle_order
 from .loader import DeviceLoader
+from .sharded_cache import ShardedCachedDataset, cache_row_of_pos
 from .stats import PipelineStats
 from .transform import TransformIter
 
 __all__ = ["DeviceLoader", "TransformIter", "PipelineStats",
            "DeviceAugment", "DeviceAugmentIter", "CachedDataset",
-           "fold_seed"]
+           "ShardedCachedDataset", "global_shuffle_order",
+           "cache_row_of_pos", "fold_seed"]

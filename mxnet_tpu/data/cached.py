@@ -40,7 +40,7 @@ from ..io import DataBatch, DataDesc, DataIter
 from .augment import (crop_input_name, fold_seed, mirror_input_name,
                       _placement_default)
 
-__all__ = ["CachedDataset"]
+__all__ = ["CachedDataset", "global_shuffle_order"]
 
 _PLACEMENTS = ("auto", "device", "host", "off")
 
@@ -50,6 +50,22 @@ def _budget_bytes(budget_mb):
         budget_mb = float(os.environ.get("MXNET_DATA_CACHE_BUDGET_MB",
                                          "1024"))
     return int(float(budget_mb) * (1 << 20))
+
+
+def global_shuffle_order(seed, epoch, rows):
+    """THE per-epoch global shuffle rule: a permutation of ``rows``
+    drawn from the ``(seed, epoch)`` coordinate via the TransformIter
+    SplitMix fold — a pure function of the coordinate, shared by
+    :class:`CachedDataset` and
+    :class:`~mxnet_tpu.data.ShardedCachedDataset` so the single-host
+    and pod-sharded caches can NEVER drift on what "epoch e shuffled"
+    means. The dp width (host count, device count) never enters, which
+    is what makes the shuffled GLOBAL order replayable across an
+    elastic resume at a CHANGED dp width: every surviving host re-draws
+    the identical permutation and gathers its new row block of it."""
+    rng = onp.random.RandomState(
+        fold_seed(int(seed) ^ 0x5ca1ab1e, int(epoch), 0))
+    return rng.permutation(int(rows))
 
 
 class CachedDataset(DataIter):
@@ -88,14 +104,21 @@ class CachedDataset(DataIter):
     shuffle : bool
         Re-permute rows every CACHED epoch (capture epoch delivers
         source order).
+    shuffle_from : int
+        First epoch coordinate the shuffle applies to (default 1).
+        Epochs below it deliver CAPTURE order even when served from
+        the cache — so re-entering the capture epoch via
+        ``set_epoch`` (guardian rollback-and-skip, resume) replays
+        exactly the stream the original pass delivered, instead of a
+        permutation the original pass never saw.
     seed : int
         Shuffle-permutation seed.
     """
 
     def __init__(self, data_iter, augment=None, module=None,
                  data_name=None, placement=None, budget_mb=None,
-                 shuffle=False, seed=0, augment_placement=None,
-                 logger=None):
+                 shuffle=False, shuffle_from=1, seed=0,
+                 augment_placement=None, logger=None):
         super().__init__(getattr(data_iter, "batch_size", 0))
         self._iter = data_iter
         self._name = data_name or data_iter.provide_data[0][0]
@@ -130,6 +153,7 @@ class CachedDataset(DataIter):
                              % (_PLACEMENTS, self.placement))
         self._budget = _budget_bytes(budget_mb)
         self.shuffle = bool(shuffle)
+        self.shuffle_from = int(shuffle_from)
         self.seed = int(seed)
         self.logger = logger or logging.getLogger(__name__)
         self.augment_placement = (augment_placement
@@ -263,11 +287,12 @@ class CachedDataset(DataIter):
     # -- delivery -------------------------------------------------------
     def _epoch_order(self):
         n = self._rows
-        if not self.shuffle:
+        if not self.shuffle or self._epoch < self.shuffle_from:
+            # pre-shuffle epochs (the capture epoch, by default) serve
+            # CAPTURE order: a set_epoch replay of the capture epoch
+            # then yields the stream it originally delivered
             return onp.arange(n)
-        rng = onp.random.RandomState(
-            fold_seed(self.seed ^ 0x5ca1ab1e, self._epoch, 0))
-        return rng.permutation(n)
+        return global_shuffle_order(self.seed, self._epoch, n)
 
     def _attach(self, img, labels, pad):
         """One delivered batch: augment params attached (device
@@ -294,6 +319,21 @@ class CachedDataset(DataIter):
                 params.get(mirror_input_name(self._name)), train=True)]
         return DataBatch(data=data, label=labels, pad=pad)
 
+    @staticmethod
+    def _host_batch(batch):
+        """THE host-unwrap rule for a streamed source batch:
+        ``(img, labels, pad)`` as numpy — shared by the capture path,
+        the sharded cache's eager prefill, and the recordio re-stream
+        so the three can never diverge on what bytes a batch holds."""
+        img = batch.data[0]
+        img = img._read() if hasattr(img, "_read") else img
+        img = onp.asarray(img)
+        labels = None
+        if batch.label:
+            labels = [onp.asarray(lb._read() if hasattr(lb, "_read")
+                                  else lb) for lb in batch.label]
+        return img, labels, int(batch.pad or 0)
+
     def next(self):
         if self._cache_ready:
             return self._next_cached()
@@ -302,27 +342,30 @@ class CachedDataset(DataIter):
         except StopIteration:
             self._epoch_complete = True
             raise
-        img = batch.data[0]
-        img = img._read() if hasattr(img, "_read") else img
-        img = onp.asarray(img)
-        labels = None
-        if batch.label:
-            labels = [onp.asarray(lb._read() if hasattr(lb, "_read")
-                                  else lb) for lb in batch.label]
+        img, labels, pad = self._host_batch(batch)
         if self._pending is not None:
-            pad = int(batch.pad or 0)
-            # pad rows are physically present only when the source
-            # wrapped the batch to full size (round-batch semantics);
-            # a SHORT tail (round_batch=False) sets pad but delivers
-            # real rows only — stripping there would lose data
-            keep = img.shape[0] - pad \
-                if pad and img.shape[0] == self.batch_size \
-                else img.shape[0]
-            self._pending.append(
-                (img[:keep].copy(),
-                 None if labels is None else
-                 [lb[:keep].copy() for lb in labels]))
-        return self._attach(img, labels, int(batch.pad or 0))
+            self._capture_batch(img, labels, pad)
+        return self._attach(img, labels, pad)
+
+    def _strip_pad(self, img, labels, pad):
+        """THE real-rows rule for a captured batch: pad rows are
+        physically present only when the source wrapped the batch to
+        full size (round-batch semantics); a SHORT tail
+        (round_batch=False) sets pad but delivers real rows only —
+        stripping there would lose data.  Shared by this class and the
+        sharded capture so the two can never strip different rows."""
+        keep = img.shape[0] - pad \
+            if pad and img.shape[0] == self.batch_size \
+            else img.shape[0]
+        return img[:keep], \
+            None if labels is None else [lb[:keep] for lb in labels]
+
+    def _capture_batch(self, img, labels, pad):
+        """Append one streamed batch's REAL rows to the capture list."""
+        img, labels = self._strip_pad(img, labels, pad)
+        self._pending.append(
+            (img.copy(),
+             None if labels is None else [lb.copy() for lb in labels]))
 
     def _next_cached(self):
         b = self.batch_size
@@ -370,12 +413,21 @@ class CachedDataset(DataIter):
     # -- introspection --------------------------------------------------
     def cache_info(self):
         """Resolved cache state: ``placement`` (None until built),
-        ``rows``, ``bytes``, ``built_epoch``."""
+        ``rows``, ``bytes``, ``built_epoch``, plus the spill-tier
+        spelling shared with :class:`ShardedCachedDataset` (``tier``:
+        ``hbm`` for the device placement, ``host`` for the host-RAM
+        fallback; single shard)."""
+        tier = {"device": "hbm", "host": "host"}.get(
+            self.cache_placement)
         return {
             "placement": self.cache_placement,
             "rows": self._rows,
             "bytes": getattr(self, "cache_bytes", 0),
             "built_epoch": self.cache_built_epoch,
+            "tier": tier,
+            "tiers": [tier] if tier else [],
+            "shard_bytes": getattr(self, "cache_bytes", 0),
+            "shard_rows": self._rows,
         }
 
     def close(self):

@@ -19,7 +19,18 @@ is placed with the group's ``NamedSharding`` (``device_put`` splits the
 host array into per-device shards directly — no host-side concat, no
 intermediate single-device copy), so ``Module.fit``'s own ``_stage``
 becomes a no-op on already-resident arrays and the trained parameters
-stay BITWISE equal to an unprefetched run.  With ``batch_group=K`` the
+stay BITWISE equal to an unprefetched run.
+
+One source class opts OUT of background staging: an iterator whose
+delivery launches a collective device program (``ShardedCachedDataset``
+— its dp-sharded gather all-gathers rows across shards) advertises
+``background_pull_safe = False``, and the loader pulls it on the
+consumer thread instead.  Collectives must enqueue in program order on
+every device; racing the training step's collectives from a stager
+thread interleaves the per-device rendezvous — a deadlock on XLA:CPU
+and a cross-host ordering hazard on a real pod.  Nothing is lost: the
+gather output is already device-resident, so there is no transfer for
+the ring to hide.  With ``batch_group=K`` the
 stager assembles K iterator batches into one contiguous ``(K, B, ...)``
 host block and stages it through the group's shared ``stage_stacked``
 helper — one transfer per K steps, the grouped train program consumes
@@ -155,6 +166,17 @@ class DeviceLoader(DataIter):
         self.device_augment_spec = getattr(data_iter,
                                            "device_augment_spec", None)
 
+        # a source whose delivery launches COLLECTIVE device programs
+        # (ShardedCachedDataset's dp-sharded gather) must be pulled on
+        # the CONSUMER thread: collectives enqueue in program order on
+        # every device, and a background launch racing the training
+        # step's collectives can interleave the per-device rendezvous
+        # (deadlock on XLA:CPU, ordering hazard on a pod).  Such
+        # batches are already device-resident — there is no transfer
+        # for the ring to hide — so the loader degrades to a
+        # pass-through that still keeps the stats wire.
+        self._passthrough = not getattr(data_iter,
+                                        "background_pull_safe", True)
         self._cond = threading.Condition()
         self._ring = []          # staged entries, delivery order
         self._closed = False
@@ -347,6 +369,16 @@ class DeviceLoader(DataIter):
     def _launch_stager(self):
         if self._stager is not None:
             return
+        if not self._passthrough and \
+                not getattr(self._iter, "background_pull_safe", True):
+            # re-evaluated at every (lazy, per-epoch) launch, not just
+            # construction: a sharded cache built against a module that
+            # binds AFTER the loader flips unsafe once its collective
+            # gather exists — a stale construction-time snapshot would
+            # background exactly the launch this protocol serializes
+            self._passthrough = True
+        if self._passthrough:
+            return
         with self._cond:
             epoch = self._live_epoch
         self._stager = threading.Thread(
@@ -382,11 +414,37 @@ class DeviceLoader(DataIter):
             self._pending = []
 
     # -- DataIter surface ----------------------------------------------
+    def _next_passthrough(self):
+        """Consumer-thread pull for collective-gather sources: one
+        batch through the normal staging rule (a no-op device_put for
+        the already-resident gather output), with delivery/staging
+        stats kept so the pipeline wire reads the same."""
+        t0 = time.perf_counter()
+        batch = self._iter.next()       # StopIteration ends the epoch
+        nbytes, dtype = _batch_wire_stats([batch])
+        t1 = time.perf_counter()
+        staged = self._stage_batch(batch)
+        self.pipeline_stats.note_staged(staged.data[0].shape[0],
+                                        time.perf_counter() - t1,
+                                        nbytes, dtype)
+        self.pipeline_stats.note_delivered(staged.data[0].shape[0],
+                                           t1 - t0)
+        return staged
+
     def next(self):
         if self._closed:
             raise MXNetError("DeviceLoader is closed")
+        if self._passthrough:
+            return self._next_passthrough()
         if self._stager is None:
             self._launch_stager()
+            if self._passthrough:
+                # the lazy launch just re-evaluated the source's
+                # background_pull_safe and flipped to pass-through (a
+                # cache finalized with a collective gather since the
+                # last epoch): route there instead of waiting on a
+                # ring no stager will ever fill
+                return self._next_passthrough()
         if self._pending:
             batch = self._pending.pop(0)
             self.pipeline_stats.note_delivered(batch.data[0].shape[0],
@@ -445,6 +503,23 @@ class DeviceLoader(DataIter):
     def getindex(self):
         return self._current.index
 
+    def _note_cache_stats(self):
+        """Forward the source dataset-cache's resolved tier/bytes into
+        the pipeline stats (once it finalizes) — the watchdog and
+        bench then read the same wire the cache resolved."""
+        info_fn = getattr(self._iter, "cache_info", None)
+        if info_fn is None:
+            return
+        try:
+            info = info_fn()
+        except Exception:  # noqa: BLE001 — attribution, never delivery
+            return
+        if info.get("tier"):
+            self.pipeline_stats.note_cache(
+                info["tier"],
+                info.get("shard_bytes", info.get("bytes", 0)),
+                info.get("rows", 0))
+
     def reset(self):
         """Rewind for a fresh epoch: cancel+join the stager and reset
         the source; the stager restarts lazily on the next ``next()``,
@@ -453,6 +528,9 @@ class DeviceLoader(DataIter):
         if self._closed:
             raise MXNetError("DeviceLoader is closed")
         self._start_epoch(reset_source=True)
+        # a CachedDataset/ShardedCachedDataset source finalizes its
+        # cache inside its reset(): pick up the resolved tier now
+        self._note_cache_stats()
 
     def set_epoch(self, epoch):
         """Forward ``fit``'s epoch-coordinate pin to the source (the
@@ -466,6 +544,7 @@ class DeviceLoader(DataIter):
         fwd = getattr(self._iter, "set_epoch", None)
         if fwd is None:
             return
+        self._note_cache_stats()
         coord = getattr(self._iter, "epoch_coord", None)
         if coord is None:
             # coordinate-less wrapper (e.g. a PrefetchingIter over

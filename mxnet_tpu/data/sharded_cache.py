@@ -1,0 +1,632 @@
+"""ShardedCachedDataset — the pod-sharded HBM dataset cache.
+
+PR 9's :class:`CachedDataset` is single-host: at dp=N every host
+captures the WHOLE decoded epoch — N x duplicated bytes, and the
+per-pod dataset budget is capped at one host's HBM.  This class shards
+the capture across the pod with the :func:`~mxnet_tpu.dist.shard_rows`
+rule (each host keeps only its row block of every streamed batch), and
+the resident cache becomes ONE global ``(N, ...)`` u8 pytree with a
+``P('dp')`` row spec — the SNIPPETS.md GSPMD pattern: the cache is
+just another sharded array, the epoch->=2 gather is a jitted program
+over it, and the per-batch transfer stays a ``(B,)`` int32 index.
+N x the dataset budget per pod, zero duplicated bytes.
+
+**Spill tiers** — each shard resolves its residency at finalize under
+one budget ladder (``MXNET_DATA_CACHE_BUDGET_MB`` ->
+``MXNET_DATA_CACHE_HOST_BUDGET_MB`` -> nothing):
+
+* ``hbm`` — the shard lives in the dp-sharded device cache; gather is
+  in-program (cross-shard rows move over ICI inside the compiled
+  program, never through the host).
+* ``host`` — the shard spills to host RAM.  Spill is COORDINATED: one
+  spilled shard moves the whole cache onto the host-assembled path
+  (per-batch rows gathered host-side and staged through the normal
+  batch staging rule), because a half-resident cache cannot be one
+  gather program without holding the spilled rows in HBM — the very
+  thing the spill avoided.  Where the runtime supports memory kinds
+  (TPU), the host block is placed in ``pinned_host`` memory and the
+  SAME jitted gather reads it directly; elsewhere it degrades to a
+  numpy gather + stage.  Per-shard resolved tiers are still recorded
+  individually (telemetry + ``cache_info()``).
+* ``recordio`` — nothing is retained; every epoch re-streams
+  (re-decodes) the source.  Global shuffle is unavailable on this
+  tier (a streaming source has no random access) — requesting it
+  warns once and delivery degrades to capture order.
+
+**dp-stable global shuffle** — the per-epoch order is
+:func:`~mxnet_tpu.data.global_shuffle_order`, a pure function of the
+``(seed, epoch)`` coordinate (SplitMix fold, the ``TransformIter``
+discipline).  The dp width never enters the draw, so an elastic resume
+at a CHANGED width (dp=8 -> dp=4) re-draws the IDENTICAL global sample
+order and each survivor simply gathers its new row block of it —
+pinned bitwise by tests/test_dist_elastic.py and the ci.sh
+sharded-cache gate.  Shuffled epochs must be fully resident before
+batch 0, so a cache built at epoch >= ``shuffle_from`` ingests the
+source EAGERLY (one untimed prefill pass) instead of streaming the
+capture epoch; epochs below ``shuffle_from`` deliver capture order and
+keep PR 9's stream-while-capturing overlap.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+from ..base import MXNetError
+from .cached import CachedDataset, _budget_bytes, global_shuffle_order
+
+__all__ = ["ShardedCachedDataset", "cache_row_of_pos"]
+
+_TIERS = ("auto", "hbm", "host", "recordio")
+_TIER_RANK = {"hbm": 0, "host": 1, "recordio": 2}
+
+
+def cache_row_of_pos(counts, num_shards, rows_per_shard_padded=None):
+    """Map global STREAM position -> cache row for the sharded layout.
+
+    The cache's global row order is host-major: shard h's block is the
+    concatenation, over capture batches k, of batch k's h-th contiguous
+    row sub-block (the ``shard_rows`` rule).  A sample at stream
+    position ``p`` (batch k, within-batch offset o) therefore sits at
+    cache row ``h * rows_per_shard_padded + cum_m[k] + (o % m_k)`` with
+    ``h = o // m_k`` and ``m_k = counts[k] / num_shards``.  Pure
+    arithmetic over the per-batch row counts — every host computes the
+    identical mapping, which is what lets a replicated ``(B,)`` index
+    drive the sharded gather.
+    """
+    counts = [int(c) for c in counts]
+    R = int(num_shards)
+    total = sum(counts)
+    for k, c in enumerate(counts):
+        if c % R:
+            raise MXNetError(
+                "captured batch %d has %d rows, not divisible over %d "
+                "shards (the shard_rows rule)" % (k, c, R))
+    rps = total // R
+    rps_pad = int(rows_per_shard_padded) if rows_per_shard_padded \
+        else rps
+    row_of_pos = onp.empty(total, onp.int64)
+    base = cum = 0
+    for c in counts:
+        m = c // R
+        o = onp.arange(c)
+        row_of_pos[base:base + c] = \
+            (o // m) * rps_pad + cum + (o % m)
+        base += c
+        cum += m
+    return row_of_pos
+
+
+class ShardedCachedDataset(CachedDataset):
+    """Pod-sharded epoch cache over a fixed-order global-batch source.
+
+    Parameters (beyond :class:`CachedDataset`'s)
+    --------------------------------------------
+    cluster : VirtualCluster, optional
+        Virtual-host mode (the CPU-CI harness): one process simulates
+        ``cluster.n_hosts`` hosts — each host's shard is captured and
+        accounted separately, and the hbm cache is assembled with
+        :func:`~mxnet_tpu.dist.staging.assemble_host_slices` (the
+        per-process placement of the real pod, driven from one
+        process).  Without a cluster: single-shard when the process is
+        alone, or one-shard-per-process under a real multi-process
+        runtime (the cache block rides
+        ``jax.make_array_from_process_local_data`` like every other
+        staged input).
+    budget_mb : float or sequence, optional
+        Per-shard HBM budget (``MXNET_DATA_CACHE_BUDGET_MB``); a
+        sequence gives each shard its own budget (the spill-tier
+        tests force one virtual host onto the host tier this way).
+    host_budget_mb : float or sequence, optional
+        Per-shard host-RAM budget for the spill tier
+        (``MXNET_DATA_CACHE_HOST_BUDGET_MB``, default 16384); a shard
+        over it resolves ``recordio``.
+    tier : str, optional
+        Force ``hbm`` / ``host`` / ``recordio`` for every shard
+        (``MXNET_DATA_CACHE_TIER``, default ``auto``).
+    """
+
+    def __init__(self, data_iter, cluster=None, augment=None,
+                 module=None, data_name=None, budget_mb=None,
+                 host_budget_mb=None, tier=None, shuffle=False,
+                 shuffle_from=1, seed=0, augment_placement=None,
+                 logger=None):
+        super().__init__(
+            data_iter, augment=augment, module=module,
+            data_name=data_name, placement="auto", budget_mb=budget_mb
+            if not isinstance(budget_mb, (list, tuple)) else None,
+            shuffle=shuffle, shuffle_from=shuffle_from, seed=seed,
+            augment_placement=augment_placement, logger=logger)
+        self._cluster = cluster
+        self.rank = 0
+        if cluster is not None:
+            self.num_shards = int(cluster.n_hosts)
+            self._virtual = True
+        else:
+            import jax
+            self._virtual = False
+            if jax.process_count() > 1:
+                from ..dist.runtime import get_runtime
+                rt = get_runtime()
+                self.rank, self.num_shards = rt.rank, rt.size
+            else:
+                self.num_shards = 1
+        self._dev_budgets = self._per_shard(
+            budget_mb, _budget_bytes, "budget_mb")
+        self._host_budgets = self._per_shard(
+            host_budget_mb,
+            lambda v: int(float(
+                v if v is not None else os.environ.get(
+                    "MXNET_DATA_CACHE_HOST_BUDGET_MB", "16384"))
+                * (1 << 20)),
+            "host_budget_mb")
+        self.tier = (tier or os.environ.get("MXNET_DATA_CACHE_TIER")
+                     or "auto")
+        if self.tier not in _TIERS:
+            raise MXNetError("tier must be one of %r (got %r)"
+                             % (_TIERS, self.tier))
+        # resolved at finalize
+        self._serving_tier = None
+        self._shard_tiers = None
+        self._dev_cache = None      # tuple of dp-sharded device leaves
+        self._host_cache = None     # list of host (N_pad, ...) leaves
+        self._counts = None
+        self._cap_counts = []       # global per-batch row counts
+        self._cap_row_nbytes = None
+        self._row_of_pos = None
+        self._rows_per_shard = 0
+        self._rows_per_shard_pad = 0
+        self.cache_shard_bytes = 0
+        self.cache_pinned = False
+
+    def _per_shard(self, value, to_bytes, name):
+        if isinstance(value, (list, tuple)):
+            if len(value) != self.num_shards:
+                raise MXNetError(
+                    "%s has %d entries for %d shards"
+                    % (name, len(value), self.num_shards))
+            return [to_bytes(v) for v in value]
+        return [to_bytes(value)] * self.num_shards
+
+    # -- mesh / sharding resolution ------------------------------------
+    def _mesh_sharding(self):
+        """(batch_sharding, host_of_device) — the module's own batch
+        sharding when bound+fused (fit's staging then no-ops on the
+        gather output), else the cluster's; (None, None) without
+        either (plain single-device placement)."""
+        grp = self._group()
+        if grp is not None:
+            sharding = grp._batch_sharding
+        elif self._cluster is not None:
+            sharding = self._cluster.batch_sharding()
+        else:
+            return None, None
+        host_of = self._cluster.host_of_device() if self._virtual \
+            else None
+        return sharding, host_of
+
+    # -- capture --------------------------------------------------------
+    def _capture_batch(self, img, labels, pad):
+        img, labels = self._strip_pad(img, labels, pad)
+        rows = int(img.shape[0])
+        if rows % self.num_shards:
+            raise MXNetError(
+                "streamed batch of %d rows does not divide over %d "
+                "shards — the sharded cache needs every captured batch "
+                "to split evenly (the shard_rows rule)"
+                % (rows, self.num_shards))
+        self._cap_counts.append(rows)
+        if self._cap_row_nbytes is None and rows:
+            self._cap_row_nbytes = int(img.nbytes) // rows + sum(
+                int(lb.nbytes) // rows for lb in (labels or []))
+        if self.tier == "recordio":
+            # a forced re-decode tier retains NOTHING: accounting only
+            # (the tier exists for epochs too big to hold — capturing
+            # them first would be the very cost it avoids)
+            return
+        if not self._virtual and self.num_shards > 1:
+            # real multi-process mode: this process retains ONLY its
+            # row block — the whole point of sharding the capture
+            from ..dist.sharded_iter import shard_rows
+            img = shard_rows(img, self.rank, self.num_shards)
+            labels = None if labels is None else \
+                [shard_rows(lb, self.rank, self.num_shards)
+                 for lb in labels]
+        self._pending.append(
+            (onp.ascontiguousarray(img),
+             None if labels is None else
+             [onp.ascontiguousarray(lb) for lb in labels]))
+
+    def _prefill(self):
+        """Eager ingest: a shuffled epoch's order touches the whole
+        epoch before batch 0 can leave, so the capture cannot overlap
+        delivery — drain the source, build the cache, then serve."""
+        while True:
+            try:
+                batch = self._iter.next()
+            except StopIteration:
+                break
+            img, labels, pad = self._host_batch(batch)
+            self._capture_batch(img, labels, pad)
+        self._epoch_complete = True
+        self._finalize()
+        if self._serving_tier == "recordio":
+            # nothing was retained and the prefill drained the source:
+            # rewind it so THIS epoch can re-stream
+            self._iter.reset()
+
+    # -- finalize -------------------------------------------------------
+    def _finalize(self):
+        # counts were recorded at capture time, BEFORE any per-process
+        # slicing, so they are GLOBAL per-batch row counts
+        counts = list(self._cap_counts)
+        if not counts or not sum(counts):
+            raise MXNetError(
+                "sharded cache captured no rows — the source must "
+                "deliver at least one batch")
+        self._counts = counts
+        total = sum(counts)
+        self._rows = int(total)
+        rps = total // self.num_shards
+        self._rows_per_shard = rps
+
+        sharding, host_of = self._mesh_sharding()
+        if not self._virtual and self.num_shards > 1 and sharding is None:
+            # without a mesh the local block cannot join a global
+            # cache — and jnp.take would silently CLAMP the global row
+            # indices into it (wrong data, no error)
+            raise MXNetError(
+                "multi-process ShardedCachedDataset needs a mesh to "
+                "place the dp-sharded cache — pass module= (a bound "
+                "fused module) or bind before the capture epoch ends")
+        n_dev = len(sharding.mesh.devices.ravel()) if sharding is not None \
+            else 1
+        per_host_dev = n_dev // self.num_shards if self.num_shards else 1
+        per_host_dev = max(1, per_host_dev)
+        rps_pad = -(-rps // per_host_dev) * per_host_dev
+        self._rows_per_shard_pad = rps_pad
+        n_pad = rps_pad * self.num_shards
+
+        self._row_of_pos = cache_row_of_pos(counts, self.num_shards,
+                                            rps_pad)
+
+        row_bytes = int(self._cap_row_nbytes or 0)
+        self.cache_bytes = total * row_bytes
+        self.cache_shard_bytes = rps * row_bytes
+        self.cache_built_epoch = self._epoch
+
+        self._shard_tiers = [self._resolve_tier(h) for h in
+                             range(self.num_shards)]
+        # coordinated degradation: the serving strategy is the WORST
+        # resolved tier (a half-resident cache cannot be one program)
+        self._serving_tier = max(self._shard_tiers,
+                                 key=lambda t: _TIER_RANK[t])
+        if self._serving_tier == "host" and not self._virtual \
+                and self.num_shards > 1:
+            # real multi-process mode captured only this process's
+            # block, but host-tier serving gathers GLOBAL cache rows —
+            # unavailable here. Re-streaming the (replicated) source
+            # is the tier that stays correct on every process.
+            self.logger.warning(
+                "ShardedCachedDataset: the host spill tier needs the "
+                "whole epoch host-side, which a multi-process capture "
+                "does not retain — degrading to the recordio "
+                "(re-stream) tier")
+            self._serving_tier = "recordio"
+        if self._serving_tier != "hbm":
+            spilled = [h for h, t in enumerate(self._shard_tiers)
+                       if t != "hbm"]
+            self.logger.warning(
+                "ShardedCachedDataset: shard(s) %s spilled off HBM "
+                "(%.1f MB/shard vs per-shard budgets) — serving tier "
+                "is %r for the whole cache", spilled,
+                self.cache_shard_bytes / (1 << 20), self._serving_tier)
+
+        # per-shard blocks in host-major cache row order (leaf 0 the
+        # image block, leaves 1.. the labels) — concatenated only for
+        # tiers that RETAIN rows; the recordio tier skips the copy
+        # entirely (its datasets are the ones too big to hold twice)
+        leaves = None
+        if self._serving_tier != "recordio" and self._pending:
+            leaves = self._collect_leaves(counts, rps, rps_pad)
+        self._pending = []
+        if self._serving_tier == "hbm":
+            try:
+                self._place_hbm(leaves, sharding, host_of, n_pad)
+            except Exception as exc:  # noqa: BLE001 — graceful spill
+                # same rule as the budget-resolved spill: the host tier
+                # needs the WHOLE epoch host-side, which a
+                # multi-process capture does not retain — there the
+                # fallback is the re-stream tier
+                fallback = "host" if self._virtual or \
+                    self.num_shards == 1 else "recordio"
+                self.logger.warning(
+                    "ShardedCachedDataset: HBM placement failed (%s) — "
+                    "spilling the whole cache to the %s tier", exc,
+                    fallback)
+                self._dev_cache = self._gather = None
+                self._serving_tier = fallback
+                self._shard_tiers = [fallback] * self.num_shards
+        if self._serving_tier == "host":
+            self._place_host(leaves, sharding, n_pad)
+        if self._serving_tier == "recordio":
+            if self.shuffle:
+                self.logger.warning(
+                    "ShardedCachedDataset: the recordio tier re-streams "
+                    "the source every epoch and has no random access — "
+                    "global shuffle is unavailable; delivering capture "
+                    "order")
+            self._host_cache = None
+        self.cache_placement = {"hbm": "device", "host": "host",
+                                "recordio": "off"}[self._serving_tier]
+        self._cache_ready = True
+        self._publish_telemetry()
+        self.logger.info(
+            "ShardedCachedDataset: %d rows cached across %d shard(s) "
+            "(%.1f MB/shard, tier=%s%s)", total, self.num_shards,
+            self.cache_shard_bytes / (1 << 20), self._serving_tier,
+            ", pinned" if self.cache_pinned else "")
+
+    def _collect_leaves(self, counts, rps, rps_pad):
+        """Per-shard blocks concatenated host-major, one padded
+        ``(num_shards * rps_pad, ...)`` numpy array per leaf.  Real
+        multi-process mode keeps only this process's block (shape
+        ``(rps_pad, ...)``)."""
+        n_labels = 0 if self._pending[0][1] is None \
+            else len(self._pending[0][1])
+        own_only = not self._virtual and self.num_shards > 1
+        shards = [self.rank] if own_only else range(self.num_shards)
+        leaves = []
+        for li in range(1 + n_labels):
+            def leaf_of(entry):
+                return entry[0] if li == 0 else entry[1][li - 1]
+
+            blocks = []
+            for h in shards:
+                if own_only:
+                    parts = [leaf_of(e) for e in self._pending]
+                else:
+                    parts = []
+                    for k, e in enumerate(self._pending):
+                        m = counts[k] // self.num_shards
+                        parts.append(leaf_of(e)[h * m:(h + 1) * m])
+                block = onp.concatenate(parts)
+                if rps_pad > rps:
+                    pad_rows = onp.zeros((rps_pad - rps,)
+                                         + block.shape[1:], block.dtype)
+                    block = onp.concatenate([block, pad_rows])
+                blocks.append(block)
+            leaves.append(blocks if self._virtual
+                          else onp.concatenate(blocks))
+        return leaves
+
+    def _resolve_tier(self, shard):
+        if self.tier != "auto":
+            return self.tier
+        if self.cache_shard_bytes <= self._dev_budgets[shard]:
+            return "hbm"
+        if self.cache_shard_bytes <= self._host_budgets[shard]:
+            return "host"
+        return "recordio"
+
+    # -- placement ------------------------------------------------------
+    def _cache_sharding(self, batch_sharding):
+        """The cache rows ride the SAME ``P('dp')`` row spec as every
+        staged batch — the cache is just another pytree on the mesh."""
+        return batch_sharding
+
+    def _place_hbm(self, leaves, sharding, host_of, n_pad):
+        import jax
+        placed = []
+        for leaf in leaves:
+            if sharding is None:
+                placed.append(jax.device_put(
+                    leaf if not isinstance(leaf, list) else leaf[0]))
+            elif self._virtual and self.num_shards > 1:
+                from ..dist.staging import assemble_host_slices
+                gshape = (n_pad,) + tuple(leaf[0].shape[1:])
+                placed.append(assemble_host_slices(
+                    self._cache_sharding(sharding), gshape, leaf,
+                    host_of))
+            elif not self._virtual and self.num_shards > 1:
+                # real pod: the local block rides THE staging rule —
+                # make_array_from_process_local_data, like every input
+                from ..dist.staging import stage_sharded
+                gshape = (n_pad,) + tuple(leaf.shape[1:])
+                placed.append(stage_sharded(
+                    leaf, self._cache_sharding(sharding), gshape))
+            else:
+                block = leaf[0] if isinstance(leaf, list) else leaf
+                placed.append(jax.device_put(
+                    block, self._cache_sharding(sharding)))
+        self._dev_cache = tuple(placed)
+        self._build_gather(sharding)
+        self._warm_gather()
+
+    def _place_host(self, leaves, sharding, n_pad):
+        """Spill path: the whole cache host-side (numpy), with an
+        opportunistic ``pinned_host`` placement where the runtime has
+        memory kinds — the jitted gather then reads the pinned block
+        directly and the numpy copy is dropped."""
+        host = []
+        for leaf in leaves:
+            host.append(onp.concatenate(leaf) if isinstance(leaf, list)
+                        else leaf)
+        self._host_cache = host
+        if sharding is None or \
+                os.environ.get("MXNET_DATA_CACHE_PINNED", "1") == "0":
+            return
+        try:
+            import jax
+            from jax.sharding import NamedSharding
+            pinned = NamedSharding(sharding.mesh, sharding.spec,
+                                   memory_kind="pinned_host")
+            placed = tuple(jax.device_put(h, pinned) for h in host)
+            self._dev_cache = placed
+            self._build_gather(sharding)
+            self._warm_gather()
+            self.cache_pinned = True
+            self._host_cache = None
+        except Exception:  # noqa: BLE001 — memory kinds are optional
+            self._dev_cache = self._gather = None
+            self.cache_pinned = False
+
+    def _build_gather(self, sharding):
+        import jax
+        import jax.numpy as jnp
+        n_leaves = len(self._dev_cache)
+
+        def gather(cache, idx):
+            return tuple(jnp.take(c, idx, axis=0) for c in cache)
+
+        if sharding is not None:
+            self._gather = jax.jit(
+                gather, out_shardings=(sharding,) * n_leaves)
+        else:
+            self._gather = jax.jit(gather)
+
+    def _warm_gather(self):
+        # compile NOW — finalize runs at the capture epoch's end, i.e.
+        # inside fit's warmup window, so cached epochs retrace nothing
+        import jax
+        import jax.numpy as jnp
+        warm = self._gather(self._dev_cache,
+                            jnp.zeros((self.batch_size,), jnp.int32))
+        jax.block_until_ready(warm)
+
+    def _publish_telemetry(self):
+        from .. import telemetry
+        reg = telemetry.registry()
+        for t in ("hbm", "host", "recordio"):
+            reg.gauge("data.cache_tier_%s" % t).set(
+                sum(1 for s in self._shard_tiers if s == t))
+        reg.gauge("data.cache_shard_bytes").set(self.cache_shard_bytes)
+        reg.gauge("data.cache_global_rows").set(self._rows)
+
+    # -- delivery -------------------------------------------------------
+    @property
+    def background_pull_safe(self):
+        """False when serving launches a COLLECTIVE gather program (any
+        mesh-sharded cache): collectives must be enqueued in the same
+        program order on every device, so a background stager thread
+        launching the gather concurrently with the training step's
+        collectives can interleave the per-device rendezvous — a
+        deadlock on XLA:CPU and a cross-host ordering hazard on a real
+        pod.  DeviceLoader consults this and pulls such a source on
+        the CONSUMER thread instead (the gather output is already
+        device-resident, so there is no transfer to hide anyway)."""
+        try:
+            sharding, _ = self._mesh_sharding()
+        except Exception:  # noqa: BLE001 — conservative default
+            return False
+        return sharding is None
+
+    def epoch_positions(self, epoch):
+        """The delivered GLOBAL sample order of ``epoch`` as capture
+        positions — a pure function of ``(seed, epoch)`` (plus the
+        capture geometry), identical at every dp width.  The elastic
+        tests pin dp=8 and dp=4 instances to the same transcript."""
+        if not self._cache_ready:
+            raise MXNetError("cache not built yet")
+        if not self.shuffle or epoch < self.shuffle_from \
+                or self._serving_tier == "recordio":
+            return onp.arange(self._rows)
+        return global_shuffle_order(self.seed, epoch, self._rows)
+
+    def next(self):
+        if not self._cache_ready and self.shuffle \
+                and self._epoch >= self.shuffle_from:
+            self._prefill()
+        return super().next()
+
+    def _next_cached(self):
+        if self._serving_tier == "recordio":
+            return self._next_restream()
+        b = self.batch_size
+        if self._order is None or self._order_epoch != self._epoch:
+            self._order = self.epoch_positions(self._epoch)
+            self._order_epoch = self._epoch
+        lo = self._seq * b
+        if lo >= len(self._order):
+            raise StopIteration
+        pos = self._order[lo:lo + b]
+        pad = b - len(pos)
+        if pad > 0:
+            # round-batch semantics: wrap the epoch head, report pad
+            pos = onp.concatenate([pos, self._order[:pad]])
+        idx = onp.ascontiguousarray(
+            self._row_of_pos[pos].astype(onp.int32))
+        if self._dev_cache is not None:
+            import jax.numpy as jnp
+            gathered = self._gather(self._dev_cache, jnp.asarray(idx))
+        else:
+            gathered = tuple(leaf[idx] for leaf in self._host_cache)
+        img = gathered[0]
+        labels = list(gathered[1:]) if len(gathered) > 1 else None
+        return self._attach(img, labels, pad)
+
+    def _next_restream(self):
+        batch = self._iter.next()   # StopIteration ends the epoch
+        img, labels, pad = self._host_batch(batch)
+        return self._attach(img, labels, pad)
+
+    def _epoch_batches(self):
+        return -(-self._rows // self.batch_size)
+
+    def skip_batches(self, n):
+        """Advance the stream position by ``n`` batches without paying
+        gather/augment for discarded resume batches (fit's mid-epoch
+        fast-forward)."""
+        n = int(n)
+        if not self._cache_ready and self.shuffle \
+                and self._epoch >= self.shuffle_from:
+            self._prefill()
+        if self._cache_ready and self._serving_tier != "recordio":
+            done = min(n, max(0, self._epoch_batches() - self._seq))
+            self._seq += done
+            return done
+        done = 0
+        for _ in range(n):
+            try:
+                self.next()     # capture-aware pull-and-discard
+            except StopIteration:
+                break
+            done += 1
+        return done
+
+    def reset(self):
+        super().reset()
+        if not self._cache_ready:
+            # a partial capture was discarded: the accounting recorded
+            # alongside it must go too, or the re-streamed epoch would
+            # double-count its head batches
+            self._cap_counts = []
+            self._cap_row_nbytes = None
+        elif self._serving_tier == "recordio":
+            # nothing was retained: the next epoch re-streams
+            self._iter.reset()
+
+    # -- introspection --------------------------------------------------
+    def cache_info(self):
+        """Resolved cache state: serving ``tier``, per-shard resolved
+        ``tiers``, per-shard ``shard_rows``/``shard_bytes``, global
+        ``rows``/``bytes``, ``num_shards``, ``pinned``,
+        ``built_epoch`` (plus ``placement`` in the CachedDataset
+        spelling)."""
+        return {
+            "tier": self._serving_tier,
+            "tiers": list(self._shard_tiers or []),
+            "placement": self.cache_placement,
+            "rows": self._rows,
+            "bytes": getattr(self, "cache_bytes", 0),
+            "shard_rows": self._rows_per_shard,
+            "shard_bytes": self.cache_shard_bytes,
+            "num_shards": self.num_shards,
+            "pinned": self.cache_pinned,
+            "built_epoch": self.cache_built_epoch,
+        }
+
+    def close(self):
+        self._dev_cache = None
+        self._host_cache = None
+        super().close()

@@ -65,6 +65,13 @@ class PipelineStats:
         # (strings; exported through snapshot())
         self.staged_dtype = None
         self.augment_placement = None
+        # dataset-cache attribution (CachedDataset /
+        # ShardedCachedDataset feeding this pipeline): the resolved
+        # serving tier plus the per-shard byte/row accounting, so the
+        # watchdog and bench read the same wire the cache resolved
+        self.cache_tier = None
+        self._g_cache_shard_bytes = self.scope.gauge("cache_shard_bytes")
+        self._g_cache_global_rows = self.scope.gauge("cache_global_rows")
         self._g_ring_depth = self.scope.gauge("ring_depth")
         self._g_ring_occupancy = self.scope.gauge("ring_occupancy")
         self._g_ring_high_water = self.scope.gauge("ring_high_water")
@@ -83,6 +90,8 @@ class PipelineStats:
     ring_full_waits = telemetry.instrument_value("_c_ring_full_waits")
     ring_occupancy = telemetry.instrument_value("_g_ring_occupancy")
     ring_high_water = telemetry.instrument_value("_g_ring_high_water")
+    cache_shard_bytes = telemetry.instrument_value("_g_cache_shard_bytes")
+    cache_global_rows = telemetry.instrument_value("_g_cache_global_rows")
 
     @property
     def ring_depth(self):
@@ -106,7 +115,9 @@ class PipelineStats:
                      self._c_host_wait_ms, self._c_stage_ms,
                      self._c_images_staged, self._c_batches_staged,
                      self._c_bytes_staged, self._c_ring_full_waits,
-                     self._g_ring_occupancy, self._g_ring_high_water):
+                     self._g_ring_occupancy, self._g_ring_high_water,
+                     self._g_cache_shard_bytes,
+                     self._g_cache_global_rows):
             inst.reset()
         self._g_ring_depth.set(depth)
 
@@ -128,6 +139,14 @@ class PipelineStats:
 
     def note_ring_full(self):
         self._c_ring_full_waits.add()
+
+    def note_cache(self, tier, shard_bytes, global_rows):
+        """Record the dataset cache feeding this pipeline: resolved
+        serving tier plus per-shard bytes / global rows (DeviceLoader
+        forwards ``cache_info()`` here once the cache finalizes)."""
+        self.cache_tier = str(tier) if tier else None
+        self._g_cache_shard_bytes.set(int(shard_bytes or 0))
+        self._g_cache_global_rows.set(int(global_rows or 0))
 
     # -- consumer side -------------------------------------------------
     def note_delivered(self, rows, wait_seconds):
@@ -164,6 +183,9 @@ class PipelineStats:
             else 0.0,
             "staged_dtype": self.staged_dtype,
             "augment_placement": self.augment_placement,
+            "cache_tier": self.cache_tier,
+            "cache_shard_bytes": self.cache_shard_bytes,
+            "cache_global_rows": self.cache_global_rows,
         }
 
     def __repr__(self):

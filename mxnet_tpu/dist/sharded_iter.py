@@ -112,6 +112,14 @@ class ShardedDataIter(DataIter):
         self.provide_label = data_iter.provide_label
 
     # ---------------------------------------------------------- epochs
+    @property
+    def epoch_coord(self):
+        """The pinned epoch coordinate — the set_epoch protocol marker:
+        wrappers that prefetch (DeviceLoader) rebase their ring when
+        the pin actually moves this value, instead of delivering
+        batches staged under a stale coordinate."""
+        return self._epoch
+
     def set_epoch(self, epoch):
         """Pin the epoch coordinate of the seeding (fit calls this with
         the true epoch index; resumed runs replay the right stream)."""

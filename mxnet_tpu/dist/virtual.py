@@ -167,6 +167,12 @@ class VirtualFeed(DataIter):
         self._straggler_gauge = None
 
     # ------------------------------------------------------- epochs
+    @property
+    def epoch_coord(self):
+        """set_epoch protocol marker (see ShardedDataIter.epoch_coord):
+        a prefetching wrapper rebases only when the pin moves this."""
+        return self._epoch
+
     def set_epoch(self, epoch):
         self._epoch = int(epoch)
 

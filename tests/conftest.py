@@ -33,6 +33,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-fit contract tests excluded from the tier-1 budget "
+        "(-m 'not slow'); ci.sh's unfiltered suite runs them")
     if not _NEEDS_REEXEC:
         return
     capman = config.pluginmanager.getplugin("capturemanager")

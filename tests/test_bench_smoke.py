@@ -35,11 +35,14 @@ def test_bench_emits_contract_json():
     # BENCH_PRECISION=0 likewise: the precision-mode window is a
     # SECOND full resnet-50 train-step compile (tests/test_precision.py
     # pins every mode contract on a small net)
+    # BENCH_SHARDED_CACHE=0 likewise: the sharded-cache tier sweep
+    # compiles its own gather programs (tests/test_sharded_cache.py
+    # pins the tier contracts on a small net)
     env.update(BENCH_BATCH="4", BENCH_STEPS="2", BENCH_PIPELINE="0",
                BENCH_DTYPE="float32", BENCH_FIT_EPOCH_BATCHES="3",
                BENCH_GROUPED="0", BENCH_HANDWRITTEN="0",
                BENCH_SERVE="0", BENCH_PREFETCH="0", BENCH_TELEMETRY="0",
-               BENCH_PRECISION="0")
+               BENCH_PRECISION="0", BENCH_SHARDED_CACHE="0")
     proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
                           capture_output=True, text=True, timeout=1200,
                           env=env, cwd=ROOT)

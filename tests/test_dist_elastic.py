@@ -325,6 +325,72 @@ def test_elastic_kill_sweep_every_commit_boundary(tmp_path):
         assert mod2._optimizer.num_update == STEPS
 
 
+def test_elastic_resume_sharded_cache_bitwise(tmp_path):
+    """The pod-sharded cache's elastic contract: dp=8 training (4
+    virtual hosts x 2 devices = a 4-SHARD cache) through a SHUFFLED
+    ShardedCachedDataset, killed between commits, resumed at dp=4
+    (2 surviving hosts = a freshly re-captured 2-shard cache) —
+    bitwise equal (params, optimizer state, num_update) to a
+    continuous dp=4 run from the same committed step.  Holds because
+    the global shuffle order is a pure function of (seed, epoch):
+    neither the dp width nor the shard count enters the draw, so the
+    resumed world re-draws the identical global stream and each
+    survivor gathers its new row block (the order transcript is
+    pinned across both shard widths below)."""
+    from mxnet_tpu.data import ShardedCachedDataset, global_shuffle_order
+
+    built = []
+
+    def cache_factory(world):
+        scd = ShardedCachedDataset(_iter(), cluster=world,
+                                   shuffle=True, seed=11)
+        built.append(scd)
+        return scd
+
+    tmp = str(tmp_path)
+    mgr = CheckpointManager(os.path.join(tmp, "ckpt"))
+    cluster = dist.VirtualCluster(4)
+    mx.random.seed(3)
+    np.random.seed(3)
+    tr = dist.ElasticTrainer(cluster, _module_factory, cache_factory,
+                             mgr, checkpoint_every_steps=4)
+    mod = tr.fit(num_epoch=3, inject_fault=(14, (2, 3)), **FIT_KW)
+    done = [e for e in tr.transcript if e["event"] == "finished"][0]
+    resume_step = done["resume_step"]
+    assert resume_step == 12
+    assert mod._optimizer.num_update == 24
+
+    # continuous dp=4 baseline from the SAME committed entry, through
+    # its own freshly captured sharded cache
+    src = os.path.join(tmp, "ckpt", "step_%08d" % resume_step)
+    dst = os.path.join(tmp, "baseline")
+    shutil.copytree(src, os.path.join(dst, "step_%08d" % resume_step))
+    cluster4 = dist.VirtualCluster(4).shrink((2, 3))
+    mod2 = _module_factory(cluster4)
+    mx.random.seed(99)
+    np.random.seed(99)          # must not matter; rng restores
+    mod2.fit(cache_factory(cluster4), num_epoch=3,
+             resume_from=CheckpointManager(dst), **FIT_KW)
+    assert _digest(mod) == _digest(mod2)
+    assert mod2._optimizer.num_update == 24
+
+    # transcript-pinned dp stability: every attempt's cache (dp=8
+    # attempt 0, dp=4 attempt 1, continuous dp=4) drew the identical
+    # global sample order for each shuffled epoch
+    ready = [s for s in built if s.cache_built_epoch is not None]
+    assert len(ready) >= 3
+    for epoch in (1, 2):
+        want = global_shuffle_order(11, epoch, ROWS)
+        for scd in ready:
+            np.testing.assert_array_equal(scd.epoch_positions(epoch),
+                                          want)
+    # ... and each attempt's cache held only its own row blocks
+    assert {s.cache_info()["num_shards"] for s in ready} == {4, 2}
+    for s in ready:
+        info = s.cache_info()
+        assert info["shard_bytes"] * info["num_shards"] == info["bytes"]
+
+
 def test_elastic_checkpoint_metadata(tmp_path):
     tr, mod, mgr = _run_elastic(str(tmp_path), fault_at=14)
     meta = mgr.step_metadata()      # latest entry, no array loads

@@ -434,7 +434,10 @@ def test_pipeline_stats_snapshot_compat():
         "ring_full_waits",
         # staged-transport provenance (docs/api/data.md field table)
         "staged_bytes", "staged_bytes_per_batch", "staged_dtype",
-        "augment_placement"}
+        "augment_placement",
+        # dataset-cache provenance (PR 15: the sharded-cache tier wire
+        # bench and the watchdog both read)
+        "cache_tier", "cache_shard_bytes", "cache_global_rows"}
     assert snap["batches_delivered"] == 1
     assert snap["images_delivered"] == 16
     assert snap["host_wait_ms"] == pytest.approx(1.0)
