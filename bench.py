@@ -448,6 +448,17 @@ def main():
         except Exception as e:
             extra["serve_error"] = str(e)[:160]
 
+    if os.environ.get("BENCH_DECODE", "1") != "0":
+        # continuous-batching decode: the slot-structured step engine
+        # under concurrent streaming clients vs the sequential
+        # per-request baseline (docs/api/serving.md "Decode engine").
+        # Cheap (bench-sized char-LM), but off in the CPU contract
+        # smoke with the other serving sections.
+        try:
+            extra.update(_bench_decode(n_dev))
+        except Exception as e:
+            extra["decode_error"] = str(e)[:160]
+
     extra.update(pipe_extra)
     if pipe_recs is not None:
         try:
@@ -949,6 +960,74 @@ def _bench_serve(mx, mod, batch, n_dev):
         "serve_warm_vs_cold": (round(cold_s / warm_s, 2)
                                if warm_s > 0 else None),
         "serve_warm_all_deserialized": warm_all_deserialized,
+    }
+
+
+def _bench_decode(n_dev):
+    """Continuous-batching decode load through
+    mxnet_tpu.serving.decode (docs/api/serving.md "Decode engine"): a
+    bench-sized char-LM decoded by concurrent streaming clients
+    through the slot-structured engine, against the sequential
+    per-request baseline on the same warmed program family.
+
+    decode_tokens_per_sec is the continuous engine's aggregate over
+    device-busy wall; TTFT percentiles come from the engine's own
+    ring; decode_slot_occupancy is the mean active-slot fraction per
+    step (the continuous-batching win is roughly occupancy /
+    (1/slots))."""
+    import numpy as np
+
+    from mxnet_tpu.serving.decode import DecodeEngine, LSTMCharLM
+
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
+    n_req = int(os.environ.get("BENCH_DECODE_REQUESTS",
+                               str(3 * slots)))
+    max_new = int(os.environ.get("BENCH_DECODE_MAX_NEW", "64"))
+    model = LSTMCharLM(vocab_size=64, num_hidden=64, num_embed=32)
+    params = model.init_params(seed=7)
+    rng = np.random.RandomState(7)
+    prompts = [list(map(int, rng.randint(0, 64, size=int(
+        rng.randint(2, 17))))) for _ in range(n_req)]
+
+    eng = DecodeEngine(model, params, slots=slots, max_prefill_len=16,
+                       start=False)
+    eng.warmup()
+    reqs = [eng.submit(p, max_new_tokens=max_new, seed=i)
+            for i, p in enumerate(prompts)]
+    t0 = time.time()
+    eng.start()
+    for r in reqs:
+        r.result(timeout=600)
+    wall = time.time() - t0
+    eng.shutdown(drain=True)
+    cont = eng.stats()["decode"]
+    eng.release()
+
+    seq = DecodeEngine(model, params, slots=slots, max_prefill_len=16)
+    seq.warmup()
+    for i, p in enumerate(prompts):
+        seq.generate(p, max_new_tokens=max_new, seed=i, timeout=600)
+    seq.shutdown(drain=True)
+    seq_tps = seq.stats()["decode"]["tokens_per_sec"]
+    seq.release()
+
+    return {
+        "decode_tokens_per_sec": cont["tokens_per_sec"],
+        "decode_sequential_tokens_per_sec": seq_tps,
+        "decode_speedup": (round(cont["tokens_per_sec"] / seq_tps, 2)
+                           if cont["tokens_per_sec"] and seq_tps
+                           else None),
+        "decode_ttft_ms_p50": (round(cont["ttft_ms"]["p50"], 3)
+                               if cont["ttft_ms"]["p50"] is not None
+                               else None),
+        "decode_ttft_ms_p99": (round(cont["ttft_ms"]["p99"], 3)
+                               if cont["ttft_ms"]["p99"] is not None
+                               else None),
+        "decode_slot_occupancy": cont["avg_occupancy"],
+        "decode_slots": slots,
+        "decode_requests": n_req,
+        "decode_tokens": cont["tokens"],
+        "decode_wall_s": round(wall, 3),
     }
 
 

@@ -368,10 +368,28 @@ stage "chaos-soak gate (seeded FaultPlan over train + elastic resume + serve)"
 # executable-cache entry — must (a) recover to the bitwise-identical
 # params digest of the fault-free continuous reference, (b) leave
 # EXACTLY the planned incidents in the plan transcript / FlightRecorder
-# / health scopes, (c) perform zero post-warmup retraces, and (d)
-# serve bitwise-correct rows after every serving fault. Emits
-# CHAOS_r01.json.
+# / health scopes, (c) perform zero post-warmup retraces, (d) serve
+# bitwise-correct rows after every serving fault, and (e) keep the
+# decode plane's non-abandoned streams bitwise across a per-step
+# slowdown, a decode-scheduler crash, and a mid-stream client
+# abandon. Emits CHAOS_r01.json.
 python -c "from __graft_entry__ import dryrun_chaos; dryrun_chaos(8, 4)" \
+    || FAILED=1
+
+stage "decode gate (continuous-batching slot engine: bitwise streams + tps win)"
+# continuous-batching decode contract (docs/api/serving.md "Decode
+# engine"): a seeded multi-client run through the slot-structured
+# DecodeEngine must (a) emit token streams bitwise equal to the same
+# requests decoded ALONE through a sequential per-request engine,
+# (b) beat the sequential baseline on aggregate decode tokens/sec,
+# (c) perform zero post-warmup retraces across slot join/retire
+# churn, (d) warm a second replica from the persistent executable
+# cache with zero XLA compiles (state init + prefill buckets + step),
+# (e) carry a phase-decomposed TTFT trace per request and populate
+# the slo.decode.ttft / slo.decode.per_token gauges on a live scrape,
+# and (f) keep the padded prefill bucket ladder bitwise vs the
+# exact-length forward. Emits DECODE_r01.json.
+python -c "from __graft_entry__ import dryrun_decode; dryrun_decode(1)" \
     || FAILED=1
 
 stage "chaos-soak numeric stage (training guardian heals NaN + loss spike)"

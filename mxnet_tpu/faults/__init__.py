@@ -22,6 +22,9 @@ site                   entry point  where it lives
 ``serving.device``     check        Predictor device launch
 ``serving.queue_flood``  fires      DynamicBatcher submit
 ``serving.cache``      corrupt      a committed executable entry
+``serving.decode_worker``  check    DecodeEngine scheduler tick
+``serving.decode_step``  check      DecodeEngine per-step launch
+``serving.decode_abandon``  fires   DecodeEngine mid-stream abandon
 ``module.step``        poison       fit step boundary (numeric seam)
 ``checkpoint.params``  corrupt_params  restore hand-off (read SDC)
 ``guardian.sdc``       value        SDC probe's second launch

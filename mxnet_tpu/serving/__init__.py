@@ -24,6 +24,15 @@ mesh Module + durable checkpoints). Three pieces:
   XLA compiles and bitwise-identical served rows.
   ``MXNET_COMPILE_CACHE_DIR`` wires jax's own persistent compilation
   cache process-wide and doubles as the default AOT entry store.
+* :class:`DecodeEngine` (:mod:`~mxnet_tpu.serving.decode`) —
+  continuous-batching step-wise serving for autoregressive sequence
+  models: bucketed-by-length prefill programs, ONE device-resident
+  slot-indexed decode state written/read by jitted scatter/gather, a
+  scheduler that admits/retires sequences between steps under a fixed
+  decode program shape (occupancy churn never retraces), per-sequence
+  TTFT / per-token :class:`~mxnet_tpu.telemetry.SLOTracker` objectives
+  — and token streams bitwise equal to unbatched decode at any
+  occupancy.
 * :class:`ServingStats` — one snapshot (``stats()``) of latency
   p50/p95/p99 (deadline-missed requests included, by their queue age),
   batch-fill ratio, queue depth, and compile counters; with telemetry
@@ -54,16 +63,18 @@ from __future__ import annotations
 from . import cache
 from .batcher import DynamicBatcher
 from .cache import ExecutableCache, enable_persistent_compile_cache
-from .errors import (QueueFull, RequestTimeout, ServerClosed, TenantShed,
-                     WorkerCrashed)
+from .decode import DecodeEngine, DecodeModel, DecodeRequest, LSTMCharLM
+from .errors import (QueueFull, RequestAbandoned, RequestTimeout,
+                     ServerClosed, TenantShed, WorkerCrashed)
 from .predictor import Predictor
 from .stats import ServingStats
 from .tenancy import Tenant
 
 __all__ = ["Predictor", "DynamicBatcher", "ServingStats", "Tenant",
+           "DecodeEngine", "DecodeModel", "DecodeRequest", "LSTMCharLM",
            "ExecutableCache", "enable_persistent_compile_cache",
-           "QueueFull", "RequestTimeout", "ServerClosed", "TenantShed",
-           "WorkerCrashed"]
+           "QueueFull", "RequestAbandoned", "RequestTimeout",
+           "ServerClosed", "TenantShed", "WorkerCrashed"]
 
 # process-wide persistent compilation cache: MXNET_COMPILE_CACHE_DIR
 # points jax's own cache (and the default AOT entry store Predictor

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["QueueFull", "RequestTimeout", "ServerClosed", "TenantShed",
-           "WorkerCrashed"]
+__all__ = ["QueueFull", "RequestAbandoned", "RequestTimeout",
+           "ServerClosed", "TenantShed", "WorkerCrashed"]
 
 
 class QueueFull(MXNetError):
@@ -46,6 +46,19 @@ class RequestTimeout(MXNetError, TimeoutError):
 
 class ServerClosed(MXNetError):
     """The batcher has been shut down and accepts no new requests."""
+
+
+class RequestAbandoned(MXNetError):
+    """A streaming decode request ended before its token budget: the
+    client cancelled mid-stream (``DecodeRequest.cancel()``), a
+    ``serving.decode_abandon`` fault fired, or the engine shut down
+    without drain while the sequence was active.
+
+    The slot is retired at the next step boundary and the future
+    resolves with THIS error — it never hangs — while the tokens
+    emitted before abandonment stay readable via
+    ``DecodeRequest.tokens()`` (a disconnect wastes at most one step
+    of device work, never a hung slot)."""
 
 
 class WorkerCrashed(MXNetError):

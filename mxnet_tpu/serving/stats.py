@@ -52,13 +52,20 @@ __all__ = ["ServingStats"]
 TRACE_PHASES = ("queue_wait_ms", "coalesce_wait_ms", "pad_ms",
                 "device_ms", "resolve_ms")
 
+# the decode plane's phase decomposition (serving.decode): one request
+# spans a queue wait, its bucketed prefill, the continuous-batched
+# decode steps it was active for, and resolution
+DECODE_TRACE_PHASES = ("queue_wait_ms", "prefill_ms", "decode_ms",
+                       "resolve_ms")
+
 
 class ServingStats:
     """Thread-safe serving counters over a telemetry-registry scope,
     with a bounded latency reservoir and a request-trace ring."""
 
     def __init__(self, latency_window=2048, scope=None,
-                 trace_capacity=None):
+                 trace_capacity=None, phases=None):
+        self._phases = tuple(phases) if phases else TRACE_PHASES
         self._lock = threading.Lock()
         self._window = int(latency_window)
         self._lat = [0.0] * self._window
@@ -231,7 +238,9 @@ class ServingStats:
                    ts_end=None):
         """Record one request's phase-decomposed trace (callers gate on
         ``telemetry.enabled()`` — one branch when off). ``phases`` maps
-        phase name (:data:`TRACE_PHASES`) to ms; missing phases are 0.
+        phase name (this instance's phase set — :data:`TRACE_PHASES`
+        by default, :data:`DECODE_TRACE_PHASES` for a decode engine)
+        to ms; missing phases are 0.
         The trace lands in the bounded ring, each phase in its
         per-bucket histogram, and (for served requests) as Chrome-trace
         ``ph:X`` events in the span timeline — ``profiler.dump_profile``
@@ -240,7 +249,7 @@ class ServingStats:
             return None
         ts_end = time.time() if ts_end is None else float(ts_end)
         phases = {p: round(float(phases.get(p, 0.0)), 3)
-                  for p in TRACE_PHASES}
+                  for p in self._phases}
         total = round(sum(phases.values()), 3)
         trace = {"id": str(req_id), "rows": int(rows),
                  "bucket": int(bucket) if bucket else None,
@@ -251,9 +260,10 @@ class ServingStats:
             self._traces.append(trace)
         if bucket:
             for p, ms in phases.items():
-                if ms or p in ("queue_wait_ms", "device_ms"):
+                if ms or p in ("queue_wait_ms", "device_ms",
+                               "decode_ms"):
                     self._phase_hist(trace["bucket"], p).observe(ms)
-        elif phases["queue_wait_ms"]:
+        elif phases.get("queue_wait_ms"):
             # never-launched outcomes (timeout, admission shed) have no
             # bucket but DID wait — their queue time lands in a
             # bucket-free histogram so the decision stays attributable
@@ -264,7 +274,7 @@ class ServingStats:
         # request renders as a contiguous bar decomposed by phase
         events, t_us = [], (ts_end - total / 1000.0) * 1e6
         tid = threading.get_ident()
-        for p in TRACE_PHASES:
+        for p in self._phases:
             dur_us = phases[p] * 1e3
             if dur_us <= 0:
                 continue

@@ -136,7 +136,25 @@ class CompileWatch(object):
         for name, i in input_idx:
             if i < len(vals):
                 shapes[name] = tuple(getattr(vals[i], "shape", ()))
-        site = _call_site()
+        self._record(_call_site(), shapes)
+
+    def note_trace(self, site, shapes=None):
+        """Count one XLA trace from an EXTERNAL traced body — the hook
+        for jitted programs that are not executor-group eval functions
+        (the decode engine's prefill/step family calls this inside each
+        traced body, the same run-exactly-once-per-trace discipline as
+        :meth:`attach`'s wrappers). ``site`` names the program;
+        ``shapes`` optionally maps input names to shapes. Honors the
+        same attribution as wrapped traces: suppressed on this thread
+        under :meth:`suppressed`, counted into
+        ``compile.warmup_compiles`` under :meth:`warmup_scope`, and a
+        post-warmup trace increments ``compile.post_warmup_retraces``
+        and warns."""
+        if getattr(self._tls, "suppress", False):
+            return
+        self._record(str(site), dict(shapes or {}))
+
+    def _record(self, site, shapes):
         if getattr(self._tls, "warmup", False):
             # a declared warmup compile (Predictor bucket warmup): its
             # OWN stream — folding it into compile.retraces would make

@@ -79,6 +79,14 @@ CASES = [
      ["--num-epoch", "3", "--seq-len", "8", "--num-hidden", "32"]),
     ("rnn/char_lstm.py",
      ["--num-epoch", "3", "--seq-len", "16", "--num-hidden", "64"]),
+    # continuous-batching decode serving (mxnet_tpu.serving.decode):
+    # trains the unfused char-LM via fit, adopts the params into the
+    # slot-structured DecodeEngine, and self-asserts module/engine
+    # argmax parity, learned-text continuation, bitwise stream parity
+    # vs unbatched decode, and the continuous > sequential tokens/sec
+    # win (the full seeded witness runs in ci.sh / dryrun_decode)
+    ("rnn/decode_lm.py",
+     ["--num-epochs", "3", "--seq-len", "16", "--num-hidden", "64"]),
     ("rnn/bucketing_lstm.py", ["--num-epoch", "3", "--num-hidden", "32"]),
     ("profiler/profiler_demo.py",
      ["--iter-num", "5", "--size", "128",

@@ -1,0 +1,471 @@
+"""Continuous-batching decode engine (mxnet_tpu.serving.decode).
+
+The contracts this tier pins, per ISSUE 16:
+
+* bitwise streams — a request decoded in a full continuous batch emits
+  the SAME tokens, bit for bit, as the same request decoded alone;
+* slot lifecycle determinism — with a fixed arrival transcript the
+  join/retire order is a pure function of (seed, arrivals);
+* zero retraces under occupancy churn — after warmup, sequences
+  joining/retiring never change a program shape (CompileWatch and the
+  serving compile counter stay frozen);
+* shutdown never hangs a future — drain finishes streams, no-drain
+  resolves them with errors, both terminate;
+* warm replica — the decode program family round-trips the persistent
+  executable cache: a second engine warms with zero XLA compiles and
+  serves bitwise-identical streams;
+* decode fault seams (serving.decode_worker / decode_step /
+  decode_abandon) and TTFT-breach admission shed.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu.serving.decode import (DecodeEngine, LSTMCharLM,
+                                      PREFILL_ROWS)
+from mxnet_tpu.serving.errors import (RequestAbandoned, ServerClosed,
+                                      TenantShed, WorkerCrashed)
+
+VOCAB = 17
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LSTMCharLM(vocab_size=VOCAB, num_hidden=16, num_embed=8)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(seed=3)
+
+
+def _prompts(n, seed=0, lo=2, hi=12):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(0, VOCAB, size=rng.randint(lo, hi)))
+            for _ in range(n)]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_prefill_len", 8)
+    return DecodeEngine(model, params, **kw)
+
+
+def _sequential_streams(model, params, prompts, max_new=10, **kw):
+    """The unbatched reference: each request decoded ALONE (occupancy
+    1) through a fresh engine's identical program family."""
+    eng = _engine(model, params, **kw)
+    eng.warmup()
+    out = [eng.generate(p, max_new_tokens=max_new, seed=i, timeout=60)
+           for i, p in enumerate(prompts)]
+    eng.shutdown(drain=True)
+    eng.release()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity
+# ---------------------------------------------------------------------------
+def test_continuous_streams_bitwise_equal_unbatched(model, params):
+    prompts = _prompts(9, seed=1)
+    eng = _engine(model, params, start=False)
+    eng.warmup()
+    reqs = [eng.submit(p, max_new_tokens=10, seed=i)
+            for i, p in enumerate(prompts)]
+    eng.start()
+    streams = [r.result(timeout=60) for r in reqs]
+    eng.shutdown(drain=True)
+    assert eng.stats()["decode"]["avg_occupancy"] > 0.5  # batching real
+    ref = _sequential_streams(model, params, prompts)
+    for i, (got, want) in enumerate(zip(streams, ref)):
+        assert got == want, "stream %d diverged: %s vs %s" % (i, got,
+                                                              want)
+    eng.release()
+
+
+def test_sampled_streams_bitwise_and_seed_dependent(model, params):
+    """temperature > 0: the counter-hash gumbel is deterministic per
+    (seed, step) and independent of occupancy."""
+    prompts = _prompts(6, seed=2)
+    eng = _engine(model, params, temperature=0.7, start=False)
+    eng.warmup()
+    reqs = [eng.submit(p, max_new_tokens=8, seed=100 + i)
+            for i, p in enumerate(prompts)]
+    eng.start()
+    streams = [r.result(timeout=60) for r in reqs]
+    eng.shutdown(drain=True)
+    eng.release()
+    eng2 = _engine(model, params, temperature=0.7)
+    eng2.warmup()
+    for i, p in enumerate(prompts):
+        assert eng2.generate(p, max_new_tokens=8, seed=100 + i,
+                             timeout=60) == streams[i]
+    a = eng2.generate(prompts[0], max_new_tokens=8, seed=1, timeout=60)
+    b = eng2.generate(prompts[0], max_new_tokens=8, seed=2, timeout=60)
+    eng2.shutdown(drain=True)
+    eng2.release()
+    assert a != b, "different seeds should explore different streams"
+
+
+def test_prefill_bucket_parity(model, params):
+    """The bucket ladder is bitwise: padded + masked prefill equals
+    the exact-length whole-sequence forward, including the chunked
+    path through the top bucket (len > max_prefill_len)."""
+    eng = _engine(model, params, start=False)
+    eng.warmup()
+    rng = np.random.RandomState(7)
+    for L in (1, 3, 4, 5, 8, 11, 19):
+        prompt = list(rng.randint(0, VOCAB, size=L))
+        assert eng.prefill_parity(prompt), "len %d" % L
+    eng.shutdown()
+    eng.release()
+
+
+def test_eos_retires_early(model, params):
+    eng = _engine(model, params, eos_id=0)
+    eng.warmup()
+    stream = eng.generate([1, 2, 3], max_new_tokens=64, seed=0,
+                          timeout=60)
+    eng.shutdown(drain=True)
+    eng.release()
+    if 0 in stream:
+        assert stream.index(0) == len(stream) - 1, \
+            "eos must end the stream"
+    else:
+        assert len(stream) == 64
+
+
+# ---------------------------------------------------------------------------
+# slot lifecycle determinism
+# ---------------------------------------------------------------------------
+def test_transcript_pure_function_of_arrivals(model, params):
+    """start=False + a fixed submit order = a fixed arrival transcript;
+    the admit/retire transcript (request, slot, step, outcome) must
+    replay identically across engines."""
+    prompts = _prompts(8, seed=4)
+
+    def run():
+        eng = _engine(model, params, start=False)
+        eng.warmup()
+        reqs = [eng.submit(p, max_new_tokens=5 + (i % 4), seed=i)
+                for i, p in enumerate(prompts)]
+        eng.start()
+        for r in reqs:
+            r.result(timeout=60)
+        eng.shutdown(drain=True)
+        t = eng.transcript()
+        eng.release()
+        return t
+
+    t1, t2 = run(), run()
+    assert t1 == t2
+    admits = [e for e in t1 if e[0] == "admit"]
+    retires = [e for e in t1 if e[0] == "retire"]
+    assert len(admits) == len(prompts) and len(retires) == len(prompts)
+    assert all(e[4] == "ok" for e in retires)
+
+
+# ---------------------------------------------------------------------------
+# zero retraces under occupancy churn
+# ---------------------------------------------------------------------------
+def test_occupancy_churn_zero_retraces(model, params):
+    """Sequences of wildly different lengths joining and retiring must
+    never retrace: the decode step is ONE fixed shape, occupancy is an
+    active-mask value."""
+    eng = _engine(model, params, start=False)
+    eng.warmup()
+    watch = telemetry.compile_watch()
+    base_post = watch.post_warmup_count
+    watch.mark_warmup_done()
+    try:
+        compiles0 = eng.stats()["compiles"]
+        prompts = _prompts(12, seed=5, lo=1, hi=20)
+        reqs = [eng.submit(p, max_new_tokens=2 + (i * 3) % 9, seed=i)
+                for i, p in enumerate(prompts)]
+        eng.start()
+        for r in reqs:
+            r.result(timeout=60)
+        eng.shutdown(drain=True)
+        assert eng.stats()["compiles"] == compiles0, \
+            "occupancy churn recompiled a decode program"
+        assert watch.post_warmup_count == base_post, \
+            "CompileWatch saw a post-warmup retrace"
+        assert eng.stats()["decode"]["steps"] > 0
+    finally:
+        watch.reset_warmup()
+        eng.release()
+
+
+# ---------------------------------------------------------------------------
+# shutdown semantics
+# ---------------------------------------------------------------------------
+def test_shutdown_drains_without_hanging_futures(model, params):
+    eng = _engine(model, params, start=False)
+    eng.warmup()
+    reqs = [eng.submit(p, max_new_tokens=12, seed=i)
+            for i, p in enumerate(_prompts(10, seed=6))]
+    eng.start()
+    eng.shutdown(drain=True, timeout=120)
+    for r in reqs:
+        assert r.done()
+        assert len(r.result(timeout=1)) == 12
+    eng.release()
+
+
+def test_shutdown_no_drain_resolves_everything(model, params):
+    eng = _engine(model, params, start=False)
+    eng.warmup()
+    reqs = [eng.submit(p, max_new_tokens=1000, seed=i)
+            for i, p in enumerate(_prompts(10, seed=7))]
+    eng.start()
+    while not any(r.tokens() for r in reqs):
+        time.sleep(0.002)
+    eng.shutdown(drain=False, timeout=60)
+    for r in reqs:
+        assert r.done(), "no-drain shutdown left a future hanging"
+        with pytest.raises((ServerClosed, RequestAbandoned)):
+            r.result(timeout=1)
+    with pytest.raises(ServerClosed):
+        eng.submit([1], max_new_tokens=1)
+    eng.release()
+
+
+def test_client_cancel_mid_stream(model, params):
+    eng = _engine(model, params)
+    eng.warmup()
+    req = eng.submit([1, 2, 3], max_new_tokens=200, seed=0)
+    while len(req.tokens()) < 3:
+        time.sleep(0.001)
+    req.cancel()
+    with pytest.raises(RequestAbandoned):
+        req.result(timeout=30)
+    assert len(req.tokens()) >= 3  # partial stream stays readable
+    eng.shutdown(drain=True)
+    eng.release()
+
+
+# ---------------------------------------------------------------------------
+# executable cache / warm replica
+# ---------------------------------------------------------------------------
+def test_warm_replica_zero_compile_bitwise(model, params, tmp_path):
+    cache_dir = str(tmp_path / "aotc")
+    prompts = _prompts(5, seed=8)
+    cold = _engine(model, params)
+    cold.warmup(cache_dir=cache_dir)
+    want = [cold.generate(p, max_new_tokens=8, seed=i, timeout=60)
+            for i, p in enumerate(prompts)]
+    cold_stats = cold.stats()
+    cold.shutdown(drain=True)
+    cold.release()
+    n_programs = 2 + len(cold.buckets)   # init + step + prefill ladder
+    assert cold_stats["cache_misses"] == n_programs
+    assert all(v["source"] == "compiled"
+               for v in cold.warmup_report().values())
+
+    warm = _engine(model, params)
+    warm.warmup(cache_dir=cache_dir)
+    got = [warm.generate(p, max_new_tokens=8, seed=i, timeout=60)
+           for i, p in enumerate(prompts)]
+    warm_stats = warm.stats()
+    warm.shutdown(drain=True)
+    warm.release()
+    assert warm_stats["compiles"] == 0, \
+        "warm replica performed XLA compiles"
+    assert warm_stats["cache_hits"] == n_programs
+    assert all(v["source"] == "deserialized"
+               for v in warm.warmup_report().values())
+    assert got == want, "warm replica streams diverged"
+
+
+def test_cache_key_separates_configs(model, params, tmp_path):
+    """A different slot count / temperature is a different program —
+    its cache key must not collide with the first engine's entries."""
+    cache_dir = str(tmp_path / "aotc")
+    e1 = _engine(model, params, start=False)
+    e1.warmup(cache_dir=cache_dir)
+    e1.shutdown()
+    e1.release()
+    e2 = _engine(model, params, slots=2, start=False)
+    e2.warmup(cache_dir=cache_dir)
+    st = e2.stats()
+    e2.shutdown()
+    e2.release()
+    assert st["cache_hits"] == 0 and st["cache_misses"] > 0, \
+        "slots=2 engine must not reuse slots=4 executables"
+
+
+# ---------------------------------------------------------------------------
+# fault seams
+# ---------------------------------------------------------------------------
+def test_decode_worker_crash_restarts_and_serves(model, params):
+    """An injected scheduler crash restarts the loop; device slot
+    state survives, every stream still completes bitwise."""
+    prompts = _prompts(6, seed=9)
+    ref = _sequential_streams(model, params, prompts, max_new=8)
+    plan = faults.arm("serving.decode_worker:error@nth=3")
+    try:
+        eng = _engine(model, params, start=False)
+        eng.warmup()
+        reqs = [eng.submit(p, max_new_tokens=8, seed=i)
+                for i, p in enumerate(prompts)]
+        eng.start()
+        streams = [r.result(timeout=60) for r in reqs]
+        eng.shutdown(drain=True)
+        st = eng.stats()
+        eng.release()
+    finally:
+        faults.disarm()
+    assert plan.unfired() == []
+    assert st["worker_restarts"] == 1
+    assert streams == ref, "streams diverged across a worker restart"
+
+
+def test_decode_step_delay_is_transparent(model, params):
+    """A per-step device slowdown (delay rule) changes latency only —
+    never tokens."""
+    prompts = _prompts(4, seed=10)
+    ref = _sequential_streams(model, params, prompts, max_new=6)
+    faults.arm("serving.decode_step:delay@nth=2,ms=30")
+    try:
+        eng = _engine(model, params, start=False)
+        eng.warmup()
+        reqs = [eng.submit(p, max_new_tokens=6, seed=i)
+                for i, p in enumerate(prompts)]
+        eng.start()
+        streams = [r.result(timeout=60) for r in reqs]
+        eng.shutdown(drain=True)
+        eng.release()
+    finally:
+        faults.disarm()
+    assert streams == ref
+
+
+def test_decode_abandon_fault_resolves_future(model, params):
+    faults.arm("serving.decode_abandon:flood@nth=2")
+    try:
+        eng = _engine(model, params, start=False)
+        eng.warmup()
+        reqs = [eng.submit(p, max_new_tokens=12, seed=i)
+                for i, p in enumerate(_prompts(4, seed=11))]
+        eng.start()
+        outcomes = []
+        for r in reqs:
+            try:
+                r.result(timeout=60)
+                outcomes.append("ok")
+            except RequestAbandoned:
+                outcomes.append("abandoned")
+        eng.shutdown(drain=True)
+        st = eng.stats()
+        eng.release()
+    finally:
+        faults.disarm()
+    assert outcomes.count("abandoned") == 1, outcomes
+    assert st["decode"]["abandoned"] == 1
+
+
+def test_restart_storm_fails_loudly(model, params, monkeypatch):
+    """Past the restart budget every future fails with WorkerCrashed —
+    nothing hangs."""
+    monkeypatch.setenv("MXNET_SERVE_MAX_WORKER_RESTARTS", "2")
+    faults.arm("serving.decode_worker:error@prob=1.0,count=0")
+    try:
+        eng = _engine(model, params, start=False)
+        eng.warmup()
+        reqs = [eng.submit(p, max_new_tokens=4, seed=i)
+                for i, p in enumerate(_prompts(3, seed=12))]
+        eng.start()
+        for r in reqs:
+            with pytest.raises(WorkerCrashed):
+                r.result(timeout=60)
+        eng.shutdown(drain=False, timeout=10)
+        eng.release()
+    finally:
+        faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# SLOs
+# ---------------------------------------------------------------------------
+def test_ttft_breach_sheds_admission(model, params):
+    """shed_on_breach: force the TTFT objective into multi-window
+    burn-rate breach with synthetic samples, then submit — the request
+    must shed with TenantShed before touching the queue."""
+    eng = _engine(model, params, ttft_slo_ms=1.0, shed_on_breach=True,
+                  start=False)
+    now = time.time()
+    for i in range(400):
+        eng.slo_ttft.record(50.0, "ok", ts=now - 0.5 + i * 0.001)
+    assert eng.slo_ttft.breached_cached()
+    with pytest.raises(TenantShed):
+        eng.submit([1, 2], max_new_tokens=2)
+    assert eng.stats()["sheds"] == 1
+    eng.shutdown(drain=False)
+    eng.release()
+
+
+def test_slo_gauges_and_traces_populated(model, params):
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        eng = _engine(model, params)
+        eng.warmup()
+        for i, p in enumerate(_prompts(4, seed=13)):
+            eng.generate(p, max_new_tokens=6, seed=i, timeout=60)
+        eng.shutdown(drain=True)
+        gauges = telemetry.registry().snapshot()["gauges"]
+        for frag in ("decode.ttft", "decode.per_token"):
+            assert any(k.startswith("slo.%s." % frag) for k in gauges), \
+                "missing slo.%s.* gauges" % frag
+        traces = eng.request_traces()
+        assert len(traces) == 4
+        for t in traces:
+            assert set(t["phases"]) == {"queue_wait_ms", "prefill_ms",
+                                        "decode_ms", "resolve_ms"}
+            assert t["phases"]["prefill_ms"] >= 0.0
+            assert t["outcome"] == "ok"
+        st = eng.stats()
+        assert st["decode"]["ttft_ms"]["count"] == 4
+        assert st["decode"]["tokens"] == 4 * 6
+        eng.release()
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_fit_trained_params_adopt(model):
+    """from_params round-trip: a params dict shaped like the unfused
+    char-LM graph adopts into a model whose digest is value-stable."""
+    src = LSTMCharLM(vocab_size=11, num_hidden=8, num_embed=4,
+                     num_layers=2)
+    params = src.init_params(seed=1)
+    adopted = LSTMCharLM.from_params(params)
+    assert (adopted.vocab_size, adopted.num_hidden,
+            adopted.num_embed, adopted.num_layers) == (11, 8, 4, 2)
+    assert adopted.params_digest(params) == src.params_digest(params)
+    eng = DecodeEngine(adopted, params, slots=2, max_prefill_len=4)
+    eng.warmup()
+    assert len(eng.generate([1, 2, 3], max_new_tokens=4,
+                            timeout=60)) == 4
+    eng.shutdown(drain=True)
+    eng.release()
+
+
+def test_prefill_rows_padding_never_lands(model, params):
+    """The scatter's mode="drop" discipline: the PREFILL_ROWS padding
+    row targets index == slots and must never corrupt slot 0..n-1
+    state — admitting A then B leaves A's stream untouched."""
+    assert PREFILL_ROWS >= 2
+    eng = _engine(model, params, slots=2, start=False)
+    eng.warmup()
+    ra = eng.submit([1, 2, 3, 4], max_new_tokens=10, seed=0)
+    rb = eng.submit([5, 6], max_new_tokens=10, seed=1)
+    eng.start()
+    a, b = ra.result(timeout=60), rb.result(timeout=60)
+    eng.shutdown(drain=True)
+    eng.release()
+    ref = _sequential_streams(model, params, [[1, 2, 3, 4], [5, 6]],
+                              max_new=10, slots=2)
+    assert [a, b] == ref
