@@ -1011,7 +1011,41 @@ def _bench_decode(n_dev):
     seq_tps = seq.stats()["decode"]["tokens_per_sec"]
     seq.release()
 
+    # weight-only int8 vs bf16 on the same load: the decode step
+    # re-reads every weight byte per token, so the memory-bound claim
+    # needs BOTH witnesses — the analyze_compiled argument-bytes shrink
+    # AND the tokens/sec ratio (precision.quant, docs/api/precision.md)
+    def _mode_run(mode):
+        e = DecodeEngine(model, params, slots=slots, max_prefill_len=16,
+                         start=False, precision=mode)
+        e.warmup()
+        rs = [e.submit(p, max_new_tokens=max_new, seed=i)
+              for i, p in enumerate(prompts)]
+        e.start()
+        for r in rs:
+            r.result(timeout=600)
+        e.shutdown(drain=True)
+        d = e.stats()["decode"]
+        out = {"tokens_per_sec": d["tokens_per_sec"],
+               "weight_bytes": d["weight_bytes"],
+               "step_argument_bytes": e.step_argument_bytes()}
+        e.release()
+        return out
+
+    bf16 = _mode_run("bf16")
+    int8 = _mode_run("int8_weight")
+
     return {
+        "decode_weight_bytes_per_token": int8["weight_bytes"],
+        "decode_weight_bytes_per_token_bf16": bf16["weight_bytes"],
+        "decode_step_argument_bytes_int8": int8["step_argument_bytes"],
+        "decode_step_argument_bytes_bf16": bf16["step_argument_bytes"],
+        "decode_int8_tokens_per_sec": int8["tokens_per_sec"],
+        "decode_bf16_tokens_per_sec": bf16["tokens_per_sec"],
+        "decode_quant_speedup": (
+            round(int8["tokens_per_sec"] / bf16["tokens_per_sec"], 2)
+            if int8["tokens_per_sec"] and bf16["tokens_per_sec"]
+            else None),
         "decode_tokens_per_sec": cont["tokens_per_sec"],
         "decode_sequential_tokens_per_sec": seq_tps,
         "decode_speedup": (round(cont["tokens_per_sec"] / seq_tps, 2)

@@ -392,6 +392,23 @@ stage "decode gate (continuous-batching slot engine: bitwise streams + tps win)"
 python -c "from __graft_entry__ import dryrun_decode; dryrun_decode(1)" \
     || FAILED=1
 
+stage "quant gate (weight-only int8 decode + calibrated int8 serving)"
+# native low-bit compute contract (docs/api/precision.md "Quantized
+# serving modes"): (a) the int8_weight decode step program's
+# analyze_compiled argument bytes shrink vs bf16 and f32 (the byte
+# witness), (b) decode streams are deterministic per (params, prompt,
+# seed) under quantized weights — across a warm replica deserialized
+# from the executable cache with zero XLA compiles — and the prefill
+# bucket ladder stays bitwise, (c) an f32 engine warming from the
+# same cache directory adopts nothing (mode + quant tag key
+# separation), (d) a calibration pass populates the quant.calib.*
+# histograms and the resulting int8_serve Predictor matches the f32
+# reference within MXNET_QUANT_TOLERANCE, (e) a cross-mode checkpoint
+# restore is refused, (f) zero post-warmup retraces. Emits
+# QUANT_r01.json.
+python -c "from __graft_entry__ import dryrun_quant; dryrun_quant(1)" \
+    || FAILED=1
+
 stage "chaos-soak numeric stage (training guardian heals NaN + loss spike)"
 # guardian contract (docs/api/guardian.md): a seeded plan poisons one
 # mid-train batch with NaN and spikes a later one; the device-resident

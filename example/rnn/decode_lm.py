@@ -68,8 +68,19 @@ def main():
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--requests", type=int, default=8)
     parser.add_argument("--max-new", type=int, default=32)
+    parser.add_argument("--int8-weights", action="store_true",
+                        help="serve the trained params through the "
+                        "weight-only int8 decode path "
+                        "(precision='int8_weight'): asserts the "
+                        "compiled step program's argument bytes "
+                        "shrink vs f32 and that parity/throughput "
+                        "survive quantization")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    precision = "int8_weight" if args.int8_weights else None
+    # int8 weight noise can flip near-tie argmaxes; the LM must still
+    # clearly track the module forward and the periodic text
+    parity_floor = 0.8 if args.int8_weights else 0.9
 
     # -- train the unfused char-LSTM through fit ------------------------
     X, Y, vocab, text = load_data(args.seq_len)
@@ -97,15 +108,30 @@ def main():
         mx.io.NDArrayIter(Xp, None, batch_size=args.batch_size)
     ).asnumpy().reshape(total, args.seq_len, len(vocab))
     eng = DecodeEngine(model, arg_params, slots=args.slots,
-                       max_prefill_len=args.seq_len)
+                       max_prefill_len=args.seq_len,
+                       precision=precision)
     eng.warmup()
+    if args.int8_weights:
+        # the byte witness: the int8-weight step program must READ
+        # fewer argument bytes than the f32 family (that is the whole
+        # memory-bound decode win, per docs/api/precision.md)
+        wide = DecodeEngine(model, arg_params, slots=args.slots,
+                            max_prefill_len=args.seq_len, start=False)
+        nb_i8, nb_f32 = (eng.step_argument_bytes(),
+                         wide.step_argument_bytes())
+        wide.release()
+        assert nb_i8 < nb_f32, \
+            "int8 step arguments %d B not below f32 %d B" % (nb_i8,
+                                                             nb_f32)
+        print("int8 weights: step argument bytes %d (f32 %d, %.1fx)"
+              % (nb_i8, nb_f32, nb_f32 / float(nb_i8)))
     agree = 0
     for i in range(total):
         prompt = [int(v) for v in Xp[i]]
         eng_next = eng.generate(prompt, max_new_tokens=1,
                                 timeout=120)[0]
         agree += int(int(np.argmax(probs[i, -1])) == eng_next)
-    assert agree >= int(0.9 * total), \
+    assert agree >= int(parity_floor * total), \
         "engine/module argmax parity %d/%d" % (agree, total)
     print("parity: engine greedy matches module argmax on "
           "%d/%d prompts" % (agree, total))
@@ -120,7 +146,7 @@ def main():
     got = "".join(chars[t] for t in stream)
     match = sum(a == b for a, b in zip(got, want)) / float(len(want))
     print("continuation: %r (true %r, match %.2f)" % (got, want, match))
-    assert match >= 0.9, "LM failed to learn the periodic text"
+    assert match >= parity_floor, "LM failed to learn the periodic text"
 
     # 3. continuous batching: bitwise streams + tokens/sec win
     rng = np.random.RandomState(5)
@@ -135,7 +161,8 @@ def main():
     eng.shutdown(drain=True)
 
     seq_eng = DecodeEngine(model, arg_params, slots=args.slots,
-                           max_prefill_len=args.seq_len)
+                           max_prefill_len=args.seq_len,
+                           precision=precision)
     seq_eng.warmup()
     ref = [seq_eng.generate(p, max_new_tokens=args.max_new, seed=i,
                             timeout=300)
@@ -152,9 +179,12 @@ def main():
           % (cont_tps, cont_stats["avg_occupancy"], seq_tps))
     assert cont_tps > seq_tps, \
         "continuous batching did not beat sequential decode"
-    print("decode_lm: all asserts passed "
+    if args.int8_weights:
+        assert cont_tps > 0, "int8-weight decode produced no tokens/sec"
+    print("decode_lm%s: all asserts passed "
           "(parity %d/%d, continuation %.2f, %.1fx throughput)"
-          % (agree, total, match, cont_tps / seq_tps))
+          % (" [int8-weights]" if args.int8_weights else "",
+             agree, total, match, cont_tps / seq_tps))
 
 
 if __name__ == "__main__":

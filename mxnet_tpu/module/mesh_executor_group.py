@@ -579,6 +579,19 @@ class MeshExecutorGroup(object):
             return v
 
         def run_fwd(params, aux, inputs, rng, is_train):
+            if not is_train:
+                # narrow-math GEMM seam (precision.quant): entered
+                # INSIDE the traced body so every (re)trace resolves
+                # the mode — calibration collect, native int8/fp8, or
+                # (the common case) a no-op passthrough that leaves the
+                # program byte-identical
+                from ..precision.quant import trace_gemm_scope
+                with trace_gemm_scope(pol):
+                    return run_fwd_body(params, aux, inputs, rng,
+                                        is_train)
+            return run_fwd_body(params, aux, inputs, rng, is_train)
+
+        def run_fwd_body(params, aux, inputs, rng, is_train):
             vals = [cast(n, params[n]) if n in params else
                     cast_input(n, inputs[n]) for n in self.arg_names]
             # aux (BN moving stats) stay f32: BatchNorm's fcompute runs its

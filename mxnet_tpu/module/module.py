@@ -300,6 +300,8 @@ class Module(BaseModule):
             name=mode, compute_dtype=_field("compute_dtype"),
             opt_state_dtype=_field("opt_state_dtype"),
             remat=_field("remat"), act_cast=desc.get("act_cast"),
+            weight_quant=desc.get("weight_quant"),
+            narrow_math=desc.get("narrow_math"),
             loss_scale=desc.get("loss_scale"),
             loss_scale_window=desc.get("loss_scale_window"),
             experimental=bool(desc.get("experimental")))
@@ -471,6 +473,15 @@ class Module(BaseModule):
             self._warn_once("rebind", "Already binded, ignoring bind()")
             return
 
+        if for_training and self._precision is not None and \
+                self._precision.serving_only():
+            # quantized weight storage / native narrow GEMMs have no
+            # gradient story — they exist for inference programs only
+            raise ValueError(
+                "precision=%r is a serving-only mode (weight_quant/"
+                "narrow_math); bind with for_training=False or train "
+                "under a training mode and quantize post-training"
+                % self._precision.name)
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True

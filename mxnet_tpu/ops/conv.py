@@ -85,11 +85,17 @@ def _convolution(attrs, ins, octx):
         spec_in, spec_k, spec_out = "NCDHW", "OIDHW", "NCDHW"
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
                                     (spec_in, spec_k, spec_out))
-    y = lax.conv_general_dilated(
-        x, w, window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=ng, precision=f32_precision(x))
+    conv_kwargs = dict(window_strides=stride,
+                       padding=[(p, p) for p in pad],
+                       rhs_dilation=dilate, dimension_numbers=dn,
+                       feature_group_count=ng,
+                       precision=f32_precision(x))
+    # narrow-math seam (precision.quant): native int8 conv (or
+    # calibration collection) under an active trace scope
+    from ..precision import quant as _quant
+    y = _quant.narrow_conv(_jnp(), lax, x, w, conv_kwargs)
+    if y is None:
+        y = lax.conv_general_dilated(x, w, **conv_kwargs)
     if not attrs.get("no_bias", False):
         b = ins[2]
         # keep the compute dtype: a f32 bias would silently promote a

@@ -60,7 +60,14 @@ def _fully_connected(attrs, ins, octx):
         # activations pull the f32 parameters down to the compute dtype
         w = w.astype(x.dtype)
     x2 = x.reshape((x.shape[0], -1))
-    y = jnp.dot(x2, w.T, precision=f32_precision(x2))
+    # narrow-math seam (precision.quant): under an active trace scope
+    # this GEMM lowers to a native int8/fp8 dot (or collects
+    # calibration ranges); inactive scope -> None -> the wide dot below
+    from ..precision import quant as _quant
+    import jax.lax as _laxmod
+    y = _quant.narrow_dot(jnp, _laxmod, x2, w, f32_precision(x2))
+    if y is None:
+        y = jnp.dot(x2, w.T, precision=f32_precision(x2))
     if not attrs.get("no_bias", False):
         y = y + ins[2].astype(y.dtype)[None, :]
     return [y]

@@ -14,8 +14,10 @@ from .policy import (MODES, PrecisionPolicy, canon_dtype, canon_remat,
                      fake_cast, loss_scale_config, mode_name,
                      register_mode, remat_checkpoint_policy, resolve,
                      state_np_dtype, wrap_fused_apply)
+from . import quant
+from .quant import CalibrationTable, calibrate
 
 __all__ = ["PrecisionPolicy", "MODES", "resolve", "register_mode",
            "mode_name", "canon_dtype", "canon_remat", "state_np_dtype",
            "wrap_fused_apply", "fake_cast", "remat_checkpoint_policy",
-           "loss_scale_config"]
+           "loss_scale_config", "quant", "CalibrationTable", "calibrate"]

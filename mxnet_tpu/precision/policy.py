@@ -26,6 +26,15 @@ existing seams:
 * ``act_cast="int8"|"fp8"`` (EXPERIMENTAL, ``MXNET_PRECISION_EXPERIMENTAL=1``)
   — fake-quantized low-bit casts at the input seam, with device-side
   dynamic loss scaling for the narrow backward.
+* ``weight_quant="int8"`` — parameters STORED as per-channel symmetric
+  int8 with f32 scales and dequantized inside the compiled program
+  (:mod:`mxnet_tpu.precision.quant`): ~4x fewer weight bytes per decode
+  token on the memory-bound serving path.  Serving-only.
+* ``narrow_math="int8"|"fp8"`` — the dot/conv call sites emit NATIVE
+  narrow GEMMs (int8xint8->int32 / e4m3 operands via
+  ``preferred_element_type``) instead of fake-quantized wide math, with
+  static per-layer activation scales from a calibration pass
+  (:class:`mxnet_tpu.precision.quant.CalibrationTable`).  Serving-only.
 
 Every mode carries the same contract the rest of the repo lives by:
 exact WITHIN-mode reproducibility (same mode + seed -> bit-identical
@@ -109,11 +118,12 @@ class PrecisionPolicy(object):
     module constructed without one (pinned by tests)."""
 
     __slots__ = ("name", "compute_dtype", "opt_state_dtype", "remat",
-                 "act_cast", "loss_scale", "loss_scale_window",
-                 "experimental")
+                 "act_cast", "weight_quant", "narrow_math", "calibration",
+                 "loss_scale", "loss_scale_window", "experimental")
 
     def __init__(self, name=None, compute_dtype=None, opt_state_dtype=None,
-                 remat=None, act_cast=None, loss_scale=None,
+                 remat=None, act_cast=None, weight_quant=None,
+                 narrow_math=None, calibration=None, loss_scale=None,
                  loss_scale_window=None, experimental=False):
         self.compute_dtype = canon_dtype(compute_dtype, "compute_dtype")
         self.opt_state_dtype = canon_dtype(opt_state_dtype,
@@ -123,6 +133,19 @@ class PrecisionPolicy(object):
             raise MXNetError("act_cast must be None, 'int8' or 'fp8' "
                              "(got %r)" % (act_cast,))
         self.act_cast = act_cast
+        if weight_quant not in (None, "int8"):
+            raise MXNetError("weight_quant must be None or 'int8' "
+                             "(got %r)" % (weight_quant,))
+        self.weight_quant = weight_quant
+        if narrow_math not in (None, "int8", "fp8"):
+            raise MXNetError("narrow_math must be None, 'int8' or 'fp8' "
+                             "(got %r)" % (narrow_math,))
+        self.narrow_math = narrow_math
+        # a CalibrationTable (precision.quant) or None; NOT part of the
+        # mode name — the same int8_serve mode serves any calibration,
+        # but the table digest goes into describe()/cache keys so two
+        # calibrations never share a compiled program
+        self.calibration = calibration
         # None means "the env/default at BIND time" — the registry's
         # named modes are built at import, so resolving the
         # MXNET_PRECISION_LOSS_SCALE/SCALE_WINDOW knobs here would
@@ -148,6 +171,10 @@ class PrecisionPolicy(object):
                                        callable(self.remat) else "custom"))
         if self.act_cast:
             parts.append("act=%s" % self.act_cast)
+        if self.weight_quant:
+            parts.append("wq=%s" % self.weight_quant)
+        if self.narrow_math:
+            parts.append("nm=%s" % self.narrow_math)
         # loss-scale fields change numerics (the scaler engages and its
         # doubling schedule differs per window), so a scale-only policy
         # must NOT collide with the "f32" baseline name — the manifest
@@ -164,7 +191,14 @@ class PrecisionPolicy(object):
         """True when this policy changes nothing vs the f32 baseline."""
         return (self.compute_dtype is None and self.opt_state_dtype is None
                 and self.remat is None and self.act_cast is None
+                and self.weight_quant is None and self.narrow_math is None
                 and self.loss_scale is None)
+
+    def serving_only(self):
+        """True when the policy only makes sense for inference programs
+        (quantized weight storage / native narrow GEMMs have no gradient
+        story); ``Module.bind(for_training=True)`` refuses these."""
+        return self.weight_quant is not None or self.narrow_math is not None
 
     def describe(self):
         return {"name": self.name,
@@ -173,6 +207,10 @@ class PrecisionPolicy(object):
                 "remat": ("custom" if callable(self.remat)
                           else (self.remat or "none")),
                 "act_cast": self.act_cast,
+                "weight_quant": self.weight_quant,
+                "narrow_math": self.narrow_math,
+                "calibration_digest": (None if self.calibration is None
+                                       else self.calibration.digest()),
                 "loss_scale": self.loss_scale,
                 "loss_scale_window": self.loss_scale_window,
                 "experimental": self.experimental}
@@ -199,6 +237,21 @@ MODES = {
                                 act_cast="int8", experimental=True),
     "fp8": PrecisionPolicy("fp8", compute_dtype="bfloat16",
                            act_cast="fp8", experimental=True),
+    # weight-only int8: params STORED as per-channel-symmetric int8 +
+    # f32 scales, dequantized inside the compiled program — 4x fewer
+    # weight bytes on the memory-bound decode path, f32 compute, no
+    # gradient story (serving-only)
+    "int8_weight": PrecisionPolicy("int8_weight", weight_quant="int8"),
+    # calibrated int8 serving: real int8 activation math through the
+    # native dot seam, with static per-layer scales from a
+    # CalibrationTable (tolerance-gated vs the f32 reference)
+    "int8_serve": PrecisionPolicy("int8_serve", act_cast="int8",
+                                  narrow_math="int8"),
+    # native fp8 GEMMs (e4m3 operands + preferred_element_type) — the
+    # numerics family is backend-dependent, so it stays experimental
+    "fp8_native": PrecisionPolicy("fp8_native", compute_dtype="bfloat16",
+                                  act_cast="fp8", narrow_math="fp8",
+                                  experimental=True),
 }
 
 

@@ -1,0 +1,543 @@
+"""Native low-bit compute: weight-only int8 storage, post-training
+activation calibration, and the narrow-math GEMM seam.
+
+PR 10's ``int8_act``/``fp8`` modes fake-quantize values but still
+compute — and, crucially, *store* — wide: every byte the roofline
+counts still moves.  This module supplies the three missing pieces
+behind the ``int8_weight`` / ``int8_serve`` / ``fp8_native`` registry
+modes (policy.py):
+
+1. **Weight-only int8** (:func:`quantize_params` /
+   :func:`dequant_params`): parameters stored as per-channel symmetric
+   int8 with f32 scales and dequantized INSIDE the compiled program.
+   The decode engine's step program re-reads every weight byte per
+   token (the memory-bound serving shape), so int8 storage is a ~4x
+   cut in argument bytes — witnessed by ``analyze_compiled``, not just
+   wall clock.
+
+2. **Post-training activation calibration** (:func:`calibrate` /
+   :class:`CalibrationTable`): a short forward pass with the GEMM
+   scope in collect mode harvests per-site input ``amax`` into
+   telemetry histograms (geometric bucket ladder); the table reads the
+   upper edge of the highest occupied bucket per site.  Static scales
+   make the int8 serve program shape-stable (no in-program reductions
+   over activations) and the table digest keys the executable cache.
+
+3. **Narrow GEMM seam** (:func:`narrow_dot` / :func:`narrow_conv` +
+   :func:`trace_gemm_scope`): the dot/conv call sites (ops/nn.py,
+   ops/conv.py) consult a thread-local trace scope.  Sites are named
+   by TRACE ORDER (``fc0``, ``conv1``, ...) — the graph executor
+   evaluates nodes in a deterministic topological order, so the same
+   graph yields the same site names in calibration and serving.  In
+   ``int8`` mode a site emits a NATIVE int8 x int8 -> int32
+   ``lax.dot_general`` (``preferred_element_type``) and rescales; in
+   ``fp8`` mode e4m3 operands with an f32 accumulator.  Backends that
+   lack a native kernel fall back to the fake-quantized round trip
+   (probed once, eagerly).
+
+Everything here is serving-only: quantized storage and native narrow
+GEMMs carry no gradient story, and ``Module.bind(for_training=True)``
+refuses policies that use them.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from contextlib import contextmanager
+from typing import NamedTuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["QuantLeaf", "quantize_weight", "quantize_params", "dequant_params",
+           "dequant_array", "is_quantized", "tree_bytes",
+           "CalibrationTable", "calibrate", "collecting",
+           "trace_gemm_scope", "narrow_dot", "narrow_conv",
+           "quant_tolerance", "calib_batches", "tolerance_check",
+           "CALIB_PREFIX", "CALIB_BUCKETS"]
+
+# geometric ladder wide enough for any sane activation amax; the +Inf
+# overflow bucket should stay empty (from_telemetry warns via clamp)
+CALIB_BUCKETS = tuple(2.0 ** e for e in range(-12, 17))
+CALIB_PREFIX = "quant.calib"
+
+
+def quant_tolerance():
+    """Max tolerated |int8_serve - f32| / max|f32| on probe outputs
+    (``MXNET_QUANT_TOLERANCE``, default 0.05)."""
+    return float(os.environ.get("MXNET_QUANT_TOLERANCE", "0.05"))
+
+
+def calib_batches(default=8):
+    """Calibration-pass length (``MXNET_PRECISION_CALIB_BATCHES``)."""
+    return int(os.environ.get("MXNET_PRECISION_CALIB_BATCHES",
+                              str(default)))
+
+
+# ---------------------------------------------------------------------------
+# weight-only int8: per-channel symmetric storage + in-program dequant
+# ---------------------------------------------------------------------------
+class QuantLeaf(NamedTuple):
+    """One int8-stored weight: ``q`` int8 with the original shape,
+    ``s`` f32 per-channel scales along axis 0.  A NamedTuple so the
+    tree is a jax pytree: ``device_put`` ships it, ``tree_map`` builds
+    ShapeDtypeStructs from it, and the compiled program's ARGUMENTS
+    stay int8 — that is the whole bytes win."""
+    q: object
+    s: object
+
+
+def quantize_weight(arr, axis=0):
+    """Per-channel symmetric int8 quantization of one weight array.
+
+    Returns ``(q, s)``: ``q`` int8 with ``arr``'s shape, ``s`` f32 of
+    shape ``(arr.shape[axis],)``.  All-zero channels get scale 1.0 so
+    the dequant is an exact 0.0 — never a 0/0 NaN (the same guard
+    :func:`policy.fake_cast` carries per-tensor)."""
+    arr = onp.asarray(arr)
+    if arr.ndim < 1:
+        raise MXNetError("quantize_weight needs ndim >= 1 (got scalar)")
+    axes = tuple(i for i in range(arr.ndim) if i != axis)
+    amax = onp.max(onp.abs(arr.astype(onp.float64)), axis=axes) \
+        if axes else onp.abs(arr.astype(onp.float64))
+    s = onp.where(amax > 0, amax / 127.0, 1.0).astype(onp.float32)
+    shape = tuple(arr.shape[axis] if i == axis else 1
+                  for i in range(arr.ndim))
+    q = onp.clip(onp.round(arr.astype(onp.float64)
+                           / s.astype(onp.float64).reshape(shape)),
+                 -127, 127).astype(onp.int8)
+    return q, s
+
+
+def is_quantized(v):
+    """True for one :class:`QuantLeaf` produced by
+    :func:`quantize_params`."""
+    return isinstance(v, QuantLeaf)
+
+
+def quantize_params(params, min_ndim=2):
+    """Quantize a ``{name: ndarray}`` tree for int8 storage.
+
+    Floating arrays with ``ndim >= min_ndim`` (the GEMM/conv weights —
+    where the bytes are) become :class:`QuantLeaf` pairs; biases, gains
+    and integer tables pass through untouched.  The result is a pytree
+    ``jax.device_put`` and the jitted dequant consume directly."""
+    out = {}
+    for name, v in params.items():
+        a = onp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+        if a.ndim >= min_ndim and onp.issubdtype(a.dtype, onp.floating):
+            q, s = quantize_weight(a, axis=0)
+            out[name] = QuantLeaf(q=q, s=s)
+        else:
+            out[name] = a
+    return out
+
+
+def dequant_array(jnp, leaf, dtype):
+    """Dense array for one quantized leaf (in-program: ``leaf`` may be
+    traced)."""
+    q, s = leaf.q, leaf.s
+    shape = (q.shape[0],) + (1,) * (q.ndim - 1)
+    return (q.astype(jnp.float32) * s.reshape(shape)).astype(dtype)
+
+
+def dequant_params(jnp, tree, dtype):
+    """Dense ``{name: array}`` view of a (possibly) quantized tree —
+    called INSIDE the jitted program so the executable's arguments stay
+    int8 and the widening is compute, not bandwidth."""
+    out = {}
+    for name, v in tree.items():
+        if is_quantized(v):
+            out[name] = dequant_array(jnp, v, dtype)
+        else:
+            out[name] = v
+    return out
+
+
+def tree_bytes(tree):
+    """Total stored bytes of a params tree (quantized leaves count
+    their int8 payload + f32 scales) — the ``weight_bytes_per_token``
+    numerator for the decode roofline."""
+    total = 0
+    for v in tree.values():
+        leaves = [v.q, v.s] if is_quantized(v) else [v]
+        for a in leaves:
+            total += int(a.size) * int(onp.dtype(a.dtype).itemsize)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# calibration: harvest per-site activation ranges from telemetry
+# ---------------------------------------------------------------------------
+class CalibrationTable(object):
+    """Static per-GEMM-site activation ranges from a calibration pass.
+
+    ``ranges`` maps trace-order site names (``fc0``, ``conv2``, ...) to
+    the input ``amax`` harvested for that site.  The digest keys
+    compiled programs (two calibrations never share an executable) and
+    lands in checkpoint/serving descriptions."""
+
+    __slots__ = ("ranges",)
+
+    def __init__(self, ranges):
+        self.ranges = {str(k): float(v) for k, v in ranges.items()}
+        for k, v in self.ranges.items():
+            if not (v > 0) or not onp.isfinite(v):
+                raise MXNetError(
+                    "calibration range for %r must be finite and > 0 "
+                    "(got %r)" % (k, v))
+
+    def amax(self, site):
+        return self.ranges.get(site)
+
+    def scale(self, site):
+        """The static int8 scale for a site (amax mapped to 127), or
+        None when the site was never observed (the GEMM falls back to a
+        dynamic per-tensor scale)."""
+        a = self.ranges.get(site)
+        return None if a is None else a / 127.0
+
+    def digest(self):
+        payload = json.dumps(self.ranges, sort_keys=True,
+                             separators=(",", ":"))
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    def to_json(self):
+        return {"version": 1, "ranges": dict(self.ranges),
+                "digest": self.digest()}
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(obj["ranges"])
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+    @classmethod
+    def from_telemetry(cls, prefix=CALIB_PREFIX):
+        """Build the table from the ``<prefix>.<site>.x_amax``
+        histograms a collect-mode pass populated: each site's range is
+        the UPPER EDGE of the highest occupied finite bucket (the
+        Prometheus-style conservative read — never under-covers an
+        observed value; overflow observations clamp to the top edge)."""
+        from ..telemetry import registry as _reg
+        reg = _reg()
+        strip, suffix = prefix + ".", ".x_amax"
+        ranges = {}
+        for name, inst in reg.instruments().items():
+            if not (name.startswith(strip) and name.endswith(suffix)
+                    and inst.kind == "histogram"):
+                continue
+            site = name[len(strip):-len(suffix)]
+            val = inst.value
+            counts, edges = val["counts"], val["buckets"]
+            hi = None
+            for i, c in enumerate(counts):
+                if c:
+                    hi = edges[min(i, len(edges) - 1)]
+            if hi is not None:
+                ranges[site] = hi
+        if not ranges:
+            raise MXNetError(
+                "no %s.*%s histograms found — run a forward pass under "
+                "quant.collecting() first" % (prefix, suffix))
+        return cls(ranges)
+
+    def __repr__(self):
+        return "CalibrationTable(%d sites, digest=%s)" % (
+            len(self.ranges), self.digest())
+
+
+def tolerance_check(ref, got, tol=None):
+    """The PR 10 accuracy-gate discipline for quantized serving: max
+    |got - ref| normalized by max|ref| must stay under the tolerance
+    (``MXNET_QUANT_TOLERANCE``).  Returns the report dict; raises
+    MXNetError when the gate fails."""
+    tol = quant_tolerance() if tol is None else float(tol)
+    ref = onp.asarray(ref, dtype=onp.float64)
+    got = onp.asarray(got, dtype=onp.float64)
+    denom = float(onp.max(onp.abs(ref)))
+    denom = denom if denom > 0 else 1.0
+    err = float(onp.max(onp.abs(got - ref))) / denom
+    report = {"max_rel_err": err, "tolerance": tol, "passed": err <= tol}
+    if not report["passed"]:
+        raise MXNetError(
+            "quantized serving failed the tolerance gate: max relative "
+            "error %.4g > %.4g (MXNET_QUANT_TOLERANCE)" % (err, tol))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the trace-time GEMM scope (consulted by ops/nn.py + ops/conv.py)
+# ---------------------------------------------------------------------------
+class _GemmScope(threading.local):
+    mode = None      # None | "collect" | "int8" | "fp8"
+    table = None     # CalibrationTable in "int8" mode
+    counts = None    # kind -> next trace-order index
+
+
+_SCOPE = _GemmScope()
+# process-global "a calibration pass is collecting" flag; consulted at
+# TRACE time by trace_gemm_scope so a fresh executor traced inside
+# collecting() bakes the observation callbacks into its program
+_COLLECT = threading.local()
+
+
+@contextmanager
+def collecting():
+    """Mark a calibration pass: any eval program TRACED inside this
+    block observes per-site input amax into the ``quant.calib.*``
+    telemetry histograms at every run."""
+    prev = getattr(_COLLECT, "on", False)
+    _COLLECT.on = True
+    try:
+        yield
+    finally:
+        _COLLECT.on = prev
+
+
+def collect_active():
+    return getattr(_COLLECT, "on", False)
+
+
+@contextmanager
+def trace_gemm_scope(policy):
+    """Entered INSIDE the traced eval body by the executor so every
+    (re)trace sees the scope with fresh trace-order site counters.  The
+    mode resolves at trace time: a collect pass wins, else the policy's
+    ``narrow_math``, else a no-op passthrough (byte-identical
+    programs)."""
+    if collect_active():
+        mode, table = "collect", None
+    else:
+        mode = getattr(policy, "narrow_math", None) if policy else None
+        table = getattr(policy, "calibration", None) if policy else None
+    prev = (_SCOPE.mode, _SCOPE.table, _SCOPE.counts)
+    _SCOPE.mode, _SCOPE.table, _SCOPE.counts = mode, table, {}
+    try:
+        yield
+    finally:
+        _SCOPE.mode, _SCOPE.table, _SCOPE.counts = prev
+
+
+def _next_site(kind):
+    i = _SCOPE.counts.get(kind, 0)
+    _SCOPE.counts[kind] = i + 1
+    return "%s%d" % (kind, i)
+
+
+def _observe_amax(site, amax):
+    from ..telemetry import registry as _reg
+    _reg().histogram("%s.%s.x_amax" % (CALIB_PREFIX, site),
+                     buckets=CALIB_BUCKETS).observe(float(amax))
+
+
+def _collect_hook(jnp, x, site):
+    """Bake an amax observation into the traced program (fires per
+    run, outside XLA, into the process-wide registry)."""
+    import jax
+    jax.debug.callback(
+        lambda a, _site=site: _observe_amax(_site, a),
+        jnp.max(jnp.abs(x.astype(jnp.float32))))
+
+
+# capability probes: one tiny EAGER op per narrow kernel family; a
+# backend without the native lowering falls back to the fake-quantized
+# round trip so the seam never hard-fails at trace time
+_CAPS = {}
+
+
+def _capable(key, fn):
+    if key not in _CAPS:
+        try:
+            fn()
+            _CAPS[key] = True
+        except Exception:  # pragma: no cover - backend-dependent
+            _CAPS[key] = False
+    return _CAPS[key]
+
+
+def _int8_dot_native():
+    def probe():
+        import jax.numpy as jnp
+        from jax import lax
+        a = jnp.zeros((2, 4), jnp.int8)
+        b = jnp.zeros((3, 4), jnp.int8)
+        r = lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+        assert r.dtype == jnp.int32
+    return _capable("int8_dot", probe)
+
+
+def _int8_conv_native():
+    def probe():
+        import jax.numpy as jnp
+        from jax import lax
+        a = jnp.zeros((1, 2, 4, 4), jnp.int8)
+        b = jnp.zeros((3, 2, 3, 3), jnp.int8)
+        dn = lax.conv_dimension_numbers(a.shape, b.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        r = lax.conv_general_dilated(
+            a, b, (1, 1), [(0, 0), (0, 0)], dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+        assert r.dtype == jnp.int32
+    return _capable("int8_conv", probe)
+
+
+def _fp8_dot_native():
+    def probe():
+        import jax.numpy as jnp
+        from jax import lax
+        import ml_dtypes
+        a = jnp.zeros((2, 4), ml_dtypes.float8_e4m3fn)
+        r = lax.dot_general(a, a, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        assert r.dtype == jnp.float32
+    return _capable("fp8_dot", probe)
+
+
+def _x_scale(jnp, x, site):
+    """Static scale from the calibration table when the site was
+    observed, else a dynamic per-tensor scale (zero-guarded)."""
+    table = _SCOPE.table
+    s = table.scale(site) if table is not None else None
+    if s is not None:
+        return jnp.float32(s), True
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return jnp.where(amax > 0, amax / 127.0, 1.0), False
+
+
+def narrow_dot(jnp, lax, x2, w, f32_precision):
+    """The FullyConnected GEMM under an active scope: ``x2`` (B, K),
+    ``w`` (C, K), result (B, C) in ``x2``'s dtype.  Returns None when
+    the scope is inactive (caller keeps its wide dot)."""
+    mode = _SCOPE.mode
+    if mode is None:
+        return None
+    if mode == "collect":
+        _collect_hook(jnp, x2, _next_site("fc"))
+        return None
+    if mode == "int8":
+        site = _next_site("fc")
+        sx, _static = _x_scale(jnp, x2, site)
+        # per-output-channel weight scale, zero-channel guarded
+        wf = w.astype(jnp.float32)
+        wmax = jnp.max(jnp.abs(wf), axis=1)
+        sw = jnp.where(wmax > 0, wmax / 127.0, 1.0)
+        qx = jnp.clip(jnp.round(x2.astype(jnp.float32) / sx),
+                      -127.0, 127.0).astype(jnp.int8)
+        qw = jnp.clip(jnp.round(wf / sw[:, None]),
+                      -127.0, 127.0).astype(jnp.int8)
+        if _int8_dot_native():
+            acc = lax.dot_general(qx, qw, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32)
+        else:  # pragma: no cover - backend-dependent
+            y = jnp.dot(qx.astype(jnp.float32), qw.astype(jnp.float32).T,
+                        precision=f32_precision)
+        return (y * sx * sw[None, :]).astype(x2.dtype)
+    if mode == "fp8":
+        _next_site("fc")
+        import ml_dtypes
+        e4m3 = ml_dtypes.float8_e4m3fn
+        if _fp8_dot_native():
+            acc = lax.dot_general(x2.astype(e4m3), w.astype(e4m3),
+                                  (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        else:  # pragma: no cover - backend-dependent
+            acc = jnp.dot(x2.astype(e4m3).astype(jnp.float32),
+                          w.astype(e4m3).astype(jnp.float32).T,
+                          precision=f32_precision)
+        return acc.astype(x2.dtype)
+    raise MXNetError("unknown gemm-scope mode %r" % (mode,))
+
+
+def narrow_conv(jnp, lax, x, w, conv_kwargs):
+    """The Convolution under an active scope; ``conv_kwargs`` are the
+    caller's ``lax.conv_general_dilated`` keywords (strides, padding,
+    dimension_numbers, ...).  Returns None when inactive."""
+    mode = _SCOPE.mode
+    if mode is None:
+        return None
+    if mode == "collect":
+        _collect_hook(jnp, x, _next_site("conv"))
+        return None
+    if mode == "int8" and _int8_conv_native():
+        site = _next_site("conv")
+        sx, _static = _x_scale(jnp, x, site)
+        wf = w.astype(jnp.float32)
+        # per-output-channel (OIHW axis 0) scale over I/H/W
+        wmax = jnp.max(jnp.abs(wf), axis=tuple(range(1, w.ndim)))
+        sw = jnp.where(wmax > 0, wmax / 127.0, 1.0)
+        qx = jnp.clip(jnp.round(x.astype(jnp.float32) / sx),
+                      -127.0, 127.0).astype(jnp.int8)
+        qw = jnp.clip(jnp.round(wf / sw.reshape((-1,) + (1,)
+                                                * (w.ndim - 1))),
+                      -127.0, 127.0).astype(jnp.int8)
+        kw = dict(conv_kwargs)
+        kw.pop("precision", None)
+        acc = lax.conv_general_dilated(qx, qw,
+                                       preferred_element_type=jnp.int32,
+                                       **kw)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        return (acc.astype(jnp.float32) * sx
+                * sw.reshape(bshape)).astype(x.dtype)
+    if mode in ("int8", "fp8"):
+        # no native narrow conv on this backend (or fp8 conv, which XLA
+        # does not lower anywhere yet): fake-quantized round trip of
+        # both operands keeps the numerics family while the GEMM stays
+        # wide — honest fallback, the dot sites still shrink
+        from .policy import fake_cast
+        kind = "int8" if mode == "int8" else "fp8"
+        _next_site("conv")
+        xq = fake_cast(jnp, x, kind)
+        wq = fake_cast(jnp, w, kind)
+        return lax.conv_general_dilated(xq, wq, **conv_kwargs)
+    raise MXNetError("unknown gemm-scope mode %r" % (mode,))
+
+
+# ---------------------------------------------------------------------------
+# the calibration pass
+# ---------------------------------------------------------------------------
+def calibrate(module, data_iter, num_batches=None, prefix=CALIB_PREFIX):
+    """Post-training calibration: forward ``num_batches`` batches
+    (default ``MXNET_PRECISION_CALIB_BATCHES``) through an eval-bound
+    module with the GEMM scope collecting, then read the harvested
+    histograms into a :class:`CalibrationTable`.
+
+    The module must be FRESHLY bound (its eval program not yet traced):
+    the observation hooks bake in at trace time.  Standard flow::
+
+        mod = mx.mod.Module(net)
+        mod.bind(data_shapes=it.provide_data, for_training=False)
+        mod.set_params(arg_params, aux_params)
+        table = quant.calibrate(mod, it)
+    """
+    from ..telemetry import registry as _reg
+    n = calib_batches() if num_batches is None else int(num_batches)
+    if n <= 0:
+        raise MXNetError("calibration needs num_batches >= 1")
+    # drop stale harvests so the table reflects THIS pass only
+    _reg().drop_scope(prefix)
+    data_iter.reset()
+    seen = 0
+    with collecting():
+        for batch in data_iter:
+            module.forward(batch, is_train=False)
+            for out in module.get_outputs():
+                out.asnumpy()  # sync so the callbacks have fired
+            seen += 1
+            if seen >= n:
+                break
+    if seen == 0:
+        raise MXNetError("calibration iterator yielded no batches")
+    return CalibrationTable.from_telemetry(prefix=prefix)

@@ -437,12 +437,28 @@ class DecodeEngine(object):
         self._digest = model.params_digest(host)
         cdt = jnp.dtype(self._policy.compute_dtype or "float32")
         self._compute_dtype = cdt
-        self._dparams = {
-            k: jax.device_put(
-                jnp.asarray(v).astype(cdt)
-                if onp.issubdtype(v.dtype, onp.floating)
-                else jnp.asarray(v))
-            for k, v in host.items()}
+        self._weight_quant = getattr(self._policy, "weight_quant", None)
+        if self._weight_quant == "int8":
+            # weight-only int8 (precision.quant): params live on device
+            # as per-channel int8 + f32 scales; the step program
+            # dequantizes IN-PROGRAM, so its arguments — re-read every
+            # token on the memory-bound decode path — shrink ~4x
+            # (step_argument_bytes is the witness)
+            from ..precision import quant as _quant
+            self._dparams = {
+                k: jax.device_put(
+                    jnp.asarray(v).astype(cdt)
+                    if (not _quant.is_quantized(v)
+                        and onp.issubdtype(v.dtype, onp.floating))
+                    else v)
+                for k, v in _quant.quantize_params(host).items()}
+        else:
+            self._dparams = {
+                k: jax.device_put(
+                    jnp.asarray(v).astype(cdt)
+                    if onp.issubdtype(v.dtype, onp.floating)
+                    else jnp.asarray(v))
+                for k, v in host.items()}
 
         # power-of-two length-bucket ladder (Predictor idiom)
         top = max(4, int(max_prefill_len))
@@ -552,11 +568,24 @@ class DecodeEngine(object):
             / onp.float32(self._temperature)
         return jnp.argmax(scaled + g, axis=-1).astype(jnp.int32)
 
+    def _dense_params(self, params):
+        """The dense param view a program body consumes: in-program
+        per-channel dequant under weight-only int8 (the executable's
+        ARGUMENTS stay int8 — that is the bytes win), identity
+        otherwise.  Bitwise-deterministic per (q, s), so quantized
+        decode streams and the prefill-parity reference agree exactly."""
+        if self._weight_quant != "int8":
+            return params
+        import jax.numpy as jnp
+        from ..precision import quant as _quant
+        return _quant.dequant_params(jnp, params, self._compute_dtype)
+
     def _build_programs(self):
         import jax
         import jax.numpy as jnp
         model, slots, pb = self._model, self._slots, PREFILL_ROWS
         tree = jax.tree_util.tree_map
+        dense = self._dense_params
 
         def init_fn():
             self._count_trace("state_init", slots=(slots,))
@@ -564,7 +593,7 @@ class DecodeEngine(object):
 
         def step_fn(params, state, tokens, active, steps, seeds):
             self._count_trace("step", tokens=(slots,))
-            rows, logits = model.step(params, tokens, state)
+            rows, logits = model.step(dense(params), tokens, state)
             nxt = self._select(logits, steps, seeds)
             bmask = lambda ref: active.reshape(  # noqa: E731
                 (slots,) + (1,) * (ref.ndim - 1))
@@ -584,8 +613,8 @@ class DecodeEngine(object):
                         jnp.take(s, clip, axis=0),
                         jnp.zeros((pb,) + s.shape[1:], s.dtype)),
                     state)
-                rows, logits = model.prefill(params, tokens, lengths,
-                                             rows0)
+                rows, logits = model.prefill(dense(params), tokens,
+                                             lengths, rows0)
                 # OOB index == slots → dropped: the padding rows (and
                 # non-final chunks of co-padded rows) never land
                 state = tree(
@@ -643,6 +672,33 @@ class DecodeEngine(object):
                 return b
         return self._buckets[-1]
 
+    # -- weight-bytes accounting (the memory-bound decode roofline) ------
+    def weight_bytes(self):
+        """Stored bytes of the device-resident param tree — what the
+        decode step re-reads per token.  Under ``int8_weight`` this is
+        the int8 payloads + f32 scale vectors (~4x under the f32
+        tree)."""
+        import jax
+        return int(sum(x.size * onp.dtype(x.dtype).itemsize
+                       for x in jax.tree_util.tree_leaves(
+                           self._dparams)))
+
+    def step_argument_bytes(self):
+        """``analyze_compiled`` argument bytes of the decode STEP
+        program — the byte witness the quant mode must shrink (the
+        arguments are dominated by the weights every token re-reads).
+        Uses the warmed executable when present, else an AOT compile
+        outside the retrace counters."""
+        from ..telemetry import analyze_compiled
+        compiled = self._step_exec
+        if compiled is None:
+            with telemetry.compile_watch().suppressed():
+                for name, _b, jit_fn, args, _i in self._program_specs():
+                    if name == "step":
+                        compiled = jit_fn.lower(*args).compile()
+                        break
+        return int(analyze_compiled(compiled).get("argument_bytes", 0))
+
     # -- warmup / executable cache --------------------------------------
     def _program_specs(self):
         """(name, bucket, jit, abstract_args, install) for the whole
@@ -690,6 +746,12 @@ class DecodeEngine(object):
         input_sig = ("decode.%s:model=%s;slots=%d;pb=%d;temp=%g"
                      % (name, self._model.signature(), self._slots,
                         PREFILL_ROWS, self._temperature))
+        if self._weight_quant:
+            # quantized storage changes the program's argument layout
+            # (int8 payloads + scale vectors): the quant scheme rides
+            # the input signature so a wide replica can never adopt a
+            # narrow executable (belt to the precision-mode suspender)
+            input_sig += ";wq=%s" % self._weight_quant
         return _cache.cache_key(self._digest, self._policy.name,
                                 bucket, input_sig, backend)
 
@@ -811,8 +873,8 @@ class DecodeEngine(object):
 
                 def ref_fn(params, tokens, lengths):
                     rows0 = self._state_zeros(pb)
-                    _, lg = model.prefill(params, tokens, lengths,
-                                          rows0)
+                    _, lg = model.prefill(self._dense_params(params),
+                                          tokens, lengths, rows0)
                     return lg
                 ref_jit = self._ref_jits[L] = jax.jit(ref_fn)
             toks = onp.zeros((PREFILL_ROWS, L), onp.int32)
@@ -1187,5 +1249,7 @@ class DecodeEngine(object):
                 "p99": ServingStats._pct(ttfts, 99),
             },
             "precision_mode": self._policy.name,
+            "weight_quant": self._weight_quant,
+            "weight_bytes": self.weight_bytes(),
         }
         return s

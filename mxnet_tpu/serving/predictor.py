@@ -70,11 +70,16 @@ class Predictor:
         ``buckets`` is given). Larger requests are chunked.
     context : list of Context, optional
         Serving devices; defaults to the source module's contexts.
+    calibration : CalibrationTable, optional
+        Static per-site activation ranges (``precision.quant``) for a
+        ``narrow_math`` policy: required by ``int8_serve`` (the int8
+        activation scales must come from a calibration pass, not from
+        in-program reductions); its digest keys the executable cache.
     """
 
     def __init__(self, module, data_shapes=None, buckets=None,
                  max_batch_size=32, context=None, logger=None,
-                 latency_window=2048):
+                 latency_window=2048, calibration=None):
         if not isinstance(module, Module):
             raise MXNetError(
                 "Predictor needs a plain Module (got %s); for wrapper "
@@ -192,10 +197,29 @@ class Predictor:
         serve_pol = None
         if src_pol is not None:
             from ..precision import PrecisionPolicy
+            narrow = getattr(src_pol, "narrow_math", None)
+            table = calibration if calibration is not None \
+                else getattr(src_pol, "calibration", None)
+            if narrow == "int8" and table is None:
+                raise MXNetError(
+                    "precision mode %r needs a CalibrationTable "
+                    "(static int8 activation scales): run "
+                    "precision.quant.calibrate(...) and pass the "
+                    "table via Predictor(calibration=...)"
+                    % src_pol.name)
             serve_pol = PrecisionPolicy(
                 name=src_pol.name, compute_dtype=src_pol.compute_dtype,
                 act_cast=src_pol.act_cast,
+                weight_quant=getattr(src_pol, "weight_quant", None),
+                narrow_math=narrow, calibration=table,
                 experimental=src_pol.experimental)
+        elif calibration is not None:
+            raise MXNetError(
+                "Predictor(calibration=...) only applies to a module "
+                "bound under a narrow_math precision mode (e.g. "
+                "'int8_serve')")
+        self._calibration = calibration if serve_pol is None \
+            else serve_pol.calibration
 
         def _make(extra):
             return Module(symbol, data_names=module._data_names,
@@ -451,9 +475,16 @@ class Predictor:
         backend = _cache.backend_signature(
             mesh_axes=grp.mesh_axes, n_dev=int(grp.mesh.devices.size),
             device_kind=grp._device_kind, platform=grp._platform)
+        input_sig = _cache.input_signature(self._data_descs)
+        if self._calibration is not None:
+            # two calibration passes may produce different static
+            # scales — and therefore different programs — under the
+            # same mode name and params digest: the table digest keeps
+            # their executables apart
+            input_sig += ";calib=%s" % self._calibration.digest()
         return _cache.cache_key(
             self._params_digest, grp.precision_mode_name(), bucket,
-            _cache.input_signature(self._data_descs), backend)
+            input_sig, backend)
 
     def _warm_bucket(self, bucket, store, watch):
         """AOT-warm one bucket through the persistent executable

@@ -87,6 +87,15 @@ CASES = [
     # win (the full seeded witness runs in ci.sh / dryrun_decode)
     ("rnn/decode_lm.py",
      ["--num-epochs", "3", "--seq-len", "16", "--num-hidden", "64"]),
+    # weight-only int8 decode (mxnet_tpu.precision.quant): the same
+    # decode demo served through precision="int8_weight" — the script
+    # additionally asserts the compiled step program's analyzed
+    # argument bytes shrink vs the f32 engine (the memory-bound decode
+    # win) while parity/continuation/throughput asserts still hold
+    # (the full seeded witness runs in ci.sh / dryrun_quant)
+    ("rnn/decode_lm.py",
+     ["--num-epochs", "3", "--seq-len", "16", "--num-hidden", "64",
+      "--int8-weights"]),
     ("rnn/bucketing_lstm.py", ["--num-epoch", "3", "--num-hidden", "32"]),
     ("profiler/profiler_demo.py",
      ["--iter-num", "5", "--size", "128",

@@ -1,0 +1,257 @@
+"""mxnet_tpu.precision.quant — native low-bit compute (ISSUE 17).
+
+The three tentpole pieces and their contracts:
+
+* weight-only int8 — per-channel symmetric storage with zero-channel
+  guards, exact round-trip determinism, in-program dequant that
+  shrinks the decode step's analyzed argument bytes vs bf16/f32 while
+  the prefill-parity pin and warm-replica zero-compile contracts hold;
+* post-training calibration — collect-mode forward passes populate
+  the quant.calib.* telemetry histograms, the CalibrationTable reads
+  conservative ranges with a stable digest, and calibrated int8_serve
+  Predictor output stays inside MXNET_QUANT_TOLERANCE of f32;
+* narrow-math GEMM seam + registry modes — int8_weight / int8_serve /
+  fp8_native carry the PR 10 mode/contract discipline: manifest
+  round-trip, serving-only training refusal, cache-key separation.
+
+Plus the fake_cast zero-input pin: an all-zero tensor must round-trip
+to finite zeros (scale-0 guard), for both the int8 and fp8 branches.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.precision import MODES, PrecisionPolicy, fake_cast, quant
+from mxnet_tpu.serving.decode import DecodeEngine, LSTMCharLM
+from mxnet_tpu.serving.predictor import Predictor
+
+
+# ------------------------------------------------------------- fake_cast
+def test_fake_cast_zero_input_pin():
+    """All-zero tensors must survive the fake-quant round trip as
+    finite zeros — a per-tensor amax of 0 must never become a 0/0
+    scale (NaN) or an inf."""
+    import jax.numpy as jnp
+    z = jnp.zeros((4, 5), jnp.float32)
+    for kind in ("int8", "fp8"):
+        out = np.asarray(fake_cast(jnp, z, kind))
+        assert np.all(np.isfinite(out)), kind
+        assert np.array_equal(out, np.zeros((4, 5), np.float32)), kind
+
+
+def test_fake_cast_int8_nonzero_roundtrip():
+    import jax.numpy as jnp
+    v = jnp.asarray(np.linspace(-2.0, 2.0, 16, dtype=np.float32))
+    out = np.asarray(fake_cast(jnp, v, "int8"))
+    assert np.all(np.isfinite(out))
+    assert np.max(np.abs(out - np.asarray(v))) <= 2.0 / 127.0 + 1e-6
+
+
+# ------------------------------------------------ per-channel weight quant
+def test_quantize_weight_zero_channel_guard():
+    w = np.zeros((3, 4), np.float32)
+    w[1] = np.linspace(-1, 1, 4)
+    q, s = quant.quantize_weight(w)
+    assert q.dtype == np.int8 and s.dtype == np.float32
+    assert np.all(np.isfinite(s)) and np.all(s > 0)
+    # all-zero channels dequantize to exact zeros
+    assert np.all(q[0] == 0) and np.all(q[2] == 0)
+    deq = q.astype(np.float32) * s[:, None]
+    assert np.array_equal(deq[0], np.zeros(4, np.float32))
+    # the nonzero channel is within half a quantization step
+    assert np.max(np.abs(deq[1] - w[1])) <= s[1] * 0.5 + 1e-7
+
+
+def test_quantize_params_tree_shapes_and_bytes():
+    params = {"w": np.random.RandomState(0).randn(8, 4).astype(
+        np.float32), "b": np.zeros((8,), np.float32),
+        "idx": np.arange(4, dtype=np.int32)}
+    qt = quant.quantize_params(params)
+    assert quant.is_quantized(qt["w"])
+    assert not quant.is_quantized(qt["b"])       # 1-d passes through
+    assert not quant.is_quantized(qt["idx"])     # ints pass through
+    assert qt["w"].q.shape == (8, 4) and qt["w"].s.shape == (8,)
+    # 8*4 int8 + 8 f32 scales + 8 f32 bias + 4 i32
+    assert quant.tree_bytes(qt) == 32 + 32 + 32 + 16
+    import jax.numpy as jnp
+    deq = quant.dequant_params(jnp, qt, np.float32)
+    assert np.max(np.abs(np.asarray(deq["w"]) - params["w"])) \
+        <= np.max(np.abs(params["w"])) / 127.0 + 1e-7
+    assert np.array_equal(np.asarray(deq["b"]), params["b"])
+
+
+# -------------------------------------------------------- registry modes
+def test_new_modes_registered_with_expected_fields():
+    assert MODES["int8_weight"].weight_quant == "int8"
+    assert MODES["int8_weight"].serving_only()
+    assert not MODES["int8_weight"].experimental
+    assert MODES["int8_serve"].narrow_math == "int8"
+    assert MODES["int8_serve"].act_cast == "int8"
+    assert MODES["fp8_native"].narrow_math == "fp8"
+    assert MODES["fp8_native"].experimental
+    # describe()/manifest round trip carries the new fields
+    desc = MODES["int8_serve"].describe()
+    assert desc["narrow_math"] == "int8"
+    from mxnet_tpu.module.module import Module
+    pol = Module._policy_from_manifest("int8_serve", desc)
+    assert pol.narrow_math == "int8" and pol.act_cast == "int8"
+
+
+def test_auto_name_carries_quant_fields():
+    p = PrecisionPolicy(weight_quant="int8")
+    assert "wq=int8" in p.name and not p.is_default()
+    p2 = PrecisionPolicy(narrow_math="fp8")
+    assert "nm=fp8" in p2.name
+
+
+def test_serving_only_mode_refuses_training_bind():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4)
+    mod = mx.mod.Module(net, label_names=[], precision="int8_weight")
+    with pytest.raises(ValueError, match="serving-only"):
+        mod.bind(data_shapes=[("data", (4, 8))], for_training=True)
+
+
+# ------------------------------------------------------ calibration table
+def test_calibration_table_json_roundtrip_and_digest():
+    t = quant.CalibrationTable({"fc0": 2.0, "fc1": 0.5})
+    t2 = quant.CalibrationTable.from_json(t.to_json())
+    assert t2.ranges == t.ranges and t2.digest() == t.digest()
+    assert t.scale("fc0") == pytest.approx(2.0 / 127.0)
+    assert t.scale("missing") is None
+    assert t.digest() != quant.CalibrationTable(
+        {"fc0": 2.0, "fc1": 1.0}).digest()
+    with pytest.raises(MXNetError):
+        quant.CalibrationTable({"fc0": 0.0})
+    with pytest.raises(MXNetError):
+        quant.CalibrationTable({"fc0": float("inf")})
+
+
+def _mlp():
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    return mx.sym.FullyConnected(h, num_hidden=8, name="fc2")
+
+
+def _bound_mlp(arg_params=None, aux_params=None, **kw):
+    it_shapes = [("data", (8, 12))]
+    mod = mx.mod.Module(_mlp(), label_names=[], context=[mx.cpu(0)],
+                        **kw)
+    mod.bind(data_shapes=it_shapes, for_training=False)
+    if arg_params is None:
+        mod.init_params(mx.init.Xavier())
+    else:
+        mod.set_params(arg_params, aux_params or {})
+    return mod
+
+
+def test_calibrate_harvests_telemetry_and_serves_within_tolerance():
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 12).astype(np.float32)
+    it = mx.io.NDArrayIter(X, None, batch_size=8)
+    src = _bound_mlp()
+    arg_p, aux_p = src.get_params()
+
+    table = quant.calibrate(_bound_mlp(arg_p, aux_p), it,
+                            num_batches=3)
+    assert set(table.ranges) == {"fc0", "fc1"}
+    hists = telemetry.registry().snapshot()["histograms"]
+    keys = [k for k in hists if k.startswith("quant.calib.")]
+    assert len(keys) == 2 and all(hists[k]["count"] >= 3 for k in keys)
+
+    ref = Predictor(src, max_batch_size=8)
+    ref.warmup()
+    r = np.asarray(ref.predict(X[:8]))
+
+    m8 = _bound_mlp(arg_p, aux_p, precision="int8_serve")
+    p8 = Predictor(m8, max_batch_size=8, calibration=table)
+    p8.warmup()
+    g = np.asarray(p8.predict(X[:8]))
+    rep = quant.tolerance_check(r, g)
+    assert rep["passed"] and rep["max_rel_err"] <= rep["tolerance"]
+
+
+def test_int8_serve_without_table_refused():
+    m8 = _bound_mlp(precision="int8_serve")
+    with pytest.raises(MXNetError, match="CalibrationTable"):
+        Predictor(m8, max_batch_size=8)
+
+
+def test_tolerance_check_gate_raises():
+    with pytest.raises(MXNetError, match="tolerance"):
+        quant.tolerance_check(np.ones(4), np.ones(4) * 2.0, tol=0.01)
+    rep = quant.tolerance_check(np.zeros(4), np.zeros(4))
+    assert rep["passed"]  # zero reference must not divide by zero
+
+
+# --------------------------------------------- weight-only int8 decoding
+def _lm():
+    model = LSTMCharLM(vocab_size=32, num_hidden=32, num_embed=16)
+    return model, model.init_params(seed=5)
+
+
+def test_int8_weight_decode_parity_and_byte_witness():
+    model, params = _lm()
+    e32 = DecodeEngine(model, params, slots=2, max_prefill_len=8,
+                       start=False)
+    e8 = DecodeEngine(model, params, slots=2, max_prefill_len=8,
+                      start=False, precision="int8_weight")
+    try:
+        # the byte witness: quantized storage shrinks the step
+        # program's ARGUMENT bytes, not just host-side accounting
+        assert e8.step_argument_bytes() < e32.step_argument_bytes()
+        assert e8.weight_bytes() < e32.weight_bytes()
+        # prefill-bucket parity pin holds under quantized weights
+        for n in (1, 3, 7, 8):
+            assert e8.prefill_parity(list(range(1, n + 1)))
+        # deterministic streams per (params, prompt, seed)
+        e8.start()
+        s1 = e8.generate([1, 2, 3], max_new_tokens=6, seed=4,
+                         timeout=60)
+        s2 = e8.generate([1, 2, 3], max_new_tokens=6, seed=4,
+                         timeout=60)
+        assert s1 == s2
+        assert e8.stats()["decode"]["weight_quant"] == "int8"
+    finally:
+        e8.shutdown(drain=True)
+        e32.release()
+        e8.release()
+
+
+def test_int8_weight_warm_replica_and_cache_key_separation(tmp_path):
+    model, params = _lm()
+    cache = str(tmp_path)
+    a = DecodeEngine(model, params, slots=2, max_prefill_len=8,
+                     start=False, precision="int8_weight")
+    a.warmup(cache_dir=cache)
+    a.start()
+    sa = a.generate([3, 1, 2], max_new_tokens=5, seed=7, timeout=60)
+    a.shutdown(drain=True)
+    a.release()
+
+    # warm replica: every program deserializes, zero XLA compiles
+    b = DecodeEngine(model, params, slots=2, max_prefill_len=8,
+                     start=False, precision="int8_weight")
+    b.warmup(cache_dir=cache)
+    assert all(v["source"] == "deserialized"
+               for v in b.warmup_report().values())
+    assert b.stats()["compiles"] == 0
+    b.start()
+    sb = b.generate([3, 1, 2], max_new_tokens=5, seed=7, timeout=60)
+    assert sa == sb
+    b.shutdown(drain=True)
+    b.release()
+
+    # an f32 engine must NOT adopt the int8 entries (key separation)
+    c = DecodeEngine(model, params, slots=2, max_prefill_len=8,
+                     start=False)
+    c.warmup(cache_dir=cache)
+    assert all(v["source"] == "compiled"
+               for v in c.warmup_report().values())
+    c.shutdown(drain=True)
+    c.release()
