@@ -483,6 +483,18 @@ def main():
             extra.update(_bench_sharded_cache(mx, batch, extra))
         except Exception as e:
             extra["sharded_cache_error"] = str(e)[:160]
+
+    if os.environ.get("BENCH_AUTOPILOT", "1") != "0":
+        # fleet autopilot (mxnet_tpu.autopilot, docs/api/autopilot.md):
+        # replica spin-up latency through the persistent executable
+        # cache vs a cold JIT spin-up (the scale-out an SLO breach
+        # triggers), and peer-memory checkpoint assembly vs the disk
+        # restore of the same step (the elastic goodput win). Cheap
+        # enough (one tiny MLP) to stay on in the CPU contract smoke.
+        try:
+            extra.update(_bench_autopilot(mx))
+        except Exception as e:
+            extra["autopilot_error"] = str(e)[:160]
     _emit(img_per_sec, extra)
 
 
@@ -1214,6 +1226,97 @@ def _bench_sharded_cache(mx, step_batch, seen_extra=None):
         out["io_cache_placement"] = single_info["placement"]
         out["io_cache_bytes"] = single_info["bytes"]
     return out
+
+
+def _bench_autopilot(mx):
+    """Autopilot actuator latencies (docs/api/autopilot.md): the
+    scale-out spin-up a breach triggers — cold (fresh JIT of every
+    bucket) vs warm (deserialized from the persistent executable
+    cache, the ReplicaPool path) — and the elastic resume restore —
+    peer host-memory assembly (PeerCheckpointStore) vs the manager's
+    disk restore of the same step.
+
+    ``autopilot_spinup_warm_over_cold`` and
+    ``peer_over_disk_restore`` are the two speedups the autopilot's
+    zero-recompile / zero-reread claims buy."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu.autopilot import PeerCheckpointStore, ReplicaPool
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.serving import Predictor
+
+    dim = 16
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, dim).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.float32)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mx.random.seed(7)
+    mod = mx.mod.Module(net, context=[mx.cpu()])
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=8), num_epoch=1,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+
+    tmp = tempfile.mkdtemp(prefix="bench_autopilot_")
+    out = {}
+    try:
+        mgr = CheckpointManager(os.path.join(tmp, "ckpt"))
+        mod.save_checkpoint(None, 1, manager=mgr, async_save=False)
+        shapes = [("data", (8, dim))]
+
+        def factory():
+            return Predictor.load(mgr, 1, data_shapes=shapes)
+
+        # cold: every bucket is a fresh XLA compile
+        with ReplicaPool(factory, min_replicas=1, max_replicas=1,
+                         cache_dir=None) as cold:
+            out["autopilot_spinup_cold_ms"] = round(
+                cold.spinup_reports[0]["spinup_ms"], 3)
+
+        # warm: the cache a real pool's first replica committed
+        cache_dir = os.path.join(tmp, "exec_cache")
+        seed_pred = factory()
+        seed_pred.warmup(cache_dir=cache_dir)
+        seed_pred.release()
+        with ReplicaPool(factory, min_replicas=1, max_replicas=1,
+                         cache_dir=cache_dir) as warm:
+            out["autopilot_spinup_warm_ms"] = round(
+                warm.spinup_reports[0]["spinup_ms"], 3)
+        out["autopilot_spinup_warm_over_cold"] = round(
+            out["autopilot_spinup_cold_ms"] /
+            max(out["autopilot_spinup_warm_ms"], 1e-9), 2)
+
+        # peer-memory assembly vs the disk restore of the same step
+        arrays = mod._checkpoint_arrays()
+        opt = mod._optimizer_state_bytes()
+        mgr.save(2, arrays, optimizer_state=opt, extra={"epoch": 1},
+                 async_save=False)
+        store = PeerCheckpointStore(2)
+        store.capture(2, arrays, optimizer_state=opt,
+                      extra={"epoch": 1})
+        t0 = time.perf_counter()
+        peer_ck = store.restore(2)
+        out["peer_restore_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 3)
+        t0 = time.perf_counter()
+        disk_ck = mgr.restore(2)
+        out["disk_restore_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 3)
+        out["peer_over_disk_restore"] = round(
+            out["disk_restore_ms"] /
+            max(out["peer_restore_ms"], 1e-9), 2)
+        assert all(np.array_equal(np.asarray(peer_ck.params[k]),
+                                  np.asarray(disk_ck.params[k]))
+                   for k in disk_ck.params)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
 
 
 def _bench_pipeline_clean(mx, recs, step_batch, steps, img):

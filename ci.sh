@@ -421,6 +421,26 @@ stage "chaos-soak numeric stage (training guardian heals NaN + loss spike)"
 python -c "from __graft_entry__ import dryrun_chaos_numeric; dryrun_chaos_numeric(8)" \
     || FAILED=1
 
+stage "autopilot gate (telemetry-to-action loop closes, warm + bitwise)"
+# fleet-autopilot contract (docs/api/autopilot.md): (a) an injected
+# slo.* burn-rate breach scales the ReplicaPool out through the
+# persistent executable cache — every bucket deserialized, zero XLA
+# compiles, rows bitwise the first replica's; (b) cooldown hysteresis
+# holds, then sustained idle scales back in; (c) a NaN-poisoned
+# committed generation is admitted as a canary, fails the finite
+# probe, rolls back and is NEVER promoted, while the clean generation
+# is — the protected stable route stays bitwise-clean throughout;
+# (d) an elastic dp-shrink (non-ring-adjacent deaths) resumes from
+# the PeerCheckpointStore's host memory, bitwise vs the disk restore
+# AND the disk-resumed control run's final params; (e) zero
+# post-warmup retraces across all the serving-plane churn; (f) the
+# armed fault plan (blinded poll + failed spin-up) fires exactly its
+# planned incidents and every transcribed decision replays through
+# the pure kernel; (g) autopilot-off serves bitwise-identical rows.
+# Emits AUTOPILOT_r01.json.
+python -c "from __graft_entry__ import dryrun_autopilot; dryrun_autopilot(8)" \
+    || FAILED=1
+
 stage "chaos smoke (train_cifar10 --fault-plan: healed faults keep the digest)"
 # the smoke-sized spelling tests/test_examples.py shares: transient
 # staging faults healed by the shared bounded-backoff retry must leave

@@ -90,6 +90,7 @@ from . import torch
 from . import plugin
 from . import parallel
 from . import dist
+from . import autopilot
 
 from .attribute import AttrScope
 from .name import NameManager

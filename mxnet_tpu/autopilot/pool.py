@@ -1,0 +1,186 @@
+"""ReplicaPool — the serving-autoscale actuator.
+
+Holds N live replicas (``Predictor`` or ``DecodeEngine`` — anything
+the caller's ``factory()`` builds) and moves N toward whatever target
+the autopilot decides, inside ``[min_replicas, max_replicas]``. Every
+spin-up warms the fresh replica through the persistent executable
+cache (``warmup(cache_dir=...)``), so a scale-out under an SLO breach
+serves with ZERO XLA compiles and bitwise-identical rows — the PR 11
+warm-start contract is what makes autoscaling safe to automate.
+Scale-in releases the newest replica (drain first for engines that
+queue).
+
+The spin-up path carries the ``autopilot.scale`` fault seam
+(kind=error): a chaos plan can make a spin-up fail exactly when the
+controller needs it, and the pool must stay at its previous size with
+the failure counted (``autopilot.scale_errors``) — never half-built.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .. import faults as _faults
+
+__all__ = ["ReplicaPool"]
+
+
+class ReplicaPool(object):
+    """A bounded pool of warm serving replicas.
+
+    Parameters
+    ----------
+    factory : callable
+        ``factory() -> replica`` building ONE fresh replica (its own
+        Predictor/DecodeEngine — replicas never share stats scopes).
+    min_replicas / max_replicas : int
+        The pool's hard bounds; ``scale_to`` clamps into them.
+    cache_dir : str, optional
+        Persistent executable-cache root handed to each spin-up's
+        ``warmup(cache_dir=...)``; None warms without the cache (every
+        spin-up then compiles — the cold baseline the bench measures).
+    warm : bool
+        Warm each new replica before it joins (default). ``False``
+        skips warmup for factories that warm internally.
+    start : bool
+        Spin up to ``min_replicas`` at construction (default).
+    """
+
+    def __init__(self, factory, min_replicas=1, max_replicas=2,
+                 cache_dir=None, warm=True, start=True, logger=None):
+        if min_replicas < 0 or max_replicas < min_replicas:
+            raise ValueError(
+                "need 0 <= min_replicas <= max_replicas (got %d..%d)"
+                % (min_replicas, max_replicas))
+        self._factory = factory
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self._cache_dir = cache_dir
+        self._warm = bool(warm)
+        self._replicas = []
+        self._rr = 0
+        self._lock = threading.RLock()
+        self._logger = logger or logging.getLogger(
+            "mxnet_tpu.autopilot")
+        from .. import telemetry
+        scope = telemetry.registry().scope("autopilot")
+        self._g_replicas = scope.gauge("replicas")
+        self._c_out = scope.counter("scale_outs")
+        self._c_in = scope.counter("scale_ins")
+        self._c_err = scope.counter("scale_errors")
+        self.spinup_reports = []
+        if start:
+            self.scale_to(self.min_replicas)
+
+    # ------------------------------------------------------------------
+    @property
+    def size(self):
+        with self._lock:
+            return len(self._replicas)
+
+    @property
+    def replicas(self):
+        """The live replicas, oldest first (a copy)."""
+        with self._lock:
+            return list(self._replicas)
+
+    # ------------------------------------------------------------------
+    def scale_to(self, n):
+        """Move the pool to ``n`` replicas (clamped into the bounds).
+        Spin-ups warm through the executable cache; a spin-up failure
+        (including a fired ``autopilot.scale`` fault) leaves the pool
+        at its current size, counts into ``autopilot.scale_errors``,
+        and re-raises — the controller's tick records the miss and the
+        cooldown paces the retry. Returns the resulting size."""
+        n = max(self.min_replicas, min(self.max_replicas, int(n)))
+        with self._lock:
+            while len(self._replicas) < n:
+                try:
+                    self._spin_up()
+                except BaseException:
+                    self._c_err.add()
+                    raise
+            while len(self._replicas) > n:
+                self._spin_down()
+            self._g_replicas.set(len(self._replicas))
+            return len(self._replicas)
+
+    def _spin_up(self):
+        from .. import telemetry
+        if _faults.armed():
+            # spin-up seam (kind=error): the deterministic stand-in
+            # for a replica that fails to come up (OOM, dead host) —
+            # the pool must absorb it without going half-built
+            _faults.check("autopilot.scale",
+                          replicas=len(self._replicas))
+        t0 = time.perf_counter()
+        rep = self._factory()
+        report = None
+        if self._warm and hasattr(rep, "warmup"):
+            try:
+                rep.warmup(cache_dir=self._cache_dir)
+            except BaseException:
+                self._release(rep)
+                raise
+            if hasattr(rep, "warmup_report"):
+                report = rep.warmup_report()
+        ms = (time.perf_counter() - t0) * 1000.0
+        self._replicas.append(rep)
+        self._c_out.add()
+        sources = sorted({r.get("source") for r in (report or {}).values()})
+        self.spinup_reports.append(
+            {"spinup_ms": round(ms, 3), "sources": sources,
+             "replicas": len(self._replicas)})
+        telemetry.flight_recorder().note(
+            "autopilot_replica_up", replicas=len(self._replicas),
+            spinup_ms=round(ms, 3), sources=sources)
+        self._logger.info(
+            "autopilot: replica %d up in %.1f ms (warm sources: %s)",
+            len(self._replicas), ms, sources or "n/a")
+
+    def _spin_down(self):
+        from .. import telemetry
+        rep = self._replicas.pop()
+        self._release(rep)
+        self._c_in.add()
+        telemetry.flight_recorder().note(
+            "autopilot_replica_down", replicas=len(self._replicas))
+        self._logger.info("autopilot: replica released (%d remain)",
+                          len(self._replicas))
+
+    @staticmethod
+    def _release(rep):
+        if hasattr(rep, "shutdown"):
+            try:
+                rep.shutdown(drain=True)
+            except TypeError:
+                rep.shutdown()
+        if hasattr(rep, "release"):
+            rep.release()
+
+    # ------------------------------------------------------------------
+    def predict(self, data, **kwargs):
+        """Round-robin one request over the live replicas (the pool's
+        minimal load-balancer; production traffic normally fronts each
+        replica with its own :class:`~mxnet_tpu.serving
+        .DynamicBatcher`)."""
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError("replica pool is empty")
+            rep = self._replicas[self._rr % len(self._replicas)]
+            self._rr += 1
+        return rep.predict(data, **kwargs)
+
+    def close(self):
+        """Release every replica (idempotent)."""
+        with self._lock:
+            while self._replicas:
+                self._spin_down()
+            self._g_replicas.set(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -299,10 +299,20 @@ class ElasticTrainer:
     def __init__(self, world, module_factory, data_factory, manager,
                  checkpoint_every_steps=1, save_optimizer_states=True,
                  min_dp_width=1, max_restarts=4, logger=None,
-                 flight_recorder=None):
+                 flight_recorder=None, peer_store=None):
         from ..checkpoint import CheckpointManager
         if isinstance(manager, str):
             manager = CheckpointManager(manager)
+        if peer_store is None and os.environ.get(
+                "MXNET_AUTOPILOT_PEER_CKPT", "0") == "1":
+            # env-armed goodput plane (docs/api/autopilot.md): every
+            # commit also lands a ring-replicated host-memory copy,
+            # and a dp-shrink resume restores from the survivors'
+            # memory instead of re-reading disk
+            from ..autopilot import PeerCheckpointStore
+            peer_store = PeerCheckpointStore(
+                world.describe().get("n_hosts", world.device_count))
+        self.peer_store = peer_store
         self.world = world
         self.module_factory = module_factory
         self.data_factory = data_factory
@@ -357,11 +367,23 @@ class ElasticTrainer:
                     "guardian: skipping checkpoint commit at "
                     "num_update=%d (health sentinel tainted)", n)
                 return
+            coords = {"epoch": param.epoch, "nbatch": param.nbatch,
+                      "num_update": n, "dp_width": world.device_count}
             mod.save_checkpoint(
                 None, n, save_optimizer_states=self.save_optimizer_states,
-                manager=self.manager,
-                extra={"epoch": param.epoch, "nbatch": param.nbatch,
-                       "num_update": n, "dp_width": world.device_count})
+                manager=self.manager, extra=coords)
+            if self.peer_store is not None:
+                # the peer-memory copy of the SAME commit: captured
+                # right after save() froze its host snapshot, with no
+                # step (or rng draw) in between, so both paths hold
+                # bitwise-identical state. A skipped (tainted) commit
+                # skips the capture too — peer memory never holds a
+                # step disk refused.
+                self.peer_store.capture(
+                    n, mod._checkpoint_arrays(),
+                    optimizer_state=mod._optimizer_state_bytes()
+                    if self.save_optimizer_states else None,
+                    extra=coords)
         return _cb
 
     def _fault_callback(self, fail_at_update, dead_hosts, monitor, mod):
@@ -465,6 +487,7 @@ class ElasticTrainer:
                     "checkpoint directory (%s); share one manager so "
                     "rollback can truncate the poisoned trajectory",
                     guardian.manager.directory, self.manager.directory)
+        resume_src = self.manager
         while True:
             if world.device_count < self.min_dp_width:
                 raise MXNetError(
@@ -491,6 +514,8 @@ class ElasticTrainer:
                     batch_end_callback, list) else [batch_end_callback])
             entry = {"attempt": attempt, "dp_width": world.device_count,
                      "resume_step": self.manager.latest(),
+                     "resume_source": "disk" if resume_src
+                     is self.manager else "peer",
                      "world": world.describe()}
             gstart = guardian.stats() if guardian is not None else None
             # a stale dump from an earlier attempt must not be
@@ -499,7 +524,7 @@ class ElasticTrainer:
             t0 = time.perf_counter()
             try:
                 mod.fit(data, num_epoch=num_epoch,
-                        resume_from=self.manager,
+                        resume_from=resume_src,
                         batch_end_callback=cbs, **fit_kwargs)
             except WorkerLost as exc:
                 entry.update({
@@ -547,6 +572,29 @@ class ElasticTrainer:
                     ) from exc
                 world = world.shrink(exc.dead_hosts,
                                      dead_count=exc.dead_count)
+                resume_src = self.manager
+                if self.peer_store is not None:
+                    # a dead host's memory is gone with it; the
+                    # survivors' ring replicas may still cover every
+                    # block — then the resume skips the disk re-read
+                    # entirely (the goodput plane's whole point). Peer
+                    # memory is only trusted when it holds EXACTLY the
+                    # step disk would restore, and any failure here
+                    # degrades to the durable path.
+                    self.peer_store.drop_hosts(exc.dead_hosts)
+                    try:
+                        peer_ckpt = self.peer_store.resume_checkpoint(
+                            self.manager.latest())
+                    except Exception:  # noqa: BLE001 — goodput is an
+                        # optimization; recovery must proceed
+                        self.logger.exception(
+                            "peer-checkpoint resume failed; falling "
+                            "back to disk")
+                        peer_ckpt = None
+                    if peer_ckpt is not None:
+                        resume_src = peer_ckpt
+                        self.recorder.note("peer_restore",
+                                           step=peer_ckpt.step)
                 fault = None  # an injected fault fires once
                 if monitor is not None:
                     # this death is handled; only a FURTHER death may

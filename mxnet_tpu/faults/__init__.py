@@ -28,6 +28,8 @@ site                   entry point  where it lives
 ``module.step``        poison       fit step boundary (numeric seam)
 ``checkpoint.params``  corrupt_params  restore hand-off (read SDC)
 ``guardian.sdc``       value        SDC probe's second launch
+``autopilot.poll``     check        Autopilot controller tick
+``autopilot.scale``    check        ReplicaPool spin-up path
 =====================  ===========  =================================
 
 The discipline is ``telemetry.enabled()``'s: an UNARMED process pays

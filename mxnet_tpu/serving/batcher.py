@@ -216,6 +216,101 @@ class DynamicBatcher:
         """The named :class:`Tenant` (KeyError for unknown names)."""
         return self._tenants[name]
 
+    def add_tenant(self, tenant):
+        """Admit a new :class:`Tenant` at RUNTIME (the canary-rollout
+        hook ``mxnet_tpu.autopilot`` drives): the tenant gets its own
+        queue and joins the priority schedule on the next gather.
+        Admission never disturbs existing clients — a single-tenant
+        batcher's default route keeps pointing at the ORIGINAL tenant,
+        so un-named ``submit()`` calls are unaffected by a canary
+        joining. Rejects duplicate names and a Predictor instance
+        another tenant already serves (their stats scopes would
+        silently merge). Returns the tenant."""
+        if not isinstance(tenant, Tenant):
+            raise TypeError("add_tenant needs a Tenant (got %s)"
+                            % type(tenant).__name__)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("batcher is shut down")
+            if tenant.name in self._tenants:
+                raise ValueError("tenant %r is already hosted"
+                                 % tenant.name)
+            for name, ten in self._tenants.items():
+                if ten.predictor is tenant.predictor:
+                    raise ValueError(
+                        "tenant %r would share tenant %r's Predictor "
+                        "instance — build one Predictor per tenant"
+                        % (tenant.name, name))
+            self._tenants[tenant.name] = tenant
+            self._queues[tenant.name] = collections.deque()
+            tenant.stats.set_queue_probe(
+                lambda q=self._queues[tenant.name]: len(q))
+            self._cond.notify_all()
+        return tenant
+
+    def remove_tenant(self, name):
+        """Stop hosting the named tenant (the canary-rollback hook):
+        its queue is detached and still-queued requests fail with
+        :class:`ServerClosed` — a rolled-back canary's backlog must
+        never launch. In-flight requests the worker already popped
+        complete normally. The default route re-resolves when the
+        removal leaves ONE tenant. Returns the removed tenant."""
+        with self._cond:
+            if name not in self._tenants:
+                raise ValueError("unknown tenant %r (hosted: %r)"
+                                 % (name, list(self._tenants)))
+            ten = self._tenants.pop(name)
+            q = self._queues.pop(name)
+            while q:
+                req = q.popleft()
+                self._n_queued -= 1
+                ten.stats.note_error()
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(ServerClosed(
+                        "tenant %r removed before request %s launched"
+                        % (name, req.id)))
+            if self._default == name or self._default is None:
+                self._default = next(iter(self._tenants)) \
+                    if len(self._tenants) == 1 else None
+                self._pred = self._tenants[self._default].predictor \
+                    if self._default else None
+            self._cond.notify_all()
+        return ten
+
+    def replace_tenant(self, name, tenant):
+        """ATOMICALLY swap the named route to a new :class:`Tenant`
+        (the canary-promotion hook): requests already queued under the
+        name stay queued and launch through the NEW tenant's Predictor
+        — there is no window where the route doesn't resolve. The new
+        tenant must carry the same name; the caller owns shape
+        compatibility (a promotion serves the same model family).
+        Returns the replaced tenant."""
+        if not isinstance(tenant, Tenant):
+            raise TypeError("replace_tenant needs a Tenant (got %s)"
+                            % type(tenant).__name__)
+        if tenant.name != str(name):
+            raise ValueError(
+                "replace_tenant(%r) got a Tenant named %r — the route "
+                "name is the identity" % (name, tenant.name))
+        with self._cond:
+            if name not in self._tenants:
+                raise ValueError("unknown tenant %r (hosted: %r)"
+                                 % (name, list(self._tenants)))
+            for other, ten in self._tenants.items():
+                if other != name and ten.predictor is tenant.predictor:
+                    raise ValueError(
+                        "tenant %r would share tenant %r's Predictor "
+                        "instance — remove that tenant first"
+                        % (name, other))
+            old = self._tenants[name]
+            self._tenants[name] = tenant
+            tenant.stats.set_queue_probe(
+                lambda q=self._queues[name]: len(q))
+            if self._default == name:
+                self._pred = tenant.predictor
+            self._cond.notify_all()
+        return old
+
     def _resolve(self, tenant):
         if tenant is None:
             if self._default is None:

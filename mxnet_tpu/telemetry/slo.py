@@ -104,6 +104,11 @@ class SLOTracker(object):
         self._lock = threading.Lock()
         self._last_eval = 0.0
         self._last_breach = False
+        # monotonic breach-epoch counter: +1 on every False->True
+        # transition of the rollup breach — the hysteresis-auditable
+        # signal a controller consumes (a sustained breach is ONE
+        # epoch however many times it is polled)
+        self._breach_epochs = 0
         if registry is None:
             import mxnet_tpu.telemetry as _tel
             registry = _tel.registry()
@@ -121,6 +126,7 @@ class SLOTracker(object):
                 for f in ("burn_rate_fast", "burn_rate_slow",
                           "budget_remaining", "breach")}
         self._g_breach = scope.gauge("breach")
+        self._g_breach_epochs = scope.gauge("breach_epochs")
 
     @staticmethod
     def _parse(key, value):
@@ -184,7 +190,8 @@ class SLOTracker(object):
             {"<objective>": {"burn_rate_fast", "burn_rate_slow",
                              "bad_fast", "n_fast", "bad_slow", "n_slow",
                              "budget_remaining", "breach"},
-             ..., "breach": any-objective, "n_events": retained}
+             ..., "breach": any-objective, "n_events": retained,
+             "breach_epochs": monotonic False->True transitions}
 
         Windows with no events burn 0.0 (no traffic spends no budget).
         """
@@ -231,7 +238,11 @@ class SLOTracker(object):
             g["budget_remaining"].set(state["budget_remaining"])
             g["breach"].set(int(breach))
         out["breach"] = any_breach
+        if any_breach and not self._last_breach:
+            self._breach_epochs += 1
+        out["breach_epochs"] = self._breach_epochs
         self._g_breach.set(int(any_breach))
+        self._g_breach_epochs.set(self._breach_epochs)
         self._last_breach = any_breach
         return out
 
@@ -250,6 +261,42 @@ class SLOTracker(object):
         if now - self._last_eval >= self.refresh_s:
             self.evaluate(now=now)
         return self._last_breach
+
+    @property
+    def breach_epochs(self):
+        """Monotonic count of distinct breach episodes (False->True
+        rollup transitions) as of the last evaluation — the hysteresis
+        signal: a controller that acted on epoch k can tell a
+        STILL-breaching tracker (same count) from a NEW breach
+        (count advanced) without scraping gauge text."""
+        return self._breach_epochs
+
+    def burn_state(self, now=None):
+        """The controller-facing snapshot (``mxnet_tpu.autopilot``'s
+        poll): one fresh evaluation folded to
+
+        ``{"breach", "breach_epochs", "burn_fast": {objective: rate},
+        "burn_slow": {...}, "n_fast", "n_slow", "n_events"}``
+
+        — the rollup breach verdict, the monotonic epoch counter, the
+        current per-objective fast/slow burn values, and the window
+        event counts (``n_fast == 0`` is the idle signal scale-in
+        watches). Field set pinned by tests/test_autopilot.py
+        (snapshot compat, like ``evaluate()``'s)."""
+        state = self.evaluate(now=now)
+        keys = [obj["key"] for obj in self._objectives]
+        first = state[keys[0]]
+        return {
+            "breach": state["breach"],
+            "breach_epochs": state["breach_epochs"],
+            "burn_fast": {k: state[k]["burn_rate_fast"] for k in keys},
+            "burn_slow": {k: state[k]["burn_rate_slow"] for k in keys},
+            # every event counts into every objective's windows, so
+            # the first objective's counts are THE window counts
+            "n_fast": first["n_fast"],
+            "n_slow": first["n_slow"],
+            "n_events": state["n_events"],
+        }
 
     def report(self, now=None):
         """Objectives + current burn state as one JSON-able dict."""

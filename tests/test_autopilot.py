@@ -1,0 +1,759 @@
+"""Fleet autopilot (mxnet_tpu.autopilot): the telemetry→action loop.
+
+* The decision kernel is PURE: every ``decide_*`` is a function of
+  (config, obs) only, and a recorded transcript replays bitwise
+  (``replay() == []``) — divergence detection is itself tested.
+* Serving autoscale: a both-window SLO breach scales the ReplicaPool
+  out to a WARM replica (executable-cache spin-up, zero compiles,
+  bitwise rows); sustained idle scales in; cooldown freezes both.
+* Continuous delivery: a new committed generation is admitted as a
+  low-priority canary tenant, promoted only after a clean soak with a
+  passing probe; a NaN-poisoned generation rolls back and is never
+  re-admitted — protected traffic never sees it.
+* Peer-replicated checkpoints: ring layout (factor 2) survives any
+  single host death and restores BITWISE vs the disk manager; two
+  ring-adjacent deaths are detected as unrestorable and the resume
+  decision falls back to disk.
+* Chaos seams ``autopilot.poll`` / ``autopilot.scale``: armed plans
+  fire exactly as planned, the controller survives both, and the
+  unarmed process never evaluates a rule.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import autopilot, faults
+from mxnet_tpu.autopilot import (AutopilotConfig, CanaryController,
+                                 PeerCheckpointStore, ReplicaPool,
+                                 decide_canary, decide_resume,
+                                 decide_scale, finite_probe, replay)
+from mxnet_tpu.serving import DynamicBatcher, Predictor, Tenant
+
+DIM = 6
+
+
+def _net(hidden):
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, DIM).astype(np.float32),
+            rng.randint(0, 10, n).astype(np.float32))
+
+
+def _fit_module(hidden=16):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_net(hidden), context=[mx.cpu()])
+    X, y = _data()
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=8), num_epoch=1,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    return mod, X
+
+
+@pytest.fixture(scope="module")
+def serving_ckpt(tmp_path_factory):
+    """A trained module committed to a CheckpointManager (step 1) plus
+    a warmed executable cache — the generation the serving-plane tests
+    load replicas and canaries from."""
+    root = tmp_path_factory.mktemp("autopilot")
+    mod, X = _fit_module()
+    mgr = mx.checkpoint.CheckpointManager(str(root / "ckpt"))
+    mod.save_checkpoint(None, 1, manager=mgr, async_save=False)
+    cache = str(root / "cache")
+    shapes = [("data", (8, DIM))]
+    pred = Predictor.load(mgr, 1, data_shapes=shapes)
+    pred.warmup(cache_dir=cache)   # populates the executable cache
+    ref = pred.predict(X[:8])
+    pred.release()
+    return {"manager": mgr, "cache": cache, "shapes": shapes,
+            "X": X, "ref": ref, "symbol": mod._symbol.tojson()}
+
+
+def _slo(name, **objectives):
+    objectives.setdefault("error_rate", 1e-3)
+    return mx.telemetry.SLOTracker(name, refresh_s=0.0, **objectives)
+
+
+class _StubSLO(object):
+    """A burn_state()-shaped sensor the controller tests script."""
+
+    def __init__(self):
+        self.breach = False
+        self.epochs = 0
+        self.n_fast = 0
+
+    def burn_state(self, now=None):
+        return {"breach": self.breach, "breach_epochs": self.epochs,
+                "burn_fast": {}, "burn_slow": {},
+                "n_fast": self.n_fast, "n_slow": self.n_fast,
+                "n_events": self.n_fast}
+
+
+class _StubPool(object):
+    def __init__(self, size=1):
+        self.size = size
+        self.calls = []
+
+    def scale_to(self, n):
+        self.calls.append(int(n))
+        self.size = int(n)
+
+
+# =====================================================================
+# pure decision kernel
+# =====================================================================
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOPILOT_MIN_REPLICAS", "2")
+    monkeypatch.setenv("MXNET_AUTOPILOT_MAX_REPLICAS", "5")
+    monkeypatch.setenv("MXNET_AUTOPILOT_COOLDOWN_S", "10")
+    cfg = AutopilotConfig.from_env(poll_interval_s=2.0)
+    assert (cfg.min_replicas, cfg.max_replicas) == (2, 5)
+    assert cfg.cooldown_ticks == 5       # ceil(10s / 2s-per-tick)
+    monkeypatch.setenv("MXNET_AUTOPILOT_MAX_REPLICAS", "1")
+    with pytest.raises(ValueError):
+        AutopilotConfig.from_env()       # min 2 > max 1
+
+
+def test_decide_scale_policy():
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=3,
+                          cooldown_ticks=2, idle_ticks=3)
+
+    def obs(**kw):
+        base = {"replicas": 1, "breach": False, "breach_epochs": 0,
+                "idle_ticks": 0, "cooldown_remaining": 0}
+        base.update(kw)
+        return base
+
+    assert decide_scale(cfg, obs(breach=True)) == {
+        "action": "scale_out", "target": 2, "reason": "slo_breach"}
+    # at the cap a breach holds — never exceed max_replicas
+    assert decide_scale(cfg, obs(replicas=3, breach=True))["reason"] \
+        == "breach_at_max"
+    # cooldown freezes everything, breach included (hysteresis)
+    assert decide_scale(cfg, obs(breach=True, cooldown_remaining=1)) \
+        == {"action": "hold", "reason": "cooldown"}
+    # idleness must be SUSTAINED for idle_ticks polls
+    assert decide_scale(cfg, obs(replicas=2, idle_ticks=2))["action"] \
+        == "hold"
+    assert decide_scale(cfg, obs(replicas=2, idle_ticks=3)) == {
+        "action": "scale_in", "target": 1, "reason": "sustained_idle"}
+    # never scale in below min
+    assert decide_scale(cfg, obs(replicas=1, idle_ticks=99))["action"] \
+        == "hold"
+    # a pool below min is repaired first
+    assert decide_scale(cfg, obs(replicas=0))["reason"] == "below_min"
+
+
+def test_decide_canary_policy():
+    cfg = AutopilotConfig(canary_soak_ticks=2)
+
+    def obs(**kw):
+        base = {"latest_step": None, "stable_step": 1,
+                "canary_step": None, "rejected": False,
+                "probe_ok": None, "canary_breach": False,
+                "ticks_in_canary": 0}
+        base.update(kw)
+        return base
+
+    assert decide_canary(cfg, obs(latest_step=2)) == {
+        "action": "admit", "step": 2, "reason": "new_generation"}
+    assert decide_canary(cfg, obs(latest_step=1))["reason"] \
+        == "no_new_generation"
+    # a rolled-back generation is never re-admitted
+    assert decide_canary(cfg, obs(latest_step=2, rejected=True))[
+        "action"] == "hold"
+    # live canary: probe failure and SLO burn both roll back
+    assert decide_canary(cfg, obs(canary_step=2, probe_ok=False)) == {
+        "action": "rollback", "step": 2, "reason": "probe_failed"}
+    assert decide_canary(cfg, obs(canary_step=2, probe_ok=True,
+                                  canary_breach=True))["reason"] \
+        == "slo_breach"
+    # promotion needs the soak AND a passing probe
+    assert decide_canary(cfg, obs(canary_step=2, probe_ok=True,
+                                  ticks_in_canary=1))["action"] == "hold"
+    assert decide_canary(cfg, obs(canary_step=2, probe_ok=True,
+                                  ticks_in_canary=2)) == {
+        "action": "promote", "step": 2, "reason": "soaked_clean"}
+
+
+def test_decide_resume_policy():
+    cfg = AutopilotConfig()
+    assert decide_resume(cfg, {"disk_step": 4, "peer_step": 4,
+                               "peer_restorable": True}) == {
+        "action": "peer_restore", "step": 4, "reason": "peer_current"}
+    # a stale peer snapshot never shadows a newer durable commit
+    assert decide_resume(cfg, {"disk_step": 5, "peer_step": 4,
+                               "peer_restorable": True})["reason"] \
+        == "peer_stale"
+    assert decide_resume(cfg, {"disk_step": 5, "peer_step": None,
+                               "peer_restorable": False})["reason"] \
+        == "no_peer_snapshot"
+    assert decide_resume(cfg, {"disk_step": 5, "peer_step": 5,
+                               "peer_restorable": False})["reason"] \
+        == "peer_shards_lost"
+
+
+def test_replay_detects_divergence():
+    cfg = AutopilotConfig()
+    obs = {"replicas": 1, "breach": True, "breach_epochs": 1,
+           "idle_ticks": 0, "cooldown_remaining": 0}
+    transcript = [
+        {"tick": 0, "plane": "poll", "error": "injected"},  # skipped
+        {"tick": 1, "plane": "scale", "obs": obs,
+         "decision": decide_scale(cfg, obs)},
+    ]
+    assert replay(cfg, transcript) == []
+    transcript[1]["decision"] = {"action": "hold", "reason": "tampered"}
+    bad = replay(cfg, transcript)
+    assert len(bad) == 1 and bad[0]["index"] == 1
+    assert bad[0]["replayed"]["action"] == "scale_out"
+
+
+# =====================================================================
+# SLOTracker controller accessors (satellite 1)
+# =====================================================================
+def test_breach_epochs_counts_rising_edges_only():
+    t = _slo("ap_epochs", fast_window_s=0.3, slow_window_s=0.3)
+    assert t.evaluate()["breach_epochs"] == 0
+    for _ in range(20):
+        t.record(outcome="error")
+    assert t.evaluate()["breach"] and t.breach_epochs == 1
+    # still breached — the SAME epoch, not a new one
+    assert t.evaluate()["breach_epochs"] == 1
+    time.sleep(0.4)                      # errors age out of both windows
+    assert not t.evaluate()["breach"]
+    assert t.breach_epochs == 1          # recovery does not count
+    for _ in range(20):
+        t.record(outcome="error")
+    assert t.evaluate()["breach_epochs"] == 2   # a second distinct epoch
+
+
+def test_burn_state_shape_and_evaluate_compat():
+    t = _slo("ap_burn")
+    t.record(outcome="ok")
+    t.record(outcome="error")
+    s = t.burn_state()
+    assert set(s) == {"breach", "breach_epochs", "burn_fast",
+                      "burn_slow", "n_fast", "n_slow", "n_events"}
+    assert s["n_fast"] == 2 and s["burn_fast"]["error_rate"] > 0
+    # evaluate() keeps every pre-autopilot key (snapshot compat) and
+    # only ADDS breach_epochs
+    ev = t.evaluate()
+    for key in ("error_rate", "breach", "n_events", "breach_epochs"):
+        assert key in ev, key
+    for key in ("breach", "burn_rate_fast", "burn_rate_slow",
+                "bad_fast", "bad_slow", "budget_remaining"):
+        assert key in ev["error_rate"], key
+
+
+# =====================================================================
+# the controller over stub sensors/actuators
+# =====================================================================
+def test_autopilot_scale_out_cooldown_scale_in():
+    slo, pool = _StubSLO(), _StubPool()
+    ap = autopilot.Autopilot(
+        config=AutopilotConfig(min_replicas=1, max_replicas=2,
+                               cooldown_ticks=2, idle_ticks=2),
+        slo=slo, pool=pool)
+    slo.breach, slo.epochs, slo.n_fast = True, 1, 10
+    ap.step(now=100.0)
+    assert pool.calls == [2]             # breach -> scale out
+    ap.step(now=101.0)                   # cooldown tick 1: frozen
+    assert pool.calls == [2]
+    assert ap.transcript[-1]["decision"]["reason"] == "cooldown"
+    slo.breach, slo.n_fast = False, 0    # traffic stops
+    for i in range(5):
+        ap.step(now=102.0 + i)
+    assert pool.calls == [2, 1]          # idle soak -> one scale-in
+    assert ap.replay() == []             # the whole run re-derives
+
+
+def test_autopilot_actuator_failure_is_recorded_not_fatal():
+    class _Boom(_StubPool):
+        def scale_to(self, n):
+            raise RuntimeError("spin-up exploded")
+
+    slo = _StubSLO()
+    slo.breach, slo.n_fast = True, 5
+    ap = autopilot.Autopilot(config=AutopilotConfig(cooldown_ticks=1),
+                             slo=slo, pool=_Boom())
+    entry = ap.step()[0]
+    assert "spin-up exploded" in entry["actuate_error"]
+    assert ap.replay() == []             # the DECISION still replays
+    ap.step()                            # and the loop keeps ticking
+    assert ap.transcript[-1]["decision"]["reason"] == "cooldown"
+
+
+def test_background_loop_gated_by_env(monkeypatch):
+    ap = autopilot.Autopilot(config=AutopilotConfig(),
+                             slo=_StubSLO(), pool=_StubPool())
+    monkeypatch.delenv("MXNET_AUTOPILOT", raising=False)
+    assert not autopilot.enabled()
+    assert ap.start() is None            # off: never self-actuates
+    assert ap._thread is None
+    monkeypatch.setenv("MXNET_AUTOPILOT", "1")
+    ap2 = autopilot.Autopilot(
+        config=AutopilotConfig(poll_interval_s=0.02),
+        slo=_StubSLO(), pool=_StubPool())
+    assert ap2.start() is ap2
+    deadline = time.time() + 5
+    while not ap2.transcript and time.time() < deadline:
+        time.sleep(0.02)
+    ap2.stop()
+    assert ap2.transcript and ap2.replay() == []
+
+
+# =====================================================================
+# fault seams (satellite 2)
+# =====================================================================
+def test_poll_fault_skips_tick_and_transcribes():
+    faults.arm("autopilot.poll:error@nth=1", seed=3)
+    try:
+        slo, pool = _StubSLO(), _StubPool()
+        slo.breach, slo.n_fast = True, 5
+        ap = autopilot.Autopilot(config=AutopilotConfig(), slo=slo,
+                                 pool=pool)
+        entries = ap.step()
+        assert entries[0]["plane"] == "poll" and "error" in entries[0]
+        assert pool.calls == []          # the blinded tick never acted
+        incidents = faults.incidents()
+        assert [i["site"] for i in incidents] == ["autopilot.poll"]
+        ap.step()                        # next poll works
+        assert pool.calls == [2]
+        assert ap.replay() == []         # poll entries are skipped
+    finally:
+        faults.disarm()
+
+
+def test_poll_delay_fault_fires_without_skipping():
+    faults.arm("autopilot.poll:delay@nth=1,ms=1", seed=0)
+    try:
+        ap = autopilot.Autopilot(config=AutopilotConfig(),
+                                 slo=_StubSLO(), pool=_StubPool())
+        entries = ap.step()
+        assert entries[0]["plane"] == "scale"   # delayed, not skipped
+        assert faults.incidents()[0]["kind"] == "delay"
+    finally:
+        faults.disarm()
+
+
+def test_scale_fault_leaves_pool_at_previous_size():
+    mk = lambda: pytest.fail("factory must not run on a fired seam")
+    faults.arm("autopilot.scale:error@nth=1", seed=0)
+    try:
+        snap0 = mx.telemetry.registry().snapshot()["counters"].get(
+            "autopilot.scale_errors", 0)
+        with pytest.raises(faults.FaultError):
+            ReplicaPool(mk, min_replicas=1, max_replicas=2, warm=False)
+        snap = mx.telemetry.registry().snapshot()["counters"]
+        assert snap["autopilot.scale_errors"] == snap0 + 1
+    finally:
+        faults.disarm()
+
+
+def test_scale_fault_through_controller_keeps_loop_alive():
+    built = []
+
+    def mk():
+        built.append(1)
+        return _FakeReplica()
+
+    pool = ReplicaPool(mk, min_replicas=1, max_replicas=2, warm=False)
+    slo = _StubSLO()
+    slo.breach, slo.n_fast = True, 5
+    ap = autopilot.Autopilot(config=AutopilotConfig(cooldown_ticks=1),
+                             slo=slo, pool=pool)
+    faults.arm("autopilot.scale:error@nth=1", seed=0)
+    try:
+        entry = ap.step()[0]
+        assert "actuate_error" in entry and pool.size == 1
+        ap.step()                                     # cooldown
+        entry = ap.step()[0]                          # retry succeeds
+        assert "actuate_error" not in entry and pool.size == 2
+    finally:
+        faults.disarm()
+        pool.close()
+
+
+class _FakeReplica(object):
+    released = False
+
+    def predict(self, data, **kw):
+        return np.asarray(data)
+
+    def release(self):
+        self.released = True
+
+
+def test_unarmed_seams_are_noops():
+    assert not faults.armed()
+    pool = ReplicaPool(lambda: _FakeReplica(), min_replicas=1,
+                       max_replicas=3, warm=False)
+    assert pool.scale_to(3) == 3 and pool.scale_to(0) == 1  # clamped
+    pool.close()
+    assert faults.incidents() == []
+
+
+# =====================================================================
+# peer-replicated in-memory checkpoints
+# =====================================================================
+def _arrays():
+    rng = np.random.RandomState(11)
+    return {"arg:w": rng.rand(8, 4).astype(np.float32),
+            "arg:b": rng.rand(3).astype(np.float32),   # replicated
+            "aux:s": np.float32(2.5).reshape(())}      # scalar
+
+
+def test_peer_store_bitwise_roundtrip_and_single_death():
+    store = PeerCheckpointStore(4)
+    arrays = _arrays()
+    store.capture(10, arrays, optimizer_state=b"opt-bytes",
+                  extra={"epoch": 3, "nbatch": 7}, rng_state=None)
+    store.drop_hosts([2])                # any SINGLE death survives
+    assert store.restorable(10) and store.latest() == 10
+    ck = store.restore()
+    assert ck.step == 10 and ck.optimizer_state == b"opt-bytes"
+    assert ck.extra == {"epoch": 3, "nbatch": 7}
+    for name, ref in arrays.items():
+        got = np.asarray(ck.params[name])
+        assert got.dtype == ref.dtype and got.shape == ref.shape
+        assert np.array_equal(got, ref)  # bitwise (no float slack)
+
+
+def test_peer_store_adjacent_deaths_lose_a_block():
+    store = PeerCheckpointStore(4)
+    store.capture(1, _arrays(), rng_state=None)
+    store.drop_hosts([1, 2])             # block 1's holders are 1 and 2
+    assert not store.restorable(1) and store.latest() is None
+    with pytest.raises(KeyError):
+        store.restore()
+    # NON-adjacent pair keeps every block's second holder alive
+    store2 = PeerCheckpointStore(4)
+    store2.capture(1, _arrays(), rng_state=None)
+    store2.drop_hosts([0, 2])
+    assert store2.restorable(1)
+
+
+def test_peer_store_keep_evicts_oldest():
+    store = PeerCheckpointStore(2, keep=2)
+    for step in (1, 2, 3):
+        store.capture(step, _arrays(), rng_state=None)
+    assert store.stats()["steps"] == [2, 3]
+    assert not store.restorable(1) and store.latest() == 3
+
+
+def test_peer_resume_decision_and_transcript():
+    store = PeerCheckpointStore(3)
+    store.capture(5, _arrays(), rng_state=None)
+    assert store.resume_checkpoint(disk_step=5).step == 5
+    # disk moved ahead of memory -> peer is stale -> disk restore
+    assert store.resume_checkpoint(disk_step=6) is None
+    planes = [e["decision"]["action"] for e in store.transcript]
+    assert planes == ["peer_restore", "disk_restore"]
+    assert replay(AutopilotConfig(), store.transcript) == []
+
+
+def test_peer_store_matches_disk_restore_bitwise(tmp_path):
+    """The tentpole parity claim: the peer path assembles the SAME
+    Checkpoint the manager's disk path does, bitwise."""
+    mod, _X = _fit_module(hidden=8)
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path / "ck"))
+    store = PeerCheckpointStore(2)
+    arrays = mod._checkpoint_arrays()
+    mgr.save(4, arrays, optimizer_state=mod._optimizer_state_bytes(),
+             extra={"epoch": 1}, async_save=False)
+    store.capture(4, arrays,
+                  optimizer_state=mod._optimizer_state_bytes(),
+                  extra={"epoch": 1})
+    disk = mgr.restore(4)
+    store.drop_hosts([0])
+    peer = store.restore(4)
+    assert set(disk.params) == set(peer.params)
+    for name in disk.params:
+        assert np.array_equal(np.asarray(disk.params[name]),
+                              np.asarray(peer.params[name])), name
+    assert disk.optimizer_state == peer.optimizer_state
+    assert peer.rng is not None
+
+
+def test_elastic_trainer_env_creates_peer_store(monkeypatch, tmp_path):
+    from mxnet_tpu.dist import ElasticTrainer, VirtualCluster
+    world = VirtualCluster(2)
+    mk_mod = lambda w: None
+    mk_data = lambda w: None
+    try:
+        monkeypatch.setenv("MXNET_AUTOPILOT_PEER_CKPT", "1")
+        tr = ElasticTrainer(world, mk_mod, mk_data, str(tmp_path / "a"))
+        assert tr.peer_store is not None
+        assert tr.peer_store.n_hosts == 2
+        monkeypatch.setenv("MXNET_AUTOPILOT_PEER_CKPT", "0")
+        tr2 = ElasticTrainer(world, mk_mod, mk_data,
+                             str(tmp_path / "b"))
+        assert tr2.peer_store is None
+    finally:
+        from mxnet_tpu import telemetry
+        telemetry.flight_recorder().disarm()
+        telemetry.flight_recorder().pop_last_dump()
+
+
+# =====================================================================
+# batcher tenant lifecycle (add/remove/replace)
+# =====================================================================
+@pytest.fixture(scope="module")
+def two_preds(serving_ckpt):
+    c = serving_ckpt
+    pA = Predictor.load(c["manager"], 1, data_shapes=c["shapes"])
+    pA.warmup(cache_dir=c["cache"])
+    pB = Predictor.load(c["manager"], 1, data_shapes=c["shapes"])
+    pB.warmup(cache_dir=c["cache"])
+    yield pA, pB
+    pA.release()
+    pB.release()
+
+
+def test_batcher_add_remove_tenant(two_preds, serving_ckpt):
+    pA, pB = two_preds
+    X, ref = serving_ckpt["X"], serving_ckpt["ref"]
+    with DynamicBatcher(tenants={"stable": Tenant("stable", pA)},
+                        max_wait_ms=2) as srv:
+        # the single-tenant default route survives an added canary
+        assert np.array_equal(srv.predict(X[:3], timeout=30), ref[:3])
+        srv.add_tenant(Tenant("canary", pB, priority=0))
+        assert set(srv.tenants()) == {"canary", "stable"}
+        out = srv.predict(X[:4], timeout=30, tenant="canary")
+        assert np.array_equal(out, ref[:4])
+        with pytest.raises(ValueError):
+            srv.add_tenant(Tenant("canary", pB))     # dup name
+        with pytest.raises(ValueError):
+            srv.add_tenant(Tenant("other", pA))      # shared Predictor
+        srv.remove_tenant("canary")
+        assert srv.tenants() == ["stable"]
+        # back to one tenant: un-named submit still routes
+        assert np.array_equal(srv.predict(X[:2], timeout=30), ref[:2])
+        with pytest.raises(ValueError):
+            srv.remove_tenant("canary")
+
+
+def test_batcher_replace_tenant_swaps_route(two_preds, serving_ckpt):
+    pA, pB = two_preds
+    X, ref = serving_ckpt["X"], serving_ckpt["ref"]
+    with DynamicBatcher(tenants={"stable": Tenant("stable", pA)},
+                        max_wait_ms=2) as srv:
+        old = srv.replace_tenant("stable", Tenant(
+            "stable", pB, priority=1, protected=True))
+        assert old.predictor is pA
+        assert srv.tenant("stable").protected
+        out = srv.predict(X[:3], timeout=30, tenant="stable")
+        assert np.array_equal(out, ref[:3])          # new route serves
+        with pytest.raises(ValueError):
+            srv.replace_tenant("stable", Tenant("renamed", pA))
+
+
+# =====================================================================
+# serving autoscale end to end: warm spin-up under breach
+# =====================================================================
+def test_pool_scales_out_warm_and_bitwise(serving_ckpt):
+    c = serving_ckpt
+
+    def factory():
+        return Predictor.load(c["manager"], 1, data_shapes=c["shapes"])
+
+    # short burn windows so the injected breach decays within the test
+    slo = mx.telemetry.SLOTracker("ap_pool", error_rate=1e-3,
+                                  fast_window_s=0.5, slow_window_s=0.5,
+                                  refresh_s=0.0)
+    with ReplicaPool(factory, min_replicas=1, max_replicas=2,
+                     cache_dir=c["cache"]) as pool:
+        ap = autopilot.Autopilot(
+            config=AutopilotConfig(min_replicas=1, max_replicas=2,
+                                   cooldown_ticks=1, idle_ticks=2),
+            slo=slo, pool=pool)
+        for _ in range(50):
+            slo.record(outcome="error")
+        ap.step()
+        assert pool.size == 2
+        assert ap.transcript[-1]["decision"]["reason"] == "slo_breach"
+        # the scaled-out replica came up WARM: every bucket program
+        # deserialized from the executable cache, zero XLA compiles
+        rep = pool.replicas[-1]
+        assert {r["source"] for r in rep.warmup_report().values()} \
+            == {"deserialized"}
+        assert rep.stats()["compiles"] == 0
+        assert pool.spinup_reports[-1]["sources"] == ["deserialized"]
+        # ... and bitwise: both replicas answer identical rows
+        a = pool.replicas[0].predict(c["X"][:8])
+        b = rep.predict(c["X"][:8])
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(b), c["ref"])
+        # idle decay -> scale back in after the soak
+        ap.step()                        # cooldown
+        deadline = time.time() + 10
+        while slo.burn_state()["n_fast"] > 0 and time.time() < deadline:
+            time.sleep(0.1)              # errors age out of the window
+        for i in range(4):
+            ap.step()
+        assert pool.size == 1
+        assert ap.replay() == []
+
+
+# =====================================================================
+# continuous delivery: clean promotes, poisoned never does
+# =====================================================================
+def _commit_generation(c, step, poison=False):
+    """Commit the trained params again as generation ``step`` —
+    optionally NaN-poisoned — with full serving metadata."""
+    from mxnet_tpu.checkpoint import params_digest
+    mgr = c["manager"]
+    base = mgr.restore(1)
+    arrays = {k: np.array(np.asarray(v)) for k, v in base.params.items()}
+    if poison:
+        name = sorted(arrays)[0]
+        arrays[name] = arrays[name].copy()
+        arrays[name].reshape(-1)[0] = np.nan
+    extra = dict(mgr.step_metadata(1))
+    extra["epoch"] = step
+    extra["params_digest"] = params_digest(c["symbol"], arrays)
+    mgr.save(step, arrays, extra=extra, async_save=False)
+    return step
+
+
+def _drive_canary(ctrl, cfg, ticks):
+    """Run the canary plane the way Autopilot.step does, standalone."""
+    entries = []
+    for tick in ticks:
+        obs = ctrl.observe(tick=tick)
+        decision = decide_canary(cfg, obs)
+        ctrl.apply(decision, tick=tick)
+        entries.append({"tick": tick, "plane": "canary", "obs": obs,
+                        "decision": decision})
+    return entries
+
+
+def test_canary_promotes_clean_generation(serving_ckpt):
+    c = serving_ckpt
+    stable = Predictor.load(c["manager"], 1, data_shapes=c["shapes"])
+    stable.warmup(cache_dir=c["cache"])
+    srv = DynamicBatcher(tenants={"stable": Tenant(
+        "stable", stable, priority=1, protected=True)}, max_wait_ms=2)
+    try:
+        step = _commit_generation(c, 2, poison=False)
+        ctrl = CanaryController(c["manager"], srv, stable_step=1,
+                                data_shapes=c["shapes"],
+                                cache_dir=c["cache"],
+                                slo_factory=_slo)
+        cfg = AutopilotConfig(canary_soak_ticks=2)
+        entries = _drive_canary(ctrl, cfg, range(4))
+        acts = [e["decision"]["action"] for e in entries]
+        assert acts == ["admit", "hold", "promote", "hold"]
+        assert ctrl.stable_step == step and ctrl.canary_step is None
+        # the promoted route is protected and serves the new generation
+        ten = srv.tenant("stable")
+        assert ten.protected and ten.priority >= 1
+        out = srv.predict(c["X"][:4], timeout=30, tenant="stable")
+        assert np.array_equal(out, c["ref"][:4])
+        assert replay(cfg, entries) == []
+    finally:
+        srv.shutdown()
+        srv.tenant("stable").predictor.release()
+
+
+def test_poisoned_canary_rolls_back_never_promotes(serving_ckpt):
+    c = serving_ckpt
+    stable = Predictor.load(c["manager"], 1, data_shapes=c["shapes"])
+    stable.warmup(cache_dir=c["cache"])
+    srv = DynamicBatcher(tenants={"stable": Tenant(
+        "stable", stable, priority=1, protected=True)}, max_wait_ms=2)
+    try:
+        step = _commit_generation(c, 3, poison=True)
+        ctrl = CanaryController(c["manager"], srv, stable_step=1,
+                                data_shapes=c["shapes"],
+                                cache_dir=c["cache"])
+        cfg = AutopilotConfig(canary_soak_ticks=2)
+        entries = _drive_canary(ctrl, cfg, range(4))
+        acts = [e["decision"]["action"] for e in entries]
+        # admitted once, probe fails on the FIRST live poll, rolled
+        # back, and the rejected generation is never re-admitted
+        assert acts == ["admit", "rollback", "hold", "hold"]
+        assert entries[1]["decision"]["reason"] == "probe_failed"
+        assert ctrl.rejected_steps == [step]
+        assert ctrl.stable_step == 1            # protected route intact
+        assert srv.tenants() == ["stable"]
+        out = srv.predict(c["X"][:4], timeout=30, tenant="stable")
+        assert np.array_equal(out, c["ref"][:4])
+        assert np.isfinite(np.asarray(out)).all()
+        assert replay(cfg, entries) == []
+    finally:
+        srv.shutdown()
+        srv.tenant("stable").predictor.release()
+
+
+def test_finite_probe_flags_nonfinite_outputs():
+    class _NaNPred(object):
+        buckets = [2]
+        _data_descs = [("data", (2, DIM))]
+
+        def predict(self, feed):
+            return np.full((2, 10), np.nan, np.float32)
+
+    class _OkPred(_NaNPred):
+        def predict(self, feed):
+            return np.zeros((2, 10), np.float32)
+
+    probe = finite_probe()
+    assert probe(_OkPred()) is True
+    assert probe(_NaNPred()) is False
+
+
+# =====================================================================
+# elastic peer resume, end to end (heavier — excluded from tier-1)
+# =====================================================================
+@pytest.mark.slow
+def test_elastic_shrink_resumes_from_peer_memory(tmp_path):
+    from mxnet_tpu.dist import ElasticTrainer, VirtualCluster
+    X, y = _data(n=256, seed=3)
+
+    def mk_mod(world):
+        net = sym.Variable("data")
+        net = sym.FullyConnected(net, num_hidden=32, name="fc1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+        return mx.mod.Module(sym.SoftmaxOutput(net, name="softmax"),
+                             context=world.contexts())
+
+    def mk_data(world):
+        return world.feed(mx.io.NDArrayIter(X, y, batch_size=32))
+
+    cluster = VirtualCluster(4)
+    store = PeerCheckpointStore(4)
+    mx.random.seed(3)
+    np.random.seed(3)
+    tr = ElasticTrainer(cluster, mk_mod, mk_data,
+                        str(tmp_path / "ckpt"),
+                        checkpoint_every_steps=4, peer_store=store)
+    try:
+        # kill NON-ring-adjacent hosts (1, 3): every replicated block
+        # keeps one surviving holder, so the resume comes from memory
+        mod = tr.fit(num_epoch=3, inject_fault=(14, (1, 3)),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     initializer=mx.initializer.Xavier())
+        done = [e for e in tr.transcript if e["event"] == "finished"]
+        assert done and done[0]["resume_source"] == "peer"
+        assert done[0]["resume_step"] == 12
+        assert mod._optimizer.num_update == 24
+        assert [e["decision"]["action"] for e in store.transcript] \
+            == ["peer_restore"]
+        assert replay(AutopilotConfig(), store.transcript) == []
+    finally:
+        from mxnet_tpu import telemetry
+        telemetry.flight_recorder().disarm()
+        telemetry.flight_recorder().pop_last_dump()
