@@ -495,6 +495,18 @@ def main():
             extra.update(_bench_autopilot(mx))
         except Exception as e:
             extra["autopilot_error"] = str(e)[:160]
+
+    if os.environ.get("BENCH_SCENARIOS", "0") != "0":
+        # pinned-workload scenario matrix (mxnet_tpu.scenarios,
+        # docs/api/scenarios.md): per-scenario training throughput
+        # through the same fit path the contract gate runs. Opt-in
+        # (BENCH_SCENARIOS=1) — the matrix trains every registered
+        # long-tail workload and is far too heavy for the CPU
+        # contract smoke.
+        try:
+            extra.update(_bench_scenarios())
+        except Exception as e:
+            extra["scenarios_error"] = str(e)[:160]
     _emit(img_per_sec, extra)
 
 
@@ -1317,6 +1329,53 @@ def _bench_autopilot(mx):
         shutil.rmtree(tmp, ignore_errors=True)
     return out
 
+
+def _bench_scenarios():
+    """Per-scenario training throughput (docs/api/scenarios.md): each
+    registered pinned workload fit twice through the matrix runner's
+    seeded fit — the first pass warms every program (trace + XLA
+    compile land in the executable caches), the second is the timed
+    steady-state run, so the rows measure training rate, not compile.
+
+    Emits ``scenario_<name>_rows_per_sec`` for every scenario and
+    additionally ``scenario_<name>_tokens_per_sec`` where the batch
+    is token-shaped (2-D integer data: the LM workloads). Honors the
+    MXNET_SCENARIOS / MXNET_SCENARIO_FILTER selection knobs."""
+    import numpy as np
+
+    from mxnet_tpu.scenarios import registry
+    from mxnet_tpu.scenarios.runner import _run_fit, _seed_all
+
+    out = {}
+    for sc in (registry.get(n) for n in registry.selected_names()):
+        kw = dict(sc.fit_kwargs() if callable(sc.fit_kwargs)
+                  else sc.fit_kwargs)
+        epochs = int(kw.get("num_epoch", 1))
+        # count one epoch's rows on a throwaway data instance (the
+        # iterators are stateful; the timed fit gets its own)
+        _seed_all(sc.seed)
+        mod = sc.make_module()
+        data = sc.make_data(mod)
+        rows, tok_len = 0, None
+        for batch in data:
+            d0 = batch.data[0]
+            arr = np.asarray(d0.asnumpy() if hasattr(d0, "asnumpy")
+                             else d0)
+            rows += arr.shape[0]
+            integral = np.issubdtype(arr.dtype, np.integer) \
+                or bool(np.all(arr == np.round(arr)))
+            if arr.ndim == 2 and arr.shape[1] > 1 and integral:
+                tok_len = arr.shape[1]
+        _run_fit(sc)                      # warmup: trace + compile
+        t0 = time.perf_counter()
+        _run_fit(sc)                      # steady state
+        dt = max(time.perf_counter() - t0, 1e-9)
+        rps = rows * epochs / dt
+        out["scenario_%s_rows_per_sec" % sc.name] = round(rps, 1)
+        if tok_len:
+            out["scenario_%s_tokens_per_sec" % sc.name] = round(
+                rps * tok_len, 1)
+    return out
 
 
 def _bench_pipeline_clean(mx, recs, step_batch, steps, img):

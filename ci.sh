@@ -441,6 +441,23 @@ stage "autopilot gate (telemetry-to-action loop closes, warm + bitwise)"
 python -c "from __graft_entry__ import dryrun_autopilot; dryrun_autopilot(8)" \
     || FAILED=1
 
+stage "scenario matrix (pinned example/ long-tail workloads, full contract set)"
+# pinned-workload scenario contract (docs/api/scenarios.md): every
+# registered mxnet_tpu.scenarios scenario — the example/ long tail
+# (transformer-lm decode serving, bucketing LSTM, NCE embeddings, toy
+# SSD) plus the u8-cache CNN and pod-sharded-cache MLP — runs through
+# the REAL Module.fit / serving stack and must hold its full contract
+# set: (a) bitwise repeat-run params digest, (b) zero post-warmup
+# retraces across the whole scenario, (c) accuracy floor met,
+# (d) declared telemetry gauges present, (e) kill/resume landing
+# bitwise on the straight run, (f) serving parity (Predictor rows /
+# DecodeEngine streams) where declared, and (g) the seeded chaos
+# sweep firing every planned fault, healing every incident, and
+# keeping the trained params bitwise-equal to the fault-free run.
+# Emits SCENARIO_r01.json.
+python -c "from __graft_entry__ import dryrun_scenarios; dryrun_scenarios(8)" \
+    || FAILED=1
+
 stage "chaos smoke (train_cifar10 --fault-plan: healed faults keep the digest)"
 # the smoke-sized spelling tests/test_examples.py shares: transient
 # staging faults healed by the shared bounded-backoff retry must leave

@@ -79,7 +79,14 @@ def build_ssd(num_classes=1):
                                     use_ignore=True, ignore_label=-1.0,
                                     normalization="valid", name="cls_prob")
     loc_diff = mx.sym.smooth_l1(loc_mask * (loc_preds - loc_t), scalar=1.0)
-    loc_loss = mx.sym.MakeLoss(mx.sym.mean(loc_diff), name="loc_loss")
+    # normalize by the number of POSITIVE anchor coords, not the full
+    # anchor grid: a plain mean dilutes the regression gradient by the
+    # (overwhelmingly masked-out) negative anchors, and localization
+    # never converges as the anchor count grows
+    num_pos = mx.sym.maximum(mx.sym.sum(loc_mask), 1.0)
+    loc_loss = mx.sym.MakeLoss(
+        mx.sym.broadcast_div(mx.sym.sum(loc_diff), num_pos),
+        name="loc_loss")
     return mx.sym.Group([cls_prob, loc_loss]), anchors, cls_preds, loc_preds
 
 

@@ -136,14 +136,29 @@ def _health_update(jnp, cfg, health, inputs, outs, grads, new_params,
     if stat is not None:
         # the guardian's loss-like scalar: the spike metric's fused
         # statistic over this batch (sum/count of its first slot —
-        # for the default cross-entropy stat, the batch's mean loss)
-        rows = stat(jnp, [inputs[n] for n in label_names], outs)
-        if isinstance(rows, tuple):
-            rows = [rows]
-        s, c = rows[0]
-        scalar = jnp.asarray(s, jnp.float32) / jnp.maximum(
-            jnp.asarray(c, jnp.float32), 1.0)
-    else:
+        # for the default cross-entropy stat, the batch's mean loss).
+        # A stat that cannot trace over this model's label/output
+        # shapes (e.g. the default "ce" stat against a non-softmax
+        # head) must NOT take the train step down: degrade to the
+        # coarse output-mean scalar the no-stat path uses and record
+        # the downgrade so the guardian's judge knows its ring is
+        # coarse (this runs at trace time, so the fallback costs
+        # nothing per step).
+        try:
+            rows = stat(jnp, [inputs[n] for n in label_names], outs)
+            if isinstance(rows, tuple):
+                rows = [rows]
+            s, c = rows[0]
+            scalar = jnp.asarray(s, jnp.float32) / jnp.maximum(
+                jnp.asarray(c, jnp.float32), 1.0)
+        except Exception as exc:  # noqa: BLE001 - any trace failure
+            cfg["stat_degraded"] = "%s: %s" % (type(exc).__name__, exc)
+            logging.getLogger("mxnet_tpu.guardian").warning(
+                "guardian spike metric cannot trace over this model's "
+                "label/output shapes (%s); falling back to the coarse "
+                "output-mean loss scalar", cfg["stat_degraded"])
+            stat = None
+    if stat is None:
         # no labels / no fusable spike metric: finiteness sentinels
         # still work; the ring carries a coarse output mean (the spike
         # judge is only as meaningful as this scalar — documented)
@@ -428,14 +443,38 @@ class MeshExecutorGroup(object):
             shared_group._shared_out = True  # parent must not rebind away
             assert shared_group.mesh_axes == self.mesh_axes, \
                 "shared_module must be bound on the same mesh_axes"
+            # non-learned state args (__lr_mult__ 0, e.g. an RNN cell's
+            # zero begin_state) are shaped by the BATCH, so a shared
+            # bind at a different batch size (a Predictor bucket, a
+            # reshaped shared module) legitimately disagrees with the
+            # parent's buffer — such args get their own zero buffers;
+            # a shape mismatch on a LEARNED param is still a hard error
+            attrs = symbol.attr_dict()
+            fresh = set()
             for n in param_names:
                 src = shared_group._param_dict[n]
-                assert tuple(src.shape) == tuple(shape_of[n]), n
-            self.param_arrays = [[shared_group._param_dict[n]]
+                if tuple(src.shape) != tuple(shape_of[n]):
+                    lr = (attrs.get(n) or {}).get("__lr_mult__")
+                    if lr is not None and float(lr) == 0.0:
+                        fresh.add(n)
+                    else:
+                        raise MXNetError(
+                            "shared_module bind: learned param %r has "
+                            "shape %r in the parent but %r here — a "
+                            "shared module must agree on every learned "
+                            "param shape" % (n, tuple(src.shape),
+                                             tuple(shape_of[n])))
+            self.param_arrays = [[zeros_with(shape_of[n], p_sh[n])]
+                                 if n in fresh else
+                                 [shared_group._param_dict[n]]
                                  for n in param_names]
-            self._param_dict = shared_group._param_dict
+            self._param_dict = dict(shared_group._param_dict)
+            for n, b in zip(param_names, self.param_arrays):
+                if n in fresh:
+                    self._param_dict[n] = b[0]
             self.grad_arrays = [[shared_group._grad_dict[n]]
                                 if n in self._grad_names
+                                and n not in fresh
                                 and n in shared_group._grad_dict else
                                 ([zeros_with(shape_of[n], p_sh[n])]
                                  if n in self._grad_names else None)

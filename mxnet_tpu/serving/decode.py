@@ -288,6 +288,159 @@ class LSTMCharLM(DecodeModel):
                 logits)
 
 
+class TransformerLM(DecodeModel):
+    """The `example/transformer-lm` causal decoder as a functional
+    decode model (the scenario matrix's transformer serving customer).
+
+    Recurrent state is the sliding token window of the training
+    length: each step writes the incoming token at its row's position
+    (shifting left once the window fills) and re-runs the full causal
+    forward over the window — the identical math the training symbol
+    graph computes (``FullyConnected`` = ``x @ W.T + b``, softmax over
+    ``scores + causal_mask``), so :meth:`from_params` adopts
+    fit-trained parameters (``embed_weight``, ``pos_embed``,
+    ``blk<i>_{att_{q,k,v,o},mlp_{fc1,fc2}}_{weight,bias}``,
+    ``head_{weight,bias}``) verbatim.  The ``causal_mask`` constant is
+    synthesized internally (``triu(-1e9)``, the LMInit rule), never
+    read from the checkpoint — a mask must not ride the weight-quant
+    path.  Positions beyond a row's real length hold zeros; the causal
+    mask keeps them out of every attended position, so the garbage is
+    unreachable.
+    """
+
+    def __init__(self, vocab_size, num_embed, num_heads, window,
+                 num_blocks):
+        self.vocab_size = int(vocab_size)
+        self.num_embed = int(num_embed)
+        self.num_heads = int(num_heads)
+        self.window = int(window)
+        self.num_blocks = int(num_blocks)
+        if self.num_embed % self.num_heads:
+            raise MXNetError(
+                "TransformerLM: num_embed %d not divisible by "
+                "num_heads %d" % (self.num_embed, self.num_heads))
+        self._mask = onp.triu(
+            onp.full((self.window, self.window), -1e9, onp.float32),
+            k=1)
+
+    def signature(self):
+        return ("transformer_lm:vocab=%d;embed=%d;heads=%d;window=%d;"
+                "blocks=%d" % (self.vocab_size, self.num_embed,
+                               self.num_heads, self.window,
+                               self.num_blocks))
+
+    def state_struct(self):
+        return {"ctx": ((self.window,), "int32"),
+                "len": ((), "int32")}
+
+    def param_shapes(self):
+        V, D, T = self.vocab_size, self.num_embed, self.window
+        shapes = {"embed_weight": (V, D), "pos_embed": (1, T, D),
+                  "head_weight": (V, D), "head_bias": (V,)}
+        for i in range(self.num_blocks):
+            for p in ("att_q", "att_k", "att_v", "att_o"):
+                shapes["blk%d_%s_weight" % (i, p)] = (D, D)
+                shapes["blk%d_%s_bias" % (i, p)] = (D,)
+            shapes["blk%d_mlp_fc1_weight" % i] = (4 * D, D)
+            shapes["blk%d_mlp_fc1_bias" % i] = (4 * D,)
+            shapes["blk%d_mlp_fc2_weight" % i] = (D, 4 * D)
+            shapes["blk%d_mlp_fc2_bias" % i] = (D,)
+        return shapes
+
+    def init_params(self, seed=0, scale=0.1):
+        """Deterministic random parameters (tests that need no
+        training)."""
+        rng = onp.random.RandomState(int(seed))
+        return {k: (rng.rand(*s) * 2 - 1).astype(onp.float32) * scale
+                for k, s in sorted(self.param_shapes().items())}
+
+    @classmethod
+    def from_params(cls, params, num_heads):
+        """Adopt a fit-trained parameter dict (numpy or NDArray
+        values) from the transformer-lm symbol graph; everything but
+        the head count is inferred from the shapes."""
+        arrs = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
+                    onp.asarray(v))
+                for k, v in params.items()}
+        V, D = arrs["embed_weight"].shape
+        T = arrs["pos_embed"].shape[1]
+        blocks = len([k for k in arrs
+                      if k.startswith("blk") and
+                      k.endswith("_att_q_weight")])
+        model = cls(V, num_embed=D, num_heads=num_heads, window=T,
+                    num_blocks=blocks)
+        want = model.param_shapes()
+        got = {k: tuple(v.shape) for k, v in arrs.items() if k in want}
+        bad = [k for k in want if got.get(k) != want[k]]
+        if bad:
+            raise MXNetError(
+                "TransformerLM.from_params: missing/mismatched params "
+                "%s (want %s)" % (bad, {k: want[k] for k in bad}))
+        model._adopted = {k: arrs[k] for k in want}
+        return model
+
+    def _block(self, jnp, params, x, i):
+        """One decoder block over the window: causal multi-head
+        attention + MLP, both residual — mirrors the training graph's
+        ``attention()``/``mlp()`` builders shape for shape."""
+        B, T, D = x.shape
+        H = self.num_heads
+        DH = D // H
+
+        def proj(name, inp):
+            return inp @ params["blk%d_%s_weight" % (i, name)].T \
+                + params["blk%d_%s_bias" % (i, name)]
+
+        def heads(p):
+            # (B, T, D) -> (B, H, T, DH)
+            return jnp.transpose(p.reshape(B, T, H, DH), (0, 2, 1, 3))
+
+        q, k, v = (heads(proj(n, x))
+                   for n in ("att_q", "att_k", "att_v"))
+        scores = (q @ jnp.swapaxes(k, -1, -2)) \
+            * onp.float32(DH ** -0.5)
+        scores = scores + jnp.asarray(self._mask)[None, None]
+        att = jax_softmax(jnp, scores)
+        ctx = att @ v                               # (B, H, T, DH)
+        ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(B, T, D)
+        x = x + proj("att_o", ctx)
+        h = x @ params["blk%d_mlp_fc1_weight" % i].T \
+            + params["blk%d_mlp_fc1_bias" % i]
+        h = jnp.maximum(h, 0.0)
+        return x + (h @ params["blk%d_mlp_fc2_weight" % i].T
+                    + params["blk%d_mlp_fc2_bias" % i])
+
+    def step(self, params, tokens, state):
+        import jax.numpy as jnp
+        T = self.window
+        ctx, ln = state["ctx"], state["len"]        # (B, T), (B,)
+        B = ctx.shape[0]
+        full = ln >= T
+        # window full: slide left one and write at T-1; else append
+        ctx = jnp.where(full[:, None], jnp.roll(ctx, -1, axis=1), ctx)
+        pos = jnp.where(full, T - 1, ln).astype(jnp.int32)
+        ctx = ctx.at[jnp.arange(B), pos].set(
+            tokens.astype(jnp.int32))
+        x = jnp.take(params["embed_weight"], ctx, axis=0) \
+            + params["pos_embed"][0]
+        for i in range(self.num_blocks):
+            x = self._block(jnp, params, x, i)
+        h = x[jnp.arange(B), pos]                   # (B, D)
+        logits = h @ params["head_weight"].T + params["head_bias"]
+        return ({"ctx": ctx,
+                 "len": jnp.minimum(ln + 1, T).astype(jnp.int32)},
+                logits)
+
+
+def jax_softmax(jnp, scores):
+    """Max-subtracted softmax over the last axis — the same lowering
+    ``mx.sym.softmax`` compiles to, kept as one shared helper so the
+    decode model and any future functional graph agree bit for bit."""
+    z = scores - scores.max(axis=-1, keepdims=True)
+    e = jnp.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
 # ---------------------------------------------------------------------------
 # request future
 # ---------------------------------------------------------------------------
