@@ -507,6 +507,21 @@ def main():
             extra.update(_bench_scenarios())
         except Exception as e:
             extra["scenarios_error"] = str(e)[:160]
+
+    if os.environ.get("BENCH_GATEWAY", "0") != "0":
+        # network serving plane (mxnet_tpu.gateway,
+        # docs/api/gateway.md): the same predict rows and decode
+        # streams measured above, but through the HTTP front door —
+        # gateway_overhead_pct is the per-request tax of the wire
+        # (JSON + socket + routing) over the in-process Predictor,
+        # and gateway_ttft_ms percentiles are CLIENT-observed first
+        # token latencies (what a caller actually waits, not the
+        # engine's internal ring). Opt-in (BENCH_GATEWAY=1) — the
+        # loopback HTTP load is meaningless in the contract smoke.
+        try:
+            extra.update(_bench_gateway(mx))
+        except Exception as e:
+            extra["gateway_error"] = str(e)[:160]
     _emit(img_per_sec, extra)
 
 
@@ -1375,6 +1390,109 @@ def _bench_scenarios():
         if tok_len:
             out["scenario_%s_tokens_per_sec" % sc.name] = round(
                 rps * tok_len, 1)
+    return out
+
+
+def _bench_gateway(mx):
+    """Network serving plane load (docs/api/gateway.md): the warmed
+    Predictor and DecodeEngine from the serving benches, fronted by a
+    loopback GatewayServer and driven through GatewayClient.
+
+    gateway_overhead_pct is the per-request HTTP tax over the
+    in-process Predictor on identical rows (JSON encode/decode +
+    socket + routing + admission — the price of the wire, not the
+    model). gateway_ttft_ms percentiles are CLIENT-observed: wall
+    from generate() call to the first streamed token crossing the
+    socket, which is the number an SLO on the front door actually
+    binds (the engine-internal TTFT ring can't see the flush path)."""
+    import numpy as np
+
+    from mxnet_tpu.gateway import GatewayClient, GatewayServer
+    from mxnet_tpu.serving import Predictor
+    from mxnet_tpu.serving.decode import DecodeEngine, LSTMCharLM
+
+    n_pred = int(os.environ.get("BENCH_GATEWAY_PREDICTS", "32"))
+    n_gen = int(os.environ.get("BENCH_GATEWAY_GENERATES", "8"))
+    max_new = int(os.environ.get("BENCH_GATEWAY_MAX_NEW", "32"))
+    rows_per = 8
+
+    def _mlp():
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu", name="relu1")
+        net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    rng = np.random.RandomState(11)
+    X = rng.rand(64, 16).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.float32)
+    mx.random.seed(11)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=8), num_epoch=1,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    pred = Predictor(mod, max_batch_size=rows_per)
+    pred.warmup()
+
+    model = LSTMCharLM(vocab_size=64, num_hidden=64, num_embed=32)
+    params = model.init_params(seed=11)
+    prompts = [list(map(int, rng.randint(0, 64, size=int(
+        rng.randint(2, 17))))) for _ in range(n_gen)]
+    eng = DecodeEngine(model, params, slots=4, max_prefill_len=16,
+                       start=False)
+    eng.warmup()
+    eng.start()
+
+    out = {}
+    try:
+        with GatewayServer(predict_backend=pred,
+                           decode_backend=eng) as gw:
+            cli = GatewayClient("127.0.0.1", gw.port, timeout=120)
+            batch = X[:rows_per]
+            cli.predict(batch)                  # warm the socket path
+            pred.predict(batch)
+
+            t0 = time.perf_counter()
+            for _ in range(n_pred):
+                pred.predict(batch)
+            inproc_s = (time.perf_counter() - t0) / n_pred
+
+            t0 = time.perf_counter()
+            for _ in range(n_pred):
+                cli.predict(batch)
+            http_s = (time.perf_counter() - t0) / n_pred
+            out["gateway_predict_rows_per_sec"] = round(
+                rows_per / http_s, 1)
+            out["gateway_overhead_pct"] = round(
+                (http_s - inproc_s) / inproc_s * 100.0, 1) \
+                if inproc_s > 0 else None
+
+            ttfts, tokens, t0 = [], 0, time.perf_counter()
+            for i, p in enumerate(prompts):
+                ts = time.perf_counter()
+                first = True
+                for _tok in cli.generate(p, max_new_tokens=max_new,
+                                         seed=i):
+                    if first:
+                        ttfts.append(
+                            (time.perf_counter() - ts) * 1000.0)
+                        first = False
+                    tokens += 1
+            wall = max(time.perf_counter() - t0, 1e-9)
+            out["gateway_decode_tokens_per_sec"] = round(
+                tokens / wall, 1)
+            ttfts.sort()
+            out["gateway_ttft_ms_p50"] = round(
+                ttfts[len(ttfts) // 2], 3) if ttfts else None
+            out["gateway_ttft_ms_p99"] = round(
+                ttfts[min(len(ttfts) - 1,
+                          int(len(ttfts) * 0.99))], 3) \
+                if ttfts else None
+            out["gateway_predicts"] = n_pred
+            out["gateway_generates"] = n_gen
+    finally:
+        eng.shutdown(drain=True)
+        eng.release()
+        pred.release()
     return out
 
 

@@ -458,6 +458,26 @@ stage "scenario matrix (pinned example/ long-tail workloads, full contract set)"
 python -c "from __graft_entry__ import dryrun_scenarios; dryrun_scenarios(8)" \
     || FAILED=1
 
+stage "network serving plane (gateway: HTTP parity, drain, chaos re-route)"
+# the mxnet_tpu.gateway contract (docs/api/gateway.md): the serving
+# stack's guarantees must survive the wire — (a) /v1/predict rows
+# through GatewayClient are bitwise-equal to the in-process Predictor
+# (float32 survives the JSON round trip exactly); (b) the raw chunked
+# /v1/generate body is byte-identical to the same-seed in-process
+# DecodeEngine stream; (c) a replica warmed from the persistent
+# executable cache serves HTTP traffic with zero XLA compiles;
+# (d) an armed gateway.accept flood answers 429 + Retry-After for
+# exactly its budget, then the same request recovers bitwise;
+# (e) /readyz flips 503 the moment drain starts yet the in-flight
+# stream runs to completion; (f) the chaos seam sweep heals — accept
+# flood by client retry, transient stream fault and a replica KILLED
+# mid-stream by deterministic affinity re-route with the replayed
+# prefix skipped, every healed stream exactly equal to the fault-free
+# reference; (g) zero post-warmup retraces across all of the above.
+# Emits GATEWAY_r01.json.
+python -c "from __graft_entry__ import dryrun_gateway; dryrun_gateway(1)" \
+    || FAILED=1
+
 stage "chaos smoke (train_cifar10 --fault-plan: healed faults keep the digest)"
 # the smoke-sized spelling tests/test_examples.py shares: transient
 # staging faults healed by the shared bounded-backoff retry must leave

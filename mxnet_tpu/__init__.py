@@ -91,6 +91,7 @@ from . import plugin
 from . import parallel
 from . import dist
 from . import autopilot
+from . import gateway
 
 from .attribute import AttrScope
 from .name import NameManager

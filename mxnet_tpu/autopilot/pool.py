@@ -10,6 +10,15 @@ warm-start contract is what makes autoscaling safe to automate.
 Scale-in releases the newest replica (drain first for engines that
 queue).
 
+Every dispatch runs under a :meth:`lease`: the replica is picked and
+its in-flight count bumped atomically, and scale-in *waits for the
+count to reach zero* before releasing the replica — a request can
+never land on (or still be running inside) a closed replica, no
+matter how ``scale_to`` oscillates underneath the traffic. Leases
+also expose per-replica outstanding counts and stable serial numbers,
+which is what the gateway router keys least-outstanding routing and
+decode session affinity on.
+
 The spin-up path carries the ``autopilot.scale`` fault seam
 (kind=error): a chaos plan can make a spin-up fail exactly when the
 controller needs it, and the pool must stay at its previous size with
@@ -17,6 +26,7 @@ the failure counted (``autopilot.scale_errors``) — never half-built.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -45,10 +55,16 @@ class ReplicaPool(object):
         skips warmup for factories that warm internally.
     start : bool
         Spin up to ``min_replicas`` at construction (default).
+    drain_timeout_s : float
+        Longest a scale-in will wait for a retiring replica's leased
+        requests to finish before releasing it anyway (with a
+        warning). Leases normally last one request, so the bound only
+        bites on a wedged replica.
     """
 
     def __init__(self, factory, min_replicas=1, max_replicas=2,
-                 cache_dir=None, warm=True, start=True, logger=None):
+                 cache_dir=None, warm=True, start=True, logger=None,
+                 drain_timeout_s=30.0):
         if min_replicas < 0 or max_replicas < min_replicas:
             raise ValueError(
                 "need 0 <= min_replicas <= max_replicas (got %d..%d)"
@@ -58,9 +74,14 @@ class ReplicaPool(object):
         self.max_replicas = int(max_replicas)
         self._cache_dir = cache_dir
         self._warm = bool(warm)
+        self._drain_timeout_s = float(drain_timeout_s)
         self._replicas = []
         self._rr = 0
+        self._inflight = {}    # id(rep) -> outstanding leased requests
+        self._serials = {}     # id(rep) -> stable spin-up serial
+        self._next_serial = 0
         self._lock = threading.RLock()
+        self._drain_cond = threading.Condition(self._lock)
         self._logger = logger or logging.getLogger(
             "mxnet_tpu.autopilot")
         from .. import telemetry
@@ -94,6 +115,7 @@ class ReplicaPool(object):
         and re-raises — the controller's tick records the miss and the
         cooldown paces the retry. Returns the resulting size."""
         n = max(self.min_replicas, min(self.max_replicas, int(n)))
+        victims = []
         with self._lock:
             while len(self._replicas) < n:
                 try:
@@ -102,9 +124,15 @@ class ReplicaPool(object):
                     self._c_err.add()
                     raise
             while len(self._replicas) > n:
-                self._spin_down()
+                # pop under the lock so no NEW lease can pick the
+                # victim; drain + release happen outside so in-flight
+                # requests (and other leases) keep making progress
+                victims.append(self._replicas.pop())
             self._g_replicas.set(len(self._replicas))
-            return len(self._replicas)
+            size = len(self._replicas)
+        for rep in victims:
+            self._retire(rep)
+        return size
 
     def _spin_up(self):
         from .. import telemetry
@@ -127,6 +155,8 @@ class ReplicaPool(object):
                 report = rep.warmup_report()
         ms = (time.perf_counter() - t0) * 1000.0
         self._replicas.append(rep)
+        self._serials[id(rep)] = self._next_serial
+        self._next_serial += 1
         self._c_out.add()
         sources = sorted({r.get("source") for r in (report or {}).values()})
         self.spinup_reports.append(
@@ -139,9 +169,27 @@ class ReplicaPool(object):
             "autopilot: replica %d up in %.1f ms (warm sources: %s)",
             len(self._replicas), ms, sources or "n/a")
 
-    def _spin_down(self):
+    def _retire(self, rep):
+        """Drain a popped replica's leased requests, then release it.
+
+        Called with the replica already removed from ``_replicas`` (so
+        no new lease can reach it) and WITHOUT the pool lock held —
+        waiting happens on ``_drain_cond`` so lease holders finishing
+        their requests wake us."""
         from .. import telemetry
-        rep = self._replicas.pop()
+        deadline = time.monotonic() + self._drain_timeout_s
+        with self._lock:
+            while self._inflight.get(id(rep), 0) > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self._logger.warning(
+                        "autopilot: replica drain timed out with %d "
+                        "request(s) still leased; releasing anyway",
+                        self._inflight.get(id(rep), 0))
+                    break
+                self._drain_cond.wait(min(left, 0.5))
+            self._inflight.pop(id(rep), None)
+            self._serials.pop(id(rep), None)
         self._release(rep)
         self._c_in.add()
         telemetry.flight_recorder().note(
@@ -160,24 +208,76 @@ class ReplicaPool(object):
             rep.release()
 
     # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def lease(self, pick=None):
+        """Check a replica out for one request.
+
+        Picks a live replica (round-robin by default), bumps its
+        in-flight count, yields it, and decrements on the way out —
+        waking any scale-in waiting to drain it. ``pick`` overrides
+        the choice: it receives a snapshot ``[(replica, outstanding,
+        serial), ...]`` (oldest replica first) and returns the chosen
+        replica — the hook the gateway router uses for
+        least-outstanding predict routing and serial-keyed decode
+        affinity."""
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError("replica pool is empty")
+            if pick is not None:
+                snap = [(r, self._inflight.get(id(r), 0),
+                         self._serials.get(id(r), -1))
+                        for r in self._replicas]
+                rep = pick(snap)
+                if rep is None or id(rep) not in self._serials:
+                    raise RuntimeError(
+                        "lease pick returned a non-live replica")
+            else:
+                rep = self._replicas[self._rr % len(self._replicas)]
+                self._rr += 1
+            key = id(rep)
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        try:
+            yield rep
+        finally:
+            with self._lock:
+                n = self._inflight.get(key, 1) - 1
+                if n > 0:
+                    self._inflight[key] = n
+                else:
+                    self._inflight.pop(key, None)
+                self._drain_cond.notify_all()
+
+    def outstanding(self, rep=None):
+        """Leased-request count for one replica (or the pool total)."""
+        with self._lock:
+            if rep is not None:
+                return self._inflight.get(id(rep), 0)
+            return sum(self._inflight.values())
+
+    def serial(self, rep):
+        """The replica's stable spin-up serial (-1 if not live)."""
+        with self._lock:
+            return self._serials.get(id(rep), -1)
+
     def predict(self, data, **kwargs):
         """Round-robin one request over the live replicas (the pool's
         minimal load-balancer; production traffic normally fronts each
         replica with its own :class:`~mxnet_tpu.serving
-        .DynamicBatcher`)."""
-        with self._lock:
-            if not self._replicas:
-                raise RuntimeError("replica pool is empty")
-            rep = self._replicas[self._rr % len(self._replicas)]
-            self._rr += 1
-        return rep.predict(data, **kwargs)
+        .DynamicBatcher`). Runs under a :meth:`lease`, so a concurrent
+        scale-in waits for this request instead of closing the replica
+        underneath it."""
+        with self.lease() as rep:
+            return rep.predict(data, **kwargs)
 
     def close(self):
         """Release every replica (idempotent)."""
+        victims = []
         with self._lock:
             while self._replicas:
-                self._spin_down()
+                victims.append(self._replicas.pop())
             self._g_replicas.set(0)
+        for rep in victims:
+            self._retire(rep)
 
     def __enter__(self):
         return self

@@ -30,6 +30,9 @@ site                   entry point  where it lives
 ``guardian.sdc``       value        SDC probe's second launch
 ``autopilot.poll``     check        Autopilot controller tick
 ``autopilot.scale``    check        ReplicaPool spin-up path
+``gateway.accept``     fires        GatewayServer edge admission
+``gateway.route``      check        Router replica selection
+``gateway.stream``     check        GatewayServer token-stream flush
 =====================  ===========  =================================
 
 The discipline is ``telemetry.enabled()``'s: an UNARMED process pays

@@ -149,17 +149,34 @@ def _tf_serving(mod):
             prompt = [int(v) for v in Xp[i]]
             nxt = eng.generate(prompt, max_new_tokens=1, timeout=120)[0]
             agree += int(int(onp.argmax(probs[i, -1])) == nxt)
+        # gateway leg (serving_gateway): the same engine behind the
+        # HTTP front door streams token-for-token what the in-process
+        # call emits — serving parity survives the network plane
+        from mxnet_tpu.gateway import GatewayClient, GatewayServer
+        gw_agree, gw_n = 0, 2
+        with GatewayServer(decode_backend=eng) as gw:
+            cli = GatewayClient("127.0.0.1", gw.port)
+            for i in range(gw_n):
+                prompt = [int(v) for v in Xp[i]]
+                ref = eng.generate(prompt, max_new_tokens=4, seed=i,
+                                   timeout=120)
+                got = list(cli.generate(prompt, max_new_tokens=4,
+                                        seed=i))
+                gw_agree += int(got == ref)
     finally:
         eng.shutdown(drain=True)
     # int8 weight noise can flip near-tie argmaxes; the LM must still
     # clearly track the module forward (decode_lm's int8 floor)
-    ok = agree >= int(0.8 * B) and nb_i8 < nb_f32
+    ok = (agree >= int(0.8 * B) and nb_i8 < nb_f32
+          and gw_agree == gw_n)
     return {"ok": ok,
             "parity": "%d/%d" % (agree, B),
+            "gateway_stream_parity": "%d/%d" % (gw_agree, gw_n),
             "step_argument_bytes": {"int8": int(nb_i8),
                                     "f32": int(nb_f32)},
-            "detail": "argmax parity %d/%d, int8 step args %dB < "
-                      "f32 %dB" % (agree, B, nb_i8, nb_f32)}
+            "detail": "argmax parity %d/%d, gateway streams %d/%d, "
+                      "int8 step args %dB < f32 %dB"
+                      % (agree, B, gw_agree, gw_n, nb_i8, nb_f32)}
 
 
 # ---------------------------------------------------------------------------
@@ -630,7 +647,8 @@ def register_all():
     register(Scenario(
         name="transformer_lm",
         features=("fit", "batch_group", "precision", "serving_decode",
-                  "checkpoint_resume", "telemetry", "chaos"),
+                  "serving_gateway", "checkpoint_resume", "telemetry",
+                  "chaos"),
         make_module=_tf_module,
         make_data=_tf_train_iter,
         fit_kwargs=lambda: dict(

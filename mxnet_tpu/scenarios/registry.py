@@ -44,6 +44,8 @@ FEATURES = {
                          "mxnet_tpu.serving.Predictor",
     "serving_decode": "served-inference parity through "
                       "mxnet_tpu.serving.decode.DecodeEngine",
+    "serving_gateway": "served-inference parity through the network "
+                       "plane (mxnet_tpu.gateway HTTP front door)",
     "chaos": "declares healable fault rules; the chaos sweep re-runs "
              "the fit under the armed seeded FaultPlan and demands "
              "bitwise equality with the fault-free run",
@@ -132,7 +134,8 @@ class Scenario(object):
         if floor_mode not in ("min", "max"):
             raise ValueError("floor_mode must be 'min' or 'max', got %r"
                              % (floor_mode,))
-        serving_tags = feats & {"serving_predictor", "serving_decode"}
+        serving_tags = feats & {"serving_predictor", "serving_decode",
+                                "serving_gateway"}
         if serving_tags and serving is None:
             raise ValueError(
                 "scenario %r declares %s but no serving probe"
@@ -174,7 +177,8 @@ class Scenario(object):
             out.append(GaugePresent(self.gauges))
         if "checkpoint_resume" in self.features:
             out.append(ResumeParity())
-        if self.features & {"serving_predictor", "serving_decode"}:
+        if self.features & {"serving_predictor", "serving_decode",
+                            "serving_gateway"}:
             out.append(ServingParity())
         return out
 

@@ -89,8 +89,8 @@ from .. import faults as _faults
 from .. import telemetry
 from ..base import MXNetError
 from ..precision import resolve as _resolve_precision
-from .errors import (QueueFull, RequestAbandoned, ServerClosed,
-                     TenantShed, WorkerCrashed)
+from .errors import (QueueFull, RequestAbandoned, RequestTimeout,
+                     ServerClosed, TenantShed, WorkerCrashed)
 from .stats import DECODE_TRACE_PHASES, ServingStats
 
 __all__ = ["DecodeModel", "LSTMCharLM", "DecodeRequest", "DecodeEngine"]
@@ -450,20 +450,25 @@ class DecodeRequest(object):
     exception) — engine shutdown and abandonment both resolve it, a
     future never hangs."""
 
-    def __init__(self, req_id, prompt, max_new_tokens, seed):
+    def __init__(self, req_id, prompt, max_new_tokens, seed,
+                 timeout_ms=None):
         self.id = req_id
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.seed = int(seed) & 0xFFFFFFFF
+        self.timeout_ms = (None if timeout_ms is None
+                           else float(timeout_ms))
         self._lock = threading.Lock()
         self._emitted = []
         self._done = threading.Event()
         self._exc = None
         self._cancel = False
-        self.outcome = None         # "ok" | "abandoned" | "error"
+        self.outcome = None   # "ok" | "abandoned" | "error" | "timeout"
         self.slot = None
         self.bucket = None          # top prefill length bucket used
         self.t_submit = time.time()
+        self.deadline = (None if self.timeout_ms is None
+                         else self.t_submit + self.timeout_ms / 1000.0)
         self.t_admit = None
         self.t_first = None         # first token emitted (TTFT point)
         self.t_done = None
@@ -1039,13 +1044,22 @@ class DecodeEngine(object):
                                     onp.asarray(logits)[0]))
 
     # -- submission -------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=32, seed=0):
+    def submit(self, prompt, max_new_tokens=32, seed=0,
+               timeout_ms=None):
         """Queue one sequence; returns its :class:`DecodeRequest`
         future. ``max_new_tokens`` is clamped to
         ``MXNET_SERVE_DECODE_MAX_STEPS``. Raises :class:`ServerClosed`
         after shutdown, :class:`QueueFull` at capacity, and
         :class:`TenantShed` when ``shed_on_breach`` and the TTFT
-        objective is in breach."""
+        objective is in breach.
+
+        ``timeout_ms`` is a per-request admission deadline (the
+        ``DynamicBatcher.submit(timeout_ms=)`` contract, applied to
+        the TTFT phase): a request still queued past its deadline
+        fails its future with :class:`RequestTimeout` instead of
+        prefilling, and the miss lands in the TTFT SLO tracker as a
+        timeout — how the gateway propagates a client's
+        ``X-Deadline-Ms`` into the decode plane."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise MXNetError("decode prompt must be non-empty")
@@ -1072,7 +1086,8 @@ class DecodeEngine(object):
                                 % self._max_queue)
             req = DecodeRequest(
                 self._stats.new_request_id(), prompt,
-                min(int(max_new_tokens), self._max_steps), seed)
+                min(int(max_new_tokens), self._max_steps), seed,
+                timeout_ms=timeout_ms)
             self._queue.append(req)
             self._stats.note_request()
             self._cond.notify_all()
@@ -1176,6 +1191,23 @@ class DecodeEngine(object):
                     "decode request %s cancelled while queued"
                     % req.id))
                 self._c_abandoned.add()
+                continue
+            if req.deadline is not None and time.time() > req.deadline:
+                age_ms = (time.time() - req.t_submit) * 1000.0
+                req._resolve("timeout", RequestTimeout(
+                    "decode request %s expired after %.0f ms in queue "
+                    "(deadline %.0f ms)"
+                    % (req.id, age_ms, req.timeout_ms)))
+                self._stats.note_timeout(age_ms)
+                if self.slo_ttft is not None:
+                    self.slo_ttft.record(age_ms, "timeout")
+                if telemetry.enabled():
+                    self._stats.note_trace(
+                        req.id, rows=1, bucket=0,
+                        phases={"queue_wait_ms": age_ms,
+                                "prefill_ms": 0.0, "decode_ms": 0.0,
+                                "resolve_ms": 0.0},
+                        outcome="timeout", ts_end=time.time())
                 continue
             try:
                 self._admit(free[0], req)
